@@ -1,0 +1,36 @@
+"""spark_timeseries_tpu — a TPU-native time-series framework.
+
+A ground-up JAX/XLA rebuild of the capability surface of
+``mjayantkumar/spark-timeseries`` (``com.cloudera.sparkts``): collections of
+time series sharing a date-time index, missing-data imputation and
+lag/difference/resample transforms, classical models (ARIMA, AR, EWMA,
+GARCH/ARGARCH, Holt-Winters, regression with ARIMA errors), and statistical
+hypothesis tests — executed as vmapped kernels over a mesh-sharded
+``[keys, time]`` device panel instead of per-series JVM loops.
+"""
+
+from . import index
+from .index import (
+    BusinessDayFrequency,
+    DateTimeIndex,
+    DayFrequency,
+    DurationFrequency,
+    Frequency,
+    HourFrequency,
+    HybridDateTimeIndex,
+    IrregularDateTimeIndex,
+    MinuteFrequency,
+    MonthFrequency,
+    SecondFrequency,
+    UniformDateTimeIndex,
+    WeekFrequency,
+    YearFrequency,
+    from_string,
+    hybrid,
+    irregular,
+    uniform,
+    uniform_from_interval,
+)
+from .ops import univariate
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+from . import univariate
+from .lagmat import lag_mat_trim_both, lag_mat_trim_both_2d
+
+__all__ = ["univariate", "lag_mat_trim_both", "lag_mat_trim_both_2d"]

@@ -1,0 +1,45 @@
+"""Lag-matrix construction for regressions (AR / ADF / Breusch-Godfrey).
+
+TPU-native replacement for ``com.cloudera.sparkts.Lag`` (SURVEY.md
+Section 2.1, upstream path unverified).  Static-shape slicing only, so the
+result is jit/vmap friendly and feeds batched ``lstsq`` on the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def lag_mat_trim_both(x: jax.Array, max_lag: int, include_original: bool = False) -> jax.Array:
+    """Trimmed lag matrix: rows are t = max_lag .. n-1.
+
+    Column order: (original x[t] if requested,) x[t-1], x[t-2], ..., x[t-max_lag].
+    Shape ``[n - max_lag, max_lag (+1)]``.
+    """
+    n = x.shape[0]
+    if max_lag >= n:
+        raise ValueError(f"max_lag {max_lag} must be < series length {n}")
+    cols = []
+    if include_original:
+        cols.append(x[max_lag:])
+    for k in range(1, max_lag + 1):
+        cols.append(x[max_lag - k : n - k])
+    return jnp.stack(cols, axis=1)
+
+
+def lag_mat_trim_both_2d(x: jax.Array, max_lag: int, include_original: bool = False) -> jax.Array:
+    """Lag matrix for multi-column input ``[n, c]`` -> ``[n - max_lag, c * lags]``.
+
+    Lag-major column grouping matches the reference: all columns at lag 1,
+    then all columns at lag 2, ...
+    """
+    n = x.shape[0]
+    if max_lag >= n:
+        raise ValueError(f"max_lag {max_lag} must be < series length {n}")
+    blocks = []
+    if include_original:
+        blocks.append(x[max_lag:])
+    for k in range(1, max_lag + 1):
+        blocks.append(x[max_lag - k : n - k])
+    return jnp.concatenate(blocks, axis=1)

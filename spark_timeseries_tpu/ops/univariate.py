@@ -1,0 +1,416 @@
+"""Per-series kernels: pure ``[time] -> [time]`` functions, NaN/mask-aware.
+
+This is the L2 layer — the TPU-native replacement for the reference's
+``com.cloudera.sparkts.UnivariateTimeSeries`` object (SURVEY.md Section 2.1,
+upstream path unverified): autocorr, lag(s), differences (order-d / at-lag),
+quotients, price2ret, the fill family (nearest / previous / next / linear /
+spline / value), NaN trims, and down/upsampling.
+
+Design: every function is written for a single ``f32/f64[time]`` vector with
+NaN marking missing data, is jit-compatible (static shapes, no data-dependent
+Python control flow), and is exposed batched over the series axis via
+``jax.vmap`` — replacing the reference's sequential per-series Breeze loops
+inside Spark executor tasks (SURVEY.md Section 3.2 hot loop #2).  Batched
+variants are exported with a ``batch_`` prefix and operate on ``[keys, time]``
+panels, which is what ``TimeSeriesPanel.map_series`` dispatches to.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "first_not_nan_loc",
+    "last_not_nan_loc",
+    "autocorr",
+    "cross_corr",
+    "lag",
+    "lags",
+    "differences_at_lag",
+    "differences_of_order",
+    "quotients",
+    "price2ret",
+    "fill_value",
+    "fill_with_default",
+    "fill_previous",
+    "fill_next",
+    "fill_nearest",
+    "fill_linear",
+    "fill_spline",
+    "fillts",
+    "trim_leading",
+    "trim_trailing",
+    "downsample",
+    "upsample",
+    "resample",
+    "batched",
+    "batch_autocorr",
+    "batch_fill",
+]
+
+
+def _isvalid(x):
+    return ~jnp.isnan(x)
+
+
+def _nan(dtype):
+    return jnp.asarray(jnp.nan, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Locations of valid data
+# ---------------------------------------------------------------------------
+
+
+def first_not_nan_loc(x: jax.Array) -> jax.Array:
+    """Index of the first non-NaN element, or ``size`` if all NaN."""
+    valid = _isvalid(x)
+    return jnp.where(jnp.any(valid), jnp.argmax(valid), x.shape[0])
+
+
+def last_not_nan_loc(x: jax.Array) -> jax.Array:
+    """Index of the last non-NaN element, or -1 if all NaN."""
+    valid = _isvalid(x)
+    rev = jnp.argmax(valid[::-1])
+    return jnp.where(jnp.any(valid), x.shape[0] - 1 - rev, -1)
+
+
+def trim_leading(x) -> jax.Array:
+    """Drop the leading NaN run.  Host-side (dynamic shape — not jittable)."""
+    import numpy as np
+
+    x = np.asarray(x)
+    loc = int(first_not_nan_loc(jnp.asarray(x)))
+    return x[loc:]
+
+
+def trim_trailing(x) -> jax.Array:
+    """Drop the trailing NaN run.  Host-side (dynamic shape — not jittable)."""
+    import numpy as np
+
+    x = np.asarray(x)
+    loc = int(last_not_nan_loc(jnp.asarray(x)))
+    return x[: loc + 1]
+
+
+# ---------------------------------------------------------------------------
+# Correlation
+# ---------------------------------------------------------------------------
+
+
+def autocorr(x: jax.Array, num_lags: int) -> jax.Array:
+    """Sample autocorrelation at lags ``1..num_lags`` -> ``[num_lags]``.
+
+    r_k = sum_{t=k}^{n-1} (x_t - m)(x_{t-k} - m) / sum_t (x_t - m)^2, computed
+    over valid (non-NaN) entries; denominators use the full valid sample.
+    Replaces ``UnivariateTimeSeries.autocorr`` (reference used Breeze loops).
+    """
+    valid = _isvalid(x)
+    n = jnp.sum(valid)
+    xz = jnp.where(valid, x, 0.0)
+    mean = jnp.sum(xz) / jnp.maximum(n, 1)
+    d = jnp.where(valid, x - mean, 0.0)
+    denom = jnp.sum(d * d)
+
+    def corr_at(k):
+        prod = d[k:] * d[: x.shape[0] - k]
+        return jnp.sum(prod) / denom
+
+    return jnp.stack([corr_at(k) for k in range(1, num_lags + 1)])
+
+
+def cross_corr(x: jax.Array, y: jax.Array, num_lags: int) -> jax.Array:
+    """Cross-correlation of ``x`` with ``y`` at lags ``-num_lags..num_lags``."""
+    xd = x - jnp.nanmean(x)
+    yd = y - jnp.nanmean(y)
+    sx = jnp.sqrt(jnp.nansum(xd * xd))
+    sy = jnp.sqrt(jnp.nansum(yd * yd))
+    xz = jnp.where(_isvalid(xd), xd, 0.0)
+    yz = jnp.where(_isvalid(yd), yd, 0.0)
+    out = []
+    for k in range(-num_lags, num_lags + 1):
+        if k >= 0:
+            prod = jnp.sum(xz[k:] * yz[: x.shape[0] - k]) if k < x.shape[0] else 0.0
+        else:
+            prod = jnp.sum(yz[-k:] * xz[: x.shape[0] + k])
+        out.append(prod / (sx * sy))
+    return jnp.stack(out)
+
+
+# ---------------------------------------------------------------------------
+# Lags and differences
+# ---------------------------------------------------------------------------
+
+
+def lag(x: jax.Array, k: int) -> jax.Array:
+    """Shift right by ``k``; the first ``k`` entries become NaN."""
+    if not 0 <= k < x.shape[0]:
+        raise ValueError(f"lag {k} must be in [0, {x.shape[0]}) for series length {x.shape[0]}")
+    if k == 0:
+        return x
+    return jnp.concatenate([jnp.full((k,), jnp.nan, dtype=x.dtype), x[:-k]])
+
+
+def lags(x: jax.Array, max_lag: int, include_original: bool = True) -> jax.Array:
+    """Lagged copies as columns -> ``[time, max_lag (+1)]``.
+
+    Column order matches the reference's ``TimeSeries.lags`` / ``Lag``:
+    original first (if included), then lag 1, lag 2, ...
+    """
+    cols = ([x] if include_original else []) + [lag(x, k) for k in range(1, max_lag + 1)]
+    return jnp.stack(cols, axis=1)
+
+
+def differences_at_lag(x: jax.Array, k: int) -> jax.Array:
+    """``out[t] = x[t] - x[t-k]``; the first ``k`` entries are NaN."""
+    return x - lag(x, k)
+
+
+def differences_of_order(x: jax.Array, d: int) -> jax.Array:
+    """Order-``d`` differencing (d applications of lag-1 differencing).
+
+    The first ``d`` entries are NaN.  ARIMA's ``d`` step.
+    """
+    for _ in range(d):
+        x = differences_at_lag(x, 1)
+    return x
+
+
+def quotients(x: jax.Array, k: int = 1) -> jax.Array:
+    """``out[t] = x[t] / x[t-k]``; the first ``k`` entries are NaN."""
+    return x / lag(x, k)
+
+
+def price2ret(x: jax.Array, k: int = 1) -> jax.Array:
+    """Simple returns: ``x[t] / x[t-k] - 1``; first ``k`` entries NaN."""
+    return quotients(x, k) - 1.0
+
+
+# ---------------------------------------------------------------------------
+# Fill family
+# ---------------------------------------------------------------------------
+
+
+def fill_value(x: jax.Array, value) -> jax.Array:
+    """Replace every NaN with ``value``."""
+    return jnp.where(_isvalid(x), x, jnp.asarray(value, dtype=x.dtype))
+
+
+def fill_with_default(x: jax.Array, default=0.0) -> jax.Array:
+    return fill_value(x, default)
+
+
+def _prev_valid_idx(valid: jax.Array) -> jax.Array:
+    """For each t, the index of the latest valid position <= t, or -1."""
+    t = jnp.arange(valid.shape[0])
+    cand = jnp.where(valid, t, -1)
+    return lax.associative_scan(jnp.maximum, cand)
+
+
+def _next_valid_idx(valid: jax.Array) -> jax.Array:
+    """For each t, the index of the earliest valid position >= t, or size."""
+    n = valid.shape[0]
+    t = jnp.arange(n)
+    cand = jnp.where(valid, t, n)
+    return lax.associative_scan(jnp.minimum, cand, reverse=True)
+
+
+def fill_previous(x: jax.Array) -> jax.Array:
+    """Forward fill (last observation carried forward); leading NaNs remain."""
+    valid = _isvalid(x)
+    ip = _prev_valid_idx(valid)
+    return jnp.where(ip >= 0, x[jnp.maximum(ip, 0)], _nan(x.dtype))
+
+
+def fill_next(x: jax.Array) -> jax.Array:
+    """Backward fill (next observation carried backward); trailing NaNs remain."""
+    valid = _isvalid(x)
+    n = x.shape[0]
+    inx = _next_valid_idx(valid)
+    return jnp.where(inx < n, x[jnp.minimum(inx, n - 1)], _nan(x.dtype))
+
+
+def fill_nearest(x: jax.Array) -> jax.Array:
+    """Fill each NaN with the nearest valid value (ties -> previous)."""
+    valid = _isvalid(x)
+    n = x.shape[0]
+    t = jnp.arange(n)
+    ip = _prev_valid_idx(valid)
+    inx = _next_valid_idx(valid)
+    dp = jnp.where(ip >= 0, t - ip, n + 1)
+    dn = jnp.where(inx < n, inx - t, n + 1)
+    pick_prev = dp <= dn
+    prev_val = x[jnp.maximum(ip, 0)]
+    next_val = x[jnp.minimum(inx, n - 1)]
+    filled = jnp.where(pick_prev, prev_val, next_val)
+    any_side = (ip >= 0) | (inx < n)
+    return jnp.where(valid, x, jnp.where(any_side, filled, _nan(x.dtype)))
+
+
+def fill_linear(x: jax.Array) -> jax.Array:
+    """Linear interpolation across interior NaN gaps; edge NaNs remain."""
+    valid = _isvalid(x)
+    n = x.shape[0]
+    t = jnp.arange(n)
+    ip = _prev_valid_idx(valid)
+    inx = _next_valid_idx(valid)
+    interior = (ip >= 0) & (inx < n)
+    ip_c = jnp.maximum(ip, 0)
+    in_c = jnp.minimum(inx, n - 1)
+    span = jnp.maximum(in_c - ip_c, 1).astype(x.dtype)
+    w = (t - ip_c).astype(x.dtype) / span
+    interp = x[ip_c] * (1.0 - w) + x[in_c] * w
+    return jnp.where(valid, x, jnp.where(interior, interp, _nan(x.dtype)))
+
+
+def fill_spline(x: jax.Array) -> jax.Array:
+    """Natural cubic spline through the valid points; edge NaNs remain.
+
+    Mask-aware, fixed-shape: valid knots are compacted to the front with a
+    stable argsort, the natural-spline tridiagonal system is solved with a
+    Thomas-algorithm ``lax.scan`` (time-serial per series, vmapped over
+    series), and interior NaNs are evaluated on their bracketing knot
+    interval.  Matches ``scipy.interpolate.CubicSpline(bc_type='natural')``
+    on the valid points (oracle-tested).  Reference used Commons-Math
+    ``SplineInterpolator`` (SURVEY.md Section 2.1).
+    """
+    n = x.shape[0]
+    dtype = x.dtype
+    valid = _isvalid(x)
+    m = jnp.sum(valid)  # number of knots
+
+    # Compact valid knots to the front (stable: preserves time order).
+    order = jnp.argsort(~valid, stable=True)
+    kx = jnp.where(jnp.arange(n) < m, order, n)  # knot time-positions, pad n
+    ky = jnp.where(jnp.arange(n) < m, x[jnp.minimum(order, n - 1)], 0.0)
+
+    kxf = kx.astype(dtype)
+    h = jnp.maximum(kxf[1:] - kxf[:-1], 1e-30)  # knot spacings [n-1]
+    dy = (ky[1:] - ky[:-1]) / h
+
+    # Natural spline: solve for second derivatives M[0..m-1], M[0]=M[m-1]=0.
+    # Interior rows i=1..m-2:  h[i-1]*M[i-1] + 2(h[i-1]+h[i])*M[i] + h[i]*M[i+1]
+    #                          = 6*(dy[i] - dy[i-1])
+    i = jnp.arange(n)
+    is_interior = (i >= 1) & (i < jnp.maximum(m - 1, 1))
+    a = jnp.where(is_interior, jnp.concatenate([jnp.zeros((1,), dtype), h]), 0.0)[:n]
+    b = jnp.where(
+        is_interior,
+        2.0 * (jnp.concatenate([jnp.zeros((1,), dtype), h])[:n] + jnp.concatenate([h, jnp.zeros((1,), dtype)])[:n]),
+        1.0,
+    )
+    c = jnp.where(is_interior, jnp.concatenate([h, jnp.zeros((1,), dtype)]), 0.0)[:n]
+    rhs_full = jnp.concatenate([jnp.zeros((1,), dtype), 6.0 * (dy[1:] - dy[:-1]), jnp.zeros((1,), dtype)])[:n]
+    rhs = jnp.where(is_interior, rhs_full, 0.0)
+
+    # Thomas algorithm: forward elimination then back substitution via scans.
+    def fwd(carry, abcr):
+        cp_prev, dp_prev = carry
+        ai, bi, ci, ri = abcr
+        denom = bi - ai * cp_prev
+        cp = ci / denom
+        dp = (ri - ai * dp_prev) / denom
+        return (cp, dp), (cp, dp)
+
+    (_, _), (cps, dps) = lax.scan(fwd, (jnp.zeros((), dtype), jnp.zeros((), dtype)), (a, b, c, rhs))
+
+    def bwd(carry, cd):
+        cp, dp = cd
+        mi = dp - cp * carry
+        return mi, mi
+
+    _, Ms_rev = lax.scan(bwd, jnp.zeros((), dtype), (cps[::-1], dps[::-1]))
+    M = Ms_rev[::-1]  # second derivatives at knots
+
+    # Evaluate at every position; knots map back exactly via the pieces.
+    # Find bracketing knot interval j: kx[j] <= t < kx[j+1].
+    t = jnp.arange(n)
+    srch_keys = jnp.where(jnp.arange(n) < m, kx, jnp.iinfo(jnp.int32).max)
+    j = jnp.clip(jnp.searchsorted(srch_keys, t, side="right") - 1, 0, n - 2)
+    x0, x1 = kxf[j], kxf[j + 1]
+    y0, y1 = ky[j], ky[j + 1]
+    M0, M1 = M[j], M[j + 1]
+    hj = jnp.maximum(x1 - x0, 1e-30)
+    tt = t.astype(dtype)
+    A = (x1 - tt) / hj
+    B = (tt - x0) / hj
+    s = (
+        A * y0
+        + B * y1
+        + ((A**3 - A) * M0 + (B**3 - B) * M1) * (hj**2) / 6.0
+    )
+
+    ip = _prev_valid_idx(valid)
+    inx = _next_valid_idx(valid)
+    interior = (ip >= 0) & (inx < n)
+    return jnp.where(valid, x, jnp.where(interior, s, _nan(dtype)))
+
+
+_FILLS: dict = {
+    "value": None,  # needs an argument; handled in fillts
+    "previous": fill_previous,
+    "next": fill_next,
+    "nearest": fill_nearest,
+    "linear": fill_linear,
+    "spline": fill_spline,
+    "zero": lambda x: fill_value(x, 0.0),
+}
+
+
+def fillts(x: jax.Array, method: str, value=None) -> jax.Array:
+    """Dispatch on fill-method name — mirrors ``UnivariateTimeSeries.fillts``."""
+    if method == "value":
+        if value is None:
+            raise ValueError("fill method 'value' requires a value")
+        return fill_value(x, value)
+    if method not in _FILLS:
+        raise ValueError(f"unknown fill method {method!r}; options: {sorted(_FILLS)}")
+    return _FILLS[method](x)
+
+
+# ---------------------------------------------------------------------------
+# Resampling
+# ---------------------------------------------------------------------------
+
+
+def downsample(x: jax.Array, n: int, offset: int = 0) -> jax.Array:
+    """Every ``n``-th element starting at ``offset`` (static output shape)."""
+    return x[offset::n]
+
+
+def upsample(x: jax.Array, n: int, offset: int = 0, use_nan: bool = True) -> jax.Array:
+    """Spread elements ``n`` apart, padding with NaN (or 0) between."""
+    out_len = x.shape[0] * n
+    pad = jnp.nan if use_nan else 0.0
+    out = jnp.full((out_len,), pad, dtype=x.dtype)
+    return out.at[offset::n].set(x)
+
+
+def resample(
+    x: jax.Array,
+    ratio: int,
+    aggr: Callable[[jax.Array], jax.Array] = jnp.nanmean,
+) -> jax.Array:
+    """Aggregate consecutive windows of length ``ratio`` (e.g. hourly->daily)."""
+    n_out = x.shape[0] // ratio
+    return aggr(x[: n_out * ratio].reshape(n_out, ratio), axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Batched (panel) variants — the TPU hot path
+# ---------------------------------------------------------------------------
+
+
+def batched(fn: Callable, *static_args, **static_kwargs) -> Callable:
+    """Lift a ``[time] -> ...`` kernel to ``[keys, time] -> ...`` via vmap+jit."""
+    lifted = jax.vmap(lambda v: fn(v, *static_args, **static_kwargs))
+    return jax.jit(lifted)
+
+
+batch_autocorr = functools.partial(batched, autocorr)
+batch_fill = lambda method: batched(fillts, method)  # noqa: E731

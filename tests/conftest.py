@@ -1,0 +1,41 @@
+"""Test configuration: force a virtual 8-device CPU mesh before jax imports.
+
+This is the exact analog of the reference's Spark ``local[n]`` test contexts
+(SURVEY.md Section 4): multi-device sharding logic is exercised with no TPU
+attached by forcing the host platform to expose 8 XLA CPU devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The axon TPU shim (sitecustomize) force-sets jax_platforms="axon,cpu",
+# overriding the JAX_PLATFORMS env var; when its tunnel is unhealthy every
+# backend init blocks.  Re-pin to pure CPU before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+# Model-fitting numerics are validated against float64 oracles.  (The env-var
+# form JAX_ENABLE_X64 is not honored by this jax build — use config.update.)
+jax.config.update("jax_enable_x64", True)
+
+# Persistent compile cache: scan-heavy kernels (spline, CSS recursions) are
+# slow to compile; cache across pytest runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_pytest_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 forced CPU devices, got {len(devs)}"
+    return devs

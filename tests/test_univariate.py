@@ -1,0 +1,216 @@
+"""L2 kernel tests: oracle comparisons vs numpy/pandas/scipy.
+
+Mirrors the reference's ``UnivariateTimeSeriesSuite`` golden-value strategy
+(SURVEY.md Section 4) with numpy/pandas/scipy as the CPU oracle.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+import jax
+import jax.numpy as jnp
+
+from spark_timeseries_tpu.ops import univariate as uv
+from spark_timeseries_tpu.ops import lag_mat_trim_both
+
+nan = np.nan
+
+
+def arr(*vals):
+    return jnp.asarray(np.array(vals, dtype=np.float64))
+
+
+class TestFills:
+    x = arr(nan, 1.0, nan, nan, 4.0, nan, 6.0, nan)
+
+    def test_fill_previous(self):
+        got = np.asarray(uv.fill_previous(self.x))
+        exp = pd.Series(np.asarray(self.x)).ffill().values
+        np.testing.assert_array_equal(got, exp)
+
+    def test_fill_next(self):
+        got = np.asarray(uv.fill_next(self.x))
+        exp = pd.Series(np.asarray(self.x)).bfill().values
+        np.testing.assert_array_equal(got, exp)
+
+    def test_fill_linear(self):
+        got = np.asarray(uv.fill_linear(self.x))
+        exp = np.array([nan, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0, nan])
+        np.testing.assert_allclose(got, exp)
+
+    def test_fill_nearest(self):
+        got = np.asarray(uv.fill_nearest(self.x))
+        # position 0 -> nearest is 1.0; pos 2 -> prev (tie at dist 2? no: prev
+        # dist 1) ; pos 3 -> next 4.0 (dist 1); pos 5 tie -> previous 4.0
+        exp = np.array([1.0, 1.0, 1.0, 4.0, 4.0, 4.0, 6.0, 6.0])
+        np.testing.assert_array_equal(got, exp)
+
+    def test_fill_value(self):
+        got = np.asarray(uv.fill_value(self.x, -1.0))
+        exp = np.where(np.isnan(np.asarray(self.x)), -1.0, np.asarray(self.x))
+        np.testing.assert_array_equal(got, exp)
+
+    def test_fill_spline_vs_scipy(self):
+        from scipy.interpolate import CubicSpline
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=40)
+        xm = x.copy()
+        miss = [3, 4, 10, 17, 18, 19, 30]
+        xm[miss] = nan
+        got = np.asarray(uv.fill_spline(jnp.asarray(xm)))
+        valid = ~np.isnan(xm)
+        cs = CubicSpline(np.where(valid)[0], xm[valid], bc_type="natural")
+        exp = xm.copy()
+        exp[miss] = cs(np.array(miss, dtype=float))
+        np.testing.assert_allclose(got, exp, rtol=1e-9, atol=1e-9)
+
+    def test_fill_spline_edges_stay_nan(self):
+        x = arr(nan, 1.0, nan, 3.0, 2.0, nan)
+        got = np.asarray(uv.fill_spline(x))
+        assert np.isnan(got[0]) and np.isnan(got[5])
+        assert not np.isnan(got[2])
+
+    def test_fillts_dispatch(self):
+        for m in ["previous", "next", "nearest", "linear", "spline", "zero"]:
+            uv.fillts(self.x, m)
+        with pytest.raises(ValueError):
+            uv.fillts(self.x, "bogus")
+
+    def test_all_nan(self):
+        x = arr(nan, nan, nan)
+        for fn in [uv.fill_previous, uv.fill_next, uv.fill_nearest, uv.fill_linear]:
+            assert np.all(np.isnan(np.asarray(fn(x))))
+
+    def test_vmap_fills(self):
+        panel = jnp.stack([self.x, arr(1.0, nan, 3.0, nan, 5.0, nan, 7.0, 8.0)])
+        got = jax.vmap(uv.fill_linear)(panel)
+        for i in range(2):
+            np.testing.assert_allclose(
+                np.asarray(got[i]), np.asarray(uv.fill_linear(panel[i]))
+            )
+
+
+class TestLagsDiffs:
+    def test_lag(self):
+        x = arr(1.0, 2.0, 3.0, 4.0)
+        got = np.asarray(uv.lag(x, 2))
+        np.testing.assert_array_equal(got, [nan, nan, 1.0, 2.0])
+
+    def test_lags_matrix(self):
+        x = arr(1.0, 2.0, 3.0, 4.0)
+        got = np.asarray(uv.lags(x, 2, include_original=True))
+        assert got.shape == (4, 3)
+        np.testing.assert_array_equal(got[:, 0], [1, 2, 3, 4])
+        np.testing.assert_array_equal(got[2:, 1], [2, 3])
+        np.testing.assert_array_equal(got[2:, 2], [1, 2])
+
+    def test_differences_at_lag(self):
+        x = arr(1.0, 4.0, 9.0, 16.0)
+        got = np.asarray(uv.differences_at_lag(x, 1))
+        np.testing.assert_array_equal(got[1:], [3.0, 5.0, 7.0])
+        assert np.isnan(got[0])
+
+    def test_differences_of_order(self):
+        x = jnp.asarray(np.arange(10.0) ** 2)
+        got = np.asarray(uv.differences_of_order(x, 2))
+        np.testing.assert_allclose(got[2:], 2.0)  # second diff of t^2 is 2
+
+    def test_vs_pandas_diff(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=50)
+        got = np.asarray(uv.differences_at_lag(jnp.asarray(x), 3))
+        exp = pd.Series(x).diff(3).values
+        np.testing.assert_allclose(got, exp, equal_nan=True)
+
+    def test_quotients_price2ret(self):
+        x = arr(100.0, 110.0, 99.0)
+        q = np.asarray(uv.quotients(x, 1))
+        np.testing.assert_allclose(q[1:], [1.1, 0.9])
+        r = np.asarray(uv.price2ret(x, 1))
+        np.testing.assert_allclose(r[1:], [0.1, -0.1])
+
+    def test_lag_mat_trim_both(self):
+        x = arr(1.0, 2.0, 3.0, 4.0, 5.0)
+        got = np.asarray(lag_mat_trim_both(x, 2))
+        # rows t=2,3,4; cols x[t-1], x[t-2]
+        np.testing.assert_array_equal(got, [[2, 1], [3, 2], [4, 3]])
+        got2 = np.asarray(lag_mat_trim_both(x, 2, include_original=True))
+        np.testing.assert_array_equal(got2[:, 0], [3, 4, 5])
+
+
+class TestAutocorr:
+    def test_vs_numpy_oracle(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=200)
+        got = np.asarray(uv.autocorr(jnp.asarray(x), 5))
+        d = x - x.mean()
+        denom = (d * d).sum()
+        exp = np.array([(d[k:] * d[:-k]).sum() / denom for k in range(1, 6)])
+        np.testing.assert_allclose(got, exp, rtol=1e-10)
+
+    def test_ar1_signal(self):
+        rng = np.random.default_rng(3)
+        n = 5000
+        x = np.zeros(n)
+        for t in range(1, n):
+            x[t] = 0.8 * x[t - 1] + rng.normal()
+        got = np.asarray(uv.autocorr(jnp.asarray(x), 3))
+        np.testing.assert_allclose(got, [0.8, 0.64, 0.512], atol=0.05)
+
+    def test_batched(self):
+        rng = np.random.default_rng(4)
+        panel = jnp.asarray(rng.normal(size=(7, 100)))
+        got = np.asarray(uv.batch_autocorr(10)(panel))
+        assert got.shape == (7, 10)
+        np.testing.assert_allclose(got[3], np.asarray(uv.autocorr(panel[3], 10)), rtol=1e-8)
+
+
+class TestResample:
+    def test_downsample(self):
+        x = jnp.arange(10.0)
+        np.testing.assert_array_equal(np.asarray(uv.downsample(x, 3)), [0, 3, 6, 9])
+        np.testing.assert_array_equal(np.asarray(uv.downsample(x, 3, offset=1)), [1, 4, 7])
+
+    def test_upsample(self):
+        x = arr(1.0, 2.0)
+        got = np.asarray(uv.upsample(x, 3))
+        np.testing.assert_array_equal(got[[0, 3]], [1.0, 2.0])
+        assert np.isnan(got[1]) and np.isnan(got[2])
+
+    def test_resample_aggregate(self):
+        x = jnp.arange(12.0)
+        got = np.asarray(uv.resample(x, 4, jnp.nanmean))
+        np.testing.assert_allclose(got, [1.5, 5.5, 9.5])
+
+    def test_trim(self):
+        x = np.array([nan, nan, 1.0, 2.0, nan])
+        np.testing.assert_array_equal(uv.trim_leading(x), [1.0, 2.0, nan])
+        np.testing.assert_array_equal(uv.trim_trailing(x)[2:], [1.0, 2.0])
+
+    def test_first_last_not_nan(self):
+        x = arr(nan, 5.0, nan, 7.0, nan)
+        assert int(uv.first_not_nan_loc(x)) == 1
+        assert int(uv.last_not_nan_loc(x)) == 3
+        allnan = arr(nan, nan)
+        assert int(uv.first_not_nan_loc(allnan)) == 2
+        assert int(uv.last_not_nan_loc(allnan)) == -1
+
+
+class TestReviewRegressions:
+    def test_lag_rejects_out_of_range(self):
+        x = arr(1.0, 2.0, 3.0)
+        with pytest.raises(ValueError):
+            uv.lag(x, 5)
+        with pytest.raises(ValueError):
+            uv.lag(x, -1)
+
+    def test_lag_mat_2d_rejects_large_lag(self):
+        from spark_timeseries_tpu.ops import lag_mat_trim_both_2d
+
+        x = jnp.ones((3, 2))
+        with pytest.raises(ValueError):
+            lag_mat_trim_both_2d(x, 3)
+
+    def test_resample_exported(self):
+        assert "resample" in uv.__all__
