@@ -32,5 +32,13 @@ from .index import (
     uniform_from_interval,
 )
 from .ops import univariate
+from .panel import (
+    TimeSeriesPanel,
+    from_dataframe,
+    from_observations,
+    from_series_dict,
+)
+from . import parallel
+from .parallel import default_mesh
 
 __version__ = "0.1.0"

@@ -1,0 +1,4 @@
+from . import arima
+from .base import FitResult
+
+__all__ = ["arima", "FitResult"]

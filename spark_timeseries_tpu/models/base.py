@@ -1,0 +1,45 @@
+"""Common model interface (L4).
+
+Mirrors the reference's ``TimeSeriesModel`` trait (SURVEY.md Section 2.2:
+``addTimeDependentEffects`` / ``removeTimeDependentEffects``) as a pair of
+pure functions on parameter pytrees, plus the fit-result container shared by
+every model family.
+
+Conventions:
+- Every model module exposes ``fit(y, ...) -> FitResult`` accepting ``[time]``
+  or ``[batch, time]`` (auto-vmapped), with all structure (orders, seasonality)
+  static so one compiled computation fits the whole batch.
+- ``FitResult.params`` is ``[batch?, k]``; per-series diagnostics (converged,
+  iterations, final objective) ride along — the structured-diagnostics
+  replacement for Spark logs (SURVEY.md Section 5.5).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class FitResult(NamedTuple):
+    """Batched fit output: parameters + convergence diagnostics."""
+
+    params: jax.Array  # [batch?, k]
+    neg_log_likelihood: jax.Array  # [batch?] final objective (model-defined)
+    converged: jax.Array  # [batch?] bool
+    iters: jax.Array  # [batch?] optimizer iterations used
+
+
+def ensure_batched(y) -> tuple[jax.Array, bool]:
+    """Promote ``[time]`` to ``[1, time]``; report whether input was single."""
+    y = jnp.asarray(y)
+    if y.ndim == 1:
+        return y[None, :], True
+    if y.ndim == 2:
+        return y, False
+    raise ValueError(f"series must be [time] or [batch, time], got {y.shape}")
+
+
+def debatch(x, single: bool):
+    return jax.tree.map(lambda a: a[0], x) if single else x

@@ -1,0 +1,171 @@
+"""Sequence (time-axis) parallelism — long-series support.
+
+The reference never shards a single series: a series is one JVM vector, so
+its maximum length is bounded by executor memory (SURVEY.md Section 5.7).
+This module removes that bound: on a 2-D ``(series, time)`` mesh, one series'
+``[time]`` axis is split across chips and within-series reductions and scans
+are rebuilt from local work + ICI collectives under ``shard_map``:
+
+- moments / autocovariance:  local partial sums + ``psum`` over the ``time``
+  axis; lagged cross terms at shard boundaries come from a halo exchange
+  (``ppermute`` of each shard's tail to its right neighbor) — a ring
+  transfer over ICI, the time-series analog of ring attention's
+  neighbor hand-off.
+- prefix scans (cumsum — the integration step of differencing):  local scan
+  + exclusive all-shard offset, computed via ``psum`` of masked shard totals
+  (carry hand-off without serializing shards).
+
+Every function here takes and returns arrays laid out ``[keys, time]`` and
+is meant to be called under ``shard_map`` with spec
+``P(SERIES_AXIS, TIME_AXIS)`` — see ``sp_*_sharded`` wrappers which bind the
+mesh. On a 1-D mesh the plain kernels in ``ops.univariate`` are the right
+tool; these exist for series too long for one chip's HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..parallel.mesh import SERIES_AXIS, TIME_AXIS
+
+
+# ---------------------------------------------------------------------------
+# Inside-shard_map kernels (axis_name = TIME_AXIS)
+# ---------------------------------------------------------------------------
+
+
+def _axis_index():
+    return lax.axis_index(TIME_AXIS)
+
+
+def _axis_size():
+    return lax.axis_size(TIME_AXIS)
+
+
+def sp_moments(block: jax.Array) -> Dict[str, jax.Array]:
+    """NaN-aware per-series count/mean/var across a time-sharded axis.
+
+    ``block``: this shard's ``[keys_local, time_local]`` slice.  Returns
+    per-series ``[keys_local]`` stats, identical on every time shard.
+    """
+    valid = ~jnp.isnan(block)
+    n = lax.psum(jnp.sum(valid, axis=1), TIME_AXIS)
+    s = lax.psum(jnp.sum(jnp.where(valid, block, 0.0), axis=1), TIME_AXIS)
+    mean = s / jnp.maximum(n, 1)
+    ss = lax.psum(
+        jnp.sum(jnp.where(valid, (block - mean[:, None]) ** 2, 0.0), axis=1), TIME_AXIS
+    )
+    var = ss / jnp.maximum(n - 1, 1)
+    return {"count": n, "mean": mean, "var": var}
+
+
+def _halo_from_left(block: jax.Array, halo: int) -> jax.Array:
+    """Each shard receives the previous shard's last ``halo`` columns
+    (zeros for the first shard) — the ring hand-off for lagged terms."""
+    nshards = _axis_size()
+    tail = block[:, -halo:]
+    perm = [(i, (i + 1) % nshards) for i in range(nshards)]
+    received = lax.ppermute(tail, TIME_AXIS, perm)
+    first = _axis_index() == 0
+    return jnp.where(first, jnp.zeros_like(received), received)
+
+
+def sp_autocov(block: jax.Array, max_lag: int) -> jax.Array:
+    """Autocovariance at lags 1..max_lag of time-sharded series.
+
+    Cross-shard lagged products use a halo exchange of ``max_lag`` columns
+    from the left neighbor.  Assumes no NaNs (fill first).  Returns
+    ``[keys_local, max_lag]`` (plus the lag-0 variance as column 0 of the
+    companion ``sp_autocorr``).
+    """
+    stats = sp_moments(block)
+    d = block - stats["mean"][:, None]
+    halo = _halo_from_left(d, max_lag)  # [k, max_lag] from left neighbor
+    ext = jnp.concatenate([halo, d], axis=1)  # [k, max_lag + t_local]
+    t_local = d.shape[1]
+    covs = []
+    for k in range(1, max_lag + 1):
+        lagged = lax.dynamic_slice_in_dim(ext, max_lag - k, t_local, axis=1)
+        # products whose lagged partner falls before the global start are
+        # zero because the first shard's halo is zeroed
+        covs.append(lax.psum(jnp.sum(d * lagged, axis=1), TIME_AXIS))
+    return jnp.stack(covs, axis=1)
+
+
+def sp_autocorr(block: jax.Array, max_lag: int) -> jax.Array:
+    """Autocorrelation at lags 1..max_lag (matches ``univariate.autocorr``
+    on unsharded data)."""
+    stats = sp_moments(block)
+    d = block - stats["mean"][:, None]
+    denom = lax.psum(jnp.sum(d * d, axis=1), TIME_AXIS)
+    return sp_autocov(block, max_lag) / denom[:, None]
+
+
+def sp_cumsum(block: jax.Array) -> jax.Array:
+    """Cumulative sum along a time-sharded axis (differencing inversion).
+
+    Local cumsum + exclusive prefix of shard totals.  The prefix is computed
+    collective-only: psum of shard totals masked to strictly-lower shard
+    indices — no serialization across shards.
+    """
+    local = jnp.cumsum(block, axis=1)
+    total = local[:, -1:]  # [k, 1] this shard's sum
+    idx = _axis_index()
+    nshards = _axis_size()
+    # all_gather shard totals, then sum those before this shard
+    gathered = lax.all_gather(total, TIME_AXIS, axis=1, tiled=True)  # [k, nshards]
+    mask = jnp.arange(nshards) < idx
+    offset = jnp.sum(jnp.where(mask[None, :], gathered, 0.0), axis=1, keepdims=True)
+    return local + offset
+
+
+def sp_differences(block: jax.Array, k_lag: int = 1) -> jax.Array:
+    """Lag-k differencing across shard boundaries via halo exchange; the
+    first ``k_lag`` global positions are NaN (matches
+    ``univariate.differences_at_lag``)."""
+    halo = _halo_from_left(block, k_lag)
+    ext = jnp.concatenate([halo, block], axis=1)
+    lagged = ext[:, : block.shape[1]]
+    out = block - lagged
+    # global positions < k_lag are NaN
+    t0 = _axis_index() * block.shape[1]
+    gpos = t0 + jnp.arange(block.shape[1])
+    return jnp.where(gpos[None, :] < k_lag, jnp.nan, out)
+
+
+# ---------------------------------------------------------------------------
+# Mesh-bound wrappers
+# ---------------------------------------------------------------------------
+
+
+def _bind(mesh: Mesh, fn, out_specs):
+    spec = P(SERIES_AXIS, TIME_AXIS)
+    return shard_map(fn, mesh=mesh, in_specs=(spec,), out_specs=out_specs)
+
+
+def sp_autocorr_sharded(mesh: Mesh, values: jax.Array, max_lag: int) -> jax.Array:
+    """``[keys, time]`` (sharded on a 2-D mesh) -> ``[keys, max_lag]``."""
+    fn = _bind(mesh, functools.partial(sp_autocorr, max_lag=max_lag), P(SERIES_AXIS, None))
+    return jax.jit(fn)(values)
+
+
+def sp_moments_sharded(mesh: Mesh, values: jax.Array) -> Dict[str, jax.Array]:
+    fn = _bind(mesh, sp_moments, {k: P(SERIES_AXIS) for k in ("count", "mean", "var")})
+    return jax.jit(fn)(values)
+
+
+def sp_cumsum_sharded(mesh: Mesh, values: jax.Array) -> jax.Array:
+    fn = _bind(mesh, sp_cumsum, P(SERIES_AXIS, TIME_AXIS))
+    return jax.jit(fn)(values)
+
+
+def sp_differences_sharded(mesh: Mesh, values: jax.Array, k_lag: int = 1) -> jax.Array:
+    fn = _bind(mesh, functools.partial(sp_differences, k_lag=k_lag), P(SERIES_AXIS, TIME_AXIS))
+    return jax.jit(fn)(values)

@@ -1,0 +1,463 @@
+"""TimeSeriesPanel — the distributed collection of time series (L3).
+
+TPU-native replacement for the reference's ``TimeSeriesRDD[K]`` (SURVEY.md
+Sections 1-3, upstream ``sparkts/TimeSeriesRDD.scala`` — path unverified).
+Where the reference stores an ``RDD[(K, Vector)]`` with one broadcast
+``DateTimeIndex`` and loops per series inside executor tasks, this class
+stores the whole collection as ONE dense device array ``values[keys, time]``
+(NaN marks missing), a host-side ``keys`` array, and a shared replicated
+index.  The mapping of reference operations:
+
+=====================================  =======================================
+reference (Spark)                      here (JAX/TPU)
+=====================================  =======================================
+``mapSeries(fn)`` per-series loop      ``jax.vmap(fn)`` over the keys axis
+ingest ``groupByKey`` shuffle          host scatter by vectorized index lookup
+``fill``/``differences``/...           batched L2 kernels (ops.univariate)
+``toInstants`` shuffle (transpose)     sharded transpose / XLA all_to_all
+``seriesStats`` via StatCounter        NaN-aware vmapped reductions (+psum)
+broadcast DateTimeIndex                replicated sharding of index arrays
+Spark hash partitioning over keys      ``NamedSharding(mesh, P("series",))``
+``saveAsCsv`` + index string header    same persisted formats (CSV / npz)
+=====================================  =======================================
+
+A series always lives whole on one chip (the keys axis is the only sharded
+axis), preserving the reference's core invariant.  Structural operations that
+change the key set (filters, union) are host-side ingest-path code; the hot
+path (map_series / fills / model fits) stays on device end to end.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from . import index as dtix
+from .index import DateTimeIndex, DateTimeLike
+from .ops import univariate as uv
+from .parallel import mesh as meshlib
+
+
+def _as_key_array(keys: Iterable) -> np.ndarray:
+    return np.asarray(list(keys), dtype=object)
+
+
+@functools.lru_cache(maxsize=512)
+def _cached_batched(fn: Callable, *args) -> Callable:
+    """jit(vmap(fn(., *args))) memoized on (fn, args) so repeated panel
+    method calls reuse one compiled kernel instead of recompiling a fresh
+    closure each time.  ``fn`` and ``args`` must be hashable (module-level
+    kernels + static scalars)."""
+    return jax.jit(jax.vmap(lambda v: fn(v, *args)))
+
+
+class TimeSeriesPanel:
+    """A collection of series sharing one ``DateTimeIndex``.
+
+    values: ``f32/f64[padded_keys, time]`` device array, NaN = missing.  Rows
+    beyond ``n_series`` are NaN padding so the keys axis divides evenly across
+    the mesh's ``series`` axis.
+    """
+
+    def __init__(
+        self,
+        index: DateTimeIndex,
+        keys: Iterable,
+        values,
+        *,
+        mesh: Optional[Mesh] = None,
+        _pad_ok: bool = False,
+    ):
+        self.index = index
+        self.keys = _as_key_array(keys)
+        self.mesh = mesh
+        vals = jnp.asarray(values)
+        if vals.ndim != 2:
+            raise ValueError(f"values must be [keys, time], got shape {vals.shape}")
+        if not _pad_ok and vals.shape[0] != len(self.keys):
+            raise ValueError(
+                f"{len(self.keys)} keys but values has {vals.shape[0]} rows"
+            )
+        if vals.shape[1] != index.size:
+            raise ValueError(
+                f"index size {index.size} but values has {vals.shape[1]} columns"
+            )
+        if mesh is not None:
+            if meshlib.TIME_AXIS in mesh.axis_names:
+                t_shards = mesh.shape[meshlib.TIME_AXIS]
+                if vals.shape[1] % t_shards:
+                    raise ValueError(
+                        f"time axis of length {vals.shape[1]} does not divide across "
+                        f"{t_shards} time shards; pad or slice the index to a multiple "
+                        f"of {t_shards} (NaN time-padding would corrupt kernels)"
+                    )
+            n_shards = mesh.shape[meshlib.SERIES_AXIS]
+            padded = meshlib.pad_to_multiple(vals.shape[0], n_shards)
+            if padded != vals.shape[0]:
+                pad = jnp.full((padded - vals.shape[0], vals.shape[1]), jnp.nan, vals.dtype)
+                vals = jnp.concatenate([vals, pad], axis=0)
+            vals = meshlib.shard_series(vals, mesh)
+        self.values = vals
+
+    # -- basics -------------------------------------------------------------
+
+    @property
+    def n_series(self) -> int:
+        return len(self.keys)
+
+    @property
+    def n_time(self) -> int:
+        return self.index.size
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def series_values(self) -> jax.Array:
+        """The unpadded ``[n_series, time]`` view (device array)."""
+        return self.values[: self.n_series]
+
+    def __len__(self) -> int:
+        return self.n_series
+
+    def __getitem__(self, key) -> jax.Array:
+        """Single series by key — ``panel["AAPL"]`` -> ``[time]`` array."""
+        locs = np.nonzero(self.keys == key)[0]
+        if locs.size == 0:
+            raise KeyError(key)
+        return self.values[int(locs[0])]
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"TimeSeriesPanel({self.n_series} series x {self.n_time} instants, "
+            f"dtype={self.dtype}, mesh={'yes' if self.mesh else 'no'})"
+        )
+
+    def _like(self, values, index: Optional[DateTimeIndex] = None, keys=None) -> "TimeSeriesPanel":
+        return TimeSeriesPanel(
+            index if index is not None else self.index,
+            keys if keys is not None else self.keys,
+            values,
+            mesh=self.mesh,
+            _pad_ok=True,
+        )
+
+    # -- the hot path -------------------------------------------------------
+
+    def map_series(
+        self,
+        fn: Callable[[jax.Array], jax.Array],
+        new_index: Optional[DateTimeIndex] = None,
+    ) -> "TimeSeriesPanel":
+        """Apply a ``[time] -> [time']`` kernel to every series.
+
+        The reference's ``mapSeries`` dispatches ``fn`` sequentially per
+        series inside executor tasks (SURVEY.md Section 3.2 hot loop #2);
+        here it is one vmapped XLA computation over the sharded keys axis —
+        with a series-sharded panel this is embarrassingly parallel and
+        XLA emits zero collectives.
+
+        Compiled kernels are cached per ``fn`` object: pass a stable (module-
+        level) function to amortize compilation; a fresh lambda each call
+        recompiles each call.
+        """
+        out = _cached_batched(fn)(self.values)
+        idx = new_index if new_index is not None else self.index
+        if out.ndim != 2 or out.shape[1] != idx.size:
+            raise ValueError(
+                f"map_series output shape {out.shape} does not match index size "
+                f"{idx.size}; pass new_index= for length-changing transforms"
+            )
+        return self._like(out, index=idx)
+
+    def fill(self, method: str, value=None) -> "TimeSeriesPanel":
+        return self._apply(uv.fillts, method, value)
+
+    def differences(self, lag: int = 1) -> "TimeSeriesPanel":
+        return self._apply(uv.differences_at_lag, lag)
+
+    def quotients(self, lag: int = 1) -> "TimeSeriesPanel":
+        return self._apply(uv.quotients, lag)
+
+    def return_rates(self, lag: int = 1) -> "TimeSeriesPanel":
+        return self._apply(uv.price2ret, lag)
+
+    def _apply(self, kernel: Callable, *args) -> "TimeSeriesPanel":
+        return self._like(_cached_batched(kernel, *args)(self.values))
+
+    def autocorr(self, num_lags: int) -> jax.Array:
+        """``[n_series, num_lags]`` sample autocorrelations."""
+        out = _cached_batched(uv.autocorr, num_lags)(self.values)
+        return out[: self.n_series]
+
+    # -- time-axis restructuring -------------------------------------------
+
+    def slice(self, start: DateTimeLike, end: DateTimeLike) -> "TimeSeriesPanel":
+        lo, hi = self.index.loc_range(start, end)
+        return self.islice(lo, hi)
+
+    def islice(self, start: int, end: int) -> "TimeSeriesPanel":
+        return self._like(self.values[:, start:end], index=self.index.islice(start, end))
+
+    def with_index(self, new_index: DateTimeIndex, how: str = "nan") -> "TimeSeriesPanel":
+        """Reindex onto ``new_index``: positions present in both indices are
+        copied; new positions are NaN (``how="nan"``) — the upstream
+        ``withIndex`` contract.  Chain ``.fill(...)`` for other semantics."""
+        if how != "nan":
+            raise ValueError(f"unsupported how={how!r}; reindex then .fill(...)")
+        locs = self.index.locs_at_datetimes(new_index.instants())  # [new_time]
+        hit = locs >= 0
+        gathered = self.values[:, np.maximum(locs, 0)]
+        out = jnp.where(jnp.asarray(hit)[None, :], gathered, jnp.nan)
+        return self._like(out, index=new_index)
+
+    def remove_instants_with_nans(self) -> "TimeSeriesPanel":
+        """Drop time positions where ANY series is NaN (host-side dynamic
+        shape — upstream ``removeInstantsWithNaNs``)."""
+        col_ok = np.asarray(
+            jax.jit(lambda v: ~jnp.any(jnp.isnan(v[: self.n_series]), axis=0))(self.values)
+        )
+        keep = np.nonzero(col_ok)[0]
+        new_index = dtix.IrregularDateTimeIndex(self.index.instants()[keep])
+        return self._like(self.values[:, jnp.asarray(keep)], index=new_index)
+
+    # -- key-axis restructuring (host-side ingest-path ops) -----------------
+
+    def filter_keys(self, predicate: Callable[[object], bool]) -> "TimeSeriesPanel":
+        mask = np.array([bool(predicate(k)) for k in self.keys])
+        return self._select_rows(np.nonzero(mask)[0])
+
+    def select(self, keys: Sequence) -> "TimeSeriesPanel":
+        pos = {k: i for i, k in enumerate(self.keys)}
+        missing = [k for k in keys if k not in pos]
+        if missing:
+            raise KeyError(f"keys not in panel: {missing[:5]}")
+        return self._select_rows(np.array([pos[k] for k in keys], dtype=np.int64))
+
+    def _select_rows(self, rows: np.ndarray) -> "TimeSeriesPanel":
+        vals = self.series_values()[jnp.asarray(rows)] if rows.size else jnp.zeros(
+            (0, self.n_time), self.dtype
+        )
+        return TimeSeriesPanel(self.index, self.keys[rows], vals, mesh=self.mesh)
+
+    def filter_starting_before(self, dt: DateTimeLike) -> "TimeSeriesPanel":
+        """Keep series whose first observation is at or before ``dt``."""
+        cutoff = self.index.insertion_loc(dt)
+        first = np.asarray(jax.jit(jax.vmap(uv.first_not_nan_loc))(self.series_values()))
+        return self._select_rows(np.nonzero(first < cutoff)[0])
+
+    def filter_ending_after(self, dt: DateTimeLike) -> "TimeSeriesPanel":
+        """Keep series whose last observation is at or after ``dt``."""
+        if dtix.to_nanos(dt) > dtix.to_nanos(self.index.last):
+            return self._select_rows(np.array([], dtype=np.int64))
+        lo = self.index.loc_at_or_after(dt)
+        last = np.asarray(jax.jit(jax.vmap(uv.last_not_nan_loc))(self.series_values()))
+        return self._select_rows(np.nonzero(last >= lo)[0])
+
+    def union(self, other: "TimeSeriesPanel") -> "TimeSeriesPanel":
+        if self.index != other.index:
+            raise ValueError("union requires identical indices")
+        keys = np.concatenate([self.keys, other.keys])
+        vals = jnp.concatenate([self.series_values(), other.series_values()], axis=0)
+        return TimeSeriesPanel(self.index, keys, vals, mesh=self.mesh)
+
+    # -- aggregates and exits ----------------------------------------------
+
+    def series_stats(self) -> Dict[str, jax.Array]:
+        """NaN-aware per-series stats — upstream ``seriesStats`` (StatCounter
+        per series).  Returns ``[n_series]`` arrays."""
+
+        def stats(v):
+            valid = ~jnp.isnan(v)
+            n = jnp.sum(valid)
+            vz = jnp.where(valid, v, 0.0)
+            mean = jnp.sum(vz) / jnp.maximum(n, 1)
+            var = jnp.sum(jnp.where(valid, (v - mean) ** 2, 0.0)) / jnp.maximum(n - 1, 1)
+            return {
+                "count": n,
+                "mean": mean,
+                "stdev": jnp.sqrt(var),
+                "min": jnp.nanmin(v),
+                "max": jnp.nanmax(v),
+            }
+
+        out = jax.jit(jax.vmap(stats))(self.values)
+        return {k: v[: self.n_series] for k, v in out.items()}
+
+    def to_instants(self) -> Tuple[np.ndarray, jax.Array]:
+        """Time-major view: ``(datetimes[time], values[time, n_series])``.
+
+        The reference implements this as a full cluster shuffle (SURVEY.md
+        Section 3.4); here it is one transpose that XLA lowers to an
+        ``all_to_all`` over ICI when the panel is mesh-sharded.
+        """
+        vals = jax.jit(lambda v: v[: self.n_series].T)(self.values)
+        if self.mesh is not None:
+            n_shards = self.mesh.shape[meshlib.SERIES_AXIS]
+            if vals.shape[0] % n_shards == 0:
+                vals = jax.device_put(vals, meshlib.instant_sharding(self.mesh))
+        return self.index.datetimes(), vals
+
+    def to_instants_dataframe(self):
+        import pandas as pd
+
+        dts, vals = self.to_instants()
+        return pd.DataFrame(np.asarray(vals), index=pd.DatetimeIndex(dts), columns=list(self.keys))
+
+    def to_observations_dataframe(self, ts_col="timestamp", key_col="key", value_col="value"):
+        """Long-format (timestamp, key, value) rows, NaNs dropped — the
+        inverse of ``from_observations``."""
+        import pandas as pd
+
+        vals = np.asarray(self.series_values())
+        kidx, tidx = np.nonzero(~np.isnan(vals))
+        return pd.DataFrame(
+            {
+                ts_col: self.index.datetimes()[tidx],
+                key_col: self.keys[kidx],
+                value_col: vals[kidx, tidx],
+            }
+        )
+
+    def to_pandas(self):
+        """Series-major DataFrame: rows = keys, columns = datetimes."""
+        import pandas as pd
+
+        return pd.DataFrame(
+            np.asarray(self.series_values()),
+            index=list(self.keys),
+            columns=pd.DatetimeIndex(self.index.datetimes()),
+        )
+
+    # -- persistence --------------------------------------------------------
+
+    def save_csv(self, path: str) -> None:
+        """One line per series: ``key,indexString`` header convention of the
+        reference's ``saveAsCsv``: every line is ``key,v0,v1,...`` and the
+        first line carries the encoded index.
+
+        Persistence coerces keys to ``str`` — a load round-trip yields string
+        keys.  Keys containing ',' are rejected (they would corrupt rows).
+        """
+        if any("," in str(k) for k in self.keys):
+            raise ValueError("CSV persistence does not support keys containing ','")
+        vals = np.asarray(self.series_values())
+        with open(path, "w") as f:
+            f.write(f"# index: {self.index.to_string()}\n")
+            for k, row in zip(self.keys, vals):
+                f.write(str(k) + "," + ",".join(repr(float(v)) for v in row) + "\n")
+
+    @staticmethod
+    def load_csv(path: str, mesh: Optional[Mesh] = None) -> "TimeSeriesPanel":
+        with open(path) as f:
+            header = f.readline()
+            if not header.startswith("# index: "):
+                raise ValueError(f"{path} missing '# index:' header")
+            index = dtix.from_string(header[len("# index: ") :].strip())
+            keys, rows = [], []
+            for line in f:
+                parts = line.rstrip("\n").split(",")
+                keys.append(parts[0])
+                rows.append([float(v) for v in parts[1:]])
+        return TimeSeriesPanel(index, keys, np.asarray(rows), mesh=mesh)
+
+    def save(self, path: str) -> None:
+        """Binary checkpoint (npz): values + keys + index string."""
+        np.savez_compressed(
+            path,
+            values=np.asarray(self.series_values()),
+            keys=np.asarray([str(k) for k in self.keys]),
+            index=self.index.to_string(),
+        )
+
+    @staticmethod
+    def load(path: str, mesh: Optional[Mesh] = None) -> "TimeSeriesPanel":
+        if not path.endswith(".npz") and not os.path.exists(path):
+            path = path + ".npz"
+        z = np.load(path, allow_pickle=False)
+        return TimeSeriesPanel(
+            dtix.from_string(str(z["index"])), list(z["keys"]), z["values"], mesh=mesh
+        )
+
+    # -- resharding ---------------------------------------------------------
+
+    def with_mesh(self, mesh: Optional[Mesh]) -> "TimeSeriesPanel":
+        return TimeSeriesPanel(self.index, self.keys, self.series_values(), mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# Ingest
+# ---------------------------------------------------------------------------
+
+
+def from_observations(
+    index: DateTimeIndex,
+    keys,
+    timestamps,
+    values,
+    *,
+    mesh: Optional[Mesh] = None,
+    dtype=jnp.float32,
+    strict: bool = False,
+) -> TimeSeriesPanel:
+    """Build a panel from long-format observation triples.
+
+    Replaces the reference's ``timeSeriesRDDFromObservations`` groupByKey
+    shuffle (SURVEY.md Section 3.1) with a host-side vectorized scatter:
+    timestamps -> positions via one ``searchsorted``-style lookup, keys ->
+    rows via factorization, then one ``values[rows, locs] = v`` write.
+
+    Observations whose timestamp is not on the index raise (``strict=True``)
+    or are dropped (default).  The resulting panel's keys are SORTED
+    (lexicographically for strings) — align downstream arrays with
+    ``panel.keys``, not with insertion order.
+    """
+    keys = _as_key_array(keys)
+    vals = np.asarray(values, dtype=np.float64)
+    locs = index.locs_at_datetimes(timestamps)
+    uniq, rows = np.unique(keys, return_inverse=True)
+    ok = locs >= 0
+    if strict and not ok.all():
+        bad = np.nonzero(~ok)[0][:5]
+        raise ValueError(f"{(~ok).sum()} observations not on the index, e.g. rows {bad}")
+    panel = np.full((len(uniq), index.size), np.nan, dtype=np.float64)
+    panel[rows[ok], locs[ok]] = vals[ok]
+    return TimeSeriesPanel(index, uniq, jnp.asarray(panel, dtype=dtype), mesh=mesh)
+
+
+def from_dataframe(
+    df,
+    index: Optional[DateTimeIndex] = None,
+    *,
+    ts_col: str = "timestamp",
+    key_col: str = "key",
+    value_col: str = "value",
+    mesh: Optional[Mesh] = None,
+    dtype=jnp.float32,
+) -> TimeSeriesPanel:
+    """Panel from a long-format pandas DataFrame.  If ``index`` is None an
+    irregular index over the distinct timestamps is built."""
+    ts = df[ts_col].to_numpy()
+    if index is None:
+        index = dtix.IrregularDateTimeIndex(np.unique(dtix.to_nanos_array(ts)))
+    return from_observations(
+        index, df[key_col].to_numpy(), ts, df[value_col].to_numpy(), mesh=mesh, dtype=dtype
+    )
+
+
+def from_series_dict(
+    series: Dict[object, np.ndarray],
+    index: DateTimeIndex,
+    *,
+    mesh: Optional[Mesh] = None,
+    dtype=jnp.float32,
+) -> TimeSeriesPanel:
+    keys = list(series.keys())
+    vals = np.stack([np.asarray(series[k], dtype=np.float64) for k in keys])
+    return TimeSeriesPanel(index, keys, jnp.asarray(vals, dtype=dtype), mesh=mesh)

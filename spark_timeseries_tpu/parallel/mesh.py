@@ -1,0 +1,96 @@
+"""Device-mesh and sharding utilities — the distributed substrate.
+
+The reference's distribution model is Spark data-parallelism over series keys
+(hash-partitioned ``RDD[(K, Vector)]``, SURVEY.md Section 2.4).  The
+TPU-native equivalent implemented here: a 1-D ``jax.sharding.Mesh`` with a
+``"series"`` axis; the panel's ``[keys, time]`` array is placed with
+``NamedSharding(mesh, P("series", None))`` so every chip owns a contiguous
+block of whole series (a series is never split across chips — the same
+invariant the reference's partitioning guarantees).  Cross-series aggregates
+ride ``psum`` over ICI; the ``toInstants`` transpose becomes an XLA
+``all_to_all``; a replicated sharding ``P(None, None)`` replaces Spark's
+TorrentBroadcast of the shared index (SURVEY.md Section 5.8).
+
+Multi-host: under ``jax.distributed``, the same code runs unchanged — the
+mesh spans all processes' devices and XLA routes ICI/DCN collectives.
+
+Sequence-sharding (the optional ``"time"`` axis) is provided for very long
+series: reductions over time decompose into per-shard partials + ``psum``,
+and scans hand carries across shards via ``ppermute`` (see
+``ops/seqparallel.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SERIES_AXIS = "series"
+TIME_AXIS = "time"
+
+
+def default_mesh(
+    n_devices: Optional[int] = None,
+    *,
+    time_shards: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over the available devices.
+
+    1-D ``(series,)`` by default; pass ``time_shards > 1`` for a 2-D
+    ``(series, time)`` mesh used by sequence-parallel kernels.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if time_shards > 1:
+        if n % time_shards:
+            raise ValueError(f"{n} devices not divisible by time_shards={time_shards}")
+        arr = np.asarray(devs).reshape(n // time_shards, time_shards)
+        return Mesh(arr, (SERIES_AXIS, TIME_AXIS))
+    return Mesh(np.asarray(devs), (SERIES_AXIS,))
+
+
+def series_sharding(mesh: Mesh) -> NamedSharding:
+    """``[keys, time]`` sharded over keys, time replicated (or time-sharded
+    on a 2-D mesh)."""
+    if TIME_AXIS in mesh.axis_names:
+        return NamedSharding(mesh, P(SERIES_AXIS, TIME_AXIS))
+    return NamedSharding(mesh, P(SERIES_AXIS, None))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated — the broadcast-index analog."""
+    return NamedSharding(mesh, P())
+
+
+def instant_sharding(mesh: Mesh) -> NamedSharding:
+    """``[time, keys]`` sharded over time — the result layout of the
+    ``to_instants`` transpose."""
+    return NamedSharding(mesh, P(SERIES_AXIS, None))
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    """Smallest multiple of ``m`` that is >= ``n``."""
+    return ((n + m - 1) // m) * m
+
+
+def shard_series(values: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
+    """Place a ``[keys, time]`` array with the series sharding.
+
+    The keys axis must already be padded to a multiple of the mesh's series
+    size (``TimeSeriesPanel`` pads with NaN rows at construction).
+    """
+    if mesh is None:
+        return values
+    return jax.device_put(values, series_sharding(mesh))
+
+
+@functools.lru_cache(maxsize=None)
+def single_device_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:1]), (SERIES_AXIS,))

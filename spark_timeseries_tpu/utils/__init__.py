@@ -1,0 +1,4 @@
+from . import optim
+from .optim import batched_minimize, minimize_lbfgs
+
+__all__ = ["optim", "minimize_lbfgs", "batched_minimize"]

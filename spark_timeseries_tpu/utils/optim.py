@@ -1,0 +1,230 @@
+"""Batched unconstrained optimization for model fitting.
+
+The reference fits every model with Apache Commons Math optimizers —
+``NonLinearConjugateGradientOptimizer`` (css-cgd) and ``BOBYQAOptimizer``
+(css-bobyqa / Holt-Winters) — one series at a time on one JVM core
+(SURVEY.md Section 2.2).  The TPU rebuild needs ONE optimizer that fits a
+million independent small problems simultaneously, which means it must be:
+
+- jit-compatible: fixed iteration budget, ``lax.while_loop`` control flow;
+- vmap-compatible: every series carries its own state (history, step size,
+  converged flag) with identical static shapes;
+- autodiff-driven: gradients come from ``jax.grad`` of the CSS/likelihood
+  scan (the reference hand-derives them).
+
+This module implements L-BFGS (two-loop recursion, fixed-size history,
+Armijo backtracking line search).  BOBYQA has no JAX analog; bounded
+problems (GARCH/Holt-Winters positivity) use parameter transforms (sigmoid /
+softplus) and come through the same unconstrained path — SURVEY.md Section 7
+"hard parts".
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class LBFGSResult(NamedTuple):
+    x: jax.Array  # [d] solution
+    f: jax.Array  # [] final objective
+    converged: jax.Array  # [] bool: grad-norm tolerance reached
+    iters: jax.Array  # [] iterations actually taken
+    grad_norm: jax.Array  # [] final gradient norm
+
+
+class _State(NamedTuple):
+    k: jax.Array
+    x: jax.Array
+    f: jax.Array
+    g: jax.Array
+    s_hist: jax.Array  # [m, d]
+    y_hist: jax.Array  # [m, d]
+    rho_hist: jax.Array  # [m]
+    converged: jax.Array
+    failed: jax.Array  # line search broke down
+
+
+def _two_loop(g, s_hist, y_hist, rho_hist, k, m):
+    """L-BFGS two-loop recursion with masked (not-yet-filled) history slots.
+
+    History is a ring buffer; slot ``i`` is valid when ``rho_hist[i] > 0``.
+    """
+    idx = (k - 1 - jnp.arange(m)) % m  # newest -> oldest
+
+    def bwd(q, i):
+        valid = rho_hist[i] > 0.0
+        alpha = jnp.where(valid, rho_hist[i] * jnp.dot(s_hist[i], q), 0.0)
+        q = q - alpha * y_hist[i] * valid
+        return q, alpha
+
+    q, alphas = lax.scan(bwd, g, idx)
+
+    # initial Hessian scaling gamma = s·y / y·y of the newest valid pair
+    newest = idx[0]
+    sy = jnp.dot(s_hist[newest], y_hist[newest])
+    yy = jnp.dot(y_hist[newest], y_hist[newest])
+    gamma = jnp.where((rho_hist[newest] > 0.0) & (yy > 0.0), sy / yy, 1.0)
+    r = gamma * q
+
+    def fwd(r, inp):
+        i, alpha = inp
+        valid = rho_hist[i] > 0.0
+        beta = jnp.where(valid, rho_hist[i] * jnp.dot(y_hist[i], r), 0.0)
+        r = r + (alpha - beta) * s_hist[i] * valid
+        return r, None
+
+    r, _ = lax.scan(fwd, r, (idx[::-1], alphas[::-1]))
+    return r  # approximates H g
+
+
+def minimize_lbfgs(
+    fun: Callable[[jax.Array], jax.Array],
+    x0: jax.Array,
+    *,
+    max_iters: int = 50,
+    history: int = 8,
+    tol: float = 1e-6,
+    max_linesearch: int = 20,
+    c1: float = 1e-4,
+) -> LBFGSResult:
+    """Minimize ``fun`` from ``x0`` with a fixed compute budget.
+
+    Designed for ``vmap``: all shapes static, all control flow ``lax``.
+    Non-finite objective values are treated as +inf by the line search, so
+    transformed-parameter models can guard invalid regions with ``jnp.where``.
+    """
+    d = x0.shape[0]
+    m = history
+    dtype = x0.dtype
+
+    value_and_grad = jax.value_and_grad(fun)
+
+    def safe_vg(x):
+        f, g = value_and_grad(x)
+        bad = ~jnp.isfinite(f) | ~jnp.all(jnp.isfinite(g))
+        return jnp.where(bad, jnp.inf, f), jnp.where(bad, 0.0, g)
+
+    f0, g0 = safe_vg(x0)
+    init = _State(
+        k=jnp.zeros((), jnp.int32),
+        x=x0,
+        f=f0,
+        g=g0,
+        s_hist=jnp.zeros((m, d), dtype),
+        y_hist=jnp.zeros((m, d), dtype),
+        rho_hist=jnp.zeros((m,), dtype),
+        converged=(jnp.linalg.norm(g0) < tol) & jnp.isfinite(f0),
+        failed=jnp.isinf(f0),
+    )
+
+    def linesearch(x, f, g, direction):
+        """Armijo backtracking: largest 0.5^j (j < max_linesearch) satisfying
+        f(x + t*dir) <= f + c1*t*g·dir.  Returns (t, ok)."""
+        gd = jnp.dot(g, direction)
+
+        def body(carry):
+            t, _, j = carry
+            fnew = fun(x + t * direction)
+            fnew = jnp.where(jnp.isfinite(fnew), fnew, jnp.inf)
+            ok = fnew <= f + c1 * t * gd
+            return jnp.where(ok, t, t * 0.5), ok, j + 1
+
+        def cond(carry):
+            t, ok, j = carry
+            return (~ok) & (j < max_linesearch)
+
+        t, ok, _ = lax.while_loop(
+            cond, body, (jnp.ones((), dtype), jnp.zeros((), bool), 0)
+        )
+        return t, ok
+
+    def step(state: _State) -> _State:
+        direction = -_two_loop(state.g, state.s_hist, state.y_hist, state.rho_hist, state.k, m)
+        # fall back to steepest descent if direction is not a descent direction
+        descent = jnp.dot(state.g, direction) < 0.0
+        direction = jnp.where(descent, direction, -state.g)
+
+        t, ok = linesearch(state.x, state.f, state.g, direction)
+        x_new = state.x + t * direction
+        f_new2, g_new = safe_vg(x_new)
+
+        s = x_new - state.x
+        y = g_new - state.g
+        sy = jnp.dot(s, y)
+        slot = state.k % m
+        good_pair = (sy > 1e-10) & ok
+        s_hist = state.s_hist.at[slot].set(jnp.where(good_pair, s, state.s_hist[slot]))
+        y_hist = state.y_hist.at[slot].set(jnp.where(good_pair, y, state.y_hist[slot]))
+        rho_hist = state.rho_hist.at[slot].set(
+            jnp.where(good_pair, 1.0 / jnp.maximum(sy, 1e-30), state.rho_hist[slot])
+        )
+
+        accept = ok & (f_new2 <= state.f)
+        x_out = jnp.where(accept, x_new, state.x)
+        f_out = jnp.where(accept, f_new2, state.f)
+        g_out = jnp.where(accept, g_new, state.g)
+        conv = jnp.linalg.norm(g_out) < tol * jnp.maximum(1.0, jnp.linalg.norm(x_out))
+        return _State(
+            k=state.k + 1,
+            x=x_out,
+            f=f_out,
+            g=g_out,
+            s_hist=jnp.where(accept, s_hist, state.s_hist),
+            y_hist=jnp.where(accept, y_hist, state.y_hist),
+            rho_hist=jnp.where(accept, rho_hist, state.rho_hist),
+            converged=conv,
+            failed=state.failed | (~ok & ~conv),
+        )
+
+    def cond(state: _State):
+        return (state.k < max_iters) & ~state.converged & ~state.failed
+
+    final = lax.while_loop(cond, step, init)
+    return LBFGSResult(
+        x=final.x,
+        f=final.f,
+        converged=final.converged & jnp.isfinite(final.f),
+        iters=final.k,
+        grad_norm=jnp.linalg.norm(final.g),
+    )
+
+
+def batched_minimize(
+    fun: Callable[[jax.Array, jax.Array], jax.Array],
+    x0: jax.Array,
+    data: jax.Array,
+    **kwargs,
+) -> LBFGSResult:
+    """vmap ``minimize_lbfgs`` over problems: ``fun(params[d], data_row)``.
+
+    ``x0``: ``[batch, d]`` initial points; ``data``: ``[batch, ...]`` per-
+    problem data (e.g. each series' observations).  This is the rebuild's
+    replacement for the reference's per-series optimizer loop: one XLA
+    computation fits every series at once.
+    """
+    solver = partial(minimize_lbfgs, **kwargs)
+    return jax.vmap(lambda x, row: solver(lambda p: fun(p, row), x))(x0, data)
+
+
+# -- bounded-parameter transforms (BOBYQA replacement) ----------------------
+
+
+def sigmoid_to_interval(u, lo, hi):
+    """Map R -> (lo, hi)."""
+    return lo + (hi - lo) * jax.nn.sigmoid(u)
+
+
+def interval_to_sigmoid(x, lo, hi):
+    """Inverse of :func:`sigmoid_to_interval` (x strictly inside)."""
+    p = (x - lo) / (hi - lo)
+    p = jnp.clip(p, 1e-7, 1 - 1e-7)
+    return jnp.log(p) - jnp.log1p(-p)
+
+
+def softplus_inverse(y):
+    return jnp.log(jnp.expm1(jnp.maximum(y, 1e-10)))

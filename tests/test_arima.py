@@ -1,0 +1,172 @@
+"""ARIMA tests: numpy CSS oracle, sample->fit parameter recovery, round trips.
+
+Mirrors the reference's ``ARIMASuite`` strategy (SURVEY.md Section 4):
+golden-value comparisons against an independent CPU oracle plus
+sample-then-fit property tests with seeded randomness.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.utils import optim
+
+
+def numpy_css_errors(params, yd, p, q, intercept):
+    """Independent scalar-loop oracle for the CSS recursion."""
+    i = int(intercept)
+    c = params[0] if intercept else 0.0
+    phi = params[i : i + p]
+    theta = params[i + p : i + p + q]
+    n = len(yd)
+    e = np.zeros(n)
+    for t in range(n):
+        pred = c
+        for k in range(1, p + 1):
+            pred += phi[k - 1] * (yd[t - k] if t - k >= 0 else 0.0)
+        for k in range(1, q + 1):
+            pred += theta[k - 1] * (e[t - k] if t - k >= 0 else 0.0)
+        e[t] = yd[t] - pred if t >= p else 0.0
+    return e
+
+
+def numpy_css_nll(params, yd, p, q, intercept):
+    e = numpy_css_errors(params, yd, p, q, intercept)
+    n_eff = len(yd) - p
+    css = float((e**2).sum())
+    s2 = css / n_eff
+    return 0.5 * n_eff * (np.log(2 * np.pi * s2) + 1.0)
+
+
+def gen_arma(key_seed, n, phi=(), theta=(), c=0.0, sigma=1.0, d=0):
+    rng = np.random.default_rng(key_seed)
+    p, q = len(phi), len(theta)
+    burn = 200
+    e = rng.normal(0, sigma, n + burn + d)
+    y = np.zeros(n + burn + d)
+    for t in range(n + burn + d):
+        y[t] = c + e[t]
+        for i in range(1, p + 1):
+            if t - i >= 0:
+                y[t] += phi[i - 1] * y[t - i]
+        for j in range(1, q + 1):
+            if t - j >= 0:
+                y[t] += theta[j - 1] * e[t - j]
+    y = y[burn:]
+    for _ in range(d):
+        y = np.cumsum(y)
+    return y
+
+
+class TestCSSOracle:
+    @pytest.mark.parametrize("p,q,intercept", [(1, 0, True), (1, 1, True), (2, 1, False), (0, 1, True)])
+    def test_nll_matches_numpy(self, p, q, intercept):
+        rng = np.random.default_rng(5)
+        yd = rng.normal(size=80)
+        k = int(intercept) + p + q
+        params = rng.normal(size=k) * 0.3
+        got = float(
+            arima.css_neg_loglik(jnp.asarray(params), jnp.asarray(yd), (p, 0, q), intercept)
+        )
+        exp = numpy_css_nll(params, yd, p, q, intercept)
+        np.testing.assert_allclose(got, exp, rtol=1e-10)
+
+    def test_gradient_matches_finite_diff(self):
+        rng = np.random.default_rng(6)
+        yd = jnp.asarray(rng.normal(size=60))
+        params = jnp.asarray([0.1, 0.5, 0.2])
+        g = jax.grad(lambda pr: arima.css_neg_loglik(pr, yd, (1, 0, 1), True))(params)
+        eps = 1e-6
+        for i in range(3):
+            up = params.at[i].add(eps)
+            dn = params.at[i].add(-eps)
+            fd = (
+                float(arima.css_neg_loglik(up, yd, (1, 0, 1), True))
+                - float(arima.css_neg_loglik(dn, yd, (1, 0, 1), True))
+            ) / (2 * eps)
+            np.testing.assert_allclose(float(g[i]), fd, rtol=1e-4)
+
+
+class TestFitRecovery:
+    def test_ar1_recovery(self):
+        y = gen_arma(1, 2000, phi=(0.7,), c=1.5)
+        res = arima.fit(jnp.asarray(y), (1, 0, 0))
+        c, phi1 = np.asarray(res.params)
+        assert abs(phi1 - 0.7) < 0.05
+        assert abs(c - 1.5) < 0.2
+        assert bool(res.converged)
+
+    def test_ma1_recovery(self):
+        y = gen_arma(2, 3000, theta=(0.6,))
+        res = arima.fit(jnp.asarray(y), (0, 0, 1))
+        theta1 = float(np.asarray(res.params)[1])
+        assert abs(theta1 - 0.6) < 0.06
+
+    def test_arima111_recovery(self):
+        y = gen_arma(3, 3000, phi=(0.5,), theta=(0.3,), d=1)
+        res = arima.fit(jnp.asarray(y), (1, 1, 1))
+        _, phi1, theta1 = np.asarray(res.params)
+        assert abs(phi1 - 0.5) < 0.1
+        assert abs(theta1 - 0.3) < 0.12
+
+    def test_batched_fit_matches_single(self):
+        ys = np.stack([gen_arma(s, 400, phi=(0.6,), c=0.5) for s in range(4)])
+        batch = arima.fit(jnp.asarray(ys), (1, 0, 0))
+        for i in range(4):
+            single = arima.fit(jnp.asarray(ys[i]), (1, 0, 0))
+            np.testing.assert_allclose(
+                np.asarray(batch.params[i]), np.asarray(single.params), rtol=1e-5, atol=1e-6
+            )
+
+    def test_fit_beats_hr_init(self):
+        y = gen_arma(4, 800, phi=(0.4,), theta=(0.4,))
+        hr = arima.fit(jnp.asarray(y), (1, 0, 1), method="hannan-rissanen")
+        mle = arima.fit(jnp.asarray(y), (1, 0, 1))
+        assert float(mle.neg_log_likelihood) <= float(hr.neg_log_likelihood) + 1e-9
+
+    def test_sample_then_fit(self):
+        params = jnp.asarray([0.0, 0.65, 0.25])
+        y = arima.sample(params, jax.random.PRNGKey(0), 4000, (1, 0, 1))
+        res = arima.fit(y, (1, 0, 1))
+        got = np.asarray(res.params)
+        assert abs(got[1] - 0.65) < 0.08
+        assert abs(got[2] - 0.25) < 0.1
+
+
+class TestForecastEffects:
+    def test_forecast_ar1_converges_to_mean(self):
+        params = jnp.asarray([2.0, 0.5])  # mean = c/(1-phi) = 4
+        y = gen_arma(7, 500, phi=(0.5,), c=2.0)
+        fc = arima.forecast(params, jnp.asarray(y), (1, 0, 0), 60)
+        assert fc.shape == (60,)
+        np.testing.assert_allclose(float(fc[-1]), 4.0, atol=0.05)
+
+    def test_forecast_arima_d1_continues_level(self):
+        params = jnp.asarray([0.0, 0.0, 0.0])
+        y = jnp.asarray(np.linspace(0, 10, 50))  # pure trend, diff is constant
+        fc = arima.forecast(params, y, (1, 1, 1), 5)
+        # with zero AR/MA the first differenced forecast is c=0 -> flat level
+        np.testing.assert_allclose(np.asarray(fc), 10.0, atol=1e-6)
+
+    def test_remove_add_roundtrip(self):
+        for order, k in [((1, 0, 1), 3), ((2, 1, 1), 4), ((1, 2, 0), 2), ((0, 0, 2), 3)]:
+            rng = np.random.default_rng(8)
+            params = jnp.asarray(rng.normal(size=k) * 0.3)
+            y = jnp.asarray(rng.normal(size=40).cumsum())
+            x = arima.remove_time_dependent_effects(params, y, order)
+            back = arima.add_time_dependent_effects(params, x, order)
+            np.testing.assert_allclose(np.asarray(back), np.asarray(y), atol=1e-8)
+
+    def test_stationarity_invertibility(self):
+        assert bool(arima.is_stationary(np.array([0.0, 0.5]), (1, 0, 0)))
+        assert not bool(arima.is_stationary(np.array([0.0, 1.1]), (1, 0, 0)))
+        assert bool(arima.is_invertible(np.array([0.0, 0.5]), (0, 0, 1)))
+        assert not bool(arima.is_invertible(np.array([0.0, -1.2]), (0, 0, 1)))
+
+    def test_aic(self):
+        y = gen_arma(9, 500, phi=(0.5,))
+        res = arima.fit(jnp.asarray(y), (1, 0, 0))
+        aic = float(arima.approx_aic(res.params, jnp.asarray(y), (1, 0, 0), True))
+        assert np.isfinite(aic)

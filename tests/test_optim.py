@@ -1,0 +1,64 @@
+"""Optimizer tests: convergence on classic problems, batched via vmap."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from spark_timeseries_tpu.utils import optim
+
+
+class TestLBFGS:
+    def test_quadratic(self):
+        A = jnp.asarray(np.diag([1.0, 10.0, 100.0]))
+        b = jnp.asarray([1.0, -2.0, 3.0])
+        res = optim.minimize_lbfgs(lambda x: 0.5 * x @ A @ x - b @ x, jnp.zeros(3))
+        np.testing.assert_allclose(np.asarray(res.x), np.linalg.solve(np.asarray(A), b), atol=1e-5)
+        assert bool(res.converged)
+
+    def test_rosenbrock(self):
+        def rosen(x):
+            return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1.0 - x[:-1]) ** 2)
+
+        res = optim.minimize_lbfgs(rosen, jnp.zeros(4), max_iters=200)
+        np.testing.assert_allclose(np.asarray(res.x), np.ones(4), atol=1e-4)
+
+    def test_vs_scipy(self):
+        from scipy.optimize import minimize as sp_minimize
+
+        def f_np(x):
+            return float(np.sum((x - np.array([3.0, -1.0])) ** 4) + np.sum(x**2))
+
+        def f_jnp(x):
+            return jnp.sum((x - jnp.asarray([3.0, -1.0])) ** 4) + jnp.sum(x**2)
+
+        sp = sp_minimize(f_np, np.zeros(2), method="L-BFGS-B")
+        res = optim.minimize_lbfgs(f_jnp, jnp.zeros(2), max_iters=100, tol=1e-8)
+        np.testing.assert_allclose(np.asarray(res.x), sp.x, atol=1e-3)
+
+    def test_batched_independent_problems(self):
+        # each row solves min (x - target_i)^2 with its own target
+        targets = jnp.asarray(np.arange(6.0).reshape(6, 1))
+        res = optim.batched_minimize(
+            lambda x, t: jnp.sum((x - t) ** 2),
+            jnp.zeros((6, 1)),
+            targets,
+        )
+        np.testing.assert_allclose(np.asarray(res.x), np.asarray(targets), atol=1e-6)
+        assert bool(jnp.all(res.converged))
+
+    def test_nonfinite_guard(self):
+        # objective returns NaN away from a basin: solver must not blow up
+        def f(x):
+            v = jnp.sum(x**2)
+            return jnp.where(v < 100.0, v + jnp.sum(jnp.log(x + 10.0)), jnp.nan)
+
+        res = optim.minimize_lbfgs(f, jnp.asarray([5.0]), max_iters=60)
+        assert bool(jnp.isfinite(res.f))
+
+    def test_interval_transforms(self):
+        u = jnp.linspace(-5, 5, 11)
+        x = optim.sigmoid_to_interval(u, 0.1, 0.9)
+        assert float(x.min()) > 0.1 and float(x.max()) < 0.9
+        back = optim.interval_to_sigmoid(x, 0.1, 0.9)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(u), atol=1e-5)

@@ -1,0 +1,239 @@
+"""L3 panel tests: ingest, transforms, exits, persistence, and sharding.
+
+The sharded cases mirror the reference's ``TimeSeriesRDDSuite`` run on Spark
+``local[n]`` (SURVEY.md Section 4) — here an 8-device forced-CPU mesh stands
+in for the cluster.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+import jax
+import jax.numpy as jnp
+
+import spark_timeseries_tpu as sts
+from spark_timeseries_tpu import index as dtix
+from spark_timeseries_tpu.ops import univariate as uv
+from spark_timeseries_tpu.parallel import mesh as meshlib
+
+nan = np.nan
+
+
+@pytest.fixture
+def small_panel():
+    ix = dtix.uniform("2020-01-01", 6, dtix.DayFrequency(1))
+    return sts.from_series_dict(
+        {
+            "a": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0],
+            "b": [nan, 20.0, nan, 40.0, 50.0, nan],
+            "c": [9.0, 8.0, 7.0, 6.0, 5.0, 4.0],
+        },
+        ix,
+        dtype=jnp.float64,
+    )
+
+
+class TestIngest:
+    def test_from_observations(self):
+        ix = dtix.uniform("2020-01-01", 4, dtix.DayFrequency(1))
+        p = sts.from_observations(
+            ix,
+            keys=["x", "y", "x", "y", "x"],
+            timestamps=["2020-01-01", "2020-01-01", "2020-01-03", "2020-01-04", "2020-01-04"],
+            values=[1.0, 10.0, 3.0, 40.0, 4.0],
+            dtype=jnp.float64,
+        )
+        assert p.n_series == 2 and p.n_time == 4
+        x = np.asarray(p["x"])
+        np.testing.assert_array_equal(x, [1.0, nan, 3.0, 4.0])
+        y = np.asarray(p["y"])
+        np.testing.assert_array_equal(y, [10.0, nan, nan, 40.0])
+
+    def test_from_observations_off_index(self):
+        ix = dtix.uniform("2020-01-01", 3, dtix.DayFrequency(1))
+        p = sts.from_observations(ix, ["x", "x"], ["2020-01-02", "2020-06-09"], [2.0, 99.0])
+        np.testing.assert_array_equal(np.asarray(p["x"]), [nan, 2.0, nan])
+        with pytest.raises(ValueError):
+            sts.from_observations(
+                ix, ["x"], ["2020-06-09"], [99.0], strict=True
+            )
+
+    def test_from_dataframe_roundtrip(self, small_panel):
+        df = small_panel.to_observations_dataframe()
+        back = sts.from_dataframe(df, small_panel.index, dtype=jnp.float64)
+        np.testing.assert_array_equal(
+            np.asarray(back.series_values()), np.asarray(small_panel.series_values())
+        )
+        assert list(back.keys) == list(small_panel.keys)
+
+
+class TestTransforms:
+    def test_fill_linear(self, small_panel):
+        filled = small_panel.fill("linear")
+        b = np.asarray(filled["b"])
+        np.testing.assert_allclose(b[:5], [nan, 20.0, 30.0, 40.0, 50.0][:5])
+        assert np.isnan(b[0]) and np.isnan(b[5])
+
+    def test_differences_matches_kernel(self, small_panel):
+        d = small_panel.differences(1)
+        np.testing.assert_allclose(np.asarray(d["a"])[1:], 1.0)
+        assert d.index == small_panel.index
+
+    def test_return_rates(self, small_panel):
+        r = small_panel.return_rates()
+        np.testing.assert_allclose(np.asarray(r["a"])[1], 1.0)  # 1->2 is +100%
+
+    def test_map_series_shape_guard(self, small_panel):
+        with pytest.raises(ValueError):
+            small_panel.map_series(lambda v: v[:-1])  # shrank without new_index
+
+    def test_map_series_new_index(self, small_panel):
+        new_ix = small_panel.index.islice(1, 6)
+        out = small_panel.map_series(lambda v: v[1:], new_index=new_ix)
+        assert out.n_time == 5
+        np.testing.assert_array_equal(np.asarray(out["a"]), [2, 3, 4, 5, 6])
+
+    def test_slice(self, small_panel):
+        sub = small_panel.slice("2020-01-02", "2020-01-04")
+        assert sub.n_time == 3
+        np.testing.assert_array_equal(np.asarray(sub["a"]), [2, 3, 4])
+
+    def test_with_index_reindex(self, small_panel):
+        big = dtix.uniform("2019-12-30", 10, dtix.DayFrequency(1))
+        out = small_panel.with_index(big)
+        a = np.asarray(out["a"])
+        assert np.isnan(a[0]) and np.isnan(a[1])
+        np.testing.assert_array_equal(a[2:8], [1, 2, 3, 4, 5, 6])
+
+    def test_remove_instants_with_nans(self, small_panel):
+        out = small_panel.remove_instants_with_nans()
+        assert out.n_time == 3  # cols 1, 3, 4 have no NaN
+        np.testing.assert_array_equal(np.asarray(out["a"]), [2, 4, 5])
+        assert isinstance(out.index, dtix.IrregularDateTimeIndex)
+
+
+class TestKeyOps:
+    def test_filter_select(self, small_panel):
+        sub = small_panel.filter_keys(lambda k: k != "b")
+        assert list(sub.keys) == ["a", "c"]
+        sel = small_panel.select(["c", "a"])
+        assert list(sel.keys) == ["c", "a"]
+        np.testing.assert_array_equal(np.asarray(sel.series_values()[0]), np.asarray(small_panel["c"]))
+        with pytest.raises(KeyError):
+            small_panel.select(["zz"])
+
+    def test_filter_starting_ending(self, small_panel):
+        # b starts at Jan 2 and ends Jan 5
+        before = small_panel.filter_starting_before("2020-01-01")
+        assert list(before.keys) == ["a", "c"]
+        after = small_panel.filter_ending_after("2020-01-06")
+        assert list(after.keys) == ["a", "c"]
+
+    def test_union(self, small_panel):
+        other = sts.from_series_dict(
+            {"d": [0.0] * 6}, small_panel.index, dtype=jnp.float64
+        )
+        u = small_panel.union(other)
+        assert list(u.keys) == ["a", "b", "c", "d"]
+        assert u.n_series == 4
+
+
+class TestExits:
+    def test_series_stats(self, small_panel):
+        st = small_panel.series_stats()
+        np.testing.assert_allclose(np.asarray(st["mean"])[0], 3.5)
+        np.testing.assert_allclose(np.asarray(st["count"])[1], 3)
+        np.testing.assert_allclose(np.asarray(st["min"])[2], 4.0)
+        np.testing.assert_allclose(
+            np.asarray(st["stdev"])[0], np.std([1, 2, 3, 4, 5, 6], ddof=1)
+        )
+
+    def test_to_instants(self, small_panel):
+        dts, vals = small_panel.to_instants()
+        assert vals.shape == (6, 3)
+        np.testing.assert_array_equal(np.asarray(vals[:, 0]), np.asarray(small_panel["a"]))
+        assert dts[0] == np.datetime64("2020-01-01")
+
+    def test_to_instants_dataframe(self, small_panel):
+        df = small_panel.to_instants_dataframe()
+        assert list(df.columns) == ["a", "b", "c"]
+        assert df.shape == (6, 3)
+        assert df.iloc[3]["b"] == 40.0
+
+    def test_to_pandas(self, small_panel):
+        df = small_panel.to_pandas()
+        assert df.shape == (3, 6)
+        assert df.loc["a"].iloc[0] == 1.0
+
+
+class TestPersistence:
+    def test_csv_roundtrip(self, small_panel, tmp_path):
+        path = str(tmp_path / "panel.csv")
+        small_panel.save_csv(path)
+        back = sts.TimeSeriesPanel.load_csv(path)
+        assert back.index == small_panel.index
+        assert list(back.keys) == list(small_panel.keys)
+        np.testing.assert_allclose(
+            np.asarray(back.series_values()),
+            np.asarray(small_panel.series_values()),
+            equal_nan=True,
+        )
+
+    def test_npz_roundtrip(self, small_panel, tmp_path):
+        path = str(tmp_path / "panel.npz")
+        small_panel.save(path)
+        back = sts.TimeSeriesPanel.load(path)
+        assert back.index == small_panel.index
+        np.testing.assert_allclose(
+            np.asarray(back.series_values()),
+            np.asarray(small_panel.series_values()),
+            equal_nan=True,
+        )
+
+
+class TestSharded:
+    """The Spark-local[n] analog: everything again on an 8-device CPU mesh."""
+
+    @pytest.fixture
+    def mesh(self, cpu_devices):
+        return meshlib.default_mesh()
+
+    @pytest.fixture
+    def sharded_panel(self, mesh):
+        rng = np.random.default_rng(7)
+        vals = rng.normal(size=(21, 50)).cumsum(axis=1)  # 21 series pad to 24
+        vals[3, 7] = nan
+        ix = dtix.uniform("2021-01-04", 50, dtix.BusinessDayFrequency(1))
+        return sts.TimeSeriesPanel(ix, [f"s{i}" for i in range(21)], jnp.asarray(vals), mesh=mesh)
+
+    def test_padding_and_sharding(self, sharded_panel, mesh):
+        assert sharded_panel.values.shape[0] == 24  # padded to multiple of 8
+        assert sharded_panel.n_series == 21
+        shard_shapes = {s.data.shape for s in sharded_panel.values.addressable_shards}
+        assert shard_shapes == {(3, 50)}
+
+    def test_map_series_stays_sharded(self, sharded_panel):
+        filled = sharded_panel.fill("linear")
+        assert filled.values.sharding.spec[0] == meshlib.SERIES_AXIS
+        assert not np.isnan(np.asarray(filled.values[3, 7]))
+
+    def test_sharded_matches_unsharded(self, sharded_panel):
+        unsharded = sharded_panel.with_mesh(None)
+        a = np.asarray(sharded_panel.differences(2).series_values())
+        b = np.asarray(unsharded.differences(2).series_values())
+        np.testing.assert_allclose(a, b, equal_nan=True)
+        sa = sharded_panel.series_stats()
+        sb = unsharded.series_stats()
+        np.testing.assert_allclose(np.asarray(sa["mean"]), np.asarray(sb["mean"]), rtol=1e-12)
+
+    def test_transpose_to_instants(self, sharded_panel):
+        dts, vals = sharded_panel.to_instants()
+        assert vals.shape == (50, 21)
+        np.testing.assert_allclose(
+            np.asarray(vals[:, 5]), np.asarray(sharded_panel["s5"]), rtol=1e-12
+        )
+
+    def test_autocorr_sharded(self, sharded_panel):
+        acf = sharded_panel.fill("linear").autocorr(3)
+        assert acf.shape == (21, 3)
+        assert np.median(np.asarray(acf[:, 0])) > 0.7  # random walks: high lag-1
