@@ -1,0 +1,85 @@
+"""North-star benchmark: batched ARIMA(1,1,1) CSS-MLE fit throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no benchmark numbers (BASELINE.md), so
+``vs_baseline`` is reported against the project's north-star target of
+100,000 series/sec (ARIMA(1,1,1) fit, 1k observations/series, TPU v5e —
+BASELINE.json): ``vs_baseline = value / 100_000``.
+
+Sizing adapts to the backend: full batch on TPU, small on CPU smoke runs.
+Steady-state timing (compile excluded; best of 3 timed runs).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+
+    # keep TPU runtime ~1 min: compile once, fit BATCH series of length T
+    batch = 65536 if on_tpu else 256
+    T = 1000
+    order = (1, 1, 1)
+    max_iters = 20
+
+    from spark_timeseries_tpu.models import arima
+    from spark_timeseries_tpu.utils import optim
+
+    rng = np.random.default_rng(0)
+    e = rng.normal(size=(batch, T)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for t in range(1, T):
+        y[:, t] = 0.6 * y[:, t - 1] + e[:, t] + 0.3 * e[:, t - 1]
+    y = jnp.asarray(np.cumsum(y, axis=1))
+
+    @jax.jit
+    def fit_step(y):
+        yd = jax.vmap(lambda v: v[1:] - v[:-1])(y)
+        init = jax.vmap(lambda v: arima.hannan_rissanen(v, order, True))(yd)
+        res = optim.batched_minimize(
+            lambda pr, v: arima.css_neg_loglik(pr, v, order, True),
+            init,
+            yd,
+            max_iters=max_iters,
+            tol=1e-4,
+        )
+        return res.x, res.converged
+
+    # compile + warm up
+    params, conv = fit_step(y)
+    params.block_until_ready()
+    frac_conv = float(jnp.mean(conv))
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        params, conv = fit_step(y)
+        params.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+
+    series_per_sec = batch / best
+    print(
+        json.dumps(
+            {
+                "metric": f"ARIMA(1,1,1) CSS-MLE fit throughput ({T} obs/series, "
+                f"batch {batch}, {platform}, converged {frac_conv:.2f})",
+                "value": round(series_per_sec, 1),
+                "unit": "series/sec",
+                "vs_baseline": round(series_per_sec / 100_000.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,5 +40,6 @@ from .panel import (
 )
 from . import parallel
 from .parallel import default_mesh
+from . import models
 
 __version__ = "0.1.0"

@@ -1,4 +1,12 @@
-from . import arima
+from . import arima, autoregression, ewma, garch, holtwinters, regression_arima
 from .base import FitResult
 
-__all__ = ["arima", "FitResult"]
+__all__ = [
+    "arima",
+    "autoregression",
+    "ewma",
+    "garch",
+    "holtwinters",
+    "regression_arima",
+    "FitResult",
+]

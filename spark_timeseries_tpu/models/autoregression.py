@@ -1,0 +1,67 @@
+"""AR(p) by ordinary least squares (L4).
+
+Rebuild of the reference's ``sparkts/models/Autoregression.scala``
+(SURVEY.md Section 2.2, upstream path unverified): lag-matrix OLS — no
+iterative optimizer.  Batched here as one normal-equations solve per series,
+vmapped over the panel (MXU matmuls).
+
+Parameter layout matches ARIMA: ``[c, phi_1..phi_p]`` (c = 0 when
+``no_intercept``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.lagmat import lag_mat_trim_both
+from . import arima as _arima
+from ..utils.linalg import ols as _ols
+from .base import FitResult, debatch, ensure_batched
+
+
+def fit(y, max_lag: int = 1, no_intercept: bool = False) -> FitResult:
+    """OLS fit of y_t on [1?, y_{t-1} .. y_{t-max_lag}]."""
+    yb, single = ensure_batched(y)
+
+    @jax.jit
+    def run(yb):
+        def one(yv):
+            X = lag_mat_trim_both(yv, max_lag)  # [n - p, p]
+            target = yv[max_lag:]
+            if not no_intercept:
+                X = jnp.concatenate([jnp.ones((X.shape[0], 1), yv.dtype), X], axis=1)
+            beta = _ols(X, target)
+            if no_intercept:
+                beta = jnp.concatenate([jnp.zeros((1,), yv.dtype), beta])
+            resid = target - X @ (beta[1:] if no_intercept else beta)
+            n = target.shape[0]
+            sigma2 = jnp.sum(resid**2) / n
+            nll = 0.5 * n * (jnp.log(2.0 * jnp.pi * sigma2) + 1.0)
+            return beta, nll
+
+        params, nll = jax.vmap(one)(yb)
+        b = yb.shape[0]
+        return FitResult(
+            params, nll, jnp.ones((b,), bool), jnp.zeros((b,), jnp.int32)
+        )
+
+    return debatch(run(yb), single)
+
+
+def forecast(params, y, max_lag: int, n_future: int):
+    """Iterate the AR recursion forward (ARIMA(p,0,0) forecast)."""
+    return _arima.forecast(params, y, (max_lag, 0, 0), n_future)
+
+
+def sample(params, key, n: int, max_lag: int, sigma: float = 1.0):
+    return _arima.sample(params, key, n, (max_lag, 0, 0), sigma=sigma)
+
+
+def remove_time_dependent_effects(params, y, max_lag: int):
+    """Series -> innovations: e_t = y_t - c - sum phi_i y_{t-i}."""
+    return _arima.remove_time_dependent_effects(params, y, (max_lag, 0, 0))
+
+
+def add_time_dependent_effects(params, x, max_lag: int):
+    return _arima.add_time_dependent_effects(params, x, (max_lag, 0, 0))

@@ -1,0 +1,95 @@
+"""EWMA — exponentially weighted moving average smoothing (L4).
+
+Rebuild of the reference's ``sparkts/models/EWMA.scala`` (SURVEY.md
+Section 2.2, upstream path unverified): smoothing recursion
+``s_t = alpha * x_t + (1 - alpha) * s_{t-1}`` with ``alpha`` fitted by
+minimizing the one-step-ahead SSE.  The reference runs a Commons-Math
+gradient optimizer per series; here the SSE is a ``lax.scan`` and a sigmoid
+transform keeps ``alpha`` in (0, 1) through the shared vmapped L-BFGS.
+
+Parameter layout: ``[alpha]``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils import optim
+from .base import FitResult, debatch, ensure_batched
+
+
+def smooth(alpha, x):
+    """The EWMA recursion (``addTimeDependentEffects``): s_0 = x_0."""
+
+    def step(s, xt):
+        s = alpha * xt + (1.0 - alpha) * s
+        return s, s
+
+    _, out = lax.scan(step, x[0], x)
+    return out
+
+
+def unsmooth(alpha, s):
+    """Invert :func:`smooth`: x_t = (s_t - (1-alpha) s_{t-1}) / alpha
+    (``removeTimeDependentEffects``)."""
+    prev = jnp.concatenate([s[:1], s[:-1]])
+    x = (s - (1.0 - alpha) * prev) / alpha
+    return x.at[0].set(s[0])
+
+
+def sse(alpha, x):
+    """One-step-ahead squared error: sum_t (x_t - s_{t-1})^2 for t >= 1."""
+    s = smooth(alpha, x)
+    err = x[1:] - s[:-1]
+    return jnp.sum(err * err)
+
+
+def fit(y, *, max_iters: int = 40, tol: Optional[float] = None) -> FitResult:
+    """Fit ``alpha`` per series by SSE minimization -> params ``[batch?, 1]``."""
+    yb, single = ensure_batched(y)
+    if tol is None:
+        tol = 1e-8 if yb.dtype == jnp.float64 else 1e-4
+
+    @jax.jit
+    def run(yb):
+        def objective(u, x):
+            return sse(optim.sigmoid_to_interval(u[0], 0.0, 1.0), x)
+
+        u0 = jnp.zeros((yb.shape[0], 1), yb.dtype)
+        res = optim.batched_minimize(objective, u0, yb, max_iters=max_iters, tol=tol)
+        alpha = optim.sigmoid_to_interval(res.x, 0.0, 1.0)
+        return FitResult(alpha, res.f, res.converged, res.iters)
+
+    return debatch(run(yb), single)
+
+
+def forecast(params, y, n_future: int):
+    """EWMA forecasts are flat at the last smoothed level."""
+    yb, single = ensure_batched(y)
+    pb = jnp.atleast_2d(params)
+
+    @jax.jit
+    def run(pb, yb):
+        last = jax.vmap(lambda a, x: smooth(a[0], x)[-1])(pb, yb)
+        return jnp.broadcast_to(last[:, None], (yb.shape[0], n_future))
+
+    out = run(pb, yb)
+    return out[0] if single else out
+
+
+def add_time_dependent_effects(params, x):
+    xb, single = ensure_batched(x)
+    pb = jnp.atleast_2d(params)
+    out = jax.jit(jax.vmap(lambda a, v: smooth(a[0], v)))(pb, xb)
+    return out[0] if single else out
+
+
+def remove_time_dependent_effects(params, s):
+    sb, single = ensure_batched(s)
+    pb = jnp.atleast_2d(params)
+    out = jax.jit(jax.vmap(lambda a, v: unsmooth(a[0], v)))(pb, sb)
+    return out[0] if single else out

@@ -1,0 +1,252 @@
+"""GARCH(1,1) and AR(1)+GARCH(1,1) volatility models (L4).
+
+Rebuild of the reference's ``sparkts/models/GARCH.scala`` (SURVEY.md
+Section 2.2, upstream path unverified).  Variance recursion
+``h_t = omega + alpha * r_{t-1}^2 + beta * h_{t-1}`` with Gaussian
+log-likelihood; the reference maximizes per series with Commons-Math
+gradient ascent/CG.  Here the likelihood is a ``lax.scan`` and the
+constraints (omega > 0, alpha, beta >= 0, alpha + beta < 1) are enforced by
+a softplus/sigmoid reparameterization through the shared vmapped L-BFGS
+(the BOBYQA-replacement strategy, SURVEY.md Section 7).
+
+Parameter layouts (natural space):
+- GARCH:   ``[omega, alpha, beta]``
+- ARGARCH: ``[c, phi, omega, alpha, beta]``
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils import optim
+from .base import FitResult, debatch, ensure_batched
+
+
+# -- transforms -------------------------------------------------------------
+
+
+def _to_natural(u):
+    """R^3 -> constrained (omega, alpha, beta)."""
+    omega = jax.nn.softplus(u[0]) + 1e-12
+    persistence = jax.nn.sigmoid(u[1]) * (1.0 - 1e-6)  # alpha + beta
+    frac = jax.nn.sigmoid(u[2])  # alpha share
+    alpha = persistence * frac
+    beta = persistence * (1.0 - frac)
+    return jnp.stack([omega, alpha, beta])
+
+
+def _from_natural(params):
+    omega, alpha, beta = params[0], params[1], params[2]
+    u0 = optim.softplus_inverse(omega)
+    pers = jnp.clip(alpha + beta, 1e-6, 1.0 - 1e-6)
+    u1 = optim.interval_to_sigmoid(pers, 0.0, 1.0)
+    u2 = optim.interval_to_sigmoid(alpha / pers, 0.0, 1.0)
+    return jnp.stack([u0, u1, u2])
+
+
+# -- likelihood -------------------------------------------------------------
+
+
+def _variance_scan(params, h0, r_sq_prev):
+    """The single GARCH recursion used everywhere:
+    h_t = omega + alpha * r_sq_prev_t + beta * h_{t-1}."""
+    omega, alpha, beta = params[0], params[1], params[2]
+
+    def step(h, rt_prev_sq):
+        h = omega + alpha * rt_prev_sq + beta * h
+        return h, h
+
+    _, h = lax.scan(step, h0, r_sq_prev)
+    return h
+
+
+def _unconditional_var(params):
+    return params[0] / jnp.maximum(1.0 - params[1] - params[2], 1e-6)
+
+
+def variances(params, r):
+    """Conditional variances h_t (h_0 = sample variance of r, which also
+    stands in for the unobserved r_{-1}^2)."""
+    h0 = jnp.var(r)
+    return _variance_scan(params, h0, jnp.concatenate([h0[None], r[:-1] ** 2]))
+
+
+def log_likelihood(params, r):
+    """Gaussian log-likelihood of returns under the variance recursion."""
+    h = variances(params, r)
+    h = jnp.maximum(h, 1e-12)
+    return -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * h) + (r * r) / h)
+
+
+def neg_log_likelihood(params, r):
+    return -log_likelihood(params, r)
+
+
+# -- fitting ----------------------------------------------------------------
+
+
+def fit(r, *, max_iters: int = 80, tol: Optional[float] = None) -> FitResult:
+    """Fit GARCH(1,1) per series -> natural params ``[batch?, 3]``."""
+    rb, single = ensure_batched(r)
+    if tol is None:
+        tol = 1e-7 if rb.dtype == jnp.float64 else 1e-4
+
+    @jax.jit
+    def run(rb):
+        def objective(u, rv):
+            return neg_log_likelihood(_to_natural(u), rv)
+
+        # moment-ish start: omega = 0.1*var, alpha=0.1, beta=0.8
+        var0 = jnp.var(rb, axis=1)
+        nat0 = jnp.stack(
+            [0.1 * var0, jnp.full_like(var0, 0.1), jnp.full_like(var0, 0.8)], axis=1
+        )
+        u0 = jax.vmap(_from_natural)(nat0)
+        res = optim.batched_minimize(objective, u0, rb, max_iters=max_iters, tol=tol)
+        return FitResult(jax.vmap(_to_natural)(res.x), res.f, res.converged, res.iters)
+
+    return debatch(run(rb), single)
+
+
+def sample(params, key, n: int):
+    """Simulate n returns from GARCH(1,1) (reference ``GARCHModel.sample``):
+    standard-normal innovations through :func:`add_time_dependent_effects`."""
+    params = jnp.asarray(params, jnp.result_type(float))
+    eps = jax.random.normal(key, (n,), params.dtype)
+    return add_time_dependent_effects(params, eps)
+
+
+def add_time_dependent_effects(params, x):
+    """White noise -> GARCH returns: scale by the running conditional vol.
+
+    The recursion needs r_{t-1}, which it itself produces, so this scan
+    carries (h, r_prev); the variance path it induces is exactly
+    ``_variance_scan`` seeded with r_prev = 0 and h_0 = the unconditional
+    variance — which is what :func:`remove_time_dependent_effects` replays.
+    """
+    xb, single = ensure_batched(x)
+    pb = jnp.atleast_2d(params)
+
+    @jax.jit
+    def run(pb, xb):
+        def one(pr, xv):
+            omega, alpha, beta = pr[0], pr[1], pr[2]
+
+            def step(carry, e):
+                h, r_prev = carry
+                h = omega + alpha * r_prev**2 + beta * h
+                r = jnp.sqrt(jnp.maximum(h, 1e-12)) * e
+                return (h, r), r
+
+            _, r = lax.scan(step, (_unconditional_var(pr), jnp.zeros((), xv.dtype)), xv)
+            return r
+
+        return jax.vmap(one)(pb, xb)
+
+    out = run(pb, xb)
+    return out[0] if single else out
+
+
+def remove_time_dependent_effects(params, r):
+    """GARCH returns -> standardized residuals r_t / sqrt(h_t), replaying
+    :func:`add_time_dependent_effects`'s variance path so the pair
+    round-trips exactly."""
+    rb, single = ensure_batched(r)
+    pb = jnp.atleast_2d(params)
+
+    @jax.jit
+    def run(pb, rb):
+        def one(pr, rv):
+            r_sq_prev = jnp.concatenate([jnp.zeros((1,), rv.dtype), rv[:-1] ** 2])
+            h = _variance_scan(pr, _unconditional_var(pr), r_sq_prev)
+            return rv / jnp.sqrt(jnp.maximum(h, 1e-12))
+
+        return jax.vmap(one)(pb, rb)
+
+    out = run(pb, rb)
+    return out[0] if single else out
+
+
+# ---------------------------------------------------------------------------
+# AR(1) + GARCH(1,1)
+# ---------------------------------------------------------------------------
+
+
+def _argarch_to_natural(u):
+    return jnp.concatenate([u[:2], _to_natural(u[2:])])
+
+
+def _argarch_from_natural(params):
+    return jnp.concatenate([params[:2], _from_natural(params[2:])])
+
+
+def argarch_neg_log_likelihood(params, y):
+    """y_t = c + phi y_{t-1} + r_t with GARCH(1,1) innovations r."""
+    c, phi = params[0], params[1]
+    prev = jnp.concatenate([y[:1], y[:-1]])
+    r = y - c - phi * prev
+    r = r.at[0].set(0.0)  # condition on the first observation
+    return neg_log_likelihood(params[2:], r)
+
+
+def fit_argarch(y, *, max_iters: int = 100, tol: Optional[float] = None) -> FitResult:
+    """Fit AR(1)+GARCH(1,1) -> natural params ``[batch?, 5]``
+    (reference ``ARGARCH.fitModel``)."""
+    yb, single = ensure_batched(y)
+    if tol is None:
+        tol = 1e-7 if yb.dtype == jnp.float64 else 1e-4
+
+    @jax.jit
+    def run(yb):
+        def objective(u, yv):
+            return argarch_neg_log_likelihood(_argarch_to_natural(u), yv)
+
+        # init: OLS-ish AR(1) by autocorrelation, then GARCH moments on resid
+        mean = jnp.mean(yb, axis=1)
+        yc = yb - mean[:, None]
+        phi0 = jnp.sum(yc[:, 1:] * yc[:, :-1], axis=1) / jnp.maximum(
+            jnp.sum(yc * yc, axis=1), 1e-12
+        )
+        phi0 = jnp.clip(phi0, -0.95, 0.95)
+        c0 = mean * (1.0 - phi0)
+        resid_var = jnp.var(yb[:, 1:] - c0[:, None] - phi0[:, None] * yb[:, :-1], axis=1)
+        nat0 = jnp.stack(
+            [
+                c0,
+                phi0,
+                0.1 * jnp.maximum(resid_var, 1e-8),
+                jnp.full_like(c0, 0.1),
+                jnp.full_like(c0, 0.8),
+            ],
+            axis=1,
+        )
+        u0 = jax.vmap(_argarch_from_natural)(nat0)
+        res = optim.batched_minimize(objective, u0, yb, max_iters=max_iters, tol=tol)
+        return FitResult(
+            jax.vmap(_argarch_to_natural)(res.x), res.f, res.converged, res.iters
+        )
+
+    return debatch(run(yb), single)
+
+
+def argarch_sample(params, key, n: int):
+    """Simulate AR(1)+GARCH(1,1)."""
+
+    @jax.jit
+    def run(params, key):
+        params = jnp.asarray(params, jnp.result_type(float))
+        c, phi = params[0], params[1]
+        r = sample(params[2:], key, n)
+
+        def step(y_prev, rt):
+            y = c + phi * y_prev + rt
+            return y, y
+
+        _, y = lax.scan(step, c / jnp.maximum(1.0 - phi, 1e-6), r)
+        return y
+
+    return run(params, key)

@@ -1,0 +1,142 @@
+"""Holt-Winters triple exponential smoothing (L4).
+
+Rebuild of the reference's ``sparkts/models/HoltWinters.scala`` (SURVEY.md
+Section 2.2, upstream path unverified): additive and multiplicative
+seasonality with period ``m``; level/trend/seasonal start values taken from
+the first two seasons; ``(alpha, beta, gamma)`` fitted by minimizing the
+one-step-ahead SSE.  The reference uses BOBYQA per series; here the
+smoothing recursion is a ``lax.scan``, the (0,1) bounds are a sigmoid
+reparameterization, and the fit is the shared vmapped L-BFGS
+(SURVEY.md Section 7's BOBYQA-replacement strategy).
+
+Parameter layout (natural space): ``[alpha, beta, gamma]``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils import optim
+from .base import FitResult, debatch, ensure_batched
+
+
+def _init_state(y, period: int, multiplicative: bool):
+    """Start values from the first two seasons (upstream's scheme)."""
+    s1 = y[:period]
+    s2 = y[period : 2 * period]
+    level0 = jnp.mean(s1)
+    trend0 = (jnp.mean(s2) - jnp.mean(s1)) / period
+    if multiplicative:
+        seasonal0 = s1 / jnp.maximum(level0, 1e-12)
+    else:
+        seasonal0 = s1 - level0
+    return level0, trend0, seasonal0
+
+
+def _run(params, y, period: int, multiplicative: bool):
+    """Run the smoothing recursion; returns (one-step forecasts, final state).
+
+    forecasts[t] is the prediction of y[t] made at t-1 (for t >= period... the
+    first ``period`` entries predict using the seed state).
+    """
+    alpha, beta, gamma = params[0], params[1], params[2]
+    level0, trend0, seasonal0 = _init_state(y, period, multiplicative)
+
+    def step(carry, yt):
+        level, trend, seasonal = carry  # seasonal: [period], rotating
+        s = seasonal[0]
+        if multiplicative:
+            pred = (level + trend) * s
+            new_level = alpha * yt / jnp.maximum(s, 1e-12) + (1 - alpha) * (level + trend)
+            new_seasonal_last = gamma * yt / jnp.maximum(new_level, 1e-12) + (1 - gamma) * s
+        else:
+            pred = level + trend + s
+            new_level = alpha * (yt - s) + (1 - alpha) * (level + trend)
+            new_seasonal_last = gamma * (yt - new_level) + (1 - gamma) * s
+        new_trend = beta * (new_level - level) + (1 - beta) * trend
+        seasonal = jnp.concatenate([seasonal[1:], new_seasonal_last[None]])
+        return (new_level, new_trend, seasonal), pred
+
+    (level, trend, seasonal), preds = lax.scan(step, (level0, trend0, seasonal0), y)
+    return preds, (level, trend, seasonal)
+
+
+def sse(params, y, period: int, multiplicative: bool):
+    """One-step-ahead SSE, skipping the seeded first season."""
+    preds, _ = _run(params, y, period, multiplicative)
+    err = (y - preds)[period:]
+    return jnp.sum(err * err)
+
+
+def fit(
+    y,
+    period: int,
+    model_type: str = "additive",
+    *,
+    max_iters: int = 60,
+    tol: Optional[float] = None,
+) -> FitResult:
+    """Fit (alpha, beta, gamma) per series -> params ``[batch?, 3]``."""
+    if model_type not in ("additive", "multiplicative"):
+        raise ValueError(f"model_type must be additive|multiplicative, got {model_type!r}")
+    multiplicative = model_type == "multiplicative"
+    yb, single = ensure_batched(y)
+    if yb.shape[1] < 2 * period:
+        raise ValueError(
+            f"need at least two seasons ({2 * period} points), got {yb.shape[1]}"
+        )
+    if tol is None:
+        tol = 1e-7 if yb.dtype == jnp.float64 else 1e-4
+
+    @jax.jit
+    def run(yb):
+        def objective(u, yv):
+            nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
+            return sse(nat, yv, period, multiplicative)
+
+        nat0 = jnp.asarray([0.3, 0.1, 0.1], yb.dtype)
+        u0 = jnp.broadcast_to(
+            optim.interval_to_sigmoid(nat0, 0.0, 1.0), (yb.shape[0], 3)
+        )
+        res = optim.batched_minimize(objective, u0, yb, max_iters=max_iters, tol=tol)
+        return FitResult(
+            optim.sigmoid_to_interval(res.x, 0.0, 1.0), res.f, res.converged, res.iters
+        )
+
+    return debatch(run(yb), single)
+
+
+def forecast(params, y, period: int, n_future: int, model_type: str = "additive"):
+    """h-step-ahead forecasts from the end state:
+    additive: (level + h*trend) + seasonal; multiplicative: * seasonal."""
+    multiplicative = model_type == "multiplicative"
+    yb, single = ensure_batched(y)
+    pb = jnp.atleast_2d(params)
+
+    @jax.jit
+    def run(pb, yb):
+        def one(pr, yv):
+            _, (level, trend, seasonal) = _run(pr, yv, period, multiplicative)
+            h = jnp.arange(1, n_future + 1, dtype=yv.dtype)
+            seas = seasonal[(jnp.arange(n_future)) % period]
+            base = level + h * trend
+            return base * seas if multiplicative else base + seas
+
+        return jax.vmap(one)(pb, yb)
+
+    out = run(pb, yb)
+    return out[0] if single else out
+
+
+def fitted(params, y, period: int, model_type: str = "additive"):
+    """In-sample one-step-ahead predictions (``addTimeDependentEffects``
+    analog for diagnostics)."""
+    multiplicative = model_type == "multiplicative"
+    yb, single = ensure_batched(y)
+    pb = jnp.atleast_2d(params)
+    out = jax.jit(jax.vmap(lambda pr, yv: _run(pr, yv, period, multiplicative)[0]))(pb, yb)
+    return out[0] if single else out

@@ -1,0 +1,91 @@
+"""Regression with serially-correlated (AR) errors — Cochrane-Orcutt (L4).
+
+Rebuild of the reference's ``sparkts/models/RegressionARIMA.scala``
+(SURVEY.md Section 2.2, upstream path unverified): y = X beta + u with
+u_t = rho * u_{t-1} + e_t, estimated by the iterative Cochrane-Orcutt
+procedure.  The reference loops OLS -> AR(1)-on-residuals -> quasi-difference
+until rho converges; here each iteration is a batched normal-equations solve
+and the loop is a fixed-trip ``lax.fori_loop`` (vmapped over series).
+
+Result layout: ``params = [beta_0 .. beta_{k-1}, rho]`` where beta_0 is the
+intercept.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.linalg import ols as _ols
+from .base import FitResult, debatch
+
+
+def _design(X):
+    """Prepend an intercept column."""
+    return jnp.concatenate([jnp.ones((X.shape[0], 1), X.dtype), X], axis=1)
+
+
+def fit_cochrane_orcutt(y, X, *, max_iter: int = 10) -> FitResult:
+    """Fit y ``[batch?, n]`` on regressors X ``[batch?, n, k]``.
+
+    Returns ``params = [batch?, k+2]``: intercept, k slopes, rho.
+    """
+    y = jnp.asarray(y)
+    X = jnp.asarray(X)
+    single = y.ndim == 1
+    yb = y[None] if single else y
+    Xb = X[None] if single else X
+
+    @jax.jit
+    def run(yb, Xb):
+        def one(yv, Xv):
+            Xd = _design(Xv)  # [n, k+1]
+
+            def body(_, carry):
+                beta, rho = carry
+                u = yv - Xd @ beta
+                # AR(1) on residuals (no intercept)
+                rho = jnp.sum(u[1:] * u[:-1]) / jnp.maximum(jnp.sum(u[:-1] ** 2), 1e-12)
+                rho = jnp.clip(rho, -0.999, 0.999)
+                # quasi-difference transform and re-estimate beta
+                ys = yv[1:] - rho * yv[:-1]
+                Xs = Xd[1:] - rho * Xd[:-1]
+                # intercept column becomes (1 - rho); solve in transformed space
+                beta_t = _ols(Xs, ys)
+                # map intercept back: beta_0 = beta_t0 (Xs keeps scaled ones)
+                return beta_t, rho
+
+            beta0 = _ols(Xd, yv)
+            beta, rho = lax.fori_loop(
+                0, max_iter, body, (beta0, jnp.zeros((), yv.dtype))
+            )
+            u = yv - Xd @ beta
+            e = u[1:] - rho * u[:-1]
+            n = e.shape[0]
+            sigma2 = jnp.sum(e * e) / n
+            nll = 0.5 * n * (jnp.log(2.0 * jnp.pi * sigma2) + 1.0)
+            return jnp.concatenate([beta, rho[None]]), nll
+
+        params, nll = jax.vmap(one)(yb, Xb)
+        b = yb.shape[0]
+        return FitResult(params, nll, jnp.ones((b,), bool), jnp.full((b,), max_iter, jnp.int32))
+
+    return debatch(run(yb, Xb), single)
+
+
+def fit(y, X, method: str = "cochrane-orcutt", **kwargs) -> FitResult:
+    """Reference ``RegressionARIMA.fitModel`` dispatcher."""
+    if method not in ("cochrane-orcutt", "cochrane_orcutt"):
+        raise ValueError(f"unknown method {method!r} (supported: cochrane-orcutt)")
+    return fit_cochrane_orcutt(y, X, **kwargs)
+
+
+def predict(params, X):
+    """Regression part only: X ``[batch?, n, k]`` -> fitted values."""
+    X = jnp.asarray(X)
+    single = X.ndim == 2
+    Xb = X[None] if single else X
+    pb = jnp.atleast_2d(params)
+    out = jax.jit(jax.vmap(lambda pr, Xv: _design(Xv) @ pr[:-1]))(pb, Xb)
+    return out[0] if single else out

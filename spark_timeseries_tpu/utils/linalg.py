@@ -1,0 +1,24 @@
+"""Shared small-regression building blocks.
+
+The reference leans on Commons-Math ``OLSMultipleLinearRegression`` across
+models and tests (SURVEY.md Section 2.2); every batched fit here funnels
+through one ridge-stabilized normal-equations solve that maps well onto the
+MXU (tiny ``[k, k]`` Gram matrices, huge batch).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def ols(X, y, ridge: float = 1e-8):
+    """OLS coefficients via ridge-stabilized normal equations.
+
+    ``X^T X`` is tiny ([k, k] for k regressors), so a Cholesky-friendly
+    solve is far cheaper than SVD-based lstsq and batches perfectly under
+    vmap; the scaled ridge keeps rank-deficient designs finite.
+    """
+    XtX = X.T @ X
+    k = XtX.shape[0]
+    scale = jnp.maximum(jnp.trace(XtX) / k, 1.0)
+    return jnp.linalg.solve(XtX + ridge * scale * jnp.eye(k, dtype=X.dtype), X.T @ y)
