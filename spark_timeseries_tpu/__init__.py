@@ -41,5 +41,6 @@ from .panel import (
 from . import parallel
 from .parallel import default_mesh
 from . import models
+from . import stats
 
 __version__ = "0.1.0"

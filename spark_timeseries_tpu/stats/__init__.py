@@ -1,0 +1,4 @@
+from . import tests
+from .tests import adftest, bgtest, bptest, dwtest, kpsstest, lbtest
+
+__all__ = ["tests", "adftest", "dwtest", "bgtest", "bptest", "lbtest", "kpsstest"]

@@ -1,0 +1,272 @@
+"""Statistical hypothesis tests (L4).
+
+Rebuild of the reference's ``sparkts/TimeSeriesStatisticalTests.scala``
+(SURVEY.md Section 2.2, upstream path unverified): Augmented Dickey-Fuller,
+Durbin-Watson, Breusch-Godfrey, Breusch-Pagan, Ljung-Box, and KPSS.  Each
+test is a pure jax function of a ``[time]`` vector (batched variants vmap
+over ``[keys, time]``); auxiliary regressions are the shared
+normal-equations OLS, and chi-square tail probabilities come from the
+regularized incomplete gamma function.
+
+Unit-root p-values follow the reference's approach of embedding published
+critical-value tables and interpolating: the asymptotic Dickey-Fuller tau
+quantiles (Fuller 1976 / MacKinnon 1994, 2010) and the KPSS table
+(Kwiatkowski et al. 1992).  Between tabulated quantiles the p-value is
+piecewise-linear in the statistic and SATURATES at the table ends — the
+attainable range is [0.01, 0.99] for ADF and [0.01, 0.10] for KPSS (the
+published table's span), adequate for accept/reject decisions at
+conventional levels.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.linalg import ols
+from ..ops.lagmat import lag_mat_trim_both
+
+# ---------------------------------------------------------------------------
+# Distribution helpers
+# ---------------------------------------------------------------------------
+
+
+def chi2_sf(x, df):
+    """Chi-square survival function via the regularized upper gamma."""
+    return jax.scipy.special.gammaincc(df / 2.0, x / 2.0)
+
+
+# ---------------------------------------------------------------------------
+# Embedded quantile tables (published asymptotic values)
+# ---------------------------------------------------------------------------
+
+# Dickey-Fuller tau quantiles: rows = cumulative probability, columns per
+# regression kind.  Asymptotic values from Fuller (1976) / MacKinnon (2010).
+_DF_PROBS = jnp.asarray([0.01, 0.025, 0.05, 0.10, 0.90, 0.95, 0.975, 0.99])
+_DF_TAU = {
+    # no deterministic terms
+    "nc": jnp.asarray([-2.56, -2.23, -1.94, -1.62, 0.89, 1.28, 1.62, 2.00]),
+    # constant
+    "c": jnp.asarray([-3.43, -3.12, -2.86, -2.57, -0.44, -0.07, 0.23, 0.60]),
+    # constant + linear trend
+    "ct": jnp.asarray([-3.96, -3.66, -3.41, -3.12, -1.25, -0.94, -0.66, -0.33]),
+}
+
+# KPSS statistic critical values (Kwiatkowski et al. 1992, Table 1);
+# upper-tail probabilities 0.10, 0.05, 0.025, 0.01.
+_KPSS_PROBS = jnp.asarray([0.10, 0.05, 0.025, 0.01])
+_KPSS_CRIT = {
+    "c": jnp.asarray([0.347, 0.463, 0.574, 0.739]),
+    "ct": jnp.asarray([0.119, 0.146, 0.176, 0.216]),
+}
+
+
+def _interp_pvalue(stat, quantiles, probs):
+    """Piecewise-linear p-value from a (quantile -> cumulative prob) table;
+    jnp.interp saturates at the table-end probabilities."""
+    return jnp.interp(stat, quantiles, probs)
+
+
+# ---------------------------------------------------------------------------
+# Augmented Dickey-Fuller
+# ---------------------------------------------------------------------------
+
+
+def adftest(y, max_lag: int = 1, regression: str = "c") -> Tuple[jax.Array, jax.Array]:
+    """ADF unit-root test -> (tau statistic, p-value).
+
+    Regression: dy_t = [deterministics] + gamma * y_{t-1}
+    + sum_{i<=max_lag} delta_i * dy_{t-i} + e_t;  tau = gamma_hat / se.
+    ``regression``: "nc" (none), "c" (constant), "ct" (constant+trend).
+    """
+    if regression not in ("nc", "c", "ct"):
+        raise ValueError(f"regression must be nc|c|ct, got {regression!r}")
+    y = jnp.asarray(y)
+    n = y.shape[0]
+    dy = y[1:] - y[:-1]  # [n-1]
+    # align rows t = max_lag .. n-2 (of dy): target dy_t, regressors
+    target = dy[max_lag:]
+    rows = target.shape[0]
+    cols = [y[max_lag:-1][:, None]]  # y_{t-1}; gamma is coefficient 0
+    for i in range(1, max_lag + 1):
+        cols.append(dy[max_lag - i : dy.shape[0] - i][:, None])
+    if regression in ("c", "ct"):
+        cols.append(jnp.ones((rows, 1), y.dtype))
+    if regression == "ct":
+        cols.append(jnp.arange(rows, dtype=y.dtype)[:, None])
+    X = jnp.concatenate(cols, axis=1)
+    # one ridge-stabilized Gram matrix serves both beta and the standard
+    # error, so singular designs (e.g. a constant series) stay finite
+    XtX = X.T @ X
+    k = XtX.shape[0]
+    ridge = 1e-8 * jnp.maximum(jnp.trace(XtX) / k, 1.0)
+    XtX_inv = jnp.linalg.inv(XtX + ridge * jnp.eye(k, dtype=X.dtype))
+    beta = XtX_inv @ (X.T @ target)
+    resid = target - X @ beta
+    dof = rows - X.shape[1]
+    sigma2 = jnp.sum(resid**2) / dof
+    se_gamma = jnp.sqrt(sigma2 * XtX_inv[0, 0])
+    tau = beta[0] / se_gamma
+    pvalue = _interp_pvalue(tau, _DF_TAU[regression], _DF_PROBS)
+    return tau, pvalue
+
+
+# ---------------------------------------------------------------------------
+# Durbin-Watson
+# ---------------------------------------------------------------------------
+
+
+def dwtest(residuals) -> jax.Array:
+    """Durbin-Watson statistic: sum (e_t - e_{t-1})^2 / sum e_t^2 in (0, 4);
+    ~2 means no first-order serial correlation (reference returns the
+    statistic only)."""
+    e = jnp.asarray(residuals)
+    return jnp.sum((e[1:] - e[:-1]) ** 2) / jnp.sum(e * e)
+
+
+# ---------------------------------------------------------------------------
+# Breusch-Godfrey
+# ---------------------------------------------------------------------------
+
+
+def bgtest(residuals, factors, max_lag: int = 1) -> Tuple[jax.Array, jax.Array]:
+    """Breusch-Godfrey serial-correlation LM test -> (n*R^2, p-value).
+
+    Auxiliary regression of e_t on [1, factors_t, e_{t-1..t-max_lag}];
+    statistic ~ chi2(max_lag) under H0.
+    """
+    e = jnp.asarray(residuals)
+    X = jnp.asarray(factors)
+    if X.ndim == 1:
+        X = X[:, None]
+    n = e.shape[0]
+    elags = lag_mat_trim_both(e, max_lag)  # [n - max_lag, max_lag]
+    rows = n - max_lag
+    Z = jnp.concatenate(
+        [jnp.ones((rows, 1), e.dtype), X[max_lag:], elags], axis=1
+    )
+    target = e[max_lag:]
+    beta = ols(Z, target)
+    resid = target - Z @ beta
+    tss = jnp.sum((target - jnp.mean(target)) ** 2)
+    r2 = 1.0 - jnp.sum(resid**2) / jnp.maximum(tss, 1e-30)
+    stat = rows * r2
+    return stat, chi2_sf(stat, float(max_lag))
+
+
+# ---------------------------------------------------------------------------
+# Breusch-Pagan
+# ---------------------------------------------------------------------------
+
+
+def bptest(residuals, factors) -> Tuple[jax.Array, jax.Array]:
+    """Breusch-Pagan heteroskedasticity LM test -> (n*R^2, p-value).
+
+    Auxiliary regression of e_t^2 on [1, factors_t]; ~ chi2(k) under H0.
+    """
+    e = jnp.asarray(residuals)
+    X = jnp.asarray(factors)
+    if X.ndim == 1:
+        X = X[:, None]
+    n = e.shape[0]
+    Z = jnp.concatenate([jnp.ones((n, 1), e.dtype), X], axis=1)
+    target = e * e
+    beta = ols(Z, target)
+    resid = target - Z @ beta
+    tss = jnp.sum((target - jnp.mean(target)) ** 2)
+    r2 = 1.0 - jnp.sum(resid**2) / jnp.maximum(tss, 1e-30)
+    stat = n * r2
+    return stat, chi2_sf(stat, float(X.shape[1]))
+
+
+# ---------------------------------------------------------------------------
+# Ljung-Box
+# ---------------------------------------------------------------------------
+
+
+def lbtest(residuals, max_lag: int = 10) -> Tuple[jax.Array, jax.Array]:
+    """Ljung-Box white-noise test -> (Q, p-value), Q ~ chi2(max_lag)."""
+    e = jnp.asarray(residuals)
+    n = e.shape[0]
+    d = e - jnp.mean(e)
+    denom = jnp.sum(d * d)
+    terms = []
+    for k in range(1, max_lag + 1):
+        rho_k = jnp.sum(d[k:] * d[: n - k]) / denom
+        terms.append(rho_k**2 / (n - k))
+    q = n * (n + 2.0) * jnp.sum(jnp.stack(terms))
+    return q, chi2_sf(q, float(max_lag))
+
+
+# ---------------------------------------------------------------------------
+# KPSS
+# ---------------------------------------------------------------------------
+
+
+def kpsstest(y, regression: str = "c", lags: int | None = None) -> Tuple[jax.Array, jax.Array]:
+    """KPSS stationarity test -> (eta, p-value).
+
+    H0 is (trend-)stationarity — note the reversed null vs ADF.  Long-run
+    variance uses a Bartlett/Newey-West window; default bandwidth
+    ``trunc(12 * (n/100)^0.25)`` (the KPSS paper's l12 rule).
+    """
+    if regression not in ("c", "ct"):
+        raise ValueError(f"regression must be c|ct, got {regression!r}")
+    y = jnp.asarray(y)
+    n = y.shape[0]
+    if lags is None:
+        lags = int(np_trunc_bandwidth(n))
+    if regression == "c":
+        e = y - jnp.mean(y)
+    else:
+        t = jnp.arange(n, dtype=y.dtype)
+        X = jnp.stack([jnp.ones((n,), y.dtype), t], axis=1)
+        beta = ols(X, y)
+        e = y - X @ beta
+    s = jnp.cumsum(e)
+    # long-run variance: gamma_0 + 2 * sum_k w_k gamma_k, Bartlett weights
+    lrv = jnp.sum(e * e) / n
+    for k in range(1, lags + 1):
+        w = 1.0 - k / (lags + 1.0)
+        lrv = lrv + 2.0 * w * jnp.sum(e[k:] * e[: n - k]) / n
+    eta = jnp.sum(s * s) / (n * n * jnp.maximum(lrv, 1e-30))
+    # upper-tail table: larger eta -> smaller p; saturates in [0.01, 0.10]
+    p = jnp.interp(eta, _KPSS_CRIT[regression], _KPSS_PROBS)
+    return eta, p
+
+
+def np_trunc_bandwidth(n: int) -> int:
+    return int(12 * (n / 100.0) ** 0.25)
+
+
+# ---------------------------------------------------------------------------
+# Batched variants — one call over a whole panel
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=128)
+def _batched(fn, *args):
+    """Memoized jit(vmap(fn(., *args))) so repeated panel-level calls reuse
+    one compiled kernel (same pattern as panel._cached_batched)."""
+    return jax.jit(jax.vmap(lambda v: fn(v, *args)))
+
+
+def batch_adftest(panel, max_lag: int = 1, regression: str = "c"):
+    return _batched(adftest, max_lag, regression)(panel)
+
+
+def batch_dwtest(panel):
+    return _batched(dwtest)(panel)
+
+
+def batch_lbtest(panel, max_lag: int = 10):
+    return _batched(lbtest, max_lag)(panel)
+
+
+def batch_kpsstest(panel, regression: str = "c", lags: int | None = None):
+    n = panel.shape[-1]
+    l = lags if lags is not None else np_trunc_bandwidth(n)
+    return _batched(kpsstest, regression, l)(panel)

@@ -1,0 +1,177 @@
+"""Statistical-test suite: numpy oracles + known-distribution sanity checks.
+
+Mirrors the reference's ``TimeSeriesStatisticalTestsSuite`` (SURVEY.md
+Section 4): golden-value cross-checks (here numpy/scipy oracles) plus
+stationary-vs-unit-root discrimination checks.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from spark_timeseries_tpu.stats import tests as st
+
+
+def ar1(seed, n, phi, c=0.0):
+    rng = np.random.default_rng(seed)
+    y = np.zeros(n)
+    for t in range(1, n):
+        y[t] = c + phi * y[t - 1] + rng.normal()
+    return y
+
+
+class TestADF:
+    def test_stationary_rejects_unit_root(self):
+        y = ar1(0, 500, 0.5)
+        tau, p = st.adftest(jnp.asarray(y), max_lag=1)
+        assert float(tau) < -3.5
+        assert float(p) <= 0.05
+
+    def test_random_walk_keeps_unit_root(self):
+        y = np.cumsum(np.random.default_rng(1).normal(size=500))
+        tau, p = st.adftest(jnp.asarray(y), max_lag=1)
+        assert float(p) > 0.10
+
+    def test_tau_matches_numpy_ols(self):
+        y = ar1(2, 300, 0.7)
+        max_lag = 2
+        tau, _ = st.adftest(jnp.asarray(y), max_lag=max_lag, regression="c")
+        # oracle: standard ADF regression via numpy lstsq
+        dy = np.diff(y)
+        target = dy[max_lag:]
+        rows = len(target)
+        X = np.column_stack(
+            [y[max_lag:-1]]
+            + [dy[max_lag - i : len(dy) - i] for i in range(1, max_lag + 1)]
+            + [np.ones(rows)]
+        )
+        beta, *_ = np.linalg.lstsq(X, target, rcond=None)
+        resid = target - X @ beta
+        sigma2 = resid @ resid / (rows - X.shape[1])
+        se = np.sqrt(sigma2 * np.linalg.inv(X.T @ X)[0, 0])
+        np.testing.assert_allclose(float(tau), beta[0] / se, rtol=1e-6)
+
+    def test_trend_regression(self):
+        rng = np.random.default_rng(3)
+        y = 0.05 * np.arange(400) + ar1(3, 400, 0.4)
+        tau_ct, p_ct = st.adftest(jnp.asarray(y), max_lag=1, regression="ct")
+        assert float(p_ct) <= 0.05  # trend-stationary: ct rejects unit root
+
+    def test_bad_regression(self):
+        with pytest.raises(ValueError):
+            st.adftest(jnp.zeros(50), regression="bogus")
+
+
+class TestDurbinWatson:
+    def test_matches_formula(self):
+        rng = np.random.default_rng(4)
+        e = rng.normal(size=200)
+        got = float(st.dwtest(jnp.asarray(e)))
+        exp = np.sum(np.diff(e) ** 2) / np.sum(e**2)
+        np.testing.assert_allclose(got, exp, rtol=1e-10)
+
+    def test_white_noise_near_two(self):
+        e = np.random.default_rng(5).normal(size=5000)
+        assert abs(float(st.dwtest(jnp.asarray(e))) - 2.0) < 0.1
+
+    def test_autocorrelated_below_two(self):
+        e = ar1(6, 1000, 0.8)
+        assert float(st.dwtest(jnp.asarray(e))) < 1.0
+
+
+class TestLjungBox:
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(7)
+        e = rng.normal(size=300)
+        q, p = st.lbtest(jnp.asarray(e), max_lag=5)
+        d = e - e.mean()
+        denom = (d * d).sum()
+        acf = np.array([(d[k:] * d[: len(d) - k]).sum() / denom for k in range(1, 6)])
+        exp_q = len(e) * (len(e) + 2) * np.sum(acf**2 / (len(e) - np.arange(1, 6)))
+        np.testing.assert_allclose(float(q), exp_q, rtol=1e-8)
+        from scipy import stats as sps
+
+        np.testing.assert_allclose(float(p), sps.chi2.sf(exp_q, 5), rtol=1e-6)
+
+    def test_detects_correlation(self):
+        e = ar1(8, 500, 0.5)
+        _, p = st.lbtest(jnp.asarray(e), max_lag=10)
+        assert float(p) < 0.01
+        wn = np.random.default_rng(9).normal(size=500)
+        _, p_wn = st.lbtest(jnp.asarray(wn), max_lag=10)
+        assert float(p_wn) > 0.01
+
+
+class TestBreuschGodfrey:
+    def test_detects_serial_correlation(self):
+        rng = np.random.default_rng(10)
+        x = rng.normal(size=400)
+        e = ar1(11, 400, 0.6)
+        stat, p = st.bgtest(jnp.asarray(e), jnp.asarray(x), max_lag=2)
+        assert float(p) < 0.01
+        e_wn = rng.normal(size=400)
+        _, p_wn = st.bgtest(jnp.asarray(e_wn), jnp.asarray(x), max_lag=2)
+        assert float(p_wn) > 0.01
+
+
+class TestBreuschPagan:
+    def test_detects_heteroskedasticity(self):
+        rng = np.random.default_rng(12)
+        x = rng.normal(size=500)
+        e_het = rng.normal(size=500) * (1.0 + 1.5 * np.abs(x))
+        stat, p = st.bptest(jnp.asarray(e_het), jnp.asarray(x**2))
+        assert float(p) < 0.01
+        e_hom = rng.normal(size=500)
+        _, p_hom = st.bptest(jnp.asarray(e_hom), jnp.asarray(x**2))
+        assert float(p_hom) > 0.01
+
+    def test_stat_matches_numpy_r2(self):
+        rng = np.random.default_rng(13)
+        x = rng.normal(size=200)
+        e = rng.normal(size=200)
+        stat, _ = st.bptest(jnp.asarray(e), jnp.asarray(x))
+        Z = np.column_stack([np.ones(200), x])
+        t = e**2
+        beta, *_ = np.linalg.lstsq(Z, t, rcond=None)
+        r2 = 1 - ((t - Z @ beta) ** 2).sum() / ((t - t.mean()) ** 2).sum()
+        np.testing.assert_allclose(float(stat), 200 * r2, rtol=1e-6)
+
+
+class TestKPSS:
+    def test_stationary_low_stat(self):
+        y = ar1(14, 1000, 0.3)
+        eta, p = st.kpsstest(jnp.asarray(y), "c")
+        assert float(eta) < 0.463  # below the 5% critical value
+        assert float(p) >= 0.05
+
+    def test_random_walk_high_stat(self):
+        y = np.cumsum(np.random.default_rng(15).normal(size=1000))
+        eta, p = st.kpsstest(jnp.asarray(y), "c")
+        assert float(eta) > 0.739
+        assert float(p) <= 0.011
+
+    def test_trend_stationary_ct(self):
+        rng = np.random.default_rng(16)
+        y = 0.1 * np.arange(800) + ar1(16, 800, 0.2)
+        eta_ct, p_ct = st.kpsstest(jnp.asarray(y), "ct")
+        assert float(p_ct) >= 0.0999
+
+    def test_bad_regression(self):
+        with pytest.raises(ValueError):
+            st.kpsstest(jnp.zeros(100), "bogus")
+
+
+class TestBatched:
+    def test_batch_adf_and_lb(self):
+        panel = jnp.asarray(
+            np.stack([ar1(s, 300, 0.4) for s in range(6)])
+        )
+        taus, ps = st.batch_adftest(panel, max_lag=1)
+        assert taus.shape == (6,) and ps.shape == (6,)
+        assert (np.asarray(ps) < 0.05).all()
+        qs, lps = st.batch_lbtest(panel, max_lag=5)
+        assert qs.shape == (6,)
+        dws = st.batch_dwtest(panel)
+        assert dws.shape == (6,)
+        etas, kps = st.batch_kpsstest(panel, "c")
+        assert etas.shape == (6,)
