@@ -42,5 +42,6 @@ from . import parallel
 from .parallel import default_mesh
 from . import models
 from . import stats
+from . import compat
 
 __version__ = "0.1.0"

@@ -1,0 +1,12 @@
+"""Drop-in compatibility layer mirroring the reference's Python API.
+
+``spark_timeseries_tpu.compat.sparkts`` exposes the upstream
+``python/sparkts`` surface (SURVEY.md §2.3): ``time_series_rdd_from_observations``,
+a ``TimeSeriesRDD`` wrapper, ``DateTimeIndex`` factories, and
+``Model.fit_model(...)`` classes — implemented on the TPU-native core, with
+no Spark, Py4J, or JVM anywhere.
+"""
+
+from . import sparkts
+
+__all__ = ["sparkts"]

@@ -124,6 +124,34 @@ def autocorr(x: jax.Array, num_lags: int) -> jax.Array:
     return jnp.stack([corr_at(k) for k in range(1, num_lags + 1)])
 
 
+def pacf(x: jax.Array, num_lags: int) -> jax.Array:
+    """Sample partial autocorrelation at lags ``1..num_lags`` -> ``[num_lags]``.
+
+    Durbin-Levinson recursion on the sample autocorrelations (Yule-Walker
+    solution), the standard estimator behind the reference's PACF plot
+    (upstream ``EasyPlot.pacfPlot`` — path unverified).  NaNs are handled by
+    the same valid-sample convention as :func:`autocorr`.
+    """
+    rho = jnp.concatenate([jnp.ones((1,), x.dtype), autocorr(x, num_lags)])
+
+    def step(carry, k):
+        phi = carry  # [num_lags] coefficients of the order-(k-1) model
+        idx = jnp.arange(num_lags)
+        prev = idx < k - 1
+        # numerator: rho[k] - sum_{j=1}^{k-1} phi_j * rho[k-j]
+        num = rho[k] - jnp.sum(jnp.where(prev, phi * rho[jnp.abs(k - 1 - idx)], 0.0))
+        den = 1.0 - jnp.sum(jnp.where(prev, phi * rho[idx + 1], 0.0))
+        pk = num / den
+        # phi_j^{(k)} = phi_j^{(k-1)} - pk * phi_{k-j}^{(k-1)}
+        rev = jnp.where(prev, phi[jnp.abs(k - 2 - idx)], 0.0)
+        phi = jnp.where(prev, phi - pk * rev, phi)
+        phi = jnp.where(idx == k - 1, pk, phi)
+        return phi, pk
+
+    _, pks = jax.lax.scan(step, jnp.zeros((num_lags,), rho.dtype), jnp.arange(1, num_lags + 1))
+    return pks
+
+
 def cross_corr(x: jax.Array, y: jax.Array, num_lags: int) -> jax.Array:
     """Cross-correlation of ``x`` with ``y`` at lags ``-num_lags..num_lags``."""
     xd = x - jnp.nanmean(x)

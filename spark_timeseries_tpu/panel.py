@@ -196,6 +196,32 @@ class TimeSeriesPanel:
         out = _cached_batched(uv.autocorr, num_lags)(self.values)
         return out[: self.n_series]
 
+    def pacf(self, num_lags: int) -> jax.Array:
+        """``[n_series, num_lags]`` partial autocorrelations (Durbin-Levinson)."""
+        out = _cached_batched(uv.pacf, num_lags)(self.values)
+        return out[: self.n_series]
+
+    def lags(self, max_lag: int, include_original: bool = True,
+             lagged_key: Callable[[object, int], object] = None) -> "TimeSeriesPanel":
+        """Panel of lagged copies of every series — the upstream
+        ``TimeSeries.lags(maxLag, includeOriginals, laggedKey)`` feature-matrix
+        builder, panel-shaped: output rows are ``key`` (if
+        ``include_original``) followed by ``lag1(key) .. lagN(key)`` for each
+        input key; lagged rows lead with NaNs.
+        """
+        if lagged_key is None:
+            lagged_key = lambda k, i: f"lag{i}({k})"
+        ks = range(0 if include_original else 1, max_lag + 1)
+        # [n, time, len(ks)] -> [n, len(ks), time]; module-level kernel so the
+        # compiled-executable cache hits across calls
+        out = _cached_batched(uv.lags, max_lag, include_original)(
+            self.series_values()
+        ).transpose(0, 2, 1)
+        new_keys = [lagged_key(k, i) if i else k for k in self.keys for i in ks]
+        return TimeSeriesPanel(
+            self.index, new_keys, out.reshape(-1, self.n_time), mesh=self.mesh
+        )
+
     # -- time-axis restructuring -------------------------------------------
 
     def slice(self, start: DateTimeLike, end: DateTimeLike) -> "TimeSeriesPanel":

@@ -1,0 +1,84 @@
+"""Plotting conveniences — the reference's ``EasyPlot`` (L6).
+
+Replaces upstream ``sparkts/EasyPlot.scala`` (``ezplot``, ``acfPlot``,
+``pacfPlot`` — path unverified, see SURVEY.md §1 L6) with matplotlib-backed
+equivalents.  The ACF/PACF values themselves come from the batched TPU
+kernels (:mod:`spark_timeseries_tpu.ops.univariate`); only the rendering is
+host-side.  matplotlib is an optional dependency: importing this module
+without it raises a clear error at call time, not import time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .ops import univariate as uv
+
+
+def _plt():
+    try:
+        import matplotlib.pyplot as plt
+
+        return plt
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("plotting requires matplotlib (not installed)") from e
+
+
+def _as_2d(values) -> np.ndarray:
+    arr = np.asarray(values)
+    return arr[None, :] if arr.ndim == 1 else arr
+
+
+def ezplot(values, index=None, labels: Optional[Sequence] = None, ax=None):
+    """Line plot of one series (``[time]``) or several (``[series, time]``).
+
+    Upstream ``EasyPlot.ezplot``.  ``index`` may be a ``DateTimeIndex`` (its
+    datetimes become the x axis) or any array of x values.
+    """
+    plt = _plt()
+    arr = _as_2d(values)
+    if ax is None:
+        _, ax = plt.subplots(figsize=(10, 4))
+    x = np.arange(arr.shape[1]) if index is None else (
+        index.datetimes() if hasattr(index, "datetimes") else np.asarray(index)
+    )
+    for i, row in enumerate(arr):
+        ax.plot(x, row, label=None if labels is None else str(labels[i]))
+    if labels is not None:
+        ax.legend(loc="best", fontsize="small")
+    ax.set_xlabel("time")
+    return ax
+
+
+def _corr_plot(corr: np.ndarray, n: int, title: str, ax):
+    """Stem plot with the +-1.96/sqrt(n) white-noise significance band the
+    upstream ACF/PACF plots draw."""
+    plt = _plt()
+    if ax is None:
+        _, ax = plt.subplots(figsize=(8, 3))
+    lags = np.arange(1, corr.shape[0] + 1)
+    ax.vlines(lags, 0.0, corr)
+    ax.plot(lags, corr, "o", markersize=3)
+    band = 1.96 / np.sqrt(max(n, 1))
+    ax.axhline(0.0, linewidth=0.8)
+    ax.axhline(band, linestyle="--", linewidth=0.8)
+    ax.axhline(-band, linestyle="--", linewidth=0.8)
+    ax.set_xlabel("lag")
+    ax.set_title(title)
+    return ax
+
+
+def acf_plot(values, max_lag: int, ax=None):
+    """ACF stem plot with significance bands — upstream ``EasyPlot.acfPlot``."""
+    x = np.asarray(values, dtype=np.float64)
+    corr = np.asarray(uv.autocorr(x, max_lag))
+    return _corr_plot(corr, int(np.sum(~np.isnan(x))), "ACF", ax)
+
+
+def pacf_plot(values, max_lag: int, ax=None):
+    """PACF stem plot with significance bands — upstream ``EasyPlot.pacfPlot``."""
+    x = np.asarray(values, dtype=np.float64)
+    corr = np.asarray(uv.pacf(x, max_lag))
+    return _corr_plot(corr, int(np.sum(~np.isnan(x))), "PACF", ax)

@@ -1,0 +1,178 @@
+"""Tests: PACF kernel, panel.lags, plotting, and the sparkts-compat shim."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+import spark_timeseries_tpu as stt
+from spark_timeseries_tpu import plot
+from spark_timeseries_tpu.compat import sparkts
+from spark_timeseries_tpu.ops import univariate as uv
+
+
+def _np_pacf(x: np.ndarray, num_lags: int) -> np.ndarray:
+    """Oracle: solve the Yule-Walker system per order with numpy."""
+    x = x - x.mean()
+    n = len(x)
+    denom = np.sum(x * x)
+    rho = np.array([np.sum(x[k:] * x[: n - k]) / denom for k in range(num_lags + 1)])
+    out = []
+    for k in range(1, num_lags + 1):
+        R = np.array([[rho[abs(i - j)] for j in range(k)] for i in range(k)])
+        phi = np.linalg.solve(R, rho[1 : k + 1])
+        out.append(phi[-1])
+    return np.array(out)
+
+
+class TestPacf:
+    def test_matches_yule_walker_oracle(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=400)
+        for t in range(1, 400):
+            x[t] += 0.7 * x[t - 1]
+        got = np.asarray(uv.pacf(jnp.asarray(x), 8))
+        want = _np_pacf(x, 8)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_ar1_pacf_cuts_off(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=2000)
+        for t in range(1, 2000):
+            x[t] += 0.8 * x[t - 1]
+        p = np.asarray(uv.pacf(jnp.asarray(x), 5))
+        assert abs(p[0] - 0.8) < 0.05
+        assert np.all(np.abs(p[1:]) < 0.1)
+
+    def test_panel_pacf_batched(self):
+        idx = stt.uniform("2020-01-01", 64, stt.DayFrequency())
+        rng = np.random.default_rng(1)
+        vals = rng.normal(size=(3, 64))
+        panel = stt.TimeSeriesPanel(idx, ["a", "b", "c"], jnp.asarray(vals))
+        out = panel.pacf(4)
+        assert out.shape == (3, 4)
+        np.testing.assert_allclose(
+            np.asarray(out[1]), np.asarray(uv.pacf(jnp.asarray(vals[1]), 4)), atol=1e-6
+        )
+
+
+class TestPanelLags:
+    def test_lags_shapes_and_keys(self):
+        idx = stt.uniform("2020-01-01", 10, stt.DayFrequency())
+        vals = jnp.arange(20.0).reshape(2, 10)
+        panel = stt.TimeSeriesPanel(idx, ["x", "y"], vals)
+        lagged = panel.lags(2)
+        assert lagged.n_series == 6
+        assert list(lagged.keys) == ["x", "lag1(x)", "lag2(x)", "y", "lag1(y)", "lag2(y)"]
+        arr = np.asarray(lagged.series_values())
+        np.testing.assert_array_equal(arr[0], np.arange(10.0))
+        assert np.isnan(arr[1][0]) and arr[1][1] == 0.0
+        assert np.isnan(arr[2][:2]).all() and arr[2][2] == 0.0
+
+    def test_lags_without_original(self):
+        idx = stt.uniform("2020-01-01", 6, stt.DayFrequency())
+        panel = stt.TimeSeriesPanel(idx, ["x"], jnp.arange(6.0)[None])
+        lagged = panel.lags(1, include_original=False)
+        assert list(lagged.keys) == ["lag1(x)"]
+        assert lagged.n_series == 1
+
+
+class TestPlot:
+    def test_plots_render(self, tmp_path):
+        import matplotlib
+
+        matplotlib.use("Agg")
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=200).cumsum()
+        ax = plot.ezplot(x)
+        ax.figure.savefig(tmp_path / "ez.png")
+        ax = plot.acf_plot(x, 10)
+        ax.figure.savefig(tmp_path / "acf.png")
+        ax = plot.pacf_plot(x, 10)
+        ax.figure.savefig(tmp_path / "pacf.png")
+        idx = stt.uniform("2020-01-01", 200, stt.DayFrequency())
+        ax = plot.ezplot(np.stack([x, -x]), index=idx, labels=["up", "down"])
+        ax.figure.savefig(tmp_path / "multi.png")
+        assert (tmp_path / "pacf.png").stat().st_size > 0
+
+
+class TestSparktsCompat:
+    @pytest.fixture
+    def obs_df(self):
+        idx = stt.uniform("2020-01-01", 30, stt.DayFrequency())
+        rng = np.random.default_rng(7)
+        rows = []
+        for k in ["AAPL", "GOOG"]:
+            for i, dt in enumerate(idx.datetimes()):
+                rows.append((dt, k, float(rng.normal() + i)))
+        return idx, pd.DataFrame(rows, columns=["timestamp", "symbol", "price"])
+
+    def test_rdd_roundtrip(self, obs_df):
+        idx, df = obs_df
+        rdd = sparkts.time_series_rdd_from_observations(
+            idx, df, "timestamp", "symbol", "price"
+        )
+        assert rdd.count() == 2
+        assert sorted(rdd.keys()) == ["AAPL", "GOOG"]
+        assert rdd.find_series("AAPL").shape == (30,)
+        filled = rdd.fill("linear").differences(1)
+        assert filled.index.size == 30
+        instants = rdd.to_instants()
+        assert len(instants) == 30 and instants[0][1].shape == (2,)
+        obs2 = rdd.to_observations_dataframe("timestamp", "symbol", "price")
+        assert len(obs2) == 60
+        stats = rdd.series_stats()
+        assert float(stats["count"][0]) == 30
+
+    def test_slice_and_filter(self, obs_df):
+        idx, df = obs_df
+        rdd = sparkts.time_series_rdd_from_observations(
+            idx, df, "timestamp", "symbol", "price"
+        )
+        sliced = rdd.slice("2020-01-05", "2020-01-10")
+        assert sliced.index.size == 6
+        only = rdd.filter(lambda k: k == "AAPL")
+        assert only.keys() == ["AAPL"]
+
+    def test_arima_fit_model(self):
+        rng = np.random.default_rng(0)
+        e = rng.normal(size=500)
+        y = np.zeros(500)
+        for t in range(1, 500):
+            y[t] = 0.5 * y[t - 1] + e[t] + 0.3 * e[t - 1]
+        y = np.cumsum(y)
+        model = sparkts.ARIMA.fit_model(1, 1, 1, y)
+        assert model.order == (1, 1, 1)
+        fc = model.forecast(y, 5)
+        assert fc.shape == (5,) and np.isfinite(fc).all()
+        assert model.is_stationary() and model.is_invertible()
+        assert np.isfinite(model.approx_aic(y))
+
+    def test_other_models(self):
+        rng = np.random.default_rng(5)
+        y = rng.normal(size=300).cumsum() + 50
+        m = sparkts.EWMA.fit_model(y)
+        assert 0.0 < m.smoothing <= 1.0
+        assert m.forecast(y, 3).shape == (3,)
+
+        ar = sparkts.Autoregression.fit_model(y, max_lag=2)
+        assert ar.coefficients.shape == (3,)
+        assert np.isfinite(ar.forecast(y, 4)).all()
+
+        r = rng.normal(size=400) * np.concatenate([np.ones(200), 2 * np.ones(200)])
+        g = sparkts.GARCH.fit_model(r)
+        assert g.omega > 0 and np.isfinite(g.log_likelihood(r))
+
+        seas = np.tile(np.sin(np.arange(12) / 12 * 2 * np.pi), 10)
+        yhw = seas * 3 + np.arange(120) * 0.05 + rng.normal(size=120) * 0.1 + 10
+        hw = sparkts.HoltWinters.fit_model(yhw, 12)
+        assert hw.forecast(yhw, 6).shape == (6,)
+
+    def test_stat_tests_exposed(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=300)
+        stat, p = sparkts.adftest(jnp.asarray(x.cumsum()), 2)
+        assert p > 0.05  # random walk: cannot reject unit root
+        d = sparkts.dwtest(jnp.asarray(x))
+        assert 1.0 < float(d) < 3.0
