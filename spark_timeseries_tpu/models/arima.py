@@ -35,7 +35,7 @@ from jax import lax
 from ..ops import univariate as uv
 from ..utils import optim
 from ..utils.linalg import ols as _ols
-from .base import FitResult, debatch, ensure_batched
+from .base import FitResult, align_right, debatch, ensure_batched
 
 Order = Tuple[int, int, int]
 
@@ -77,25 +77,38 @@ def _lagged(yd, p: int):
 # ---------------------------------------------------------------------------
 
 
-def _css_errors(params, yd, order: Order, include_intercept: bool, condition: bool = True):
+def _css_errors(params, yd, order: Order, include_intercept: bool, condition: bool = True,
+                n_valid=None):
     """One-step-ahead prediction errors of the ARMA(p,q) recursion.
 
-    ``condition=True`` zeroes errors for t < p (conditional likelihood —
-    the reference's CSS).  ``condition=False`` keeps zero-padded-lag errors
-    for every t, which makes the transform exactly invertible
-    (remove/add_time_dependent_effects).
+    ``condition=True`` zeroes errors for the first p valid steps (conditional
+    likelihood — the reference's CSS).  ``condition=False`` keeps
+    zero-padded-lag errors for every valid t, which makes the transform
+    exactly invertible (remove/add_time_dependent_effects).
+
+    ``n_valid`` (traced scalar) marks a right-aligned valid span (see
+    ``base.align_right``): errors in the zero prefix are forced to 0 so
+    padded series contribute nothing there.
     """
     p, _, q = order
+    n = yd.shape[0]
+    t_idx = jnp.arange(n)
+    start = 0
+    if n_valid is not None:
+        start = n - n_valid
+        # differencing across the padding boundary leaves a garbage raw-level
+        # value at yd[start-1]; zero the prefix so lags reaching below start
+        # bring exactly the zeros a trimmed series would see
+        yd = jnp.where(t_idx >= start, yd, 0.0)
     c, phi, theta = _split_params(params, order, include_intercept)
     ylags = _lagged(yd, p)  # [n, p]
-    t_idx = jnp.arange(yd.shape[0])
+    zero_before = start + p if condition else start
 
     def step(errs, inp):
         yt, yl, t = inp
         pred = c + jnp.dot(phi, yl) + (jnp.dot(theta, errs) if q else 0.0)
         e = yt - pred
-        if condition:
-            e = jnp.where(t >= p, e, 0.0)
+        e = jnp.where(t >= zero_before, e, 0.0)
         new_errs = jnp.concatenate([e[None], errs[:-1]]) if q else errs
         return new_errs, e
 
@@ -104,12 +117,13 @@ def _css_errors(params, yd, order: Order, include_intercept: bool, condition: bo
     return e
 
 
-def css_neg_loglik(params, yd, order: Order, include_intercept: bool):
+def css_neg_loglik(params, yd, order: Order, include_intercept: bool, n_valid=None):
     """Negative conditional-sum-of-squares Gaussian log-likelihood with the
     innovation variance concentrated out (sigma^2 = CSS / n_eff)."""
     p = order[0]
-    e = _css_errors(params, yd, order, include_intercept)
-    n_eff = yd.shape[0] - p
+    nv = yd.shape[0] if n_valid is None else n_valid
+    e = _css_errors(params, yd, order, include_intercept, n_valid=n_valid)
+    n_eff = nv - p
     css = jnp.sum(e * e)
     sigma2 = css / n_eff
     return 0.5 * n_eff * (jnp.log(2.0 * jnp.pi * sigma2) + 1.0)
@@ -125,21 +139,28 @@ def approx_aic(params, yd, order: Order, include_intercept: bool):
 # ---------------------------------------------------------------------------
 
 
-def hannan_rissanen(yd, order: Order, include_intercept: bool):
+def hannan_rissanen(yd, order: Order, include_intercept: bool, n_valid=None):
     """Two-stage startup values: long-AR residuals stand in for the
-    unobserved MA innovations, then one OLS of y on [1, y-lags, e-lags]."""
+    unobserved MA innovations, then one OLS of y on [1, y-lags, e-lags].
+
+    With ``n_valid`` (right-aligned span), row selection becomes 0/1 row
+    weights — zeroed rows add nothing to the normal equations, keeping the
+    math identical to the static-slice full-series case.
+    """
     p, _, q = order
     n = yd.shape[0]
     m = min(p + q + 1, max(n // 4, 1))  # long-AR order, static
+    start = 0 if n_valid is None else n - n_valid
+    t = jnp.arange(n)
 
     # stage 1: AR(m) by OLS -> residual estimates of the innovations
     ylags_m = _lagged(yd, m)
     ones = jnp.ones((n, 1), yd.dtype)
     Xar = jnp.concatenate([ones, ylags_m], axis=1)
-    # rows t < m have zero-padded lags; drop them from the fit (static slice)
-    beta_ar = _ols(Xar[m:], yd[m:])
-    ehat = yd - Xar @ beta_ar
-    ehat = jnp.concatenate([jnp.zeros((m,), yd.dtype), ehat[m:]])
+    # rows with any zero-padded lag (t < start + m) get weight 0
+    w1 = (t >= start + m).astype(yd.dtype)
+    beta_ar = _ols(Xar * w1[:, None], yd * w1)
+    ehat = (yd - Xar @ beta_ar) * w1
 
     # stage 2: OLS of y on [1?, y-lags 1..p, e-lags 1..q]
     cols = []
@@ -152,8 +173,8 @@ def hannan_rissanen(yd, order: Order, include_intercept: bool):
     if not cols:
         return jnp.zeros((0,), yd.dtype)
     X = jnp.concatenate(cols, axis=1)
-    start = m + q  # rows where every regressor is real
-    return _ols(X[start:], yd[start:])
+    w2 = (t >= start + m + q).astype(yd.dtype)  # rows where every regressor is real
+    return _ols(X * w2[:, None], yd * w2)
 
 
 # ---------------------------------------------------------------------------
@@ -189,26 +210,34 @@ def fit(
 
     @jax.jit
     def run(yb):
-        yd = jax.vmap(lambda v: _difference(v, d))(yb)
+        ya, nv0 = jax.vmap(align_right)(yb)  # ragged support: NaN head/tail
+        yd = jax.vmap(lambda v: _difference(v, d))(ya)
+        nvd = nv0 - d  # valid length after differencing
         init = (
             jnp.broadcast_to(init_params, (yd.shape[0], k))
             if init_params is not None
-            else jax.vmap(lambda v: hannan_rissanen(v, order, include_intercept))(yd)
+            else jax.vmap(
+                lambda v, n: hannan_rissanen(v, order, include_intercept, n)
+            )(yd, nvd)
         )
+        # too-short series cannot be fit: need lags + a few dof
+        ok = nvd >= p + q + max(p + q + 1, 1) + k + 2
         if method == "hannan-rissanen":
-            nll = jax.vmap(lambda pr, v: css_neg_loglik(pr, v, order, include_intercept))(
-                init, yd
-            )
+            nll = jax.vmap(
+                lambda pr, v, n: css_neg_loglik(pr, v, order, include_intercept, n)
+            )(init, yd, nvd)
             z = jnp.zeros((yd.shape[0],), jnp.int32)
-            return FitResult(init, nll, jnp.ones((yd.shape[0],), bool), z)
+            params = jnp.where(ok[:, None], init, jnp.nan)
+            return FitResult(params, jnp.where(ok, nll, jnp.nan), ok, z)
         res = optim.batched_minimize(
-            lambda pr, v: css_neg_loglik(pr, v, order, include_intercept),
+            lambda pr, data: css_neg_loglik(pr, data[0], order, include_intercept, data[1]),
             init,
-            yd,
+            (yd, nvd),
             max_iters=max_iters,
             tol=tol,
         )
-        return FitResult(res.x, res.f, res.converged, res.iters)
+        params = jnp.where(ok[:, None], res.x, jnp.nan)
+        return FitResult(params, jnp.where(ok, res.f, jnp.nan), res.converged & ok, res.iters)
 
     return debatch(run(yb), single)
 
@@ -233,9 +262,11 @@ def forecast(params, y, order: Order, n_future: int, include_intercept: bool = T
     @jax.jit
     def run(params_b, yb):
         def one(pr, yv):
+            yv, nv0 = align_right(yv)  # ragged support: NaN head/tail
             yd = _difference(yv, d)
             c, phi, theta = _split_params(pr, order, include_intercept)
-            e = _css_errors(pr, yd, order, include_intercept, condition=False)
+            e = _css_errors(pr, yd, order, include_intercept, condition=False,
+                            n_valid=nv0 - d)
             # carries: last p differenced values (newest first), last q errors
             ydlast = yd[::-1][:p] if p else jnp.zeros((0,), yd.dtype)
             elast = e[::-1][: max(q, 1)]
@@ -374,6 +405,8 @@ def is_stationary(params, order: Order, include_intercept: bool = True) -> np.nd
     if p == 0:
         return np.asarray(True)
     c, phi, _ = _split_params(np.asarray(params), order, include_intercept)
+    if not np.all(np.isfinite(phi)):  # failed fit (e.g. all-NaN series)
+        return np.asarray(False)
     roots = np.roots(np.concatenate([[1.0], -np.asarray(phi)])[::-1])
     return np.asarray(np.all(np.abs(roots) > 1.0 + 1e-9))
 
@@ -384,5 +417,7 @@ def is_invertible(params, order: Order, include_intercept: bool = True) -> np.nd
     if q == 0:
         return np.asarray(True)
     _, _, theta = _split_params(np.asarray(params), order, include_intercept)
+    if not np.all(np.isfinite(theta)):  # failed fit (e.g. all-NaN series)
+        return np.asarray(False)
     roots = np.roots(np.concatenate([[1.0], np.asarray(theta)])[::-1])
     return np.asarray(np.all(np.abs(roots) > 1.0 + 1e-9))

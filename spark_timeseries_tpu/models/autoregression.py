@@ -17,33 +17,46 @@ import jax.numpy as jnp
 from ..ops.lagmat import lag_mat_trim_both
 from . import arima as _arima
 from ..utils.linalg import ols as _ols
-from .base import FitResult, debatch, ensure_batched
+from .base import FitResult, align_right, debatch, ensure_batched
 
 
 def fit(y, max_lag: int = 1, no_intercept: bool = False) -> FitResult:
-    """OLS fit of y_t on [1?, y_{t-1} .. y_{t-max_lag}]."""
+    """OLS fit of y_t on [1?, y_{t-1} .. y_{t-max_lag}].
+
+    Leading/trailing NaNs are tolerated (right-aligned 0/1 row weights in the
+    normal equations); too-short series come back NaN, ``converged=False``.
+    """
     yb, single = ensure_batched(y)
 
     @jax.jit
     def run(yb):
-        def one(yv):
+        def one(yv, nv):
+            start = yv.shape[0] - nv
             X = lag_mat_trim_both(yv, max_lag)  # [n - p, p]
             target = yv[max_lag:]
             if not no_intercept:
                 X = jnp.concatenate([jnp.ones((X.shape[0], 1), yv.dtype), X], axis=1)
-            beta = _ols(X, target)
+            # row i regresses t = max_lag + i; lags reach back to t - max_lag,
+            # so rows with t - max_lag < start carry padding -> weight 0
+            w = (jnp.arange(target.shape[0]) >= start).astype(yv.dtype)
+            beta = _ols(X * w[:, None], target * w)
             if no_intercept:
                 beta = jnp.concatenate([jnp.zeros((1,), yv.dtype), beta])
-            resid = target - X @ (beta[1:] if no_intercept else beta)
-            n = target.shape[0]
+            resid = (target - X @ (beta[1:] if no_intercept else beta)) * w
+            n = nv - max_lag
             sigma2 = jnp.sum(resid**2) / n
             nll = 0.5 * n * (jnp.log(2.0 * jnp.pi * sigma2) + 1.0)
             return beta, nll
 
-        params, nll = jax.vmap(one)(yb)
+        ya, nv = jax.vmap(align_right)(yb)
+        params, nll = jax.vmap(one)(ya, nv)
+        ok = nv >= max_lag + (1 if no_intercept else 2) + 1
         b = yb.shape[0]
         return FitResult(
-            params, nll, jnp.ones((b,), bool), jnp.zeros((b,), jnp.int32)
+            jnp.where(ok[:, None], params, jnp.nan),
+            jnp.where(ok, nll, jnp.nan),
+            ok,
+            jnp.zeros((b,), jnp.int32),
         )
 
     return debatch(run(yb), single)

@@ -43,3 +43,31 @@ def ensure_batched(y) -> tuple[jax.Array, bool]:
 
 def debatch(x, single: bool):
     return jax.tree.map(lambda a: a[0], x) if single else x
+
+
+def align_right(y: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Shift a series' valid span to END at the last position -> ``(y', n_valid)``.
+
+    Model fits accept series with leading/trailing NaNs (unobserved head or
+    tail — the ragged-panel case of SURVEY.md §7): the valid run
+    ``[first_non_nan, last_non_nan]`` is rolled so it ends at ``T-1``, padding
+    positions become 0.0, and ``n_valid`` (its length, traced scalar) lets
+    objectives mask the padded prefix while every shape stays static.  With
+    the data right-aligned, "last value" / "last errors" logic in forecasting
+    needs no dynamic indexing.
+
+    Interior NaNs inside the valid run are replaced by 0.0 — fill them first
+    (``panel.fill``) for meaningful fits.  All-NaN input yields ``n_valid=0``
+    (callers flag such series as failed).
+    """
+    y = jnp.asarray(y)
+    T = y.shape[0]
+    valid = ~jnp.isnan(y)
+    any_valid = jnp.any(valid)
+    first = jnp.argmax(valid)
+    last = T - 1 - jnp.argmax(valid[::-1])
+    nv = jnp.where(any_valid, last - first + 1, 0)
+    rolled = jnp.roll(y, (T - 1) - last)
+    t = jnp.arange(T)
+    rolled = jnp.where(t >= T - nv, rolled, 0.0)
+    return jnp.nan_to_num(rolled), nv

@@ -19,17 +19,33 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import FitResult, debatch, ensure_batched
+from .base import FitResult, align_right, debatch, ensure_batched
 
 
-def smooth(alpha, x):
-    """The EWMA recursion (``addTimeDependentEffects``): s_0 = x_0."""
+def smooth(alpha, x, n_valid=None):
+    """The EWMA recursion (``addTimeDependentEffects``): s_0 = x_0.
 
-    def step(s, xt):
-        s = alpha * xt + (1.0 - alpha) * s
+    ``n_valid`` marks a right-aligned valid span (``base.align_right``): the
+    state seeds at the first valid value and the zero prefix emits 0.
+    """
+    if n_valid is None:
+        def step(s, xt):
+            s = alpha * xt + (1.0 - alpha) * s
+            return s, s
+
+        _, out = lax.scan(step, x[0], x)
+        return out
+
+    start = x.shape[0] - n_valid
+
+    def step(s, inp):
+        xt, t = inp
+        s = jnp.where(
+            t < start, 0.0, jnp.where(t == start, xt, alpha * xt + (1.0 - alpha) * s)
+        )
         return s, s
 
-    _, out = lax.scan(step, x[0], x)
+    _, out = lax.scan(step, jnp.zeros((), x.dtype), (x, jnp.arange(x.shape[0])))
     return out
 
 
@@ -41,28 +57,44 @@ def unsmooth(alpha, s):
     return x.at[0].set(s[0])
 
 
-def sse(alpha, x):
-    """One-step-ahead squared error: sum_t (x_t - s_{t-1})^2 for t >= 1."""
-    s = smooth(alpha, x)
+def sse(alpha, x, n_valid=None):
+    """One-step-ahead squared error: sum_t (x_t - s_{t-1})^2 for valid t >= 1."""
+    s = smooth(alpha, x, n_valid)
     err = x[1:] - s[:-1]
+    if n_valid is not None:
+        start = x.shape[0] - n_valid
+        err = jnp.where(jnp.arange(1, x.shape[0]) > start, err, 0.0)
     return jnp.sum(err * err)
 
 
 def fit(y, *, max_iters: int = 40, tol: Optional[float] = None) -> FitResult:
-    """Fit ``alpha`` per series by SSE minimization -> params ``[batch?, 1]``."""
+    """Fit ``alpha`` per series by SSE minimization -> params ``[batch?, 1]``.
+
+    Leading/trailing NaNs are tolerated (right-aligned masking); series with
+    fewer than 3 valid points come back NaN with ``converged=False``.
+    """
     yb, single = ensure_batched(y)
     if tol is None:
         tol = 1e-8 if yb.dtype == jnp.float64 else 1e-4
 
     @jax.jit
     def run(yb):
-        def objective(u, x):
-            return sse(optim.sigmoid_to_interval(u[0], 0.0, 1.0), x)
+        ya, nv = jax.vmap(align_right)(yb)
+
+        def objective(u, data):
+            x, n = data
+            return sse(optim.sigmoid_to_interval(u[0], 0.0, 1.0), x, n)
 
         u0 = jnp.zeros((yb.shape[0], 1), yb.dtype)
-        res = optim.batched_minimize(objective, u0, yb, max_iters=max_iters, tol=tol)
+        res = optim.batched_minimize(objective, u0, (ya, nv), max_iters=max_iters, tol=tol)
         alpha = optim.sigmoid_to_interval(res.x, 0.0, 1.0)
-        return FitResult(alpha, res.f, res.converged, res.iters)
+        ok = nv >= 3
+        return FitResult(
+            jnp.where(ok[:, None], alpha, jnp.nan),
+            jnp.where(ok, res.f, jnp.nan),
+            res.converged & ok,
+            res.iters,
+        )
 
     return debatch(run(yb), single)
 
@@ -74,7 +106,13 @@ def forecast(params, y, n_future: int):
 
     @jax.jit
     def run(pb, yb):
-        last = jax.vmap(lambda a, x: smooth(a[0], x)[-1])(pb, yb)
+        def one(a, x):
+            xa, nv = align_right(x)
+            last = smooth(a[0], xa, nv)[-1]
+            # empty span or failed-fit params must not yield a plausible 0.0
+            return jnp.where((nv > 0) & jnp.isfinite(a[0]), last, jnp.nan)
+
+        last = jax.vmap(one)(pb, yb)
         return jnp.broadcast_to(last[:, None], (yb.shape[0], n_future))
 
     out = run(pb, yb)

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import FitResult, debatch, ensure_batched
+from .base import FitResult, align_right, debatch, ensure_batched
 
 
 # -- transforms -------------------------------------------------------------
@@ -68,22 +68,57 @@ def _unconditional_var(params):
     return params[0] / jnp.maximum(1.0 - params[1] - params[2], 1e-6)
 
 
-def variances(params, r):
+def _masked_var(r, n_valid):
+    """Variance over the right-aligned valid span."""
+    t = jnp.arange(r.shape[0])
+    m = (t >= r.shape[0] - n_valid).astype(r.dtype)
+    n = jnp.maximum(n_valid, 1)
+    mean = jnp.sum(r * m) / n
+    return jnp.sum(m * (r - mean) ** 2) / n
+
+
+def variances(params, r, n_valid=None):
     """Conditional variances h_t (h_0 = sample variance of r, which also
-    stands in for the unobserved r_{-1}^2)."""
-    h0 = jnp.var(r)
-    return _variance_scan(params, h0, jnp.concatenate([h0[None], r[:-1] ** 2]))
+    stands in for the unobserved r_{-1}^2).
+
+    ``n_valid`` marks a right-aligned valid span (``base.align_right``): the
+    recursion holds h = h_0 through the zero prefix and seeds at the first
+    valid step exactly as the full-series recursion seeds at t=0.
+    """
+    if n_valid is None:
+        h0 = jnp.var(r)
+        return _variance_scan(params, h0, jnp.concatenate([h0[None], r[:-1] ** 2]))
+
+    h0 = _masked_var(r, n_valid)
+    start = r.shape[0] - n_valid
+    t = jnp.arange(r.shape[0])
+    r_sq_prev = jnp.where(
+        t == start, h0, jnp.concatenate([jnp.zeros((1,), r.dtype), r[:-1] ** 2])
+    )
+    omega, alpha, beta = params[0], params[1], params[2]
+
+    def step(h, inp):
+        rsq, ti = inp
+        h = jnp.where(ti < start, h0, omega + alpha * rsq + beta * h)
+        return h, h
+
+    _, h = lax.scan(step, h0, (r_sq_prev, t))
+    return h
 
 
-def log_likelihood(params, r):
-    """Gaussian log-likelihood of returns under the variance recursion."""
-    h = variances(params, r)
+def log_likelihood(params, r, n_valid=None):
+    """Gaussian log-likelihood of returns under the variance recursion
+    (summed over the valid span when ``n_valid`` is given)."""
+    h = variances(params, r, n_valid)
     h = jnp.maximum(h, 1e-12)
-    return -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * h) + (r * r) / h)
+    ll_t = jnp.log(2.0 * jnp.pi * h) + (r * r) / h
+    if n_valid is not None:
+        ll_t = jnp.where(jnp.arange(r.shape[0]) >= r.shape[0] - n_valid, ll_t, 0.0)
+    return -0.5 * jnp.sum(ll_t)
 
 
-def neg_log_likelihood(params, r):
-    return -log_likelihood(params, r)
+def neg_log_likelihood(params, r, n_valid=None):
+    return -log_likelihood(params, r, n_valid)
 
 
 # -- fitting ----------------------------------------------------------------
@@ -97,17 +132,27 @@ def fit(r, *, max_iters: int = 80, tol: Optional[float] = None) -> FitResult:
 
     @jax.jit
     def run(rb):
-        def objective(u, rv):
-            return neg_log_likelihood(_to_natural(u), rv)
+        ra, nv = jax.vmap(align_right)(rb)
+
+        def objective(u, data):
+            rv, n = data
+            return neg_log_likelihood(_to_natural(u), rv, n)
 
         # moment-ish start: omega = 0.1*var, alpha=0.1, beta=0.8
-        var0 = jnp.var(rb, axis=1)
+        var0 = jax.vmap(_masked_var)(ra, nv)
         nat0 = jnp.stack(
-            [0.1 * var0, jnp.full_like(var0, 0.1), jnp.full_like(var0, 0.8)], axis=1
+            [0.1 * jnp.maximum(var0, 1e-10), jnp.full_like(var0, 0.1),
+             jnp.full_like(var0, 0.8)], axis=1
         )
         u0 = jax.vmap(_from_natural)(nat0)
-        res = optim.batched_minimize(objective, u0, rb, max_iters=max_iters, tol=tol)
-        return FitResult(jax.vmap(_to_natural)(res.x), res.f, res.converged, res.iters)
+        res = optim.batched_minimize(objective, u0, (ra, nv), max_iters=max_iters, tol=tol)
+        ok = nv >= 10  # GARCH needs a handful of observations to identify
+        return FitResult(
+            jnp.where(ok[:, None], jax.vmap(_to_natural)(res.x), jnp.nan),
+            jnp.where(ok, res.f, jnp.nan),
+            res.converged & ok,
+            res.iters,
+        )
 
     return debatch(run(rb), single)
 
@@ -184,13 +229,18 @@ def _argarch_from_natural(params):
     return jnp.concatenate([params[:2], _from_natural(params[2:])])
 
 
-def argarch_neg_log_likelihood(params, y):
+def argarch_neg_log_likelihood(params, y, n_valid=None):
     """y_t = c + phi y_{t-1} + r_t with GARCH(1,1) innovations r."""
     c, phi = params[0], params[1]
     prev = jnp.concatenate([y[:1], y[:-1]])
     r = y - c - phi * prev
-    r = r.at[0].set(0.0)  # condition on the first observation
-    return neg_log_likelihood(params[2:], r)
+    if n_valid is None:
+        r = r.at[0].set(0.0)  # condition on the first observation
+        return neg_log_likelihood(params[2:], r)
+    start = y.shape[0] - n_valid
+    r = jnp.where(jnp.arange(y.shape[0]) <= start, 0.0, r)  # condition on y[start]
+    # one fewer residual than valid observations (the conditioned first one)
+    return neg_log_likelihood(params[2:], r, n_valid - 1)
 
 
 def fit_argarch(y, *, max_iters: int = 100, tol: Optional[float] = None) -> FitResult:
@@ -202,18 +252,26 @@ def fit_argarch(y, *, max_iters: int = 100, tol: Optional[float] = None) -> FitR
 
     @jax.jit
     def run(yb):
-        def objective(u, yv):
-            return argarch_neg_log_likelihood(_argarch_to_natural(u), yv)
+        ya, nv = jax.vmap(align_right)(yb)
+
+        def objective(u, data):
+            yv, n = data
+            return argarch_neg_log_likelihood(_argarch_to_natural(u), yv, n)
 
         # init: OLS-ish AR(1) by autocorrelation, then GARCH moments on resid
-        mean = jnp.mean(yb, axis=1)
-        yc = yb - mean[:, None]
+        # (masked over each right-aligned valid span)
+        T = ya.shape[1]
+        m = (jnp.arange(T)[None, :] >= (T - nv)[:, None]).astype(ya.dtype)
+        nvf = jnp.maximum(nv, 1).astype(ya.dtype)
+        mean = jnp.sum(ya * m, axis=1) / nvf
+        yc = (ya - mean[:, None]) * m
         phi0 = jnp.sum(yc[:, 1:] * yc[:, :-1], axis=1) / jnp.maximum(
             jnp.sum(yc * yc, axis=1), 1e-12
         )
         phi0 = jnp.clip(phi0, -0.95, 0.95)
         c0 = mean * (1.0 - phi0)
-        resid_var = jnp.var(yb[:, 1:] - c0[:, None] - phi0[:, None] * yb[:, :-1], axis=1)
+        resid = (ya[:, 1:] - c0[:, None] - phi0[:, None] * ya[:, :-1]) * m[:, 1:]
+        resid_var = jnp.sum(resid**2, axis=1) / nvf
         nat0 = jnp.stack(
             [
                 c0,
@@ -225,9 +283,13 @@ def fit_argarch(y, *, max_iters: int = 100, tol: Optional[float] = None) -> FitR
             axis=1,
         )
         u0 = jax.vmap(_argarch_from_natural)(nat0)
-        res = optim.batched_minimize(objective, u0, yb, max_iters=max_iters, tol=tol)
+        res = optim.batched_minimize(objective, u0, (ya, nv), max_iters=max_iters, tol=tol)
+        ok = nv >= 12
         return FitResult(
-            jax.vmap(_argarch_to_natural)(res.x), res.f, res.converged, res.iters
+            jnp.where(ok[:, None], jax.vmap(_argarch_to_natural)(res.x), jnp.nan),
+            jnp.where(ok, res.f, jnp.nan),
+            res.converged & ok,
+            res.iters,
         )
 
     return debatch(run(yb), single)

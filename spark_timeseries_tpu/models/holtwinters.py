@@ -21,13 +21,21 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import FitResult, debatch, ensure_batched
+from .base import FitResult, align_right, debatch, ensure_batched
 
 
-def _init_state(y, period: int, multiplicative: bool):
-    """Start values from the first two seasons (upstream's scheme)."""
-    s1 = y[:period]
-    s2 = y[period : 2 * period]
+def _init_state(y, period: int, multiplicative: bool, start=None):
+    """Start values from the first two seasons (upstream's scheme).
+
+    ``start`` (traced scalar) points at the first valid observation of a
+    right-aligned series; the seasons are sliced dynamically from there.
+    """
+    if start is None:
+        s1 = y[:period]
+        s2 = y[period : 2 * period]
+    else:
+        s1 = lax.dynamic_slice(y, (start,), (period,))
+        s2 = lax.dynamic_slice(y, (start + period,), (period,))
     level0 = jnp.mean(s1)
     trend0 = (jnp.mean(s2) - jnp.mean(s1)) / period
     if multiplicative:
@@ -37,16 +45,20 @@ def _init_state(y, period: int, multiplicative: bool):
     return level0, trend0, seasonal0
 
 
-def _run(params, y, period: int, multiplicative: bool):
+def _run(params, y, period: int, multiplicative: bool, n_valid=None):
     """Run the smoothing recursion; returns (one-step forecasts, final state).
 
     forecasts[t] is the prediction of y[t] made at t-1 (for t >= period... the
-    first ``period`` entries predict using the seed state).
+    first ``period`` entries predict using the seed state).  ``n_valid`` marks
+    a right-aligned valid span: the state holds through the zero prefix so the
+    recursion effectively starts at the first valid observation.
     """
     alpha, beta, gamma = params[0], params[1], params[2]
-    level0, trend0, seasonal0 = _init_state(y, period, multiplicative)
+    start = None if n_valid is None else y.shape[0] - n_valid
+    level0, trend0, seasonal0 = _init_state(y, period, multiplicative, start)
 
-    def step(carry, yt):
+    def step(carry, inp):
+        yt, t = inp
         level, trend, seasonal = carry  # seasonal: [period], rotating
         s = seasonal[0]
         if multiplicative:
@@ -58,17 +70,26 @@ def _run(params, y, period: int, multiplicative: bool):
             new_level = alpha * (yt - s) + (1 - alpha) * (level + trend)
             new_seasonal_last = gamma * (yt - new_level) + (1 - gamma) * s
         new_trend = beta * (new_level - level) + (1 - beta) * trend
-        seasonal = jnp.concatenate([seasonal[1:], new_seasonal_last[None]])
-        return (new_level, new_trend, seasonal), pred
+        new_seasonal = jnp.concatenate([seasonal[1:], new_seasonal_last[None]])
+        if start is not None:
+            skip = t < start
+            new_level = jnp.where(skip, level, new_level)
+            new_trend = jnp.where(skip, trend, new_trend)
+            new_seasonal = jnp.where(skip, seasonal, new_seasonal)
+        return (new_level, new_trend, new_seasonal), pred
 
-    (level, trend, seasonal), preds = lax.scan(step, (level0, trend0, seasonal0), y)
+    (level, trend, seasonal), preds = lax.scan(
+        step, (level0, trend0, seasonal0), (y, jnp.arange(y.shape[0]))
+    )
     return preds, (level, trend, seasonal)
 
 
-def sse(params, y, period: int, multiplicative: bool):
+def sse(params, y, period: int, multiplicative: bool, n_valid=None):
     """One-step-ahead SSE, skipping the seeded first season."""
-    preds, _ = _run(params, y, period, multiplicative)
-    err = (y - preds)[period:]
+    preds, _ = _run(params, y, period, multiplicative, n_valid)
+    err = y - preds
+    start = 0 if n_valid is None else y.shape[0] - n_valid
+    err = jnp.where(jnp.arange(y.shape[0]) >= start + period, err, 0.0)
     return jnp.sum(err * err)
 
 
@@ -94,17 +115,24 @@ def fit(
 
     @jax.jit
     def run(yb):
-        def objective(u, yv):
+        ya, nv = jax.vmap(align_right)(yb)
+
+        def objective(u, data):
+            yv, n = data
             nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
-            return sse(nat, yv, period, multiplicative)
+            return sse(nat, yv, period, multiplicative, n)
 
         nat0 = jnp.asarray([0.3, 0.1, 0.1], yb.dtype)
         u0 = jnp.broadcast_to(
             optim.interval_to_sigmoid(nat0, 0.0, 1.0), (yb.shape[0], 3)
         )
-        res = optim.batched_minimize(objective, u0, yb, max_iters=max_iters, tol=tol)
+        res = optim.batched_minimize(objective, u0, (ya, nv), max_iters=max_iters, tol=tol)
+        ok = nv >= 2 * period  # seed needs two full seasons of real data
         return FitResult(
-            optim.sigmoid_to_interval(res.x, 0.0, 1.0), res.f, res.converged, res.iters
+            jnp.where(ok[:, None], optim.sigmoid_to_interval(res.x, 0.0, 1.0), jnp.nan),
+            jnp.where(ok, res.f, jnp.nan),
+            res.converged & ok,
+            res.iters,
         )
 
     return debatch(run(yb), single)
@@ -120,7 +148,8 @@ def forecast(params, y, period: int, n_future: int, model_type: str = "additive"
     @jax.jit
     def run(pb, yb):
         def one(pr, yv):
-            _, (level, trend, seasonal) = _run(pr, yv, period, multiplicative)
+            ya, nv = align_right(yv)
+            _, (level, trend, seasonal) = _run(pr, ya, period, multiplicative, nv)
             h = jnp.arange(1, n_future + 1, dtype=yv.dtype)
             seas = seasonal[(jnp.arange(n_future)) % period]
             base = level + h * trend

@@ -1,0 +1,218 @@
+"""Ragged-series support: model fits with leading/trailing NaNs must agree
+with fits on the trimmed series (SURVEY.md §7 "NaN padding + masks through
+every kernel").  The right-aligned masking makes the padded computation sum
+over exactly the same terms as the trimmed one, so agreement is tight.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu.models import (
+    arima,
+    autoregression,
+    base,
+    ewma,
+    garch,
+    holtwinters,
+)
+
+
+def _arma_series(n, phi=0.6, theta=0.3, seed=0, integrate=False):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=n)
+    y = np.zeros(n)
+    y[0] = e[0]
+    for t in range(1, n):
+        y[t] = phi * y[t - 1] + e[t] + theta * e[t - 1]
+    return np.cumsum(y) if integrate else y
+
+
+def _pad(y, lead, trail):
+    return np.concatenate([np.full(lead, np.nan), y, np.full(trail, np.nan)])
+
+
+class TestAlignRight:
+    def test_basic(self):
+        y = jnp.asarray([np.nan, 1.0, 2.0, 3.0, np.nan])
+        a, nv = base.align_right(y)
+        np.testing.assert_array_equal(np.asarray(a), [0.0, 0.0, 1.0, 2.0, 3.0])
+        assert int(nv) == 3
+
+    def test_no_nans(self):
+        y = jnp.arange(4.0)
+        a, nv = base.align_right(y)
+        np.testing.assert_array_equal(np.asarray(a), np.arange(4.0))
+        assert int(nv) == 4
+
+    def test_all_nan(self):
+        a, nv = base.align_right(jnp.full((5,), jnp.nan))
+        assert int(nv) == 0
+        assert np.all(np.asarray(a) == 0.0)
+
+    def test_interior_nan_zeroed(self):
+        y = jnp.asarray([np.nan, 1.0, np.nan, 3.0])
+        a, nv = base.align_right(y)
+        assert int(nv) == 3
+        np.testing.assert_array_equal(np.asarray(a), [0.0, 1.0, 0.0, 3.0])
+
+
+class TestArimaRagged:
+    def test_padded_matches_trimmed(self):
+        y = _arma_series(300, seed=1, integrate=True)
+        yp = _pad(y, 17, 9)
+        r_trim = arima.fit(jnp.asarray(y), (1, 1, 1))
+        r_pad = arima.fit(jnp.asarray(yp), (1, 1, 1))
+        assert bool(r_pad.converged)
+        np.testing.assert_allclose(
+            np.asarray(r_pad.params), np.asarray(r_trim.params), rtol=1e-3, atol=1e-4
+        )
+
+    def test_forecast_padded_matches_trimmed(self):
+        y = _arma_series(300, seed=2, integrate=True)
+        yp = _pad(y, 11, 4)
+        res = arima.fit(jnp.asarray(y), (1, 1, 1))
+        f_trim = arima.forecast(res.params, jnp.asarray(y), (1, 1, 1), 6)
+        f_pad = arima.forecast(res.params, jnp.asarray(yp), (1, 1, 1), 6)
+        np.testing.assert_allclose(np.asarray(f_pad), np.asarray(f_trim), rtol=1e-4)
+
+    def test_short_series_forecast_boundary_clean(self):
+        # regression: the garbage differenced value at the padding boundary
+        # must not leak into the error recursion (visible on SHORT series
+        # where the MA carry cannot decay before the end)
+        y = np.asarray(_arma_series(12, seed=13, integrate=True)) + 100
+        yp = _pad(y, 8, 0)
+        params = jnp.asarray([0.1, 0.5, 0.8])
+        f_trim = arima.forecast(params, jnp.asarray(y), (1, 1, 1), 4)
+        f_pad = arima.forecast(params, jnp.asarray(yp), (1, 1, 1), 4)
+        np.testing.assert_allclose(np.asarray(f_pad), np.asarray(f_trim), rtol=1e-6)
+
+    def test_too_short_series_flagged(self):
+        y = np.full(100, np.nan)
+        y[50:54] = [1.0, 2.0, 1.5, 2.5]  # 4 valid points
+        res = arima.fit(jnp.asarray(y), (1, 1, 1))
+        assert not bool(res.converged)
+        assert np.isnan(np.asarray(res.params)).all()
+
+    def test_batch_mixed_ragged(self):
+        y = _arma_series(200, seed=3)
+        batch = np.stack([_pad(y, 0, 0), _pad(y[:180], 20, 0), _pad(y[20:], 0, 20)])
+        res = arima.fit(jnp.asarray(batch), (1, 0, 1))
+        assert res.params.shape == (3, 3)
+        assert np.isfinite(np.asarray(res.params)).all()
+
+
+class TestEwmaRagged:
+    def test_padded_matches_trimmed(self):
+        y = np.abs(_arma_series(150, seed=4)) + 5
+        yp = _pad(y, 8, 3)
+        a_trim = ewma.fit(jnp.asarray(y)).params
+        a_pad = ewma.fit(jnp.asarray(yp)).params
+        np.testing.assert_allclose(np.asarray(a_pad), np.asarray(a_trim), rtol=1e-4)
+
+    def test_forecast_padded(self):
+        y = _arma_series(100, seed=5) + 10
+        yp = _pad(y, 5, 2)
+        res = ewma.fit(jnp.asarray(y))
+        f_trim = ewma.forecast(res.params, jnp.asarray(y), 3)
+        f_pad = ewma.forecast(res.params, jnp.asarray(yp), 3)
+        np.testing.assert_allclose(np.asarray(f_pad), np.asarray(f_trim), rtol=1e-6)
+
+    def test_all_nan_flagged(self):
+        res = ewma.fit(jnp.full((50,), jnp.nan))
+        assert not bool(res.converged)
+        assert np.isnan(float(res.params[0]))
+
+    def test_failed_fit_forecast_is_nan(self):
+        # regression: all-NaN series must forecast NaN, not a plausible 0.0
+        res = ewma.fit(jnp.full((50,), jnp.nan))
+        f = ewma.forecast(res.params, jnp.full((50,), jnp.nan), 3)
+        assert np.isnan(np.asarray(f)).all()
+
+
+class TestArRagged:
+    def test_padded_matches_trimmed(self):
+        y = _arma_series(250, theta=0.0, seed=6)
+        yp = _pad(y, 13, 6)
+        r_trim = autoregression.fit(jnp.asarray(y), max_lag=2)
+        r_pad = autoregression.fit(jnp.asarray(yp), max_lag=2)
+        np.testing.assert_allclose(
+            np.asarray(r_pad.params), np.asarray(r_trim.params), rtol=1e-6, atol=1e-8
+        )
+        np.testing.assert_allclose(
+            float(r_pad.neg_log_likelihood), float(r_trim.neg_log_likelihood), rtol=1e-6
+        )
+
+
+class TestGarchRagged:
+    def test_padded_matches_trimmed(self):
+        rng = np.random.default_rng(7)
+        n = 400
+        h = np.zeros(n)
+        r = np.zeros(n)
+        h[0] = 0.2
+        for t in range(1, n):
+            h[t] = 0.1 + 0.2 * r[t - 1] ** 2 + 0.6 * h[t - 1]
+            r[t] = np.sqrt(h[t]) * rng.normal()
+        rp = _pad(r, 21, 10)
+        g_trim = garch.fit(jnp.asarray(r))
+        g_pad = garch.fit(jnp.asarray(rp))
+        assert bool(g_pad.converged)
+        np.testing.assert_allclose(
+            np.asarray(g_pad.params), np.asarray(g_trim.params), rtol=5e-3, atol=1e-4
+        )
+
+    def test_loglik_masked_equals_trimmed(self):
+        rng = np.random.default_rng(8)
+        r = rng.normal(size=100)
+        rp, nv = base.align_right(jnp.asarray(_pad(r, 7, 3)))
+        params = jnp.asarray([0.1, 0.15, 0.7])
+        ll_pad = float(garch.log_likelihood(params, rp, nv))
+        ll_trim = float(garch.log_likelihood(params, jnp.asarray(r)))
+        np.testing.assert_allclose(ll_pad, ll_trim, rtol=1e-6)
+
+    def test_argarch_padded(self):
+        rng = np.random.default_rng(9)
+        n = 300
+        y = np.zeros(n)
+        for t in range(1, n):
+            y[t] = 0.5 + 0.4 * y[t - 1] + rng.normal() * 0.3
+        yp = _pad(y, 15, 5)
+        f_trim = garch.fit_argarch(jnp.asarray(y))
+        f_pad = garch.fit_argarch(jnp.asarray(yp))
+        np.testing.assert_allclose(
+            np.asarray(f_pad.params)[:2], np.asarray(f_trim.params)[:2], atol=0.05
+        )
+
+
+class TestHoltWintersRagged:
+    def _seasonal(self, n=144, period=12, seed=10):
+        rng = np.random.default_rng(seed)
+        t = np.arange(n)
+        return 10 + 0.05 * t + 3 * np.sin(2 * np.pi * t / period) + rng.normal(size=n) * 0.1
+
+    def test_padded_matches_trimmed(self):
+        period = 12
+        y = self._seasonal()
+        yp = _pad(y, 10, 7)
+        r_trim = holtwinters.fit(jnp.asarray(y), period)
+        r_pad = holtwinters.fit(jnp.asarray(yp), period)
+        assert bool(r_pad.converged)
+        np.testing.assert_allclose(
+            np.asarray(r_pad.params), np.asarray(r_trim.params), rtol=1e-3, atol=1e-4
+        )
+
+    def test_forecast_padded_matches_trimmed(self):
+        period = 12
+        y = self._seasonal(seed=11)
+        yp = _pad(y, 6, 2)
+        res = holtwinters.fit(jnp.asarray(y), period)
+        f_trim = holtwinters.forecast(res.params, jnp.asarray(y), period, 8)
+        f_pad = holtwinters.forecast(res.params, jnp.asarray(yp), period, 8)
+        np.testing.assert_allclose(np.asarray(f_pad), np.asarray(f_trim), rtol=1e-5)
+
+    def test_short_span_flagged(self):
+        y = _pad(self._seasonal()[:20], 60, 40)  # 20 valid < 2*12
+        res = holtwinters.fit(jnp.asarray(y), 12)
+        assert not bool(res.converged)
