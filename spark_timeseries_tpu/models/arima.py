@@ -191,37 +191,76 @@ def fit(
     init_params: Optional[jax.Array] = None,
     max_iters: int = 60,
     tol: Optional[float] = None,
+    backend: str = "auto",
 ) -> FitResult:
     """Fit ARIMA(p,d,q) to one series ``[time]`` or a batch ``[batch, time]``.
 
     The entire batch is one jitted computation: differencing -> vmapped
-    Hannan-Rissanen -> vmapped L-BFGS on the CSS objective.  ``method``
+    Hannan-Rissanen -> batched L-BFGS on the CSS objective.  ``method``
     accepts ``"css-lbfgs"`` (also aliased from the reference's ``"css-cgd"``
     and ``"css-bobyqa"``) and ``"hannan-rissanen"`` (init only, no MLE).
+
+    ``backend`` selects the CSS objective implementation: ``"scan"``
+    (``vmap(lax.scan)``, runs everywhere), ``"pallas"`` (fused TPU kernel
+    with hand-derived adjoint, ``ops.pallas_kernels``), or ``"auto"``
+    (pallas whenever :func:`ops.pallas_kernels.supported` says so).
     """
     if method not in ("css-lbfgs", "css-cgd", "css-bobyqa", "hannan-rissanen"):
         raise ValueError(f"unknown method {method!r}")
+    if backend not in ("auto", "scan", "pallas", "pallas-interpret"):
+        raise ValueError(f"unknown backend {backend!r}")
     p, d, q = order
     yb, single = ensure_batched(y)
     k = _n_params(order, include_intercept)
     if tol is None:
         # f32 gradients of a ~1k-term CSS bottom out near 1e-4 relative noise
         tol = 1e-6 if yb.dtype == jnp.float64 else 1e-4
+    if backend == "auto":
+        from ..ops import pallas_kernels as _pk
 
-    @jax.jit
-    def run(yb):
+        backend = "pallas" if _pk.supported(yb.dtype, yb.shape[1] - d) else "scan"
+
+    run = _fit_program(
+        order, include_intercept, method, backend, max_iters, float(tol),
+        init_params is not None,
+    )
+    if init_params is None:
+        return debatch(run(yb), single)
+    return debatch(run(yb, jnp.asarray(init_params)), single)
+
+
+@functools.lru_cache(maxsize=256)
+def _fit_program(order: Order, include_intercept: bool, method: str,
+                 backend: str, max_iters: int, tol: float, has_init: bool):
+    """Build + cache ONE compiled fit computation per static configuration.
+
+    Model entry points are library calls (no long-lived jit closure at the
+    call site), so caching here is what makes repeated ``fit`` calls pay
+    tracing/compilation once — the analog of the reference reusing one JVM
+    JIT-compiled code path across series.
+    """
+    p, d, q = order
+    k = _n_params(order, include_intercept)
+
+    def run(yb, init_params=None):
         ya, nv0 = jax.vmap(align_right)(yb)  # ragged support: NaN head/tail
         yd = jax.vmap(lambda v: _difference(v, d))(ya)
         nvd = nv0 - d  # valid length after differencing
         init = (
             jnp.broadcast_to(init_params, (yd.shape[0], k))
-            if init_params is not None
+            if has_init
             else jax.vmap(
                 lambda v, n: hannan_rissanen(v, order, include_intercept, n)
             )(yd, nvd)
         )
         # too-short series cannot be fit: need lags + a few dof
         ok = nvd >= p + q + max(p + q + 1, 1) + k + 2
+        if not has_init:
+            # Hannan-Rissanen's long-AR order m = min(p+q+1, n//4) is static
+            # (shapes), so it is computed from the PADDED length; requiring
+            # nvd >= 4*(p+q+1) ensures m would be p+q+1 either way, keeping
+            # padded and trimmed inits identical inside the supported region
+            ok = ok & (nvd >= 4 * (p + q + 1))
         if method == "hannan-rissanen":
             nll = jax.vmap(
                 lambda pr, v, n: css_neg_loglik(pr, v, order, include_intercept, n)
@@ -229,17 +268,30 @@ def fit(
             z = jnp.zeros((yd.shape[0],), jnp.int32)
             params = jnp.where(ok[:, None], init, jnp.nan)
             return FitResult(params, jnp.where(ok, nll, jnp.nan), ok, z)
-        res = optim.batched_minimize(
-            lambda pr, data: css_neg_loglik(pr, data[0], order, include_intercept, data[1]),
-            init,
-            (yd, nvd),
-            max_iters=max_iters,
-            tol=tol,
-        )
+        if backend in ("pallas", "pallas-interpret"):
+            from ..ops import pallas_kernels as _pk
+
+            interp = backend == "pallas-interpret"
+            res = optim.minimize_lbfgs_batched(
+                lambda P: _pk.css_neg_loglik(
+                    P, yd, order, include_intercept, nvd, interpret=interp
+                ),
+                init,
+                max_iters=max_iters,
+                tol=tol,
+            )
+        else:
+            res = optim.batched_minimize(
+                lambda pr, data: css_neg_loglik(pr, data[0], order, include_intercept, data[1]),
+                init,
+                (yd, nvd),
+                max_iters=max_iters,
+                tol=tol,
+            )
         params = jnp.where(ok[:, None], res.x, jnp.nan)
         return FitResult(params, jnp.where(ok, res.f, jnp.nan), res.converged & ok, res.iters)
 
-    return debatch(run(yb), single)
+    return jax.jit(run)
 
 
 # ---------------------------------------------------------------------------

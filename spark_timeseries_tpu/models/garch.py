@@ -232,15 +232,16 @@ def _argarch_from_natural(params):
 def argarch_neg_log_likelihood(params, y, n_valid=None):
     """y_t = c + phi y_{t-1} + r_t with GARCH(1,1) innovations r."""
     c, phi = params[0], params[1]
+    n = y.shape[0]
     prev = jnp.concatenate([y[:1], y[:-1]])
     r = y - c - phi * prev
-    if n_valid is None:
-        r = r.at[0].set(0.0)  # condition on the first observation
-        return neg_log_likelihood(params[2:], r)
-    start = y.shape[0] - n_valid
-    r = jnp.where(jnp.arange(y.shape[0]) <= start, 0.0, r)  # condition on y[start]
-    # one fewer residual than valid observations (the conditioned first one)
-    return neg_log_likelihood(params[2:], r, n_valid - 1)
+    # one code path for trimmed and padded series: condition on the first
+    # valid observation, whose residual is excluded from both the variance
+    # seed and the likelihood sum (one fewer residual than observations)
+    nv = jnp.asarray(n, jnp.int32) if n_valid is None else n_valid
+    start = n - nv
+    r = jnp.where(jnp.arange(n) <= start, 0.0, r)
+    return neg_log_likelihood(params[2:], r, nv - 1)
 
 
 def fit_argarch(y, *, max_iters: int = 100, tol: Optional[float] = None) -> FitResult:

@@ -153,7 +153,10 @@ def forecast(params, y, period: int, n_future: int, model_type: str = "additive"
             h = jnp.arange(1, n_future + 1, dtype=yv.dtype)
             seas = seasonal[(jnp.arange(n_future)) % period]
             base = level + h * trend
-            return base * seas if multiplicative else base + seas
+            out = base * seas if multiplicative else base + seas
+            # seeding needs two full seasons (same gate as fit): shorter
+            # spans would return finite garbage from clamped seed windows
+            return jnp.where(nv >= 2 * period, out, jnp.nan)
 
         return jax.vmap(one)(pb, yb)
 

@@ -1,0 +1,298 @@
+"""Pallas TPU kernels for the sequential-recursion hot paths.
+
+The reference runs its model recursions (ARMA one-step-ahead CSS errors,
+GARCH conditional variance, EWMA smoothing) as per-series JVM loops
+(``sparkts/models/ARIMA.scala`` ``logLikelihoodCSS`` /
+``gradientLogLikelihoodCSSARMA``, ``GARCH.scala``, ``EWMA.scala`` —
+SURVEY.md §2.2, upstream paths unverified).  The portable rebuild expresses
+them as ``jax.vmap(lax.scan)`` (``models/arima.py`` etc.), which is correct
+everywhere but pays one XLA loop iteration — several HBM round trips — per
+time step.
+
+These kernels fuse the *entire* recursion into one grid step whose series
+block lives in VMEM: series are folded to ``[time, 8, 128]`` tiles
+(sublane x lane = 1024 series per block), the natural f32 vector-register
+shape, so every time step is a handful of full-width VPU ops instead of an
+XLA loop iteration.
+
+Like the reference — which hand-derives ``gradientLogLikelihoodCSSARMA``
+rather than relying on automatic differentiation — the ARMA kernel ships a
+hand-derived adjoint recursion, exposed through ``jax.custom_vjp`` so the
+batched L-BFGS driver (``utils/optim``) can differentiate the CSS objective
+without XLA's scan transpose.  The adjoint propagates cotangents to the
+parameters only; the observations are treated as constants (exactly the
+reference's gradient), so these entry points are used inside fit objectives
+and not exposed as general autodiff building blocks.
+
+Everything here is optional: callers gate on :func:`supported` and fall back
+to the ``lax.scan`` implementations (same semantics, cross-checked by
+``tests/test_pallas.py`` in interpret mode).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Order = Tuple[int, int, int]
+
+_SUBL = 8  # f32 sublanes per vector register
+_LANES = 128  # TPU lane width
+_SBLK = _SUBL * _LANES  # series per grid step (1024)
+# VMEM budget: the adjoint kernel holds y, e, and the e-adjoint as
+# [T, 8, 128] f32 tiles (4 KiB per time step each) -> ~12 KiB * T; cap T to
+# stay well inside ~16 MiB/core.
+_MAX_T = 1024
+
+
+def supported(dtype, n_time: int) -> bool:
+    """True when the fused kernels can run natively on this platform/shape."""
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:  # pragma: no cover - no backend at all
+        return False
+    return (
+        platform in ("tpu", "axon")
+        and jnp.dtype(dtype) == jnp.dtype(jnp.float32)
+        and n_time <= _MAX_T
+    )
+
+
+def _pad_to(n: int, m: int) -> int:
+    return (-n) % m
+
+
+def _fold(x2d):
+    """``[B, n] -> [n, B_pad/128-groups]`` series folding.
+
+    Returns ``[n, Bp // 128 sublane-rows, 128]`` where consecutive series map
+    to consecutive lanes; the kernel grid walks 8-sublane blocks of axis 1.
+    """
+    b, n = x2d.shape
+    x2d = jnp.pad(x2d, ((0, _pad_to(b, _SBLK)), (0, 0)))
+    bp = x2d.shape[0]
+    return x2d.T.reshape(n, bp // _LANES, _LANES)
+
+
+def _unfold(x3d, b: int):
+    """Inverse of :func:`_fold`: ``[n, Bp/128, 128] -> [B, n]``."""
+    n = x3d.shape[0]
+    return x3d.reshape(n, -1).T[:b]
+
+
+def _blockspec(n0: int):
+    """Whole axis 0, one [8, 128] series block of axis 1/2 per grid step."""
+    return pl.BlockSpec((n0, _SUBL, _LANES), lambda blk: (0, blk, 0))
+
+
+# ---------------------------------------------------------------------------
+# ARMA CSS one-step-ahead prediction errors (forward + hand-derived adjoint)
+# ---------------------------------------------------------------------------
+#
+# Per series (reference ARIMAModel.logLikelihoodCSSARMA):
+#   u_t = y_t - c - sum_i phi_i * y_{t-i} - sum_j theta_j * e_{t-j}
+#   e_t = m_t * u_t        with m_t = [zb <= t < t_limit], y_{<0} = e_{<0} = 0
+#
+# Adjoint (reference gradientLogLikelihoodCSSARMA, generalized to an
+# arbitrary upstream cotangent gbar of e):
+#   a_t         = m_t * (gbar_t - sum_j theta_j * a_{t+j})      (t descending)
+#   dL/dc       = -sum_t a_t
+#   dL/dphi_i   = -sum_t y_{t-i} * a_t
+#   dL/dtheta_j = -sum_t e_{t-j} * a_t
+
+
+def _css_fwd_kernel(p, q, t_limit, n_t, y_ref, par_ref, zb_ref, e_ref):
+    zb = zb_ref[0]
+
+    def body(t, _):
+        pred = par_ref[0]
+        for i in range(1, p + 1):
+            yi = y_ref[jnp.maximum(t - i, 0)]
+            pred += par_ref[i] * jnp.where(t - i >= 0, yi, 0.0)
+        for j in range(1, q + 1):
+            ej = e_ref[jnp.maximum(t - j, 0)]
+            pred += par_ref[p + j] * jnp.where(t - j >= 0, ej, 0.0)
+        live = (t.astype(jnp.float32) >= zb) & (t < t_limit)
+        e_ref[t] = jnp.where(live, y_ref[t] - pred, 0.0)
+        return 0
+
+    lax.fori_loop(0, n_t, body, 0)
+
+
+def _css_bwd_kernel(p, q, t_limit, n_t,
+                    y_ref, e_ref, par_ref, zb_ref, g_ref, gpar_ref, adj_ref):
+    adj_ref[:] = g_ref[:]
+    zb = zb_ref[0]
+    k = 1 + p + q
+    zero = jnp.zeros((_SUBL, _LANES), jnp.float32)
+
+    def body(i, accs):
+        t = n_t - 1 - i
+        live = (t.astype(jnp.float32) >= zb) & (t < t_limit)
+        a = jnp.where(live, adj_ref[t], 0.0)
+        for j in range(1, q + 1):
+            idx = jnp.maximum(t - j, 0)
+            contrib = jnp.where(t - j >= 0, par_ref[p + j] * a, 0.0)
+            adj_ref[idx] = adj_ref[idx] - contrib
+        new = [accs[0] - a]
+        for i_ in range(1, p + 1):
+            yi = jnp.where(t - i_ >= 0, y_ref[jnp.maximum(t - i_, 0)], 0.0)
+            new.append(accs[i_] - yi * a)
+        for j in range(1, q + 1):
+            ej = jnp.where(t - j >= 0, e_ref[jnp.maximum(t - j, 0)], 0.0)
+            new.append(accs[p + j] - ej * a)
+        return tuple(new)
+
+    accs = lax.fori_loop(0, n_t, body, tuple(zero for _ in range(k)))
+    for r in range(k):
+        gpar_ref[r] = accs[r]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def css_errors(p: int, q: int, interpret: bool, params, yd, zb):
+    """Batched ARMA(p, q) CSS errors ``[B, T]`` on a fused TPU kernel.
+
+    ``params``: ``[B, 1 + p + q]`` rows ``[c, phi_1..p, theta_1..q]`` (models
+    without an intercept pass ``c = 0``); ``yd``: ``[B, T]`` differenced
+    series with any invalid prefix already zeroed; ``zb``: ``[B]`` float —
+    errors before this position are forced to zero (``start + p`` for the
+    conditional likelihood).  Gradients flow to ``params`` only.
+    """
+    e, _ = _css_errors_fwd(p, q, interpret, params, yd, zb)
+    return e
+
+
+def _css_errors_fwd(p, q, interpret, params, yd, zb):
+    b, t = yd.shape
+    k = 1 + p + q
+    assert params.shape == (b, k), (params.shape, (b, k))
+    tp = t + _pad_to(t, _SUBL)
+    y3 = _fold(jnp.pad(yd, ((0, 0), (0, tp - t))))
+    par3 = _fold(params)
+    zb3 = _fold(zb.astype(yd.dtype)[:, None])
+    nblk = y3.shape[1] // _SUBL
+    e3 = pl.pallas_call(
+        functools.partial(_css_fwd_kernel, p, q, t, tp),
+        grid=(nblk,),
+        in_specs=[_blockspec(tp), _blockspec(k), _blockspec(1)],
+        out_specs=_blockspec(tp),
+        out_shape=jax.ShapeDtypeStruct(y3.shape, yd.dtype),
+        interpret=interpret,
+    )(y3, par3, zb3)
+    return _unfold(e3, b)[:, :t], (y3, par3, zb3, e3)
+
+
+def _css_errors_bwd(p, q, interpret, res, g):
+    y3, par3, zb3, e3 = res
+    tp = y3.shape[0]
+    b, t = g.shape
+    k = 1 + p + q
+    g3 = _fold(jnp.pad(g, ((0, 0), (0, tp - t))))
+    nblk = y3.shape[1] // _SUBL
+    gpar3 = pl.pallas_call(
+        functools.partial(_css_bwd_kernel, p, q, t, tp),
+        grid=(nblk,),
+        in_specs=[_blockspec(tp)] * 2 + [_blockspec(k), _blockspec(1), _blockspec(tp)],
+        out_specs=_blockspec(k),
+        out_shape=jax.ShapeDtypeStruct(par3.shape, g.dtype),
+        scratch_shapes=[pltpu.VMEM((tp, _SUBL, _LANES), jnp.float32)],
+        # y/e/g tiles + the adjoint scratch at T=1024 exceed the default
+        # 16 MiB scoped-vmem budget once the pipeline double-buffers inputs
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024
+        ),
+        interpret=interpret,
+    )(y3, e3, par3, zb3, g3)
+    gparams = _unfold(gpar3, b)
+    # observations and the mask boundary are constants of the fit objective
+    return gparams, jnp.zeros((b, t), g.dtype), jnp.zeros((b,), g.dtype)
+
+
+css_errors.defvjp(_css_errors_fwd, _css_errors_bwd)
+
+
+def css_neg_loglik(params, yd, order: Order, include_intercept: bool,
+                   n_valid=None, *, interpret: bool = False):
+    """Batched CSS negative log-likelihood ``[B]`` on the fused kernel.
+
+    Matches ``models.arima.css_neg_loglik`` (vmapped) to float tolerance;
+    differentiable in ``params`` via the hand-derived adjoint.
+    """
+    p, _, q = order
+    b, n = yd.shape
+    nv = jnp.full((b,), n, yd.dtype) if n_valid is None else n_valid.astype(yd.dtype)
+    start = n - nv
+    t_idx = jnp.arange(n, dtype=yd.dtype)
+    ydz = jnp.where(t_idx[None, :] >= start[:, None], yd, 0.0)
+    if include_intercept:
+        params_k = params
+    else:  # kernel layout always carries an intercept slot
+        params_k = jnp.concatenate(
+            [jnp.zeros((b, 1), params.dtype), params], axis=1
+        )
+    e = css_errors(p, q, interpret, params_k, ydz, start + p)
+    n_eff = nv - p
+    css = jnp.sum(e * e, axis=1)
+    sigma2 = css / n_eff
+    return 0.5 * n_eff * (jnp.log(2.0 * jnp.pi * sigma2) + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# GARCH(1, 1) conditional-variance recursion
+# ---------------------------------------------------------------------------
+#
+# h_t = omega + alpha * r_{t-1}^2 + beta * h_{t-1}, h_start = h0
+# (reference GARCH.scala log-likelihood loop).  The prefix [0, zb) holds
+# h_t = h0 so padded series contribute nothing.
+
+
+def _garch_fwd_kernel(t_limit, n_t, r2_ref, par_ref, h0_ref, zb_ref, h_ref):
+    zb = zb_ref[0]
+    h0 = h0_ref[0]
+
+    def body(t, _):
+        tf = t.astype(jnp.float32)
+        hp = h_ref[jnp.maximum(t - 1, 0)]
+        hp = jnp.where(t - 1 >= 0, hp, h0)
+        r2p = jnp.where(t - 1 >= 0, r2_ref[jnp.maximum(t - 1, 0)], 0.0)
+        # the first live step seeds with h0 standing in for r_{start-1}^2
+        # (matching models.garch.variances)
+        r2p = jnp.where(tf == zb, h0, r2p)
+        h = par_ref[0] + par_ref[1] * r2p + par_ref[2] * hp
+        live = (tf >= zb) & (t < t_limit)
+        h_ref[t] = jnp.where(live, h, h0)
+        return 0
+
+    lax.fori_loop(0, n_t, body, 0)
+
+
+def garch_variances(params, r, h0, zb, *, interpret: bool = False):
+    """Batched GARCH(1,1) conditional variances ``[B, T]`` (no grad path —
+    used for the forward/diagnostic entry points).
+
+    ``params``: ``[B, 3]`` rows ``[omega, alpha, beta]``; ``r``: ``[B, T]``
+    returns with the invalid prefix zeroed; ``h0``: ``[B]`` start variance;
+    ``zb``: ``[B]`` first live position.
+    """
+    b, t = r.shape
+    tp = t + _pad_to(t, _SUBL)
+    r2 = _fold(jnp.pad(r * r, ((0, 0), (0, tp - t))))
+    par3 = _fold(params)
+    h03 = _fold(h0[:, None].astype(r.dtype))
+    zb3 = _fold(zb.astype(r.dtype)[:, None])
+    nblk = r2.shape[1] // _SUBL
+    h3 = pl.pallas_call(
+        functools.partial(_garch_fwd_kernel, t, tp),
+        grid=(nblk,),
+        in_specs=[_blockspec(tp), _blockspec(3), _blockspec(1), _blockspec(1)],
+        out_specs=_blockspec(tp),
+        out_shape=jax.ShapeDtypeStruct(r2.shape, r.dtype),
+        interpret=interpret,
+    )(r2, par3, h03, zb3)
+    return _unfold(h3, b)[:, :t]

@@ -53,16 +53,21 @@ def _two_loop(g, s_hist, y_hist, rho_hist, k, m):
     """L-BFGS two-loop recursion with masked (not-yet-filled) history slots.
 
     History is a ring buffer; slot ``i`` is valid when ``rho_hist[i] > 0``.
+    The loops are unrolled (``m`` is a small static history size): unrolling
+    lets XLA fuse the whole recursion into a couple of kernels instead of
+    ``2m`` sequential scan steps — this machinery runs once per optimizer
+    iteration on every series, so launch overhead matters.
     """
     idx = (k - 1 - jnp.arange(m)) % m  # newest -> oldest
 
-    def bwd(q, i):
+    q = g
+    alphas = []
+    for j in range(m):
+        i = idx[j]
         valid = rho_hist[i] > 0.0
         alpha = jnp.where(valid, rho_hist[i] * jnp.dot(s_hist[i], q), 0.0)
         q = q - alpha * y_hist[i] * valid
-        return q, alpha
-
-    q, alphas = lax.scan(bwd, g, idx)
+        alphas.append(alpha)
 
     # initial Hessian scaling gamma = s·y / y·y of the newest valid pair
     newest = idx[0]
@@ -71,14 +76,11 @@ def _two_loop(g, s_hist, y_hist, rho_hist, k, m):
     gamma = jnp.where((rho_hist[newest] > 0.0) & (yy > 0.0), sy / yy, 1.0)
     r = gamma * q
 
-    def fwd(r, inp):
-        i, alpha = inp
+    for j in reversed(range(m)):
+        i = idx[j]
         valid = rho_hist[i] > 0.0
         beta = jnp.where(valid, rho_hist[i] * jnp.dot(y_hist[i], r), 0.0)
-        r = r + (alpha - beta) * s_hist[i] * valid
-        return r, None
-
-    r, _ = lax.scan(fwd, r, (idx[::-1], alphas[::-1]))
+        r = r + (alphas[j] - beta) * s_hist[i] * valid
     return r  # approximates H g
 
 
@@ -191,6 +193,140 @@ def minimize_lbfgs(
         converged=final.converged & jnp.isfinite(final.f),
         iters=final.k,
         grad_norm=jnp.linalg.norm(final.g),
+    )
+
+
+def minimize_lbfgs_batched(
+    fun_batched: Callable[[jax.Array], jax.Array],
+    x0: jax.Array,
+    *,
+    max_iters: int = 50,
+    history: int = 8,
+    tol: float = 1e-6,
+    max_linesearch: int = 20,
+    c1: float = 1e-4,
+) -> LBFGSResult:
+    """Jointly minimize ``B`` independent problems with ONE batched objective.
+
+    ``fun_batched(x[B, d]) -> f[B]`` evaluates every problem at once — the
+    entry point for fused whole-batch objectives (e.g. the Pallas CSS kernel,
+    ``ops.pallas_kernels``) that cannot be traced per-series under ``vmap``.
+    Semantics match ``vmap(minimize_lbfgs)``: each row carries its own
+    history, step size, and convergence flag; rows are block-diagonal so the
+    gradient of ``sum(f)`` is exactly the per-row gradient.  All rows step in
+    lockstep (as they do under ``vmap`` of a ``while_loop``); finished rows
+    freeze their state.
+    """
+    bsz, d = x0.shape
+    m = history
+    dtype = x0.dtype
+
+    def vg(x):
+        f, pullback = jax.vjp(fun_batched, x)
+        (g,) = pullback(jnp.ones_like(f))
+        bad = ~jnp.isfinite(f) | ~jnp.all(jnp.isfinite(g), axis=-1)
+        return jnp.where(bad, jnp.inf, f), jnp.where(bad[:, None], 0.0, g)
+
+    rownorm = lambda v: jnp.linalg.norm(v, axis=-1)
+    rowdot = lambda a, b: jnp.sum(a * b, axis=-1)
+
+    f0, g0 = vg(x0)
+    init = _State(
+        k=jnp.zeros((), jnp.int32),
+        x=x0,
+        f=f0,
+        g=g0,
+        s_hist=jnp.zeros((bsz, m, d), dtype),
+        y_hist=jnp.zeros((bsz, m, d), dtype),
+        rho_hist=jnp.zeros((bsz, m), dtype),
+        converged=(rownorm(g0) < tol) & jnp.isfinite(f0),
+        failed=jnp.isinf(f0),
+    )
+    iters0 = jnp.zeros((bsz,), jnp.int32)
+
+    two_loop_b = jax.vmap(_two_loop, in_axes=(0, 0, 0, 0, None, None))
+
+    def linesearch(x, f, g, direction, done):
+        # done rows are pre-satisfied: their (frozen) state can never pass the
+        # strict Armijo test, and one such row would otherwise drag the whole
+        # batch through max_linesearch extra objective evaluations
+        gd = rowdot(g, direction)
+
+        def body(carry):
+            t, ok, j = carry
+            fnew = fun_batched(x + t[:, None] * direction)
+            fnew = jnp.where(jnp.isfinite(fnew), fnew, jnp.inf)
+            ok_new = ok | (fnew <= f + c1 * t * gd)
+            return jnp.where(ok_new, t, t * 0.5), ok_new, j + 1
+
+        def cond(carry):
+            _, ok, j = carry
+            return jnp.any(~ok) & (j < max_linesearch)
+
+        t, ok, _ = lax.while_loop(
+            cond, body, (jnp.ones((bsz,), dtype), done, 0)
+        )
+        return t, ok
+
+    def step(carry):
+        state, iters = carry
+        done = state.converged | state.failed
+        direction = -two_loop_b(
+            state.g, state.s_hist, state.y_hist, state.rho_hist, state.k, m
+        )
+        descent = rowdot(state.g, direction) < 0.0
+        direction = jnp.where(descent[:, None], direction, -state.g)
+
+        t, ok = linesearch(state.x, state.f, state.g, direction, done)
+        x_new = state.x + t[:, None] * direction
+        f_new, g_new = vg(x_new)
+
+        s = x_new - state.x
+        y = g_new - state.g
+        sy = rowdot(s, y)
+        slot = state.k % m
+        good_pair = (sy > 1e-10) & ok & ~done
+        upd = lambda hist, v: hist.at[:, slot].set(
+            jnp.where(good_pair[:, None], v, hist[:, slot])
+        )
+        s_hist = upd(state.s_hist, s)
+        y_hist = upd(state.y_hist, y)
+        rho_hist = state.rho_hist.at[:, slot].set(
+            jnp.where(good_pair, 1.0 / jnp.maximum(sy, 1e-30), state.rho_hist[:, slot])
+        )
+
+        accept = ok & (f_new <= state.f) & ~done
+        x_out = jnp.where(accept[:, None], x_new, state.x)
+        f_out = jnp.where(accept, f_new, state.f)
+        g_out = jnp.where(accept[:, None], g_new, state.g)
+        conv = state.converged | (
+            rownorm(g_out) < tol * jnp.maximum(1.0, rownorm(x_out))
+        )
+        new_state = _State(
+            k=state.k + 1,
+            x=x_out,
+            f=f_out,
+            g=g_out,
+            s_hist=s_hist,
+            y_hist=y_hist,
+            rho_hist=rho_hist,
+            converged=conv,
+            failed=state.failed | (~ok & ~conv & ~done),
+        )
+        iters = jnp.where(done, iters, state.k + 1)
+        return new_state, iters
+
+    def cond(carry):
+        state, _ = carry
+        return (state.k < max_iters) & jnp.any(~(state.converged | state.failed))
+
+    final, iters = lax.while_loop(cond, step, (init, iters0))
+    return LBFGSResult(
+        x=final.x,
+        f=final.f,
+        converged=final.converged & jnp.isfinite(final.f),
+        iters=iters,
+        grad_norm=rownorm(final.g),
     )
 
 
