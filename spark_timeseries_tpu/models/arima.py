@@ -35,7 +35,7 @@ from jax import lax
 from ..ops import univariate as uv
 from ..utils import optim
 from ..utils.linalg import ols as _ols
-from .base import FitResult, align_right, debatch, ensure_batched
+from .base import FitResult, align_right, debatch, ensure_batched, jit_program
 
 Order = Tuple[int, int, int]
 
@@ -229,16 +229,9 @@ def fit(
     return debatch(run(yb, jnp.asarray(init_params)), single)
 
 
-@functools.lru_cache(maxsize=256)
+@jit_program
 def _fit_program(order: Order, include_intercept: bool, method: str,
                  backend: str, max_iters: int, tol: float, has_init: bool):
-    """Build + cache ONE compiled fit computation per static configuration.
-
-    Model entry points are library calls (no long-lived jit closure at the
-    call site), so caching here is what makes repeated ``fit`` calls pay
-    tracing/compilation once — the analog of the reference reusing one JVM
-    JIT-compiled code path across series.
-    """
     p, d, q = order
     k = _n_params(order, include_intercept)
 
@@ -291,7 +284,7 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
         params = jnp.where(ok[:, None], res.x, jnp.nan)
         return FitResult(params, jnp.where(ok, res.f, jnp.nan), res.converged & ok, res.iters)
 
-    return jax.jit(run)
+    return run
 
 
 # ---------------------------------------------------------------------------
@@ -307,11 +300,16 @@ def forecast(params, y, order: Order, n_future: int, include_intercept: bool = T
     order-d differencing is inverted step by step (reference
     ``ARIMAModel.forecast`` semantics).
     """
-    p, d, q = order
     yb, single = ensure_batched(y)
     params_b = jnp.atleast_2d(params)
+    out = _forecast_program(order, n_future, include_intercept)(params_b, yb)
+    return out[0] if single else out
 
-    @jax.jit
+
+@jit_program
+def _forecast_program(order, n_future, include_intercept):
+    p, d, q = order
+
     def run(params_b, yb):
         def one(pr, yv):
             yv, nv0 = align_right(yv)  # ragged support: NaN head/tail
@@ -349,16 +347,19 @@ def forecast(params, y, order: Order, n_future: int, include_intercept: bool = T
 
         return jax.vmap(one)(params_b, yb)
 
-    out = run(params_b, yb)
-    return out[0] if single else out
+    return run
 
 
 def sample(params, key, n: int, order: Order, include_intercept: bool = True, sigma: float = 1.0):
     """Generate a series of length ``n`` from the model with N(0, sigma^2)
     innovations (reference ``ARIMAModel.sample``)."""
+    return _sample_program(order, n, include_intercept, float(sigma))(params, key)
+
+
+@jit_program
+def _sample_program(order, n, include_intercept, sigma):
     p, d, q = order
 
-    @jax.jit
     def run(params, key):
         params = jnp.asarray(params, jnp.result_type(float))
         c, phi, theta = _split_params(params, order, include_intercept)
@@ -378,18 +379,23 @@ def sample(params, key, n: int, order: Order, include_intercept: bool = True, si
             y = jnp.cumsum(y)
         return y[d:] if d else y
 
-    return run(params, key)
+    return run
 
 
 def remove_time_dependent_effects(params, y, order: Order, include_intercept: bool = True):
     """Destructure a series into its innovations (zero-padded-lag recursion;
     exactly inverted by :func:`add_time_dependent_effects`).  The first ``d``
     output entries carry the integration constants."""
-    _, d, _ = order
     yb, single = ensure_batched(y)
     params_b = jnp.atleast_2d(params)
+    out = _remove_effects_program(order, include_intercept)(params_b, yb)
+    return out[0] if single else out
 
-    @jax.jit
+
+@jit_program
+def _remove_effects_program(order, include_intercept):
+    _, d, _ = order
+
     def run(params_b, yb):
         def one(pr, yv):
             # integration constants: the FIRST value of each difference level
@@ -407,18 +413,22 @@ def remove_time_dependent_effects(params, y, order: Order, include_intercept: bo
 
         return jax.vmap(one)(params_b, yb)
 
-    out = run(params_b, yb)
-    return out[0] if single else out
+    return run
 
 
 def add_time_dependent_effects(params, x, order: Order, include_intercept: bool = True):
     """Inverse of :func:`remove_time_dependent_effects`: innovations (with
     integration constants in the first ``d`` slots) -> the observed series."""
-    p, d, q = order
     xb, single = ensure_batched(x)
     params_b = jnp.atleast_2d(params)
+    out = _add_effects_program(order, include_intercept)(params_b, xb)
+    return out[0] if single else out
 
-    @jax.jit
+
+@jit_program
+def _add_effects_program(order, include_intercept):
+    p, d, q = order
+
     def run(params_b, xb):
         def one(pr, xv):
             c, phi, theta = _split_params(pr, order, include_intercept)
@@ -447,8 +457,7 @@ def add_time_dependent_effects(params, x, order: Order, include_intercept: bool 
 
         return jax.vmap(one)(params_b, xb)
 
-    out = run(params_b, xb)
-    return out[0] if single else out
+    return run
 
 
 def is_stationary(params, order: Order, include_intercept: bool = True) -> np.ndarray:

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from ..ops.lagmat import lag_mat_trim_both
 from . import arima as _arima
 from ..utils.linalg import ols as _ols
-from .base import FitResult, align_right, debatch, ensure_batched
+from .base import FitResult, align_right, debatch, ensure_batched, jit_program
 
 
 def fit(y, max_lag: int = 1, no_intercept: bool = False) -> FitResult:
@@ -27,8 +27,11 @@ def fit(y, max_lag: int = 1, no_intercept: bool = False) -> FitResult:
     normal equations); too-short series come back NaN, ``converged=False``.
     """
     yb, single = ensure_batched(y)
+    return debatch(_fit_program(max_lag, no_intercept)(yb), single)
 
-    @jax.jit
+
+@jit_program
+def _fit_program(max_lag, no_intercept):
     def run(yb):
         def one(yv, nv):
             start = yv.shape[0] - nv
@@ -59,7 +62,7 @@ def fit(y, max_lag: int = 1, no_intercept: bool = False) -> FitResult:
             jnp.zeros((b,), jnp.int32),
         )
 
-    return debatch(run(yb), single)
+    return run
 
 
 def forecast(params, y, max_lag: int, n_future: int):

@@ -16,10 +16,31 @@ Conventions:
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+
+def jit_program(builder):
+    """Build + cache ONE compiled program per static configuration.
+
+    ``builder(*static) -> traceable fn``; ``jit_program(builder)(*static)``
+    returns the jitted fn, cached on the static args.  Model entry points are
+    plain library calls (no long-lived jit closure at the call site), so
+    without this every ``fit``/``forecast`` call would re-trace and
+    re-compile — the analog of the reference reusing one JVM JIT-compiled
+    code path across calls.
+    """
+    cached = functools.lru_cache(maxsize=512)(
+        lambda *static: jax.jit(builder(*static))
+    )
+
+    def norm(a):  # tolerate list-valued order/shape args (lists don't hash)
+        return tuple(a) if isinstance(a, list) else a
+
+    return functools.wraps(builder)(lambda *static: cached(*map(norm, static)))
 
 
 class FitResult(NamedTuple):

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import FitResult, align_right, debatch, ensure_batched
+from .base import FitResult, align_right, debatch, ensure_batched, jit_program
 
 
 def smooth(alpha, x, n_valid=None):
@@ -76,8 +76,11 @@ def fit(y, *, max_iters: int = 40, tol: Optional[float] = None) -> FitResult:
     yb, single = ensure_batched(y)
     if tol is None:
         tol = 1e-8 if yb.dtype == jnp.float64 else 1e-4
+    return debatch(_fit_program(max_iters, float(tol))(yb), single)
 
-    @jax.jit
+
+@jit_program
+def _fit_program(max_iters, tol):
     def run(yb):
         ya, nv = jax.vmap(align_right)(yb)
 
@@ -96,15 +99,19 @@ def fit(y, *, max_iters: int = 40, tol: Optional[float] = None) -> FitResult:
             res.iters,
         )
 
-    return debatch(run(yb), single)
+    return run
 
 
 def forecast(params, y, n_future: int):
     """EWMA forecasts are flat at the last smoothed level."""
     yb, single = ensure_batched(y)
     pb = jnp.atleast_2d(params)
+    out = _forecast_program(n_future)(pb, yb)
+    return out[0] if single else out
 
-    @jax.jit
+
+@jit_program
+def _forecast_program(n_future):
     def run(pb, yb):
         def one(a, x):
             xa, nv = align_right(x)
@@ -115,19 +122,22 @@ def forecast(params, y, n_future: int):
         last = jax.vmap(one)(pb, yb)
         return jnp.broadcast_to(last[:, None], (yb.shape[0], n_future))
 
-    out = run(pb, yb)
-    return out[0] if single else out
+    return run
+
+
+_smooth_batched = jax.jit(jax.vmap(lambda a, v: smooth(a[0], v)))
+_unsmooth_batched = jax.jit(jax.vmap(lambda a, v: unsmooth(a[0], v)))
 
 
 def add_time_dependent_effects(params, x):
     xb, single = ensure_batched(x)
     pb = jnp.atleast_2d(params)
-    out = jax.jit(jax.vmap(lambda a, v: smooth(a[0], v)))(pb, xb)
+    out = _smooth_batched(pb, xb)
     return out[0] if single else out
 
 
 def remove_time_dependent_effects(params, s):
     sb, single = ensure_batched(s)
     pb = jnp.atleast_2d(params)
-    out = jax.jit(jax.vmap(lambda a, v: unsmooth(a[0], v)))(pb, sb)
+    out = _unsmooth_batched(pb, sb)
     return out[0] if single else out

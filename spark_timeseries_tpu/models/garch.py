@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import FitResult, align_right, debatch, ensure_batched
+from .base import FitResult, align_right, debatch, ensure_batched, jit_program
 
 
 # -- transforms -------------------------------------------------------------
@@ -129,8 +129,11 @@ def fit(r, *, max_iters: int = 80, tol: Optional[float] = None) -> FitResult:
     rb, single = ensure_batched(r)
     if tol is None:
         tol = 1e-7 if rb.dtype == jnp.float64 else 1e-4
+    return debatch(_fit_program(max_iters, float(tol))(rb), single)
 
-    @jax.jit
+
+@jit_program
+def _fit_program(max_iters, tol):
     def run(rb):
         ra, nv = jax.vmap(align_right)(rb)
 
@@ -154,7 +157,7 @@ def fit(r, *, max_iters: int = 80, tol: Optional[float] = None) -> FitResult:
             res.iters,
         )
 
-    return debatch(run(rb), single)
+    return run
 
 
 def sample(params, key, n: int):
@@ -175,25 +178,25 @@ def add_time_dependent_effects(params, x):
     """
     xb, single = ensure_batched(x)
     pb = jnp.atleast_2d(params)
-
-    @jax.jit
-    def run(pb, xb):
-        def one(pr, xv):
-            omega, alpha, beta = pr[0], pr[1], pr[2]
-
-            def step(carry, e):
-                h, r_prev = carry
-                h = omega + alpha * r_prev**2 + beta * h
-                r = jnp.sqrt(jnp.maximum(h, 1e-12)) * e
-                return (h, r), r
-
-            _, r = lax.scan(step, (_unconditional_var(pr), jnp.zeros((), xv.dtype)), xv)
-            return r
-
-        return jax.vmap(one)(pb, xb)
-
-    out = run(pb, xb)
+    out = _add_effects_batched(pb, xb)
     return out[0] if single else out
+
+
+@jax.jit
+def _add_effects_batched(pb, xb):
+    def one(pr, xv):
+        omega, alpha, beta = pr[0], pr[1], pr[2]
+
+        def step(carry, e):
+            h, r_prev = carry
+            h = omega + alpha * r_prev**2 + beta * h
+            r = jnp.sqrt(jnp.maximum(h, 1e-12)) * e
+            return (h, r), r
+
+        _, r = lax.scan(step, (_unconditional_var(pr), jnp.zeros((), xv.dtype)), xv)
+        return r
+
+    return jax.vmap(one)(pb, xb)
 
 
 def remove_time_dependent_effects(params, r):
@@ -202,18 +205,18 @@ def remove_time_dependent_effects(params, r):
     round-trips exactly."""
     rb, single = ensure_batched(r)
     pb = jnp.atleast_2d(params)
-
-    @jax.jit
-    def run(pb, rb):
-        def one(pr, rv):
-            r_sq_prev = jnp.concatenate([jnp.zeros((1,), rv.dtype), rv[:-1] ** 2])
-            h = _variance_scan(pr, _unconditional_var(pr), r_sq_prev)
-            return rv / jnp.sqrt(jnp.maximum(h, 1e-12))
-
-        return jax.vmap(one)(pb, rb)
-
-    out = run(pb, rb)
+    out = _remove_effects_batched(pb, rb)
     return out[0] if single else out
+
+
+@jax.jit
+def _remove_effects_batched(pb, rb):
+    def one(pr, rv):
+        r_sq_prev = jnp.concatenate([jnp.zeros((1,), rv.dtype), rv[:-1] ** 2])
+        h = _variance_scan(pr, _unconditional_var(pr), r_sq_prev)
+        return rv / jnp.sqrt(jnp.maximum(h, 1e-12))
+
+    return jax.vmap(one)(pb, rb)
 
 
 # ---------------------------------------------------------------------------
@@ -250,8 +253,11 @@ def fit_argarch(y, *, max_iters: int = 100, tol: Optional[float] = None) -> FitR
     yb, single = ensure_batched(y)
     if tol is None:
         tol = 1e-7 if yb.dtype == jnp.float64 else 1e-4
+    return debatch(_fit_argarch_program(max_iters, float(tol))(yb), single)
 
-    @jax.jit
+
+@jit_program
+def _fit_argarch_program(max_iters, tol):
     def run(yb):
         ya, nv = jax.vmap(align_right)(yb)
 
@@ -293,13 +299,16 @@ def fit_argarch(y, *, max_iters: int = 100, tol: Optional[float] = None) -> FitR
             res.iters,
         )
 
-    return debatch(run(yb), single)
+    return run
 
 
 def argarch_sample(params, key, n: int):
     """Simulate AR(1)+GARCH(1,1)."""
+    return _argarch_sample_program(n)(params, key)
 
-    @jax.jit
+
+@jit_program
+def _argarch_sample_program(n):
     def run(params, key):
         params = jnp.asarray(params, jnp.result_type(float))
         c, phi = params[0], params[1]
@@ -312,4 +321,4 @@ def argarch_sample(params, key, n: int):
         _, y = lax.scan(step, c / jnp.maximum(1.0 - phi, 1e-6), r)
         return y
 
-    return run(params, key)
+    return run

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import FitResult, align_right, debatch, ensure_batched
+from .base import FitResult, align_right, debatch, ensure_batched, jit_program
 
 
 def _init_state(y, period: int, multiplicative: bool, start=None):
@@ -112,8 +112,13 @@ def fit(
         )
     if tol is None:
         tol = 1e-7 if yb.dtype == jnp.float64 else 1e-4
+    return debatch(
+        _fit_program(period, multiplicative, max_iters, float(tol))(yb), single
+    )
 
-    @jax.jit
+
+@jit_program
+def _fit_program(period, multiplicative, max_iters, tol):
     def run(yb):
         ya, nv = jax.vmap(align_right)(yb)
 
@@ -135,7 +140,7 @@ def fit(
             res.iters,
         )
 
-    return debatch(run(yb), single)
+    return run
 
 
 def forecast(params, y, period: int, n_future: int, model_type: str = "additive"):
@@ -144,8 +149,12 @@ def forecast(params, y, period: int, n_future: int, model_type: str = "additive"
     multiplicative = model_type == "multiplicative"
     yb, single = ensure_batched(y)
     pb = jnp.atleast_2d(params)
+    out = _forecast_program(period, multiplicative, n_future)(pb, yb)
+    return out[0] if single else out
 
-    @jax.jit
+
+@jit_program
+def _forecast_program(period, multiplicative, n_future):
     def run(pb, yb):
         def one(pr, yv):
             ya, nv = align_right(yv)
@@ -160,8 +169,7 @@ def forecast(params, y, period: int, n_future: int, model_type: str = "additive"
 
         return jax.vmap(one)(pb, yb)
 
-    out = run(pb, yb)
-    return out[0] if single else out
+    return run
 
 
 def fitted(params, y, period: int, model_type: str = "additive"):
@@ -170,5 +178,10 @@ def fitted(params, y, period: int, model_type: str = "additive"):
     multiplicative = model_type == "multiplicative"
     yb, single = ensure_batched(y)
     pb = jnp.atleast_2d(params)
-    out = jax.jit(jax.vmap(lambda pr, yv: _run(pr, yv, period, multiplicative)[0]))(pb, yb)
+    out = _fitted_program(period, multiplicative)(pb, yb)
     return out[0] if single else out
+
+
+@jit_program
+def _fitted_program(period, multiplicative):
+    return jax.vmap(lambda pr, yv: _run(pr, yv, period, multiplicative)[0])

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils.linalg import ols as _ols
-from .base import FitResult, debatch
+from .base import FitResult, debatch, jit_program
 
 
 def _design(X):
@@ -36,8 +36,11 @@ def fit_cochrane_orcutt(y, X, *, max_iter: int = 10) -> FitResult:
     single = y.ndim == 1
     yb = y[None] if single else y
     Xb = X[None] if single else X
+    return debatch(_co_program(max_iter)(yb, Xb), single)
 
-    @jax.jit
+
+@jit_program
+def _co_program(max_iter):
     def run(yb, Xb):
         def one(yv, Xv):
             Xd = _design(Xv)  # [n, k+1]
@@ -71,7 +74,7 @@ def fit_cochrane_orcutt(y, X, *, max_iter: int = 10) -> FitResult:
         b = yb.shape[0]
         return FitResult(params, nll, jnp.ones((b,), bool), jnp.full((b,), max_iter, jnp.int32))
 
-    return debatch(run(yb, Xb), single)
+    return run
 
 
 def fit(y, X, method: str = "cochrane-orcutt", **kwargs) -> FitResult:
@@ -87,5 +90,8 @@ def predict(params, X):
     single = X.ndim == 2
     Xb = X[None] if single else X
     pb = jnp.atleast_2d(params)
-    out = jax.jit(jax.vmap(lambda pr, Xv: _design(Xv) @ pr[:-1]))(pb, Xb)
+    out = _predict_batched(pb, Xb)
     return out[0] if single else out
+
+
+_predict_batched = jax.jit(jax.vmap(lambda pr, Xv: _design(Xv) @ pr[:-1]))
