@@ -49,13 +49,17 @@ _SBLK = _SUBL * _LANES  # series per grid step (1024)
 # [T, 8, 128] f32 tiles (4 KiB per time step each) -> ~12 KiB * T; cap T to
 # stay well inside ~16 MiB/core.
 _MAX_T = 1024
+# Scoped-VMEM override shared by every kernel here: at T near _MAX_T the
+# double-buffered in/out tiles (plus the adjoint scratch in the backward
+# kernel) exceed the default 16 MiB budget.
+_VMEM_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
 
 
 def supported(dtype, n_time: int) -> bool:
     """True when the fused kernels can run natively on this platform/shape."""
     try:
         platform = jax.devices()[0].platform
-    except RuntimeError:  # pragma: no cover - no backend at all
+    except Exception:  # pragma: no cover - no/broken backend
         return False
     return (
         platform in ("tpu", "axon")
@@ -183,6 +187,7 @@ def _css_errors_fwd(p, q, interpret, params, yd, zb):
         in_specs=[_blockspec(tp), _blockspec(k), _blockspec(1)],
         out_specs=_blockspec(tp),
         out_shape=jax.ShapeDtypeStruct(y3.shape, yd.dtype),
+        compiler_params=_VMEM_PARAMS,
         interpret=interpret,
     )(y3, par3, zb3)
     return _unfold(e3, b)[:, :t], (y3, par3, zb3, e3)
@@ -202,11 +207,7 @@ def _css_errors_bwd(p, q, interpret, res, g):
         out_specs=_blockspec(k),
         out_shape=jax.ShapeDtypeStruct(par3.shape, g.dtype),
         scratch_shapes=[pltpu.VMEM((tp, _SUBL, _LANES), jnp.float32)],
-        # y/e/g tiles + the adjoint scratch at T=1024 exceed the default
-        # 16 MiB scoped-vmem budget once the pipeline double-buffers inputs
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024
-        ),
+        compiler_params=_VMEM_PARAMS,
         interpret=interpret,
     )(y3, e3, par3, zb3, g3)
     gparams = _unfold(gpar3, b)
@@ -293,6 +294,7 @@ def garch_variances(params, r, h0, zb, *, interpret: bool = False):
         in_specs=[_blockspec(tp), _blockspec(3), _blockspec(1), _blockspec(1)],
         out_specs=_blockspec(tp),
         out_shape=jax.ShapeDtypeStruct(r2.shape, r.dtype),
+        compiler_params=_VMEM_PARAMS,
         interpret=interpret,
     )(r2, par3, h03, zb3)
     return _unfold(h3, b)[:, :t]

@@ -285,7 +285,11 @@ def minimize_lbfgs_batched(
         y = g_new - state.g
         sy = rowdot(s, y)
         slot = state.k % m
-        good_pair = (sy > 1e-10) & ok & ~done
+        accept = ok & (f_new <= state.f) & ~done
+        # gate history on accept (not just the linesearch ok), matching the
+        # per-series minimize_lbfgs: a step rejected at the re-evaluation must
+        # not poison the curvature history
+        good_pair = (sy > 1e-10) & accept
         upd = lambda hist, v: hist.at[:, slot].set(
             jnp.where(good_pair[:, None], v, hist[:, slot])
         )
@@ -294,8 +298,6 @@ def minimize_lbfgs_batched(
         rho_hist = state.rho_hist.at[:, slot].set(
             jnp.where(good_pair, 1.0 / jnp.maximum(sy, 1e-30), state.rho_hist[:, slot])
         )
-
-        accept = ok & (f_new <= state.f) & ~done
         x_out = jnp.where(accept[:, None], x_new, state.x)
         f_out = jnp.where(accept, f_new, state.f)
         g_out = jnp.where(accept[:, None], g_new, state.g)

@@ -134,6 +134,37 @@ class TestGARCH:
         back = garch.remove_time_dependent_effects(params, r)
         np.testing.assert_allclose(np.asarray(back), np.asarray(eps), atol=1e-8)
 
+    def test_argarch_likelihood_pin(self):
+        # Pins the intended full-series ARGARCH likelihood: condition on the
+        # first observation, exclude its residual from both the variance seed
+        # and the sum (n-1 residuals total) — the same convention as the
+        # ragged path with n_valid = n.
+        rng = np.random.default_rng(11)
+        y = rng.normal(size=150).cumsum() * 0.1 + 1.0
+        c, phi = 0.3, 0.5
+        omega, alpha, beta = 0.2, 0.1, 0.8
+        params = jnp.asarray([c, phi, omega, alpha, beta])
+        got = float(garch.argarch_neg_log_likelihood(params, jnp.asarray(y)))
+
+        r = y - c - phi * np.concatenate([[y[0]], y[:-1]])
+        rv = r[1:]  # residual of the conditioning observation excluded
+        h0 = rv.var()
+        h = np.empty(rv.size)
+        hprev, rsq_prev = h0, h0  # h0 stands in for the unobserved r_{start-1}^2
+        for t in range(rv.size):
+            h[t] = omega + alpha * rsq_prev + beta * hprev
+            hprev, rsq_prev = h[t], rv[t] ** 2
+        exp = 0.5 * np.sum(np.log(2 * np.pi * h) + rv**2 / h)
+        np.testing.assert_allclose(got, exp, rtol=1e-10)
+
+        # and the ragged path with the full length is the same number
+        got_nv = float(
+            garch.argarch_neg_log_likelihood(
+                params, jnp.asarray(y), jnp.asarray(y.size)
+            )
+        )
+        np.testing.assert_allclose(got_nv, exp, rtol=1e-10)
+
     def test_argarch_recovery(self):
         true = jnp.asarray([0.5, 0.6, 0.1, 0.15, 0.75])
         keys = jax.random.split(jax.random.PRNGKey(1), 8)
