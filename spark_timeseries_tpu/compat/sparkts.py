@@ -11,8 +11,10 @@ collection in one compiled program.
 
 Intentional deltas from upstream:
 - no SparkContext / SQLContext arguments anywhere;
-- ``map_series`` takes a JAX ``[time] -> [time']`` kernel, not a
-  pandas-Series lambda (use ``.to_pandas()`` for host-side work);
+- ``map_series`` prefers a JAX ``[time] -> [time']`` kernel (one vmapped XLA
+  computation); pandas-Series lambdas — the upstream contract — are supported
+  through ``mode="host"`` (or the ``mode="auto"`` fallback) at Python-loop
+  speed;
 - model wrappers hold device parameter arrays and work on batches too.
 """
 
@@ -83,9 +85,62 @@ class TimeSeriesRDD:
 
     # -- transforms ------------------------------------------------------
 
-    def map_series(self, fn: Callable, dt_index: Optional[DateTimeIndex] = None
-                   ) -> "TimeSeriesRDD":
-        return TimeSeriesRDD(self.panel.map_series(fn, dt_index))
+    def map_series(self, fn: Callable, dt_index: Optional[DateTimeIndex] = None,
+                   mode: str = "auto") -> "TimeSeriesRDD":
+        """Apply ``fn`` to every series.
+
+        ``mode="device"``: ``fn`` is a JAX ``[time] -> [time']`` kernel, run
+        as one vmapped XLA computation (the fast path).  ``mode="host"``:
+        ``fn`` takes and returns a pandas Series (the upstream Python
+        contract, SURVEY.md §3.5) and runs in a chunked host loop — complete
+        parity, Python-loop speed.  ``mode="auto"`` tries the device path and
+        falls back to host with a warning when tracing ``fn`` fails.
+        """
+        if mode not in ("auto", "device", "host"):
+            raise ValueError(f"mode must be auto|device|host, got {mode!r}")
+        if mode != "host":
+            try:
+                return TimeSeriesRDD(self.panel.map_series(fn, dt_index))
+            except (TypeError, AttributeError, NotImplementedError):
+                # tracing failures only — shape/runtime errors from a
+                # traceable fn propagate rather than masquerading as
+                # "not traceable" and silently rerouting to the slow path
+                if mode == "device":
+                    raise
+                import warnings
+
+                warnings.warn(
+                    "map_series: fn is not JAX-traceable; falling back to the "
+                    "host (pandas) path. Pass mode='host' to silence "
+                    "or mode='device' to raise.",
+                    stacklevel=2,
+                )
+        return self._map_series_host(fn, dt_index)
+
+    def _map_series_host(self, fn: Callable, dt_index: Optional[DateTimeIndex]
+                         ) -> "TimeSeriesRDD":
+        import pandas as pd
+
+        idx = self.panel.index
+        out_index = dt_index if dt_index is not None else idx
+        dts = pd.DatetimeIndex(idx.datetimes())
+        vals = np.asarray(self.panel.series_values())
+        rows = [
+            np.asarray(fn(pd.Series(row, index=dts)), dtype=vals.dtype)
+            for row in vals
+        ]
+        out = np.stack(rows) if rows else vals[:0]
+        if out.shape[1] != out_index.size:
+            raise ValueError(
+                f"host map_series output length {out.shape[1]} does not match "
+                f"index size {out_index.size}; pass dt_index= for "
+                "length-changing transforms"
+            )
+        return TimeSeriesRDD(
+            panellib.TimeSeriesPanel(
+                out_index, list(self.panel.keys), out, mesh=self.panel.mesh
+            )
+        )
 
     def fill(self, method: str) -> "TimeSeriesRDD":
         return TimeSeriesRDD(self.panel.fill(method))
@@ -130,6 +185,16 @@ class TimeSeriesRDD:
 
     def to_instants_dataframe(self):
         return self.panel.to_instants_dataframe()
+
+    def to_row_matrix(self):
+        """``[time, n_series]`` numpy matrix (upstream ``toRowMatrix``)."""
+        return np.asarray(self.panel.to_row_matrix())
+
+    def to_indexed_row_matrix(self):
+        """``[(loc, row[n_series])]`` pairs (upstream ``toIndexedRowMatrix``)."""
+        locs, vals = self.panel.to_indexed_row_matrix()
+        vals = np.asarray(vals)
+        return [(int(locs[i]), vals[i]) for i in range(len(locs))]
 
     def to_observations_dataframe(self, ts_col="timestamp", key_col="key",
                                   value_col="value"):

@@ -51,9 +51,13 @@ def smooth(alpha, x, n_valid=None):
 
 def unsmooth(alpha, s):
     """Invert :func:`smooth`: x_t = (s_t - (1-alpha) s_{t-1}) / alpha
-    (``removeTimeDependentEffects``)."""
+    (``removeTimeDependentEffects``).  The inverse does not exist at
+    alpha = 0 (smoothing discards the input entirely); near-zero alpha
+    returns NaN rather than silently overflowing to inf."""
     prev = jnp.concatenate([s[:1], s[:-1]])
-    x = (s - (1.0 - alpha) * prev) / alpha
+    x = jnp.where(
+        jnp.abs(alpha) > 1e-12, (s - (1.0 - alpha) * prev) / alpha, jnp.nan
+    )
     return x.at[0].set(s[0])
 
 

@@ -48,13 +48,44 @@ def _as_key_array(keys: Iterable) -> np.ndarray:
     return np.asarray(list(keys), dtype=object)
 
 
-@functools.lru_cache(maxsize=512)
+_BATCH_CACHE: Dict = {}
+_BATCH_CACHE_MAX = 512
+
+
+def _fn_cache_key(fn: Callable):
+    """A cache identity for ``fn`` that is stable across textually identical
+    lambdas: (module, qualname, bytecode, consts, defaults, closure values).
+    Functions whose closure captures unhashable state (arrays, lists) or
+    not-yet-assigned cells get no stable key (raises ValueError/TypeError;
+    the caller compiles uncached)."""
+    code = getattr(fn, "__code__", None)
+    if code is None:  # functools.partial / callables: fall back to the object
+        return fn
+    cells = tuple(c.cell_contents for c in (fn.__closure__ or ()))
+    kwdefs = tuple(sorted((fn.__kwdefaults__ or {}).items()))
+    return (
+        fn.__module__, fn.__qualname__, code.co_code, code.co_consts,
+        code.co_names, fn.__defaults__, kwdefs, cells,
+    )
+
+
 def _cached_batched(fn: Callable, *args) -> Callable:
-    """jit(vmap(fn(., *args))) memoized on (fn, args) so repeated panel
-    method calls reuse one compiled kernel instead of recompiling a fresh
-    closure each time.  ``fn`` and ``args`` must be hashable (module-level
-    kernels + static scalars)."""
-    return jax.jit(jax.vmap(lambda v: fn(v, *args)))
+    """jit(vmap(fn(., *args))) memoized so repeated panel method calls reuse
+    one compiled kernel.  The cache keys on the function's bytecode + closure
+    values rather than its object identity, so a fresh-but-identical lambda
+    per call (the natural ``map_series`` usage) still hits the cache instead
+    of recompiling and permanently occupying an lru slot."""
+    try:
+        key = (_fn_cache_key(fn), args)
+        hash(key)
+    except (TypeError, ValueError):  # unhashable capture / empty cell: uncached
+        return jax.jit(jax.vmap(lambda v: fn(v, *args)))
+    hit = _BATCH_CACHE.get(key)
+    if hit is None:
+        if len(_BATCH_CACHE) >= _BATCH_CACHE_MAX:
+            _BATCH_CACHE.pop(next(iter(_BATCH_CACHE)))
+        hit = _BATCH_CACHE[key] = jax.jit(jax.vmap(lambda v: fn(v, *args)))
+    return hit
 
 
 class TimeSeriesPanel:
@@ -321,7 +352,10 @@ class TimeSeriesPanel:
 
         The reference implements this as a full cluster shuffle (SURVEY.md
         Section 3.4); here it is one transpose that XLA lowers to an
-        ``all_to_all`` over ICI when the panel is mesh-sharded.
+        ``all_to_all`` over ICI when the panel is mesh-sharded AND the time
+        axis divides evenly across the mesh's series shards.  When it does
+        not divide, the result stays sharded over the (now-column) series
+        axis instead — functionally identical, no re-shard collective.
         """
         vals = jax.jit(lambda v: v[: self.n_series].T)(self.values)
         if self.mesh is not None:
@@ -329,6 +363,18 @@ class TimeSeriesPanel:
             if vals.shape[0] % n_shards == 0:
                 vals = jax.device_put(vals, meshlib.instant_sharding(self.mesh))
         return self.index.datetimes(), vals
+
+    def to_row_matrix(self) -> jax.Array:
+        """``[time, n_series]`` instant-major matrix — the named analog of the
+        reference's ``toRowMatrix`` (MLlib RowMatrix whose rows are instants).
+        Same data as :meth:`to_instants` without the datetimes."""
+        return self.to_instants()[1]
+
+    def to_indexed_row_matrix(self) -> Tuple[np.ndarray, jax.Array]:
+        """``(row_indices[time], values[time, n_series])`` — the reference's
+        ``toIndexedRowMatrix``: each row is an instant tagged with its integer
+        location on the index."""
+        return np.arange(self.n_time), self.to_instants()[1]
 
     def to_instants_dataframe(self):
         import pandas as pd

@@ -176,3 +176,37 @@ class TestSparktsCompat:
         assert p > 0.05  # random walk: cannot reject unit root
         d = sparkts.dwtest(jnp.asarray(x))
         assert 1.0 < float(d) < 3.0
+
+
+class TestHostMapSeries:
+    def _rdd(self):
+        idx = stt.uniform("2020-01-01", 8, stt.DayFrequency())
+        vals = np.arange(16.0).reshape(2, 8)
+        return sparkts.TimeSeriesRDD(
+            stt.TimeSeriesPanel(idx, ["a", "b"], jnp.asarray(vals))
+        )
+
+    def test_host_mode_pandas_lambda(self):
+        rdd = self._rdd()
+        out = rdd.map_series(lambda s: s.rolling(2, min_periods=1).mean(), mode="host")
+        got = dict(out.collect())
+        want = pd.Series(np.arange(8.0)).rolling(2, min_periods=1).mean().to_numpy()
+        np.testing.assert_allclose(got["a"], want)
+
+    def test_auto_mode_falls_back_with_warning(self):
+        rdd = self._rdd()
+        with pytest.warns(UserWarning, match="host"):
+            out = rdd.map_series(lambda s: s.fillna(0.0) * 2.0)
+        np.testing.assert_allclose(dict(out.collect())["b"], 2 * np.arange(8.0, 16.0))
+
+    def test_device_mode_raises_on_untraceable(self):
+        rdd = self._rdd()
+        with pytest.raises(Exception):
+            rdd.map_series(lambda s: s.fillna(0.0), mode="device")
+
+    def test_matrix_exits_compat(self):
+        rdd = self._rdd()
+        rm = rdd.to_row_matrix()
+        assert rm.shape == (8, 2)
+        irm = rdd.to_indexed_row_matrix()
+        assert irm[3][0] == 3 and np.allclose(irm[3][1], [3.0, 11.0])

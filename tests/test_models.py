@@ -255,3 +255,20 @@ class TestRegressionARIMA:
     def test_unknown_method(self):
         with pytest.raises(ValueError):
             regression_arima.fit(jnp.zeros(10), jnp.zeros((10, 1)), method="mle")
+
+
+class TestEwmaUnsmoothGuard:
+    def test_alpha_zero_returns_nan_not_inf(self):
+        from spark_timeseries_tpu.models import ewma
+
+        s = jnp.asarray([1.0, 1.0, 1.0, 1.0])
+        out = np.asarray(ewma.unsmooth(0.0, s))
+        assert out[0] == 1.0
+        assert np.all(np.isnan(out[1:]))
+
+    def test_normal_alpha_roundtrip(self):
+        from spark_timeseries_tpu.models import ewma
+
+        x = jnp.asarray([1.0, 3.0, 2.0, 5.0])
+        s = ewma.smooth(0.4, x)
+        np.testing.assert_allclose(np.asarray(ewma.unsmooth(0.4, s)), np.asarray(x), atol=1e-6)

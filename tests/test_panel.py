@@ -237,3 +237,42 @@ class TestSharded:
         acf = sharded_panel.fill("linear").autocorr(3)
         assert acf.shape == (21, 3)
         assert np.median(np.asarray(acf[:, 0])) > 0.7  # random walks: high lag-1
+
+
+class TestRound2Fixes:
+    def test_map_series_cache_hits_across_identical_lambdas(self, small_panel):
+        from spark_timeseries_tpu import panel as panellib
+
+        def call():
+            return panellib._cached_batched(lambda v: v * 2.0)
+
+        assert call() is call()  # fresh-but-identical lambdas share one program
+
+    def test_map_series_cache_distinguishes_closures(self, small_panel):
+        from spark_timeseries_tpu import panel as panellib
+
+        def make(c):
+            return panellib._cached_batched(lambda v: v * c)
+
+        assert make(2.0) is not make(3.0)
+        p2 = small_panel.map_series(lambda v: v * 2.0)
+        np.testing.assert_allclose(
+            np.asarray(p2["a"]), 2 * np.asarray(small_panel["a"])
+        )
+
+    def test_matrix_exits(self, small_panel):
+        rm = small_panel.to_row_matrix()
+        assert rm.shape == (6, 3)
+        np.testing.assert_array_equal(
+            np.asarray(rm), np.asarray(small_panel.series_values()).T
+        )
+        locs, vals = small_panel.to_indexed_row_matrix()
+        np.testing.assert_array_equal(locs, np.arange(6))
+        np.testing.assert_array_equal(np.asarray(vals), np.asarray(rm))
+
+    def test_map_series_cache_distinguishes_defaults(self):
+        from spark_timeseries_tpu import panel as panellib
+
+        assert panellib._cached_batched(lambda v, c=2.0: v * c) is not (
+            panellib._cached_batched(lambda v, c=3.0: v * c)
+        )
