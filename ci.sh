@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI entry point: run the full test suite on a simulated 8-device CPU mesh —
+# the analog of the reference's Travis `mvn scalatest:test` single-node run
+# (SURVEY.md §4): multi-chip logic is exercised with no TPU attached, exactly
+# as Spark local[n] stood in for a cluster.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_ci_cache}"
+
+python -m pytest tests/ -q "$@"
+
+# the driver's multi-chip artifact, same environment
+python - <<'EOF'
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+EOF

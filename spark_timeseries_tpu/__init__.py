@@ -44,4 +44,4 @@ from . import models
 from . import stats
 from . import compat
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

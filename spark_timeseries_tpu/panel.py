@@ -50,42 +50,62 @@ def _as_key_array(keys: Iterable) -> np.ndarray:
 
 _BATCH_CACHE: Dict = {}
 _BATCH_CACHE_MAX = 512
+_MISSING = object()  # co_names entry not in fn.__globals__ (builtin/attribute)
 
 
 def _fn_cache_key(fn: Callable):
     """A cache identity for ``fn`` that is stable across textually identical
-    lambdas: (module, qualname, bytecode, consts, defaults, closure values).
-    Functions whose closure captures unhashable state (arrays, lists) or
-    not-yet-assigned cells get no stable key (raises ValueError/TypeError;
-    the caller compiles uncached)."""
-    code = getattr(fn, "__code__", None)
+    lambdas but distinguishes everything the function's behavior can depend
+    on: module, qualname, bytecode, consts, defaults, closure values, the
+    CURRENT values of referenced globals, and a bound method's ``__self__``.
+    Unhashable captures (arrays, lists) or not-yet-assigned cells raise
+    (ValueError/TypeError) and the caller compiles uncached."""
+    self_obj = getattr(fn, "__self__", None)
+    f = getattr(fn, "__func__", fn)
+    code = getattr(f, "__code__", None)
     if code is None:  # functools.partial / callables: fall back to the object
         return fn
-    cells = tuple(c.cell_contents for c in (fn.__closure__ or ()))
-    kwdefs = tuple(sorted((fn.__kwdefaults__ or {}).items()))
+    cells = tuple(c.cell_contents for c in (f.__closure__ or ()))
+    kwdefs = tuple(sorted((f.__kwdefaults__ or {}).items()))
+    gl = f.__globals__
+    gvals = tuple(gl.get(n, _MISSING) for n in code.co_names)
     return (
-        fn.__module__, fn.__qualname__, code.co_code, code.co_consts,
-        code.co_names, fn.__defaults__, kwdefs, cells,
+        f.__module__, f.__qualname__, code.co_code, code.co_consts,
+        code.co_names, f.__defaults__, kwdefs, cells, gvals, self_obj,
     )
 
 
 def _cached_batched(fn: Callable, *args) -> Callable:
     """jit(vmap(fn(., *args))) memoized so repeated panel method calls reuse
-    one compiled kernel.  The cache keys on the function's bytecode + closure
-    values rather than its object identity, so a fresh-but-identical lambda
-    per call (the natural ``map_series`` usage) still hits the cache instead
-    of recompiling and permanently occupying an lru slot."""
+    one compiled kernel.  The cache keys on the function's bytecode, closure,
+    referenced-global values, and defaults rather than its object identity,
+    so a fresh-but-identical lambda per call (the natural ``map_series``
+    usage) still hits the cache instead of recompiling each time.  Entries
+    are inserted only after the first successful call, so untraceable
+    functions (e.g. pandas lambdas probing the device path) never occupy
+    cache slots."""
     try:
         key = (_fn_cache_key(fn), args)
         hash(key)
     except (TypeError, ValueError):  # unhashable capture / empty cell: uncached
-        return jax.jit(jax.vmap(lambda v: fn(v, *args)))
-    hit = _BATCH_CACHE.get(key)
-    if hit is None:
+        key = None
+    if key is not None:
+        hit = _BATCH_CACHE.get(key)
+        if hit is not None:
+            return hit
+    compiled = jax.jit(jax.vmap(lambda v: fn(v, *args)))
+    if key is None:
+        return compiled
+
+    @functools.wraps(compiled)
+    def call_then_cache(*a, **k):
+        out = compiled(*a, **k)  # a tracing failure caches nothing
         if len(_BATCH_CACHE) >= _BATCH_CACHE_MAX:
             _BATCH_CACHE.pop(next(iter(_BATCH_CACHE)))
-        hit = _BATCH_CACHE[key] = jax.jit(jax.vmap(lambda v: fn(v, *args)))
-    return hit
+        _BATCH_CACHE[key] = compiled
+        return out
+
+    return call_then_cache
 
 
 class TimeSeriesPanel:
@@ -194,9 +214,10 @@ class TimeSeriesPanel:
         with a series-sharded panel this is embarrassingly parallel and
         XLA emits zero collectives.
 
-        Compiled kernels are cached per ``fn`` object: pass a stable (module-
-        level) function to amortize compilation; a fresh lambda each call
-        recompiles each call.
+        Compiled kernels are cached on the function's bytecode, closure and
+        referenced-global values (not object identity), so passing a fresh
+        but textually identical lambda each call reuses one compiled program;
+        kernels whose closures capture unhashable state compile uncached.
         """
         out = _cached_batched(fn)(self.values)
         idx = new_index if new_index is not None else self.index

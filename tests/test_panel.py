@@ -239,13 +239,25 @@ class TestSharded:
         assert np.median(np.asarray(acf[:, 0])) > 0.7  # random walks: high lag-1
 
 
+_CACHE_TEST_SCALE = 2.0
+
+
+class _CacheTestTransform:
+    def __init__(self, c):
+        self.c = c
+
+    def tr(self, v):
+        return v * self.c
+
+
 class TestRound2Fixes:
     def test_map_series_cache_hits_across_identical_lambdas(self, small_panel):
         from spark_timeseries_tpu import panel as panellib
 
         def call():
-            return panellib._cached_batched(lambda v: v * 2.0)
+            return panellib._cached_batched(lambda v: v * 2.125)
 
+        call()(jnp.ones((2, 3)))  # first successful call populates the cache
         assert call() is call()  # fresh-but-identical lambdas share one program
 
     def test_map_series_cache_distinguishes_closures(self, small_panel):
@@ -259,6 +271,30 @@ class TestRound2Fixes:
         np.testing.assert_allclose(
             np.asarray(p2["a"]), 2 * np.asarray(small_panel["a"])
         )
+
+    def test_map_series_cache_sees_global_rebinding(self, small_panel):
+        global _CACHE_TEST_SCALE
+        _CACHE_TEST_SCALE = 2.0
+        r1 = small_panel.map_series(lambda v: v * _CACHE_TEST_SCALE)
+        _CACHE_TEST_SCALE = 3.0
+        r2 = small_panel.map_series(lambda v: v * _CACHE_TEST_SCALE)
+        np.testing.assert_allclose(np.asarray(r1["a"]), 2 * np.asarray(small_panel["a"]))
+        np.testing.assert_allclose(np.asarray(r2["a"]), 3 * np.asarray(small_panel["a"]))
+
+    def test_map_series_cache_distinguishes_bound_methods(self, small_panel):
+        a, b = _CacheTestTransform(2.0), _CacheTestTransform(3.0)
+        ra = small_panel.map_series(a.tr)
+        rb = small_panel.map_series(b.tr)
+        np.testing.assert_allclose(np.asarray(ra["a"]), 2 * np.asarray(small_panel["a"]))
+        np.testing.assert_allclose(np.asarray(rb["a"]), 3 * np.asarray(small_panel["a"]))
+
+    def test_untraceable_fn_leaves_no_cache_entry(self, small_panel):
+        from spark_timeseries_tpu import panel as panellib
+
+        before = len(panellib._BATCH_CACHE)
+        with pytest.raises(Exception):
+            small_panel.map_series(lambda v: v.fillna(0.0))  # pandas-only API
+        assert len(panellib._BATCH_CACHE) == before
 
     def test_matrix_exits(self, small_panel):
         rm = small_panel.to_row_matrix()
