@@ -35,7 +35,8 @@ from jax import lax
 from ..ops import univariate as uv
 from ..utils import optim
 from ..utils.linalg import ols as _ols
-from .base import FitResult, align_right, debatch, ensure_batched, jit_program
+from .base import (FitResult, align_right, debatch, ensure_batched,
+                   jit_program, resolve_backend)
 
 Order = Tuple[int, int, int]
 
@@ -207,18 +208,13 @@ def fit(
     """
     if method not in ("css-lbfgs", "css-cgd", "css-bobyqa", "hannan-rissanen"):
         raise ValueError(f"unknown method {method!r}")
-    if backend not in ("auto", "scan", "pallas", "pallas-interpret"):
-        raise ValueError(f"unknown backend {backend!r}")
     p, d, q = order
     yb, single = ensure_batched(y)
     k = _n_params(order, include_intercept)
     if tol is None:
         # f32 gradients of a ~1k-term CSS bottom out near 1e-4 relative noise
         tol = 1e-6 if yb.dtype == jnp.float64 else 1e-4
-    if backend == "auto":
-        from ..ops import pallas_kernels as _pk
-
-        backend = "pallas" if _pk.supported(yb.dtype, yb.shape[1] - d) else "scan"
+    backend = resolve_backend(backend, yb.dtype, yb.shape[1] - d)
 
     run = _fit_program(
         order, include_intercept, method, backend, max_iters, float(tol),

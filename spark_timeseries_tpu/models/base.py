@@ -43,6 +43,23 @@ def jit_program(builder):
     return functools.wraps(builder)(lambda *static: cached(*map(norm, static)))
 
 
+def resolve_backend(backend: str, dtype, n_time: int) -> str:
+    """Validate a fit ``backend`` and resolve ``"auto"``.
+
+    ``auto`` picks the fused Pallas objective when the platform/dtype/length
+    allow (``ops.pallas_kernels.supported``), else the portable ``lax.scan``
+    path.  Shared by every model family so the backend vocabulary cannot
+    drift between them.
+    """
+    if backend not in ("auto", "scan", "pallas", "pallas-interpret"):
+        raise ValueError(f"unknown backend {backend!r}")
+    if backend != "auto":
+        return backend
+    from ..ops import pallas_kernels as pk
+
+    return "pallas" if pk.supported(dtype, n_time) else "scan"
+
+
 class FitResult(NamedTuple):
     """Batched fit output: parameters + convergence diagnostics."""
 

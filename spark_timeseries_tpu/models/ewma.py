@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import FitResult, align_right, debatch, ensure_batched, jit_program
+from .base import (FitResult, align_right, debatch, ensure_batched,
+                   jit_program, resolve_backend)
 
 
 def smooth(alpha, x, n_valid=None):
@@ -71,29 +72,46 @@ def sse(alpha, x, n_valid=None):
     return jnp.sum(err * err)
 
 
-def fit(y, *, max_iters: int = 40, tol: Optional[float] = None) -> FitResult:
+def fit(y, *, max_iters: int = 40, tol: Optional[float] = None,
+        backend: str = "auto") -> FitResult:
     """Fit ``alpha`` per series by SSE minimization -> params ``[batch?, 1]``.
 
     Leading/trailing NaNs are tolerated (right-aligned masking); series with
     fewer than 3 valid points come back NaN with ``converged=False``.
+    ``backend``: ``"scan"`` (portable), ``"pallas"`` (fused TPU kernel), or
+    ``"auto"`` (pallas when ``ops.pallas_kernels.supported`` says so).
     """
     yb, single = ensure_batched(y)
     if tol is None:
         tol = 1e-8 if yb.dtype == jnp.float64 else 1e-4
-    return debatch(_fit_program(max_iters, float(tol))(yb), single)
+    backend = resolve_backend(backend, yb.dtype, yb.shape[1])
+    return debatch(_fit_program(max_iters, float(tol), backend)(yb), single)
 
 
 @jit_program
-def _fit_program(max_iters, tol):
+def _fit_program(max_iters, tol, backend):
     def run(yb):
         ya, nv = jax.vmap(align_right)(yb)
 
-        def objective(u, data):
-            x, n = data
-            return sse(optim.sigmoid_to_interval(u[0], 0.0, 1.0), x, n)
-
         u0 = jnp.zeros((yb.shape[0], 1), yb.dtype)
-        res = optim.batched_minimize(objective, u0, (ya, nv), max_iters=max_iters, tol=tol)
+        if backend in ("pallas", "pallas-interpret"):
+            from ..ops import pallas_kernels as pk
+
+            interp = backend == "pallas-interpret"
+
+            def fb(u):
+                alpha = optim.sigmoid_to_interval(u[:, 0], 0.0, 1.0)
+                return pk.ewma_sse(alpha, ya, nv, interpret=interp)
+
+            res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
+        else:
+            def objective(u, data):
+                x, n = data
+                return sse(optim.sigmoid_to_interval(u[0], 0.0, 1.0), x, n)
+
+            res = optim.batched_minimize(
+                objective, u0, (ya, nv), max_iters=max_iters, tol=tol
+            )
         alpha = optim.sigmoid_to_interval(res.x, 0.0, 1.0)
         ok = nv >= 3
         return FitResult(

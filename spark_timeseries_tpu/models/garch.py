@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import FitResult, align_right, debatch, ensure_batched, jit_program
+from .base import (FitResult, align_right, debatch, ensure_batched,
+                   jit_program, resolve_backend)
 
 
 # -- transforms -------------------------------------------------------------
@@ -124,22 +125,20 @@ def neg_log_likelihood(params, r, n_valid=None):
 # -- fitting ----------------------------------------------------------------
 
 
-def fit(r, *, max_iters: int = 80, tol: Optional[float] = None) -> FitResult:
+def fit(r, *, max_iters: int = 80, tol: Optional[float] = None,
+        backend: str = "auto") -> FitResult:
     """Fit GARCH(1,1) per series -> natural params ``[batch?, 3]``."""
     rb, single = ensure_batched(r)
     if tol is None:
         tol = 1e-7 if rb.dtype == jnp.float64 else 1e-4
-    return debatch(_fit_program(max_iters, float(tol))(rb), single)
+    backend = resolve_backend(backend, rb.dtype, rb.shape[1])
+    return debatch(_fit_program(max_iters, float(tol), backend)(rb), single)
 
 
 @jit_program
-def _fit_program(max_iters, tol):
+def _fit_program(max_iters, tol, backend):
     def run(rb):
         ra, nv = jax.vmap(align_right)(rb)
-
-        def objective(u, data):
-            rv, n = data
-            return neg_log_likelihood(_to_natural(u), rv, n)
 
         # moment-ish start: omega = 0.1*var, alpha=0.1, beta=0.8
         var0 = jax.vmap(_masked_var)(ra, nv)
@@ -148,7 +147,24 @@ def _fit_program(max_iters, tol):
              jnp.full_like(var0, 0.8)], axis=1
         )
         u0 = jax.vmap(_from_natural)(nat0)
-        res = optim.batched_minimize(objective, u0, (ra, nv), max_iters=max_iters, tol=tol)
+        if backend in ("pallas", "pallas-interpret"):
+            from ..ops import pallas_kernels as pk
+
+            interp = backend == "pallas-interpret"
+
+            def fb(u):
+                nat = jax.vmap(_to_natural)(u)
+                return pk.garch_neg_loglik(nat, ra, nv, interpret=interp)
+
+            res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
+        else:
+            def objective(u, data):
+                rv, n = data
+                return neg_log_likelihood(_to_natural(u), rv, n)
+
+            res = optim.batched_minimize(
+                objective, u0, (ra, nv), max_iters=max_iters, tol=tol
+            )
         ok = nv >= 10  # GARCH needs a handful of observations to identify
         return FitResult(
             jnp.where(ok[:, None], jax.vmap(_to_natural)(res.x), jnp.nan),
@@ -247,17 +263,19 @@ def argarch_neg_log_likelihood(params, y, n_valid=None):
     return neg_log_likelihood(params[2:], r, nv - 1)
 
 
-def fit_argarch(y, *, max_iters: int = 100, tol: Optional[float] = None) -> FitResult:
+def fit_argarch(y, *, max_iters: int = 100, tol: Optional[float] = None,
+                backend: str = "auto") -> FitResult:
     """Fit AR(1)+GARCH(1,1) -> natural params ``[batch?, 5]``
     (reference ``ARGARCH.fitModel``)."""
     yb, single = ensure_batched(y)
     if tol is None:
         tol = 1e-7 if yb.dtype == jnp.float64 else 1e-4
-    return debatch(_fit_argarch_program(max_iters, float(tol))(yb), single)
+    backend = resolve_backend(backend, yb.dtype, yb.shape[1])
+    return debatch(_fit_argarch_program(max_iters, float(tol), backend)(yb), single)
 
 
 @jit_program
-def _fit_argarch_program(max_iters, tol):
+def _fit_argarch_program(max_iters, tol, backend):
     def run(yb):
         ya, nv = jax.vmap(align_right)(yb)
 
@@ -290,7 +308,28 @@ def _fit_argarch_program(max_iters, tol):
             axis=1,
         )
         u0 = jax.vmap(_argarch_from_natural)(nat0)
-        res = optim.batched_minimize(objective, u0, (ya, nv), max_iters=max_iters, tol=tol)
+        if backend in ("pallas", "pallas-interpret"):
+            from ..ops import pallas_kernels as pk
+
+            interp = backend == "pallas-interpret"
+            T = ya.shape[1]
+            t_idx = jnp.arange(T)
+            start = T - nv
+            prev = jnp.concatenate([ya[:, :1], ya[:, :-1]], axis=1)
+
+            def fb(u):
+                nat = jax.vmap(_argarch_to_natural)(u)
+                r = ya - nat[:, 0:1] - nat[:, 1:2] * prev
+                # condition on the first valid observation (see
+                # argarch_neg_log_likelihood): its residual is excluded
+                r = jnp.where(t_idx[None, :] <= start[:, None], 0.0, r)
+                return pk.garch_neg_loglik(nat[:, 2:], r, nv - 1, interpret=interp)
+
+            res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
+        else:
+            res = optim.batched_minimize(
+                objective, u0, (ya, nv), max_iters=max_iters, tol=tol
+            )
         ok = nv >= 12
         return FitResult(
             jnp.where(ok[:, None], jax.vmap(_argarch_to_natural)(res.x), jnp.nan),

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import FitResult, align_right, debatch, ensure_batched, jit_program
+from .base import (FitResult, align_right, debatch, ensure_batched,
+                   jit_program, resolve_backend)
 
 
 def _init_state(y, period: int, multiplicative: bool, start=None):
@@ -100,8 +101,14 @@ def fit(
     *,
     max_iters: int = 60,
     tol: Optional[float] = None,
+    backend: str = "auto",
 ) -> FitResult:
-    """Fit (alpha, beta, gamma) per series -> params ``[batch?, 3]``."""
+    """Fit (alpha, beta, gamma) per series -> params ``[batch?, 3]``.
+
+    ``backend``: ``"scan"`` (portable, all model types / ragged panels),
+    ``"pallas"`` (fused TPU kernel — additive model on dense panels only), or
+    ``"auto"`` (pallas when the platform, model type, and data allow).
+    """
     if model_type not in ("additive", "multiplicative"):
         raise ValueError(f"model_type must be additive|multiplicative, got {model_type!r}")
     multiplicative = model_type == "multiplicative"
@@ -112,26 +119,57 @@ def fit(
         )
     if tol is None:
         tol = 1e-7 if yb.dtype == jnp.float64 else 1e-4
+    was_auto = backend == "auto"
+    traced = isinstance(yb, jax.core.Tracer)  # fit() called under jit/vmap
+    backend = resolve_backend(backend, yb.dtype, yb.shape[1])
+    if backend in ("pallas", "pallas-interpret"):
+        # the fused kernel is additive-only and needs a dense panel; density
+        # of traced data cannot be inspected, so auto falls back to the
+        # portable path rather than guessing (explicit pallas under jit is
+        # the caller asserting density)
+        if multiplicative or (was_auto and (traced or bool(jnp.any(jnp.isnan(yb))))):
+            if not was_auto:
+                raise ValueError("pallas backend supports the additive model only")
+            backend = "scan"
+        elif not traced and bool(jnp.any(jnp.isnan(yb))):
+            raise ValueError(
+                "pallas backend needs a dense panel (no NaNs); fill first or "
+                "use backend='scan'"
+            )
     return debatch(
-        _fit_program(period, multiplicative, max_iters, float(tol))(yb), single
+        _fit_program(period, multiplicative, max_iters, float(tol), backend)(yb),
+        single,
     )
 
 
 @jit_program
-def _fit_program(period, multiplicative, max_iters, tol):
+def _fit_program(period, multiplicative, max_iters, tol, backend):
     def run(yb):
         ya, nv = jax.vmap(align_right)(yb)
-
-        def objective(u, data):
-            yv, n = data
-            nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
-            return sse(nat, yv, period, multiplicative, n)
 
         nat0 = jnp.asarray([0.3, 0.1, 0.1], yb.dtype)
         u0 = jnp.broadcast_to(
             optim.interval_to_sigmoid(nat0, 0.0, 1.0), (yb.shape[0], 3)
         )
-        res = optim.batched_minimize(objective, u0, (ya, nv), max_iters=max_iters, tol=tol)
+        if backend in ("pallas", "pallas-interpret"):
+            from ..ops import pallas_kernels as pk
+
+            interp = backend == "pallas-interpret"
+
+            def fb(u):
+                nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
+                return pk.hw_additive_sse(nat, ya, period, interpret=interp)
+
+            res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
+        else:
+            def objective(u, data):
+                yv, n = data
+                nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
+                return sse(nat, yv, period, multiplicative, n)
+
+            res = optim.batched_minimize(
+                objective, u0, (ya, nv), max_iters=max_iters, tol=tol
+            )
         ok = nv >= 2 * period  # seed needs two full seasons of real data
         return FitResult(
             jnp.where(ok[:, None], optim.sigmoid_to_interval(res.x, 0.0, 1.0), jnp.nan),

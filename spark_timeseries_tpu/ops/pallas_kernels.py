@@ -245,12 +245,23 @@ def css_neg_loglik(params, yd, order: Order, include_intercept: bool,
 
 
 # ---------------------------------------------------------------------------
-# GARCH(1, 1) conditional-variance recursion
+# GARCH(1, 1) conditional-variance recursion (forward + hand-derived adjoint)
 # ---------------------------------------------------------------------------
 #
 # h_t = omega + alpha * r_{t-1}^2 + beta * h_{t-1}, h_start = h0
 # (reference GARCH.scala log-likelihood loop).  The prefix [0, zb) holds
 # h_t = h0 so padded series contribute nothing.
+#
+# Adjoint, for an upstream cotangent gbar of h (t descending over live steps):
+#   lam_t      = gbar_t + beta * lam_{t+1}
+#   dL/domega  = sum_t lam_t
+#   dL/dalpha  = sum_t lam_t * r2p_t          (r2p_zb = h0 at the seed)
+#   dL/dbeta   = sum_t lam_t * h_{t-1}        (h_{zb-1} = h0 at the seed)
+#   dL/dr2_t   = alpha * lam_{t+1}            (t+1 live and not the seed)
+#   dL/dh0     = lam_zb * (alpha + beta) + sum_{dead t} gbar_t
+# Cotangents flow to r^2 and h0 as well as the parameters so callers that
+# build the returns from model parameters (ARGARCH's AR(1) mean) get exact
+# gradients; ``zb`` is a constant of the objective.
 
 
 def _garch_fwd_kernel(t_limit, n_t, r2_ref, par_ref, h0_ref, zb_ref, h_ref):
@@ -273,28 +284,425 @@ def _garch_fwd_kernel(t_limit, n_t, r2_ref, par_ref, h0_ref, zb_ref, h_ref):
     lax.fori_loop(0, n_t, body, 0)
 
 
-def garch_variances(params, r, h0, zb, *, interpret: bool = False):
-    """Batched GARCH(1,1) conditional variances ``[B, T]`` (no grad path —
-    used for the forward/diagnostic entry points).
+def _garch_bwd_kernel(t_limit, n_t, r2_ref, par_ref, h0_ref, zb_ref, h_ref,
+                      g_ref, gpar_ref, gr2_ref, gh0_ref):
+    zb = zb_ref[0]
+    h0 = h0_ref[0]
+    alpha = par_ref[1]
+    beta = par_ref[2]
+    zero = jnp.zeros((_SUBL, _LANES), jnp.float32)
 
-    ``params``: ``[B, 3]`` rows ``[omega, alpha, beta]``; ``r``: ``[B, T]``
-    returns with the invalid prefix zeroed; ``h0``: ``[B]`` start variance;
-    ``zb``: ``[B]`` first live position.
-    """
-    b, t = r.shape
+    def body(i, carry):
+        lam_next, dw, da, db, dh0 = carry
+        t = n_t - 1 - i
+        tf = t.astype(jnp.float32)
+        live = (tf >= zb) & (t < t_limit)
+        lam = g_ref[t] + beta * lam_next
+        lam = jnp.where(live, lam, 0.0)
+        # dead positions emit h0 directly
+        dh0 = dh0 + jnp.where(live, 0.0, g_ref[t])
+        seed = tf == zb
+        hp = jnp.where(t - 1 >= 0, h_ref[jnp.maximum(t - 1, 0)], h0)
+        r2p = jnp.where(t - 1 >= 0, r2_ref[jnp.maximum(t - 1, 0)], 0.0)
+        r2p_eff = jnp.where(seed, h0, r2p)
+        dw = dw + lam
+        da = da + lam * r2p_eff
+        db = db + lam * hp
+        # h0 enters the seed step through BOTH recursion inputs
+        hp_is_h0 = tf - 1.0 < zb
+        dh0 = dh0 + jnp.where(live & seed, alpha * lam, 0.0)
+        dh0 = dh0 + jnp.where(live & hp_is_h0, beta * lam, 0.0)
+        # r2_{t-1} feeds h_t except at the seed (which uses h0 instead)
+        cur = gr2_ref[jnp.maximum(t - 1, 0)]
+        val = jnp.where(live & ~seed, alpha * lam, 0.0)
+        gr2_ref[jnp.maximum(t - 1, 0)] = jnp.where(t - 1 >= 0, val, cur)
+        return lam, dw, da, db, dh0
+
+    # slot T-1 of gr2 is never the (t-1) of any step; clear it up front
+    gr2_ref[n_t - 1] = zero
+    _, dw, da, db, dh0 = lax.fori_loop(
+        0, n_t, body, (zero, zero, zero, zero, zero)
+    )
+    gpar_ref[0] = dw
+    gpar_ref[1] = da
+    gpar_ref[2] = db
+    gh0_ref[0] = dh0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _garch_h(interpret: bool, params, r2, h0, zb):
+    h, _ = _garch_h_fwd(interpret, params, r2, h0, zb)
+    return h
+
+
+def _garch_h_fwd(interpret, params, r2, h0, zb):
+    b, t = r2.shape
     tp = t + _pad_to(t, _SUBL)
-    r2 = _fold(jnp.pad(r * r, ((0, 0), (0, tp - t))))
+    r23 = _fold(jnp.pad(r2, ((0, 0), (0, tp - t))))
     par3 = _fold(params)
-    h03 = _fold(h0[:, None].astype(r.dtype))
-    zb3 = _fold(zb.astype(r.dtype)[:, None])
-    nblk = r2.shape[1] // _SUBL
+    h03 = _fold(h0[:, None].astype(r2.dtype))
+    zb3 = _fold(zb.astype(r2.dtype)[:, None])
+    nblk = r23.shape[1] // _SUBL
     h3 = pl.pallas_call(
         functools.partial(_garch_fwd_kernel, t, tp),
         grid=(nblk,),
         in_specs=[_blockspec(tp), _blockspec(3), _blockspec(1), _blockspec(1)],
         out_specs=_blockspec(tp),
-        out_shape=jax.ShapeDtypeStruct(r2.shape, r.dtype),
+        out_shape=jax.ShapeDtypeStruct(r23.shape, r2.dtype),
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
-    )(r2, par3, h03, zb3)
-    return _unfold(h3, b)[:, :t]
+    )(r23, par3, h03, zb3)
+    return _unfold(h3, b)[:, :t], (r23, par3, h03, zb3, h3, b, t)
+
+
+def _garch_h_bwd(interpret, res, g):
+    r23, par3, h03, zb3, h3, b, t = res
+    tp = r23.shape[0]
+    g3 = _fold(jnp.pad(g, ((0, 0), (0, tp - t))))
+    nblk = r23.shape[1] // _SUBL
+    gpar3, gr23, gh03 = pl.pallas_call(
+        functools.partial(_garch_bwd_kernel, t, tp),
+        grid=(nblk,),
+        in_specs=[_blockspec(tp), _blockspec(3), _blockspec(1), _blockspec(1),
+                  _blockspec(tp), _blockspec(tp)],
+        out_specs=[_blockspec(3), _blockspec(tp), _blockspec(1)],
+        out_shape=[
+            jax.ShapeDtypeStruct(par3.shape, g.dtype),
+            jax.ShapeDtypeStruct(r23.shape, g.dtype),
+            jax.ShapeDtypeStruct(h03.shape, g.dtype),
+        ],
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(r23, par3, h03, zb3, h3, g3)
+    return (
+        _unfold(gpar3, b),
+        _unfold(gr23, b)[:, :t],
+        _unfold(gh03, b)[:, 0],
+        jnp.zeros((b,), g.dtype),
+    )
+
+
+_garch_h.defvjp(_garch_h_fwd, _garch_h_bwd)
+
+
+def garch_variances(params, r, h0, zb, *, interpret: bool = False):
+    """Batched GARCH(1,1) conditional variances ``[B, T]`` on a fused kernel.
+
+    ``params``: ``[B, 3]`` rows ``[omega, alpha, beta]``; ``r``: ``[B, T]``
+    returns with the invalid prefix zeroed; ``h0``: ``[B]`` start variance;
+    ``zb``: ``[B]`` first live position.  Differentiable in ``params``, ``r``,
+    and ``h0`` via the hand-derived adjoint (``zb`` is constant).
+    """
+    return _garch_h(interpret, params, r * r, h0, zb)
+
+
+def garch_neg_loglik(params, r, n_valid=None, *, interpret: bool = False):
+    """Batched GARCH(1,1) Gaussian negative log-likelihood ``[B]``.
+
+    Matches ``models.garch.neg_log_likelihood`` (vmapped) to float tolerance:
+    h0 is the masked sample variance of the valid span, the prefix is dead,
+    and the likelihood sums over valid steps.  Differentiable in ``params``
+    and (through the returns/variance seed) in ``r``.
+    """
+    b, n = r.shape
+    nv = (
+        jnp.full((b,), n, jnp.int32)
+        if n_valid is None
+        else n_valid.astype(jnp.int32)
+    )
+    start = (n - nv).astype(r.dtype)
+    t_idx = jnp.arange(n, dtype=r.dtype)
+    mask = t_idx[None, :] >= start[:, None]
+    rz = jnp.where(mask, r, 0.0)
+    nvf = jnp.maximum(nv, 1).astype(r.dtype)
+    mean = jnp.sum(rz, axis=1) / nvf
+    h0 = jnp.sum(jnp.where(mask, (rz - mean[:, None]) ** 2, 0.0), axis=1) / nvf
+    h = garch_variances(params, rz, h0, start, interpret=interpret)
+    h = jnp.maximum(h, 1e-12)
+    ll_t = jnp.where(mask, jnp.log(2.0 * jnp.pi * h) + (rz * rz) / h, 0.0)
+    return 0.5 * jnp.sum(ll_t, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# EWMA smoothing recursion (forward + hand-derived adjoint)
+# ---------------------------------------------------------------------------
+#
+# s_t = alpha * x_t + (1 - alpha) * s_{t-1}, seeded s_zb = x_zb, prefix 0
+# (reference EWMA.scala; matches models.ewma.smooth with a right-aligned
+# span).  Adjoint for an upstream cotangent gbar of s:
+#   lam_t     = gbar_t + (1 - alpha) * lam_{t+1}   (no flow into the seed's
+#                                                   predecessor)
+#   dL/dalpha = sum_{t > zb} lam_t * (x_t - s_{t-1})
+
+
+def _ewma_fwd_kernel(t_limit, n_t, x_ref, a_ref, zb_ref, s_ref):
+    zb = zb_ref[0]
+    a = a_ref[0]
+
+    def body(t, _):
+        tf = t.astype(jnp.float32)
+        sp = jnp.where(t - 1 >= 0, s_ref[jnp.maximum(t - 1, 0)], 0.0)
+        s = a * x_ref[t] + (1.0 - a) * sp
+        s = jnp.where(tf == zb, x_ref[t], s)
+        live = (tf >= zb) & (t < t_limit)
+        s_ref[t] = jnp.where(live, s, 0.0)
+        return 0
+
+    lax.fori_loop(0, n_t, body, 0)
+
+
+def _ewma_bwd_kernel(t_limit, n_t, x_ref, a_ref, zb_ref, s_ref, g_ref, ga_ref):
+    zb = zb_ref[0]
+    a = a_ref[0]
+    zero = jnp.zeros((_SUBL, _LANES), jnp.float32)
+
+    def body(i, carry):
+        lam_next, da = carry
+        t = n_t - 1 - i
+        tf = t.astype(jnp.float32)
+        live = (tf >= zb) & (t < t_limit)
+        lam = g_ref[t] + (1.0 - a) * lam_next
+        lam = jnp.where(live, lam, 0.0)
+        sp = jnp.where(t - 1 >= 0, s_ref[jnp.maximum(t - 1, 0)], 0.0)
+        da = da + jnp.where(live & (tf > zb), lam * (x_ref[t] - sp), 0.0)
+        # the seed step s_zb = x_zb does not read s_{zb-1}
+        lam_out = jnp.where(tf > zb, lam, 0.0)
+        return lam_out, da
+
+    _, da = lax.fori_loop(0, n_t, body, (zero, zero))
+    ga_ref[0] = da
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _ewma_s(interpret: bool, alpha, x, zb):
+    s, _ = _ewma_s_fwd(interpret, alpha, x, zb)
+    return s
+
+
+def _ewma_s_fwd(interpret, alpha, x, zb):
+    b, t = x.shape
+    tp = t + _pad_to(t, _SUBL)
+    x3 = _fold(jnp.pad(x, ((0, 0), (0, tp - t))))
+    a3 = _fold(alpha[:, None].astype(x.dtype))
+    zb3 = _fold(zb.astype(x.dtype)[:, None])
+    nblk = x3.shape[1] // _SUBL
+    s3 = pl.pallas_call(
+        functools.partial(_ewma_fwd_kernel, t, tp),
+        grid=(nblk,),
+        in_specs=[_blockspec(tp), _blockspec(1), _blockspec(1)],
+        out_specs=_blockspec(tp),
+        out_shape=jax.ShapeDtypeStruct(x3.shape, x.dtype),
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(x3, a3, zb3)
+    return _unfold(s3, b)[:, :t], (x3, a3, zb3, s3, b, t)
+
+
+def _ewma_s_bwd(interpret, res, g):
+    x3, a3, zb3, s3, b, t = res
+    tp = x3.shape[0]
+    g3 = _fold(jnp.pad(g, ((0, 0), (0, tp - t))))
+    nblk = x3.shape[1] // _SUBL
+    ga3 = pl.pallas_call(
+        functools.partial(_ewma_bwd_kernel, t, tp),
+        grid=(nblk,),
+        in_specs=[_blockspec(tp), _blockspec(1), _blockspec(1),
+                  _blockspec(tp), _blockspec(tp)],
+        out_specs=_blockspec(1),
+        out_shape=jax.ShapeDtypeStruct(a3.shape, g.dtype),
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(x3, a3, zb3, s3, g3)
+    return (
+        _unfold(ga3, b)[:, 0],
+        jnp.zeros((b, t), g.dtype),
+        jnp.zeros((b,), g.dtype),
+    )
+
+
+_ewma_s.defvjp(_ewma_s_fwd, _ewma_s_bwd)
+
+
+def ewma_smooth(alpha, x, zb, *, interpret: bool = False):
+    """Batched EWMA smoothing ``[B, T]`` on a fused kernel.
+
+    ``alpha``: ``[B]``; ``x``: ``[B, T]`` with the invalid prefix zeroed;
+    ``zb``: ``[B]`` first live position.  Differentiable in ``alpha``.
+    """
+    return _ewma_s(interpret, alpha, x, zb)
+
+
+def ewma_sse(alpha, x, n_valid=None, *, interpret: bool = False):
+    """Batched one-step-ahead EWMA SSE ``[B]`` (matches ``models.ewma.sse``)."""
+    b, n = x.shape
+    nv = (
+        jnp.full((b,), n, jnp.int32)
+        if n_valid is None
+        else n_valid.astype(jnp.int32)
+    )
+    start = (n - nv).astype(x.dtype)
+    t_idx = jnp.arange(n, dtype=x.dtype)
+    xz = jnp.where(t_idx[None, :] >= start[:, None], x, 0.0)
+    s = ewma_smooth(alpha, xz, start, interpret=interpret)
+    err = xz[:, 1:] - s[:, :-1]
+    err = jnp.where(t_idx[None, 1:] > start[:, None], err, 0.0)
+    return jnp.sum(err * err, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Holt-Winters additive smoothing (forward + hand-derived adjoint)
+# ---------------------------------------------------------------------------
+#
+# Per series (reference HoltWinters.scala, additive; matches
+# models.holtwinters._run on a dense panel):
+#   pred_t = L_{t-1} + T_{t-1} + S_t          with S_t = ring[t mod m]
+#   L_t    = a (y_t - S_t) + (1-a)(L_{t-1} + T_{t-1})
+#   T_t    = b (L_t - L_{t-1}) + (1-b) T_{t-1}
+#   ring[t mod m] = g (y_t - L_t) + (1-g) S_t
+#   e_t    = [t >= m] * (y_t - pred_t)
+# The seasonal ring lives in a [m, 8, 128] VMEM scratch.  Seeds (L_0, T_0,
+# ring init) are computed OUTSIDE the kernel from the first two seasons —
+# they depend on the data only, so the adjoint propagates to the three
+# smoothing parameters alone.  Reverse pass replays saved (L, T, S_old)
+# trajectories with a ring of seasonal adjoints:
+#   vL        = uL + b uT - g uS
+#   da       += (y_t - S_t - L_{t-1} - T_{t-1}) vL
+#   db       += (L_t - L_{t-1} - T_{t-1}) uT
+#   dg       += (y_t - L_t - S_t) uS
+#   uL'       = -b uT + (1-a) vL + gp
+#   uT'       = (1-b) uT + (1-a) vL + gp
+#   rho[slot] = (1-g) uS - a vL + gp          with gp = -[t >= m] gbar_t
+
+
+def _hw_fwd_kernel(m, t_limit, n_t, y_ref, par_ref, l0_ref, t0_ref, s0_ref,
+                   e_ref, lv_ref, tr_ref, so_ref, seas_ref):
+    for j in range(m):
+        seas_ref[j] = s0_ref[j]
+    a = par_ref[0]
+    b = par_ref[1]
+    g = par_ref[2]
+
+    def body(t, carry):
+        level, trend = carry
+        slot = lax.rem(t, m)
+        s = seas_ref[slot]
+        pred = level + trend + s
+        e_ref[t] = jnp.where((t >= m) & (t < t_limit), y_ref[t] - pred, 0.0)
+        so_ref[t] = s
+        yt = y_ref[t]
+        nl = a * (yt - s) + (1.0 - a) * (level + trend)
+        nt = b * (nl - level) + (1.0 - b) * trend
+        seas_ref[slot] = g * (yt - nl) + (1.0 - g) * s
+        lv_ref[t] = nl
+        tr_ref[t] = nt
+        return nl, nt
+
+    lax.fori_loop(0, n_t, body, (l0_ref[0], t0_ref[0]))
+
+
+def _hw_bwd_kernel(m, t_limit, n_t, y_ref, par_ref, l0_ref, t0_ref,
+                   lv_ref, tr_ref, so_ref, g_ref, gpar_ref, rho_ref):
+    zero = jnp.zeros((_SUBL, _LANES), jnp.float32)
+    for j in range(m):
+        rho_ref[j] = zero
+    a = par_ref[0]
+    b = par_ref[1]
+    g = par_ref[2]
+
+    def body(i, carry):
+        lamL, lamT, da, db, dg = carry
+        t = n_t - 1 - i
+        slot = lax.rem(t, m)
+        uS = rho_ref[slot]
+        uL = lamL
+        uT = lamT
+        gp = jnp.where((t >= m) & (t < t_limit), -g_ref[t], 0.0)
+        lp = jnp.where(t - 1 >= 0, lv_ref[jnp.maximum(t - 1, 0)], l0_ref[0])
+        tp_ = jnp.where(t - 1 >= 0, tr_ref[jnp.maximum(t - 1, 0)], t0_ref[0])
+        so = so_ref[t]
+        lt = lv_ref[t]
+        yt = y_ref[t]
+        vL = uL + b * uT - g * uS
+        da = da + (yt - so - lp - tp_) * vL
+        db = db + (lt - lp - tp_) * uT
+        dg = dg + (yt - lt - so) * uS
+        new_lamL = -b * uT + (1.0 - a) * vL + gp
+        new_lamT = (1.0 - b) * uT + (1.0 - a) * vL + gp
+        rho_ref[slot] = (1.0 - g) * uS - a * vL + gp
+        return new_lamL, new_lamT, da, db, dg
+
+    _, _, da, db, dg = lax.fori_loop(0, n_t, body, (zero, zero, zero, zero, zero))
+    gpar_ref[0] = da
+    gpar_ref[1] = db
+    gpar_ref[2] = dg
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _hw_e(interpret: bool, m: int, params, y, l0, t0, s0):
+    e, _ = _hw_e_fwd(interpret, m, params, y, l0, t0, s0)
+    return e
+
+
+def _hw_e_fwd(interpret, m, params, y, l0, t0, s0):
+    b, t = y.shape
+    tp = t + _pad_to(t, _SUBL)
+    y3 = _fold(jnp.pad(y, ((0, 0), (0, tp - t))))
+    par3 = _fold(params)
+    l03 = _fold(l0[:, None].astype(y.dtype))
+    t03 = _fold(t0[:, None].astype(y.dtype))
+    s03 = _fold(s0)
+    nblk = y3.shape[1] // _SUBL
+    e3, lv3, tr3, so3 = pl.pallas_call(
+        functools.partial(_hw_fwd_kernel, m, t, tp),
+        grid=(nblk,),
+        in_specs=[_blockspec(tp), _blockspec(3), _blockspec(1), _blockspec(1),
+                  _blockspec(m)],
+        out_specs=[_blockspec(tp)] * 4,
+        out_shape=[jax.ShapeDtypeStruct(y3.shape, y.dtype)] * 4,
+        scratch_shapes=[pltpu.VMEM((m, _SUBL, _LANES), jnp.float32)],
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(y3, par3, l03, t03, s03)
+    return _unfold(e3, b)[:, :t], (y3, par3, l03, t03, lv3, tr3, so3, b, t)
+
+
+def _hw_e_bwd(interpret, m, res, g):
+    y3, par3, l03, t03, lv3, tr3, so3, b, t = res
+    tp = y3.shape[0]
+    g3 = _fold(jnp.pad(g, ((0, 0), (0, tp - t))))
+    nblk = y3.shape[1] // _SUBL
+    gpar3 = pl.pallas_call(
+        functools.partial(_hw_bwd_kernel, m, t, tp),
+        grid=(nblk,),
+        in_specs=[_blockspec(tp), _blockspec(3), _blockspec(1), _blockspec(1),
+                  _blockspec(tp), _blockspec(tp), _blockspec(tp), _blockspec(tp)],
+        out_specs=_blockspec(3),
+        out_shape=jax.ShapeDtypeStruct(par3.shape, g.dtype),
+        scratch_shapes=[pltpu.VMEM((m, _SUBL, _LANES), jnp.float32)],
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(y3, par3, l03, t03, lv3, tr3, so3, g3)
+    return (
+        _unfold(gpar3, b),
+        jnp.zeros((b, t), g.dtype),
+        jnp.zeros((b,), g.dtype),
+        jnp.zeros((b,), g.dtype),
+        jnp.zeros((b, m), g.dtype),
+    )
+
+
+_hw_e.defvjp(_hw_e_fwd, _hw_e_bwd)
+
+
+def hw_additive_sse(params, y, period: int, *, interpret: bool = False):
+    """Batched Holt-Winters additive one-step-ahead SSE ``[B]`` on a fused
+    kernel (dense panels only — matches ``models.holtwinters.sse`` with a
+    full valid span).  Differentiable in ``params``; the level/trend/seasonal
+    seeds come from the first two seasons and are constants of the objective.
+    """
+    m = period
+    l0 = jnp.mean(y[:, :m], axis=1)
+    t0 = (jnp.mean(y[:, m : 2 * m], axis=1) - l0) / m
+    s0 = y[:, :m] - l0[:, None]
+    e = _hw_e(interpret, m, params, y, l0, t0, s0)
+    return jnp.sum(e * e, axis=1)

@@ -53,25 +53,62 @@ _BATCH_CACHE_MAX = 512
 _MISSING = object()  # co_names entry not in fn.__globals__ (builtin/attribute)
 
 
+class _ArrayIdKey:
+    """Identity-based cache key for an immutable ``jax.Array`` captured by a
+    kernel (module constant, closure cell, default).  Holding the reference
+    pins the id so it cannot be recycled; equality is identity — a REBOUND
+    capture produces a different key, while the same array keeps hitting the
+    cache (jax arrays are immutable, so identity implies equal contents)."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    def __hash__(self):
+        return object.__hash__(self.arr)
+
+    def __eq__(self, other):
+        return isinstance(other, _ArrayIdKey) and self.arr is other.arr
+
+
+def _hashable(v):
+    return _ArrayIdKey(v) if isinstance(v, jax.Array) else v
+
+
 def _fn_cache_key(fn: Callable):
     """A cache identity for ``fn`` that is stable across textually identical
     lambdas but distinguishes everything the function's behavior can depend
     on: module, qualname, bytecode, consts, defaults, closure values, the
-    CURRENT values of referenced globals, and a bound method's ``__self__``.
-    Unhashable captures (arrays, lists) or not-yet-assigned cells raise
+    CURRENT values of referenced globals, and — for bound methods — the
+    receiver plus a snapshot of its instance attributes (so mutating the
+    receiver after a call cannot serve stale kernels).  Captured ``jax.Array``
+    values key by identity (immutable, see ``_ArrayIdKey``); other unhashable
+    captures (numpy arrays, lists) or not-yet-assigned cells raise
     (ValueError/TypeError) and the caller compiles uncached."""
     self_obj = getattr(fn, "__self__", None)
     f = getattr(fn, "__func__", fn)
     code = getattr(f, "__code__", None)
     if code is None:  # functools.partial / callables: fall back to the object
         return fn
-    cells = tuple(c.cell_contents for c in (f.__closure__ or ()))
-    kwdefs = tuple(sorted((f.__kwdefaults__ or {}).items()))
+    cells = tuple(_hashable(c.cell_contents) for c in (f.__closure__ or ()))
+    kwdefs = tuple((k, _hashable(v)) for k, v in sorted((f.__kwdefaults__ or {}).items()))
+    defaults = tuple(_hashable(v) for v in (f.__defaults__ or ()))
     gl = f.__globals__
-    gvals = tuple(gl.get(n, _MISSING) for n in code.co_names)
+    gvals = tuple(_hashable(gl.get(n, _MISSING)) for n in code.co_names)
+    if self_obj is None:
+        self_key = None
+    else:  # snapshot attribute VALUES: obj.c = 5.0 must change the key
+        attrs = getattr(self_obj, "__dict__", None)
+        self_key = (
+            self_obj,
+            tuple((k, _hashable(v)) for k, v in sorted(attrs.items()))
+            if attrs is not None
+            else None,
+        )
     return (
         f.__module__, f.__qualname__, code.co_code, code.co_consts,
-        code.co_names, f.__defaults__, kwdefs, cells, gvals, self_obj,
+        code.co_names, defaults, kwdefs, cells, gvals, self_key,
     )
 
 

@@ -148,3 +148,237 @@ def test_minimize_lbfgs_batched_matches_vmapped():
         tol=1e-5,
     )
     np.testing.assert_allclose(np.asarray(res.x), np.asarray(res_v.x), atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# GARCH fused objective
+# ---------------------------------------------------------------------------
+
+
+def _returns_panel(b, t, seed=11):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(scale=0.02, size=(b, t)).astype(np.float32))
+
+
+def test_garch_neg_loglik_matches_scan():
+    from spark_timeseries_tpu.models import garch
+
+    b, t = 5, 47
+    r = _returns_panel(b, t)
+    rng = np.random.default_rng(12)
+    params = jnp.asarray(
+        np.column_stack(
+            [
+                rng.uniform(0.01, 0.2, b),
+                rng.uniform(0.05, 0.2, b),
+                rng.uniform(0.5, 0.8, b),
+            ]
+        ).astype(np.float32)
+    )
+    nv = jnp.asarray([t, t - 4, t, t - 9, t - 1], jnp.int32)
+    start = (t - nv).astype(jnp.float32)
+    rz = jnp.where(jnp.arange(t)[None, :] >= start[:, None], r, 0.0)
+
+    ref = jax.vmap(lambda pr, rv, n: garch.neg_log_likelihood(pr, rv, n))(
+        params, rz, nv
+    )
+    got = pk.garch_neg_loglik(params, rz, nv, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=3e-5, atol=3e-5)
+
+
+def test_garch_gradient_matches_autodiff_of_scan():
+    from spark_timeseries_tpu.models import garch
+
+    b, t = 4, 39
+    r = _returns_panel(b, t, seed=13)
+    rng = np.random.default_rng(14)
+    params = jnp.asarray(
+        np.column_stack(
+            [
+                rng.uniform(0.01, 0.2, b),
+                rng.uniform(0.05, 0.2, b),
+                rng.uniform(0.5, 0.8, b),
+            ]
+        ).astype(np.float32)
+    )
+    nv = jnp.asarray([t, t - 5, t - 2, t], jnp.int32)
+    start = (t - nv).astype(jnp.float32)
+    rz = jnp.where(jnp.arange(t)[None, :] >= start[:, None], r, 0.0)
+
+    def loss_scan(P):
+        return jnp.sum(
+            jax.vmap(lambda pr, rv, n: garch.neg_log_likelihood(pr, rv, n))(
+                P, rz, nv
+            )
+        )
+
+    def loss_pal(P):
+        return jnp.sum(pk.garch_neg_loglik(P, rz, nv, interpret=True))
+
+    g_ref = jax.grad(loss_scan)(params)
+    g_got = jax.grad(loss_pal)(params)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), rtol=2e-4, atol=2e-4)
+
+
+def test_argarch_objective_gradient_matches_scan():
+    """Exercises the r^2 / h0 cotangent paths of the GARCH adjoint: the AR(1)
+    mean parameters reach the variance recursion through the residuals."""
+    from spark_timeseries_tpu.models import garch
+
+    b, t = 4, 45
+    key = jax.random.PRNGKey(0)
+    pars_nat = jnp.asarray(
+        np.tile([[0.05, 0.4, 0.02, 0.1, 0.7]], (b, 1)).astype(np.float32)
+    )
+    y = jax.vmap(lambda pr, k: garch.argarch_sample(pr, k, t))(
+        pars_nat, jax.random.split(key, b)
+    ).astype(jnp.float32)
+    nv = jnp.asarray([t, t - 3, t, t - 7], jnp.int32)
+    start = (t - nv)[:, None]
+    t_idx = jnp.arange(t)[None, :]
+    ya = jnp.where(t_idx >= start, y, 0.0)
+    rng = np.random.default_rng(15)
+    u = jnp.asarray(rng.normal(scale=0.3, size=(b, 5)).astype(np.float32))
+
+    def loss_scan(U):
+        nat = jax.vmap(garch._argarch_to_natural)(U)
+        return jnp.sum(
+            jax.vmap(lambda pr, yv, n: garch.argarch_neg_log_likelihood(pr, yv, n))(
+                nat, ya, nv
+            )
+        )
+
+    def loss_pal(U):
+        nat = jax.vmap(garch._argarch_to_natural)(U)
+        prev = jnp.concatenate([ya[:, :1], ya[:, :-1]], axis=1)
+        r = ya - nat[:, 0:1] - nat[:, 1:2] * prev
+        r = jnp.where(t_idx <= start, 0.0, r)
+        return jnp.sum(pk.garch_neg_loglik(nat[:, 2:], r, nv - 1, interpret=True))
+
+    np.testing.assert_allclose(
+        np.asarray(loss_pal(u)), np.asarray(loss_scan(u)), rtol=3e-5
+    )
+    g_ref = jax.grad(loss_scan)(u)
+    g_got = jax.grad(loss_pal)(u)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), rtol=3e-4, atol=3e-4)
+
+
+def test_garch_fit_backend_pallas_matches_scan():
+    from spark_timeseries_tpu.models import garch
+
+    b, t = 6, 200
+    key = jax.random.PRNGKey(3)
+    pars = jnp.asarray(np.tile([[0.05, 0.15, 0.7]], (b, 1)).astype(np.float32))
+    r = jax.vmap(lambda pr, k: garch.sample(pr, k, t))(
+        pars, jax.random.split(key, b)
+    ).astype(jnp.float32)
+    r_scan = garch.fit(r, backend="scan", max_iters=50)
+    r_pal = garch.fit(r, backend="pallas-interpret", max_iters=50)
+    np.testing.assert_allclose(
+        np.asarray(r_pal.params), np.asarray(r_scan.params), rtol=5e-2, atol=5e-3
+    )
+
+
+# ---------------------------------------------------------------------------
+# EWMA fused objective
+# ---------------------------------------------------------------------------
+
+
+def test_ewma_sse_and_grad_matches_scan():
+    from spark_timeseries_tpu.models import ewma
+
+    b, t = 5, 61
+    rng = np.random.default_rng(21)
+    x = jnp.asarray(rng.normal(size=(b, t)).astype(np.float32))
+    nv = jnp.asarray([t, t - 6, t, t - 11, t - 1], jnp.int32)
+    start = (t - nv).astype(jnp.float32)
+    xz = jnp.where(jnp.arange(t)[None, :] >= start[:, None], x, 0.0)
+    alpha = jnp.asarray(rng.uniform(0.1, 0.9, b).astype(np.float32))
+
+    ref = jax.vmap(lambda a, v, n: ewma.sse(a, v, n))(alpha, xz, nv)
+    got = pk.ewma_sse(alpha, xz, nv, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def loss_scan(A):
+        return jnp.sum(jax.vmap(lambda a, v, n: ewma.sse(a, v, n))(A, xz, nv))
+
+    def loss_pal(A):
+        return jnp.sum(pk.ewma_sse(A, xz, nv, interpret=True))
+
+    g_ref = jax.grad(loss_scan)(alpha)
+    g_got = jax.grad(loss_pal)(alpha)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), rtol=1e-4, atol=1e-4)
+
+
+def test_ewma_fit_backend_pallas_matches_scan():
+    from spark_timeseries_tpu.models import ewma
+
+    rng = np.random.default_rng(22)
+    b, t = 6, 90
+    x = np.cumsum(rng.normal(size=(b, t)), axis=1).astype(np.float32)
+    x[1, :13] = np.nan  # ragged head
+    r_scan = ewma.fit(jnp.asarray(x), backend="scan")
+    r_pal = ewma.fit(jnp.asarray(x), backend="pallas-interpret")
+    np.testing.assert_allclose(
+        np.asarray(r_pal.params), np.asarray(r_scan.params), rtol=1e-3, atol=1e-3
+    )
+
+
+# ---------------------------------------------------------------------------
+# Holt-Winters additive fused objective
+# ---------------------------------------------------------------------------
+
+
+def _seasonal_panel(b, t, m, seed=31):
+    rng = np.random.default_rng(seed)
+    tt = np.arange(t)
+    base = 10.0 + 0.05 * tt[None, :]
+    seas = 2.0 * np.sin(2 * np.pi * tt[None, :] / m)
+    noise = rng.normal(scale=0.3, size=(b, t))
+    return jnp.asarray((base + seas + noise).astype(np.float32))
+
+
+def test_hw_sse_and_grad_matches_scan():
+    from spark_timeseries_tpu.models import holtwinters as hw
+
+    b, t, m = 4, 73, 7
+    y = _seasonal_panel(b, t, m)
+    rng = np.random.default_rng(32)
+    params = jnp.asarray(rng.uniform(0.05, 0.9, (b, 3)).astype(np.float32))
+
+    ref = jax.vmap(lambda pr, v: hw.sse(pr, v, m, False))(params, y)
+    got = pk.hw_additive_sse(params, y, m, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=1e-3)
+
+    def loss_scan(P):
+        return jnp.sum(jax.vmap(lambda pr, v: hw.sse(pr, v, m, False))(P, y))
+
+    def loss_pal(P):
+        return jnp.sum(pk.hw_additive_sse(P, y, m, interpret=True))
+
+    g_ref = jax.grad(loss_scan)(params)
+    g_got = jax.grad(loss_pal)(params)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), rtol=1e-3, atol=1e-2)
+
+
+def test_hw_fit_backend_pallas_matches_scan():
+    from spark_timeseries_tpu.models import holtwinters as hw
+
+    b, t, m = 5, 96, 8
+    y = _seasonal_panel(b, t, m, seed=33)
+    r_scan = hw.fit(y, m, "additive", backend="scan", max_iters=40)
+    r_pal = hw.fit(y, m, "additive", backend="pallas-interpret", max_iters=40)
+    np.testing.assert_allclose(
+        np.asarray(r_pal.params), np.asarray(r_scan.params), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_hw_fit_pallas_rejects_nan_and_multiplicative():
+    from spark_timeseries_tpu.models import holtwinters as hw
+
+    y = np.array(_seasonal_panel(3, 60, 6, seed=34))
+    with pytest.raises(ValueError, match="additive"):
+        hw.fit(jnp.asarray(y), 6, "multiplicative", backend="pallas-interpret")
+    y[0, 0] = np.nan
+    with pytest.raises(ValueError, match="dense"):
+        hw.fit(jnp.asarray(y), 6, "additive", backend="pallas-interpret")
