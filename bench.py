@@ -1,94 +1,540 @@
-"""North-star benchmark: batched ARIMA(1,1,1) CSS-MLE fit throughput.
+"""Benchmark harness: all five BASELINE configs + a measured CPU baseline.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Emits ONE JSON line per benchmark, each with the driver schema
+``{"metric", "value", "unit", "vs_baseline"}`` plus extra diagnostic fields.
+The HEADLINE line (config 3, the north-star ARIMA fit) is printed LAST.
 
-The reference publishes no benchmark numbers (BASELINE.md), so
-``vs_baseline`` is reported against the project's north-star target of
-100,000 series/sec (ARIMA(1,1,1) fit, 1k observations/series, TPU v5e-8 —
-BASELINE.json), pro-rated to the chips actually visible:
-``vs_baseline = value / (100_000 * n_chips / 8)``.  The pro-rating is a
-per-chip comparison, not a multi-chip measurement: this host exposes one
-chip, the workload is embarrassingly parallel over series (independent
-fits, zero cross-series communication — the 8-chip sharding itself is
-exercised by ``__graft_entry__.dryrun_multichip``), and the metric string
-records ``n_chips`` so the scaling assumption is visible.
+Configs (``BASELINE.json.configs``):
+  1. autocorr via the mapSeries equivalent, 1k keys x 1k obs
+  2. fillLinear + lag/difference batched ops, 100k keys x 1k obs
+  3. ARIMA(1,1,1) fit + forecast, 100k keys x 1k obs   <- headline
+  4. GARCH(1,1) fit on a daily-returns panel, 50k tickers x 1k obs
+  5. Holt-Winters additive (period 24), 1M hourly series x 960 obs
 
-The measured path is the public ``models.arima.fit`` entry (ragged-series
-alignment + Hannan-Rissanen init + batched L-BFGS on the CSS objective),
-with the fused Pallas CSS kernel on TPU and the ``lax.scan`` objective on
-CPU.  Steady-state timing: compile excluded, fresh data per timed call so
-nothing can be memoized, and a host-side reduction forces full device sync
-(``block_until_ready`` alone does not drain the remote-execution pipe on
-tunneled TPU runtimes).
+CPU baseline (the reference publishes no numbers — BASELINE.md): measured
+here with faithful single-core oracles.  The sequential recursions (ARIMA
+CSS, GARCH variance) run at C speed via ``scipy.signal.lfilter`` — the
+honest stand-in for the reference's compiled JVM/Breeze loops — driven by
+``scipy.optimize`` L-BFGS-B exactly where the reference drives Commons-Math
+optimizers; autocorr/fill are vectorized numpy.  Holt-Winters has no
+lfilter form (three coupled carries + a seasonal ring) and uses a Python
+loop, flagged in its metric string.  All-core rates are the single-core
+rate times ``os.cpu_count()`` (the workload is embarrassingly parallel
+across series — the same assumption Spark's per-partition loops make).
+
+``vs_baseline`` semantics:
+  - config 3: throughput / (100k series/sec * n_chips/8) — the pro-rated
+    north-star target; ``vs_target_unscaled`` carries the raw /100k ratio.
+  - configs 1/2/4/5: measured speedup over the ALL-CORE CPU oracle divided
+    by the 30x north-star speedup target, so > 1.0 beats the target.
+
+Convergence honesty (VERDICT round 1): the headline fit runs the library
+default optimizer budget and reports the converged fraction and converged-
+only throughput; before any timing, the fused Pallas objective is checked
+against the portable scan objective on-device (native lowering parity).
+
+Usage: ``python bench.py [--configs 1,2,3,4,5] [--quick] [--profile DIR]``
 """
 
+import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 
+NORTH_STAR = 100_000.0  # series/sec, config 3, v5e-8
+SPEEDUP_TARGET = 30.0  # vs CPU baseline
+CPU_BUDGET_S = 30.0  # max wall time per CPU oracle measurement
+
+
+def _emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# synthetic data (host-side numpy; device transfer happens before timing)
+# ---------------------------------------------------------------------------
+
+
+def gen_arima_panel(b, t, seed=0, phi=0.6, theta=0.3):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(b, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, t):
+        y[:, i] = phi * y[:, i - 1] + e[:, i] + theta * e[:, i - 1]
+    return np.cumsum(y, axis=1)  # d=1 integration
+
+
+def gen_garch_returns(b, t, seed=0, omega=0.05, alpha=0.12, beta=0.8):
+    rng = np.random.default_rng(seed)
+    z = rng.normal(size=(b, t)).astype(np.float32)
+    r = np.zeros_like(z)
+    h = np.full((b,), omega / (1 - alpha - beta), np.float32)
+    rprev = np.zeros((b,), np.float32)
+    for i in range(t):
+        h = omega + alpha * rprev**2 + beta * h
+        r[:, i] = np.sqrt(h) * z[:, i]
+        rprev = r[:, i]
+    return r
+
+
+def gen_seasonal_panel(b, t, m, seed=0):
+    rng = np.random.default_rng(seed)
+    tt = np.arange(t, dtype=np.float32)
+    base = 10.0 + 0.02 * tt[None, :]
+    phase = rng.uniform(0, 2 * np.pi, (b, 1)).astype(np.float32)
+    seas = 2.0 * np.sin(2 * np.pi * tt[None, :] / m + phase)
+    return (base + seas + rng.normal(scale=0.3, size=(b, t))).astype(np.float32)
+
+
+def gen_gappy_panel(b, t, seed=0, gap_frac=0.1):
+    rng = np.random.default_rng(seed)
+    y = np.cumsum(rng.normal(size=(b, t)), axis=1).astype(np.float32)
+    mask = rng.random((b, t)) < gap_frac
+    mask[:, 0] = False  # keep edges so linear fill is interior
+    mask[:, -1] = False
+    y[mask] = np.nan
+    return y
+
+
+# ---------------------------------------------------------------------------
+# timing
+# ---------------------------------------------------------------------------
+
+
+def time_calls(run, variants):
+    """``run(v) -> host float`` (the host reduction is the sync point).
+    First call compiles/warms; returns per-call durations over ``variants``."""
+    run(variants[0])
+    times = []
+    for v in variants:
+        t0 = time.perf_counter()
+        run(v)
+        times.append(time.perf_counter() - t0)
+    return times
+
+
+def stage(jnp, arrs):
+    """Move arrays to device and force the transfers to finish."""
+    out = [jnp.asarray(a) for a in arrs]
+    for o in out:
+        float(jnp.sum(jnp.nan_to_num(o[:1])))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CPU oracles (single core; per-series loops like the reference)
+# ---------------------------------------------------------------------------
+
+
+def _rate_loop(one_series, panel, budget_s):
+    """Per-series rate: run ``one_series(row)`` until the budget is spent."""
+    t0 = time.perf_counter()
+    done = 0
+    for row in panel:
+        one_series(row)
+        done += 1
+        if time.perf_counter() - t0 > budget_s:
+            break
+    dt = time.perf_counter() - t0
+    return done / dt, done
+
+
+def cpu_rate_autocorr(t, num_lags, budget_s):
+    rng = np.random.default_rng(1)
+    panel = np.cumsum(rng.normal(size=(4096, t)), axis=1)
+
+    def one(x):
+        d = x - x.mean()
+        denom = float(d @ d)
+        return [float(d[k:] @ d[:-k]) / denom for k in range(1, num_lags + 1)]
+
+    return _rate_loop(one, panel, budget_s)
+
+
+def cpu_rate_fill_chain(t, budget_s):
+    panel = gen_gappy_panel(4096, t, seed=2).astype(np.float64)
+    idx = np.arange(t)
+
+    def one(x):
+        valid = ~np.isnan(x)
+        f = np.interp(idx, idx[valid], x[valid])
+        d = np.diff(f)
+        lagged = np.concatenate([[np.nan], f[:-1]])
+        return d, lagged
+
+    return _rate_loop(one, panel, budget_s)
+
+
+def _css_nll_lfilter(params, y, lfilter):
+    """ARIMA(1,0,1)+c CSS objective at C speed (the JVM-loop stand-in)."""
+    c, phi, theta = params
+    n = y.shape[0]
+    u = np.empty_like(y)
+    u[0] = 0.0  # conditional: first p errors zeroed
+    u[1:] = y[1:] - c - phi * y[:-1]
+    e = lfilter([1.0], [1.0, theta], u)
+    e[0] = 0.0
+    n_eff = n - 1
+    css = float(e @ e)
+    sigma2 = css / n_eff
+    return 0.5 * n_eff * (np.log(2.0 * np.pi * sigma2) + 1.0)
+
+
+def cpu_rate_arima(t, budget_s):
+    from scipy.optimize import minimize
+    from scipy.signal import lfilter
+
+    panel = np.diff(gen_arima_panel(512, t, seed=3).astype(np.float64), axis=1)
+
+    def one(yd):
+        res = minimize(
+            _css_nll_lfilter, np.array([0.0, 0.3, 0.1]), args=(yd, lfilter),
+            method="L-BFGS-B", options={"maxiter": 60},
+        )
+        return res.x
+
+    return _rate_loop(one, panel, budget_s)
+
+
+def _garch_nll_lfilter(params, r2, lfilter):
+    omega, alpha, beta = params
+    if omega <= 0 or alpha < 0 or beta < 0 or alpha + beta >= 1:
+        return 1e12
+    h0 = float(r2.mean())
+    drive = omega + alpha * np.concatenate([[h0], r2[:-1]])
+    # h_t = drive_t + beta h_{t-1}, h_{-1} = h0
+    h = lfilter([1.0], [1.0, -beta], drive)
+    h += (beta ** np.arange(1, len(drive) + 1)) * h0
+    h = np.maximum(h, 1e-12)
+    return 0.5 * float(np.sum(np.log(2 * np.pi * h) + r2 / h))
+
+
+def cpu_rate_garch(t, budget_s):
+    from scipy.optimize import minimize
+    from scipy.signal import lfilter
+
+    panel = (gen_garch_returns(512, t, seed=4).astype(np.float64)) ** 2
+
+    def one(r2):
+        res = minimize(
+            _garch_nll_lfilter, np.array([0.05, 0.1, 0.8]), args=(r2, lfilter),
+            method="L-BFGS-B",
+            bounds=[(1e-8, None), (0.0, 1.0), (0.0, 1.0)],
+            options={"maxiter": 80},
+        )
+        return res.x
+
+    return _rate_loop(one, panel, budget_s)
+
+
+def _hw_sse_py(params, y, m):
+    a, b, g = params
+    level = y[:m].mean()
+    trend = (y[m : 2 * m].mean() - level) / m
+    seas = (y[:m] - level).copy()
+    sse = 0.0
+    for t in range(y.shape[0]):
+        s = seas[t % m]
+        pred = level + trend + s
+        if t >= m:
+            sse += (y[t] - pred) ** 2
+        nl = a * (y[t] - s) + (1 - a) * (level + trend)
+        trend = b * (nl - level) + (1 - b) * trend
+        seas[t % m] = g * (y[t] - nl) + (1 - g) * s
+        level = nl
+    return sse
+
+
+def cpu_rate_hw(t, m, budget_s):
+    from scipy.optimize import minimize
+
+    panel = gen_seasonal_panel(64, t, m, seed=5).astype(np.float64)
+
+    def one(y):
+        res = minimize(
+            _hw_sse_py, np.array([0.3, 0.1, 0.1]), args=(y, m),
+            method="L-BFGS-B", bounds=[(0.0, 1.0)] * 3,
+            options={"maxiter": 60},
+        )
+        return res.x
+
+    return _rate_loop(one, panel, budget_s)
+
+
+# ---------------------------------------------------------------------------
+# TPU-side configs
+# ---------------------------------------------------------------------------
+
+
+def _speedup_line(name, value, unit, cpu_rate, n_done, extra=None):
+    n_cores = os.cpu_count() or 1
+    all_core = cpu_rate * n_cores
+    speedup = value / all_core if all_core > 0 else float("nan")
+    obj = {
+        "metric": name,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": round(speedup / SPEEDUP_TARGET, 4),
+        "cpu_series_per_sec_1core": round(cpu_rate, 2),
+        "cpu_series_per_sec_allcore_est": round(all_core, 1),
+        "cpu_oracle_series_measured": n_done,
+        "speedup_vs_cpu_allcore": round(speedup, 2),
+    }
+    if extra:
+        obj.update(extra)
+    return obj
+
+
+def bench_autocorr(jnp, quick):
+    from spark_timeseries_tpu.ops import univariate as uv
+
+    b, t, lags = (256, 200, 5) if quick else (1024, 1000, 10)
+    kern = uv.batch_autocorr(lags)
+    panels = [
+        np.cumsum(np.random.default_rng(s).normal(size=(b, t)), axis=1).astype(np.float32)
+        for s in range(4)
+    ]
+    dev = stage(jnp, panels)
+    times = time_calls(lambda v: float(jnp.sum(kern(v))), dev)
+    rate = b / min(times)
+    cpu_rate, n_done = cpu_rate_autocorr(t, lags, 2.0 if quick else CPU_BUDGET_S / 3)
+    return _speedup_line(
+        f"config1: autocorr({lags}) mapSeries equivalent, {b}x{t}",
+        rate, "series/sec", cpu_rate, n_done,
+    )
+
+
+def bench_fill_chain(jnp, quick, on_tpu):
+    import jax
+
+    from spark_timeseries_tpu.ops import univariate as uv
+
+    b = 2048 if quick or not on_tpu else 100_000
+    t = 200 if quick else 1000
+
+    @jax.jit
+    def chain(v):
+        f = jax.vmap(uv.fill_linear)(v)
+        d = jax.vmap(lambda x: uv.differences_at_lag(x, 1))(f)
+        lagged = jax.vmap(lambda x: uv.lag(x, 1))(f)
+        return d, lagged
+
+    panels = [gen_gappy_panel(b, t, seed=s) for s in range(3)]
+    dev = stage(jnp, panels)
+
+    def run(v):
+        d, lagged = chain(v)
+        return float(jnp.sum(jnp.nan_to_num(d))) + float(
+            jnp.sum(jnp.nan_to_num(lagged))
+        )
+
+    times = time_calls(run, dev)
+    rate = b / min(times)
+    cpu_rate, n_done = cpu_rate_fill_chain(t, 2.0 if quick else CPU_BUDGET_S / 3)
+    return _speedup_line(
+        f"config2: fillLinear+difference+lag chain, {b}x{t}",
+        rate, "series/sec", cpu_rate, n_done,
+    )
+
+
+def bench_garch(jnp, quick, on_tpu):
+    from spark_timeseries_tpu.models import garch
+
+    b = 1024 if quick or not on_tpu else 50_000
+    t = 200 if quick else 1000
+    panels = [gen_garch_returns(b, t, seed=s) for s in range(3)]
+    dev = stage(jnp, panels)
+
+    conv = {}
+
+    def run(v):
+        r = garch.fit(v)
+        conv["frac"] = float(jnp.mean(r.converged))
+        return float(jnp.sum(jnp.nan_to_num(r.params)))
+
+    times = time_calls(run, dev)
+    rate = b / min(times)
+    cpu_rate, n_done = cpu_rate_garch(t, 2.0 if quick else CPU_BUDGET_S)
+    return _speedup_line(
+        f"config4: GARCH(1,1) fit, {b} tickers x {t} obs, converged {conv['frac']:.2f}",
+        rate, "series/sec", cpu_rate, n_done,
+        extra={"converged_frac": round(conv["frac"], 4)},
+    )
+
+
+def bench_holtwinters(jnp, quick, on_tpu):
+    from spark_timeseries_tpu.models import holtwinters as hw
+
+    m = 24
+    if quick or not on_tpu:
+        chunk, n_chunks, t = 1024, 1, 96
+    else:
+        chunk, n_chunks, t = 131_072, 8, 960  # 1,048,576 series total
+    total = chunk * n_chunks
+
+    conv = []
+
+    def fit_chunk(v):
+        r = hw.fit(v, m, "additive", max_iters=40)
+        conv.append(float(jnp.mean(r.converged)))
+        return float(jnp.sum(jnp.nan_to_num(r.params)))
+
+    # warm/compile on one chunk
+    warm = stage(jnp, [gen_seasonal_panel(chunk, t, m, seed=99)])[0]
+    fit_chunk(warm)
+    del warm
+    conv.clear()
+
+    # stream chunks: generate + transfer excluded from the timed section
+    elapsed = 0.0
+    for i in range(n_chunks):
+        v = stage(jnp, [gen_seasonal_panel(chunk, t, m, seed=i)])[0]
+        t0 = time.perf_counter()
+        fit_chunk(v)
+        elapsed += time.perf_counter() - t0
+        del v
+    rate = total / elapsed
+    frac = float(np.mean(conv))
+    cpu_rate, n_done = cpu_rate_hw(t, m, 2.0 if quick else CPU_BUDGET_S)
+    return _speedup_line(
+        f"config5: HoltWinters additive (period {m}) fit, {total} hourly series x "
+        f"{t} obs, converged {frac:.2f} (CPU oracle: python-loop recursion)",
+        rate, "series/sec", cpu_rate, n_done,
+        extra={"converged_frac": round(frac, 4), "chunks": n_chunks},
+    )
+
+
+def check_backend_parity(jnp, on_tpu):
+    """Native-lowering guard: the fused Pallas objectives must agree with the
+    portable scan objectives ON DEVICE before any timing (ADVICE round 1)."""
+    if not on_tpu:
+        return {"checked": False, "reason": "no TPU; scan backend is the oracle"}
+    from spark_timeseries_tpu.models import arima, ewma, garch
+    from spark_timeseries_tpu.models import holtwinters as hw
+
+    y = jnp.asarray(gen_arima_panel(1024, 200, seed=7))
+    rs = arima.fit(y, (1, 1, 1), backend="scan", max_iters=30)
+    rp = arima.fit(y, (1, 1, 1), backend="pallas", max_iters=30)
+    da = float(jnp.nanmax(jnp.abs(rs.params - rp.params)))
+    r = jnp.asarray(gen_garch_returns(1024, 200, seed=8))
+    gs = garch.fit(r, backend="scan", max_iters=40)
+    gp = garch.fit(r, backend="pallas", max_iters=40)
+    dg = float(jnp.nanmax(jnp.abs(gs.params - gp.params)))
+    x = jnp.asarray(np.cumsum(
+        np.random.default_rng(9).normal(size=(1024, 200)).astype(np.float32), axis=1
+    ))
+    es = ewma.fit(x, backend="scan")
+    ep = ewma.fit(x, backend="pallas")
+    de = float(jnp.nanmax(jnp.abs(es.params - ep.params)))
+    w = jnp.asarray(gen_seasonal_panel(1024, 192, 24, seed=10))
+    hs = hw.fit(w, 24, "additive", backend="scan", max_iters=30)
+    hp = hw.fit(w, 24, "additive", backend="pallas", max_iters=30)
+    dh = float(jnp.nanmax(jnp.abs(hs.params - hp.params)))
+    assert da < 5e-2, f"ARIMA pallas/scan divergence on device: {da}"
+    assert dg < 5e-2, f"GARCH pallas/scan divergence on device: {dg}"
+    assert de < 1e-2, f"EWMA pallas/scan divergence on device: {de}"
+    assert dh < 5e-2, f"HoltWinters pallas/scan divergence on device: {dh}"
+    return {"checked": True, "arima_max_abs_diff": da, "garch_max_abs_diff": dg,
+            "ewma_max_abs_diff": de, "hw_max_abs_diff": dh}
+
+
+def bench_arima_headline(jnp, quick, on_tpu, n_chips, platform):
+    from spark_timeseries_tpu.models import arima
+
+    b = 1024 if quick else (100_352 if on_tpu else 256)  # 98 x 1024 blocks
+    t = 200 if quick else 1000
+    order = (1, 1, 1)
+    panels = [gen_arima_panel(b, t, seed=s) for s in range(4 if on_tpu else 2)]
+    dev = stage(jnp, panels)
+
+    state = {}
+
+    def run(v):
+        r = arima.fit(v, order)  # library-default budget (60 iters) + tol
+        state["conv"] = float(jnp.mean(r.converged))
+        state["res"] = r
+        return float(jnp.sum(jnp.nan_to_num(r.params)))
+
+    times = time_calls(run, dev)
+    best = min(times)
+    p50 = float(np.median(times))
+    frac_conv = state["conv"]
+    rate = b / best
+    rate_converged = b * frac_conv / best
+
+    # forecast ride-along (config says fit + forecast)
+    r = state["res"]
+    t0 = time.perf_counter()
+    fc = arima.forecast(r.params, dev[0], order, 10)
+    float(jnp.sum(jnp.nan_to_num(fc)))
+    forecast_s = time.perf_counter() - t0
+
+    cpu_rate, n_done = cpu_rate_arima(t, 2.0 if quick else CPU_BUDGET_S)
+    n_cores = os.cpu_count() or 1
+    target = NORTH_STAR * n_chips / 8.0
+    return {
+        "metric": (
+            f"config3 HEADLINE: ARIMA(1,1,1) CSS-MLE fit throughput ({t} obs/series, "
+            f"batch {b}, {n_chips}x {platform}, converged {frac_conv:.3f})"
+        ),
+        "value": round(rate_converged, 1),
+        "unit": "series/sec (converged-only; raw rate x converged fraction)",
+        "vs_baseline": round(rate_converged / target, 4),
+        "raw_series_per_sec": round(rate, 1),
+        "converged_frac": round(frac_conv, 4),
+        "vs_target_unscaled": round(rate_converged / NORTH_STAR, 4),
+        "p50_fit_latency_s": round(p50, 3),
+        "best_fit_latency_s": round(best, 3),
+        "forecast_latency_s": round(forecast_s, 3),
+        "cpu_series_per_sec_1core": round(cpu_rate, 2),
+        "cpu_series_per_sec_allcore_est": round(cpu_rate * n_cores, 1),
+        "cpu_oracle_series_measured": n_done,
+        "speedup_vs_cpu_1core": round(rate_converged / cpu_rate, 1),
+        "speedup_vs_cpu_allcore": round(rate_converged / (cpu_rate * n_cores), 2),
+    }
+
+
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="1,2,4,5,3",
+                    help="comma-separated subset of 1..5 (3 always prints last)")
+    ap.add_argument("--quick", action="store_true", help="small sizes (CI smoke)")
+    ap.add_argument("--profile", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of the headline config")
+    args = ap.parse_args()
+    wanted = [c.strip() for c in args.configs.split(",") if c.strip()]
+
     import jax
     import jax.numpy as jnp
 
-    from spark_timeseries_tpu.models import arima
-
     platform = jax.devices()[0].platform
     on_tpu = platform in ("tpu", "axon")
-
-    batch = 65536 if on_tpu else 256
-    T = 1000
-    order = (1, 1, 1)
-
-    rng = np.random.default_rng(0)
-    e = rng.normal(size=(batch, T)).astype(np.float32)
-    y0 = np.zeros_like(e)
-    y0[:, 0] = e[:, 0]
-    for t in range(1, T):
-        y0[:, t] = 0.6 * y0[:, t - 1] + e[:, t] + 0.3 * e[:, t - 1]
-    y0 = np.cumsum(y0, axis=1)
-
-    def run(y):
-        t0 = time.perf_counter()
-        r = arima.fit(y, order, max_iters=20, tol=1e-4)
-        # host-side reduction = hard sync point
-        checksum = float(jnp.sum(jnp.nan_to_num(r.params)))
-        return time.perf_counter() - t0, checksum, r
-
-    # stage input variants on-device BEFORE timing (device transfer is not
-    # part of the measured fit; distinct data defeats any memoization)
-    variants = [
-        jnp.asarray(y0 + rng.normal(scale=0.01, size=y0.shape).astype(np.float32))
-        for _ in range(3)
-    ]
-    for v in variants:
-        float(jnp.sum(v))  # force the transfer to complete
-
-    # compile + warm up
-    _, _, r = run(variants[0])
-    frac_conv = float(jnp.mean(r.converged))
-
-    best = float("inf")
-    for v in variants:
-        dt, _, _ = run(v)
-        best = min(best, dt)
-
-    series_per_sec = batch / best
     n_chips = len(jax.devices())
-    target = 100_000.0 * n_chips / 8.0
-    print(
-        json.dumps(
-            {
-                "metric": f"ARIMA(1,1,1) CSS-MLE fit throughput ({T} obs/series, "
-                f"batch {batch}, {n_chips}x {platform}, converged {frac_conv:.2f})",
-                "value": round(series_per_sec, 1),
-                "unit": "series/sec",
-                "vs_baseline": round(series_per_sec / target, 4),
-            }
-        )
-    )
+
+    parity = check_backend_parity(jnp, on_tpu)
+    _emit({"metric": "pallas/scan on-device parity gate", "value": 1.0,
+           "unit": "ok", "vs_baseline": 1.0, **parity})
+
+    if "1" in wanted:
+        _emit(bench_autocorr(jnp, args.quick))
+    if "2" in wanted:
+        _emit(bench_fill_chain(jnp, args.quick, on_tpu))
+    if "4" in wanted:
+        _emit(bench_garch(jnp, args.quick, on_tpu))
+    if "5" in wanted:
+        _emit(bench_holtwinters(jnp, args.quick, on_tpu))
+    if "3" in wanted:
+        if args.profile:
+            with jax.profiler.trace(args.profile):
+                line = bench_arima_headline(jnp, args.quick, on_tpu, n_chips, platform)
+        else:
+            line = bench_arima_headline(jnp, args.quick, on_tpu, n_chips, platform)
+        _emit(line)
 
 
 if __name__ == "__main__":

@@ -209,6 +209,11 @@ class TimeSeriesRDD:
     def save_as_csv(self, path: str) -> None:
         self.panel.save_csv(path)
 
+    def save_as_parquet_data_frame(self, path: str) -> None:
+        """Upstream ``saveAsParquetDataFrame`` analog (series-major Parquet —
+        see ``TimeSeriesPanel.save_parquet`` for the layout rationale)."""
+        self.panel.save_parquet(path)
+
     def __len__(self) -> int:
         return self.panel.n_series
 
@@ -222,6 +227,11 @@ def time_series_rdd_from_observations(dt_index: DateTimeIndex, df,
             df, dt_index, ts_col=ts_col, key_col=key_col, value_col=val_col
         )
     )
+
+
+def time_series_rdd_from_parquet(path: str) -> TimeSeriesRDD:
+    """Upstream ``timeSeriesRDDFromParquet`` analog."""
+    return TimeSeriesRDD(TimeSeriesPanel.load_parquet(path))
 
 
 def time_series_rdd_from_pandas_dataframe(dt_index: DateTimeIndex, df

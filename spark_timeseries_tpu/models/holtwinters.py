@@ -127,11 +127,12 @@ def fit(
         # of traced data cannot be inspected, so auto falls back to the
         # portable path rather than guessing (explicit pallas under jit is
         # the caller asserting density)
-        if multiplicative or (was_auto and (traced or bool(jnp.any(jnp.isnan(yb))))):
+        has_nan = False if traced else bool(jnp.any(jnp.isnan(yb)))
+        if multiplicative or (was_auto and (traced or has_nan)):
             if not was_auto:
                 raise ValueError("pallas backend supports the additive model only")
             backend = "scan"
-        elif not traced and bool(jnp.any(jnp.isnan(yb))):
+        elif has_nan:
             raise ValueError(
                 "pallas backend needs a dense panel (no NaNs); fill first or "
                 "use backend='scan'"

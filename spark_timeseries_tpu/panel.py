@@ -48,6 +48,18 @@ def _as_key_array(keys: Iterable) -> np.ndarray:
     return np.asarray(list(keys), dtype=object)
 
 
+def _require_pyarrow():
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+    except ImportError as e:  # pragma: no cover - pyarrow is an extra
+        raise ImportError(
+            "Parquet persistence needs pyarrow (pip install "
+            "spark-timeseries-tpu[parquet])"
+        ) from e
+    return pa, pq
+
+
 _BATCH_CACHE: Dict = {}
 _BATCH_CACHE_MAX = 512
 _MISSING = object()  # co_names entry not in fn.__globals__ (builtin/attribute)
@@ -514,6 +526,67 @@ class TimeSeriesPanel:
         return TimeSeriesPanel(
             dtix.from_string(str(z["index"])), list(z["keys"]), z["values"], mesh=mesh
         )
+
+    def save_parquet(self, path: str, *, row_group_series: int = 16384) -> None:
+        """Columnar checkpoint via Arrow/Parquet (the reference's
+        ``saveAsParquetDataFrame`` / ``timeSeriesRDDFromParquet`` pair —
+        SURVEY.md §2.1 TimeSeriesRDD row).
+
+        Layout is SERIES-major — one row per series, schema
+        ``key: string, values: fixed_size_list<float>[n_time]`` with the
+        encoded ``DateTimeIndex`` in the file metadata — not the reference's
+        instant-major DataFrame: a million-series panel would need a million
+        Parquet columns instant-major, while series-major rows write
+        incrementally in row groups of ``row_group_series``, so Arrow-side
+        memory stays one row group beyond the single host copy of the panel.
+        Keys are coerced to ``str`` (same contract as ``save_csv``).
+        """
+        pa, pq = _require_pyarrow()
+        vals = np.asarray(self.series_values())
+        t = vals.shape[1]
+        schema = pa.schema(
+            [("key", pa.string()), ("values", pa.list_(pa.from_numpy_dtype(vals.dtype), t))],
+            metadata={
+                b"spark_timeseries_tpu.index": self.index.to_string().encode(),
+                b"spark_timeseries_tpu.version": b"1",
+            },
+        )
+        with pq.ParquetWriter(path, schema) as writer:
+            for lo in range(0, vals.shape[0], row_group_series):
+                chunk = vals[lo : lo + row_group_series]
+                arr = pa.FixedSizeListArray.from_arrays(
+                    pa.array(chunk.reshape(-1)), t
+                )
+                keys = pa.array(
+                    [str(k) for k in self.keys[lo : lo + row_group_series]],
+                    pa.string(),
+                )
+                writer.write_table(
+                    pa.Table.from_arrays([keys, arr], schema=schema)
+                )
+
+    @staticmethod
+    def load_parquet(path: str, mesh: Optional[Mesh] = None) -> "TimeSeriesPanel":
+        """Load a :meth:`save_parquet` checkpoint (round-trips keys as str,
+        values bit-exact, and the index through its string codec)."""
+        pa, pq = _require_pyarrow()
+        table = pq.read_table(path)
+        meta = table.schema.metadata or {}
+        enc = meta.get(b"spark_timeseries_tpu.index")
+        if enc is None:
+            raise ValueError(
+                f"{path} is not a spark_timeseries_tpu panel checkpoint "
+                "(missing index metadata)"
+            )
+        index = dtix.from_string(enc.decode())
+        col = table.column("values").combine_chunks()
+        if isinstance(col, pa.ChunkedArray):  # zero-chunk tables stay chunked
+            col = col.chunk(0) if col.num_chunks else pa.array([], pa.list_(pa.float32(), 0))
+        n = len(table)
+        t = col.type.list_size
+        vals = np.asarray(col.flatten()).reshape(n, t)
+        keys = table.column("key").to_pylist()
+        return TimeSeriesPanel(index, keys, vals, mesh=mesh)
 
     # -- resharding ---------------------------------------------------------
 

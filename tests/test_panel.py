@@ -190,6 +190,47 @@ class TestPersistence:
             equal_nan=True,
         )
 
+    def test_parquet_roundtrip(self, small_panel, tmp_path):
+        pytest.importorskip("pyarrow")
+        path = str(tmp_path / "panel.parquet")
+        small_panel.save_parquet(path)
+        back = sts.TimeSeriesPanel.load_parquet(path)
+        assert back.index == small_panel.index
+        assert list(back.keys) == [str(k) for k in small_panel.keys]
+        np.testing.assert_array_equal(  # bit-exact, incl. NaN positions
+            np.asarray(back.series_values()),
+            np.asarray(small_panel.series_values()),
+        )
+
+    def test_parquet_row_groups_stream(self, small_panel, tmp_path):
+        pytest.importorskip("pyarrow")
+        path = str(tmp_path / "panel_rg.parquet")
+        small_panel.save_parquet(path, row_group_series=1)
+        back = sts.TimeSeriesPanel.load_parquet(path)
+        np.testing.assert_array_equal(
+            np.asarray(back.series_values()),
+            np.asarray(small_panel.series_values()),
+        )
+
+    def test_parquet_rejects_foreign_file(self, tmp_path):
+        pa = pytest.importorskip("pyarrow")
+        import pyarrow.parquet as pq
+
+        path = str(tmp_path / "foreign.parquet")
+        pq.write_table(pa.table({"x": [1, 2]}), path)
+        with pytest.raises(ValueError, match="checkpoint"):
+            sts.TimeSeriesPanel.load_parquet(path)
+
+    def test_parquet_compat_aliases(self, small_panel, tmp_path):
+        pytest.importorskip("pyarrow")
+        from spark_timeseries_tpu.compat import sparkts
+
+        path = str(tmp_path / "compat.parquet")
+        rdd = sparkts.TimeSeriesRDD(small_panel)
+        rdd.save_as_parquet_data_frame(path)
+        back = sparkts.time_series_rdd_from_parquet(path)
+        assert len(back) == len(rdd)
+
 
 class TestSharded:
     """The Spark-local[n] analog: everything again on an 8-device CPU mesh."""

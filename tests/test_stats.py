@@ -175,3 +175,129 @@ class TestBatched:
         assert dws.shape == (6,)
         etas, kps = st.batch_kpsstest(panel, "c")
         assert etas.shape == (6,)
+
+
+class TestRaggedNaN:
+    """Every test must tolerate NaN heads/tails/gaps via row dropping."""
+
+    def _walk(self, n, seed=0):
+        return np.cumsum(np.random.default_rng(seed).normal(size=n))
+
+    def test_adf_ragged_matches_trimmed(self):
+        y = self._walk(240, seed=1)
+        ypad = np.full(300, np.nan)
+        ypad[40:280] = y
+        tau_t, p_t = st.adftest(jnp.asarray(y))
+        tau_p, p_p = st.adftest(jnp.asarray(ypad))
+        np.testing.assert_allclose(float(tau_p), float(tau_t), rtol=1e-5)
+        np.testing.assert_allclose(float(p_p), float(p_t), rtol=1e-4, atol=1e-4)
+
+    def test_adf_ct_ragged_matches_trimmed(self):
+        y = self._walk(200, seed=2)
+        ypad = np.concatenate([[np.nan] * 30, y, [np.nan] * 10])
+        tau_t, _ = st.adftest(jnp.asarray(y), regression="ct")
+        tau_p, _ = st.adftest(jnp.asarray(ypad), regression="ct")
+        # the trend-origin shift is absorbed by the intercept up to the
+        # ridge stabilizer, so agreement is near- but not bit-exact
+        np.testing.assert_allclose(float(tau_p), float(tau_t), rtol=1e-3)
+
+    def test_dw_ragged_matches_trimmed(self):
+        e = np.random.default_rng(3).normal(size=150)
+        epad = np.concatenate([[np.nan] * 20, e, [np.nan] * 5])
+        np.testing.assert_allclose(
+            float(st.dwtest(jnp.asarray(epad))),
+            float(st.dwtest(jnp.asarray(e))),
+            rtol=1e-6,
+        )
+
+    def test_lb_ragged_matches_trimmed(self):
+        e = np.random.default_rng(4).normal(size=180)
+        epad = np.concatenate([[np.nan] * 25, e])
+        q_t, p_t = st.lbtest(jnp.asarray(e), 5)
+        q_p, p_p = st.lbtest(jnp.asarray(epad), 5)
+        np.testing.assert_allclose(float(q_p), float(q_t), rtol=1e-6)
+        np.testing.assert_allclose(float(p_p), float(p_t), rtol=1e-5)
+
+    def test_kpss_ragged_matches_trimmed(self):
+        y = np.random.default_rng(5).normal(size=200)
+        ypad = np.concatenate([[np.nan] * 30, y])
+        # same bandwidth so the statistic is comparable
+        lags = st.np_trunc_bandwidth(200)
+        eta_t, p_t = st.kpsstest(jnp.asarray(y), lags=lags)
+        eta_p, p_p = st.kpsstest(jnp.asarray(ypad), lags=lags)
+        np.testing.assert_allclose(float(eta_p), float(eta_t), rtol=1e-6)
+        np.testing.assert_allclose(float(p_p), float(p_t), rtol=1e-4, atol=1e-3)
+
+    def test_bg_bp_ragged_match_trimmed(self):
+        rng = np.random.default_rng(6)
+        n = 160
+        x = rng.normal(size=n)
+        e = 0.6 * np.concatenate([[0], x[:-1]]) + rng.normal(size=n)
+        epad = np.concatenate([[np.nan] * 12, e])
+        xpad = np.concatenate([[np.nan] * 12, x])
+        s_t, p_t = st.bgtest(jnp.asarray(e), jnp.asarray(x), 2)
+        s_p, p_p = st.bgtest(jnp.asarray(epad), jnp.asarray(xpad), 2)
+        np.testing.assert_allclose(float(s_p), float(s_t), rtol=1e-5)
+        s_t2, _ = st.bptest(jnp.asarray(e), jnp.asarray(x))
+        s_p2, _ = st.bptest(jnp.asarray(epad), jnp.asarray(xpad))
+        np.testing.assert_allclose(float(s_p2), float(s_t2), rtol=1e-5)
+
+    def test_batch_adf_ragged_no_nans_out(self):
+        rng = np.random.default_rng(7)
+        panel = np.cumsum(rng.normal(size=(5, 120)), axis=1)
+        panel[0, :20] = np.nan
+        panel[2, 100:] = np.nan
+        taus, ps = st.batch_adftest(jnp.asarray(panel))
+        assert np.isfinite(np.asarray(taus)).all()
+        assert np.isfinite(np.asarray(ps)).all()
+
+
+class TestBatchBgBp:
+    def test_batch_bg_shared_factors(self):
+        rng = np.random.default_rng(8)
+        n, b = 150, 4
+        X = rng.normal(size=(n, 1))
+        E = np.stack(
+            [0.7 * np.concatenate([[0], rng.normal(size=n - 1)]) + rng.normal(size=n)
+             for _ in range(b)]
+        )
+        stats_, ps = st.batch_bgtest(jnp.asarray(E), jnp.asarray(X), 2)
+        assert stats_.shape == (b,) and ps.shape == (b,)
+
+    def test_batch_bp_per_series_factors(self):
+        rng = np.random.default_rng(9)
+        n, b = 150, 3
+        X = rng.normal(size=(b, n, 2))
+        E = rng.normal(size=(b, n)) * np.exp(0.8 * X[:, :, 0])
+        stats_, ps = st.batch_bptest(jnp.asarray(E), jnp.asarray(X))
+        assert stats_.shape == (b,)
+        assert (np.asarray(ps) < 0.05).any()
+
+
+class TestFiniteSampleTables:
+    def test_adf_pvalue_depends_on_n(self):
+        # same tau is LESS significant in a smaller sample
+        from spark_timeseries_tpu.stats import _tables
+
+        tau = jnp.asarray(-2.86)
+        p_small = st._table_pvalue(tau, jnp.asarray(30.0), _tables.DF_TAU["c"], False)
+        p_large = st._table_pvalue(tau, jnp.asarray(2000.0), _tables.DF_TAU["c"], False)
+        assert float(p_small) > float(p_large)
+        np.testing.assert_allclose(float(p_large), 0.05, atol=0.01)
+
+    def test_adf_asymptotic_anchors(self):
+        from spark_timeseries_tpu.stats import _tables
+
+        for reg, tau5 in (("nc", -1.94), ("c", -2.86), ("ct", -3.41)):
+            p = st._table_pvalue(
+                jnp.asarray(tau5), jnp.asarray(2000.0), _tables.DF_TAU[reg], False
+            )
+            np.testing.assert_allclose(float(p), 0.05, atol=0.012)
+
+    def test_kpss_wide_range(self):
+        # p-values now resolve beyond the published [0.01, 0.10] clip
+        y = np.random.default_rng(10).normal(size=300)
+        eta, p = st.kpsstest(jnp.asarray(y))
+        assert 0.01 <= float(p) <= 0.99
+        # strongly stationary series should sit WELL above 0.10
+        assert float(p) > 0.2
