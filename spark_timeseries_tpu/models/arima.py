@@ -232,16 +232,18 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
     k = _n_params(order, include_intercept)
 
     def run(yb, init_params=None):
-        ya, nv0 = jax.vmap(align_right)(yb)  # ragged support: NaN head/tail
-        yd = jax.vmap(lambda v: _difference(v, d))(ya)
-        nvd = nv0 - d  # valid length after differencing
-        init = (
-            jnp.broadcast_to(init_params, (yd.shape[0], k))
-            if has_init
-            else jax.vmap(
-                lambda v, n: hannan_rissanen(v, order, include_intercept, n)
-            )(yd, nvd)
-        )
+        with jax.named_scope("arima.align_and_difference"):
+            ya, nv0 = jax.vmap(align_right)(yb)  # ragged support: NaN head/tail
+            yd = jax.vmap(lambda v: _difference(v, d))(ya)
+            nvd = nv0 - d  # valid length after differencing
+        with jax.named_scope("arima.hannan_rissanen_init"):
+            init = (
+                jnp.broadcast_to(init_params, (yd.shape[0], k))
+                if has_init
+                else jax.vmap(
+                    lambda v, n: hannan_rissanen(v, order, include_intercept, n)
+                )(yd, nvd)
+            )
         # too-short series cannot be fit: need lags + a few dof
         ok = nvd >= p + q + max(p + q + 1, 1) + k + 2
         if not has_init:

@@ -152,6 +152,10 @@ def _fit_program(period, multiplicative, max_iters, tol, backend):
         u0 = jnp.broadcast_to(
             optim.interval_to_sigmoid(nat0, 0.0, 1.0), (yb.shape[0], 3)
         )
+        # optimize the MEAN one-step squared error: same argmin as the SSE,
+        # but the gradient scale is O(1), so the relative grad-norm stopping
+        # rule fires when the fit is actually done instead of never
+        n_err = jnp.maximum(nv - period, 1).astype(yb.dtype)
         if backend in ("pallas", "pallas-interpret"):
             from ..ops import pallas_kernels as pk
 
@@ -159,22 +163,22 @@ def _fit_program(period, multiplicative, max_iters, tol, backend):
 
             def fb(u):
                 nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
-                return pk.hw_additive_sse(nat, ya, period, interpret=interp)
+                return pk.hw_additive_sse(nat, ya, period, interpret=interp) / n_err
 
             res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
         else:
             def objective(u, data):
-                yv, n = data
+                yv, n, ne = data
                 nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
-                return sse(nat, yv, period, multiplicative, n)
+                return sse(nat, yv, period, multiplicative, n) / ne
 
             res = optim.batched_minimize(
-                objective, u0, (ya, nv), max_iters=max_iters, tol=tol
+                objective, u0, (ya, nv, n_err), max_iters=max_iters, tol=tol
             )
         ok = nv >= 2 * period  # seed needs two full seasons of real data
         return FitResult(
             jnp.where(ok[:, None], optim.sigmoid_to_interval(res.x, 0.0, 1.0), jnp.nan),
-            jnp.where(ok, res.f, jnp.nan),
+            jnp.where(ok, res.f * n_err, jnp.nan),  # report the SSE as before
             res.converged & ok,
             res.iters,
         )

@@ -1,32 +1,43 @@
 """Pallas TPU kernels for the sequential-recursion hot paths.
 
 The reference runs its model recursions (ARMA one-step-ahead CSS errors,
-GARCH conditional variance, EWMA smoothing) as per-series JVM loops
-(``sparkts/models/ARIMA.scala`` ``logLikelihoodCSS`` /
-``gradientLogLikelihoodCSSARMA``, ``GARCH.scala``, ``EWMA.scala`` —
-SURVEY.md §2.2, upstream paths unverified).  The portable rebuild expresses
-them as ``jax.vmap(lax.scan)`` (``models/arima.py`` etc.), which is correct
-everywhere but pays one XLA loop iteration — several HBM round trips — per
-time step.
+GARCH conditional variance, EWMA smoothing, Holt-Winters state) as
+per-series JVM loops (``sparkts/models/ARIMA.scala`` ``logLikelihoodCSS`` /
+``gradientLogLikelihoodCSSARMA``, ``GARCH.scala``, ``EWMA.scala``,
+``HoltWinters.scala`` — SURVEY.md §2.2, upstream paths unverified).  The
+portable rebuild expresses them as ``jax.vmap(lax.scan)`` (``models/*``),
+which is correct everywhere but pays one XLA loop iteration — several HBM
+round trips — per time step.
 
-These kernels fuse the *entire* recursion into one grid step whose series
-block lives in VMEM: series are folded to ``[time, 8, 128]`` tiles
-(sublane x lane = 1024 series per block), the natural f32 vector-register
-shape, so every time step is a handful of full-width VPU ops instead of an
-XLA loop iteration.
+These kernels fuse the recursion into grid steps whose series block lives in
+VMEM: series are folded to ``[time, 8, 128]`` tiles (sublane x lane = 1024
+series per block), the natural f32 vector-register shape, so every time step
+is a handful of full-width VPU ops instead of an XLA loop iteration.
+
+SERIES LENGTH IS UNBOUNDED: the grid is ``(series_block, time_chunk)`` with
+the chunk axis innermost (TPU iterates it sequentially), each chunk holding
+``_CHUNK_T`` steps in VMEM.  Lag reads that cross a chunk boundary come from
+a NEIGHBOR INPUT BLOCK (the previous time chunk mapped as a second input);
+recursion state that flows forward/backward across chunks (trailing errors,
+the variance/smoothing carry, adjoint carries, gradient accumulators) lives
+in VMEM scratch, which persists across the sequential chunk dimension.
+Parameter-gradient outputs use the revisited-output-block reduction pattern
+(initialize at the first chunk, accumulate, final value flushed once).
 
 Like the reference — which hand-derives ``gradientLogLikelihoodCSSARMA``
-rather than relying on automatic differentiation — the ARMA kernel ships a
+rather than relying on automatic differentiation — every kernel pair ships a
 hand-derived adjoint recursion, exposed through ``jax.custom_vjp`` so the
-batched L-BFGS driver (``utils/optim``) can differentiate the CSS objective
-without XLA's scan transpose.  The adjoint propagates cotangents to the
-parameters only; the observations are treated as constants (exactly the
-reference's gradient), so these entry points are used inside fit objectives
-and not exposed as general autodiff building blocks.
+batched L-BFGS driver (``utils/optim``) can differentiate the objectives
+without XLA's scan transpose.  Cotangents flow to the parameters (and for
+GARCH also to the squared returns and the variance seed, so ARGARCH's mean
+parameters differentiate exactly); everything else is a constant of the fit
+objective, so these entry points are used inside fit objectives and not
+exposed as general autodiff building blocks.
 
 Everything here is optional: callers gate on :func:`supported` and fall back
 to the ``lax.scan`` implementations (same semantics, cross-checked by
-``tests/test_pallas.py`` in interpret mode).
+``tests/test_pallas.py`` in interpret mode and by the on-device parity gate
+in ``bench.py``).
 """
 
 from __future__ import annotations
@@ -45,39 +56,56 @@ Order = Tuple[int, int, int]
 _SUBL = 8  # f32 sublanes per vector register
 _LANES = 128  # TPU lane width
 _SBLK = _SUBL * _LANES  # series per grid step (1024)
-# VMEM budget: the adjoint kernel holds y, e, and the e-adjoint as
-# [T, 8, 128] f32 tiles (4 KiB per time step each) -> ~12 KiB * T; cap T to
-# stay well inside ~16 MiB/core.
-_MAX_T = 1024
-# Scoped-VMEM override shared by every kernel here: at T near _MAX_T the
-# double-buffered in/out tiles (plus the adjoint scratch in the backward
-# kernel) exceed the default 16 MiB budget.
+_CHUNK_T = 1024  # time steps resident in VMEM per grid step
+# Scoped-VMEM override shared by every kernel here: a handful of
+# [_CHUNK_T, 8, 128] blocks plus double buffering exceeds the default budget.
 _VMEM_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+
+_ZERO = lambda: jnp.zeros((_SUBL, _LANES), jnp.float32)  # noqa: E731
 
 
 def supported(dtype, n_time: int) -> bool:
-    """True when the fused kernels can run natively on this platform/shape."""
+    """True when the fused kernels can run natively on this platform/dtype.
+
+    ``n_time`` is unrestricted (time-chunked grids); it remains a parameter
+    so callers keep passing their shape and future constraints stay cheap.
+    """
+    del n_time
     try:
         platform = jax.devices()[0].platform
     except Exception:  # pragma: no cover - no/broken backend
         return False
-    return (
-        platform in ("tpu", "axon")
-        and jnp.dtype(dtype) == jnp.dtype(jnp.float32)
-        and n_time <= _MAX_T
-    )
+    return platform in ("tpu", "axon") and jnp.dtype(dtype) == jnp.dtype(jnp.float32)
 
 
 def _pad_to(n: int, m: int) -> int:
     return (-n) % m
 
 
-def _fold(x2d):
-    """``[B, n] -> [n, B_pad/128-groups]`` series folding.
+def _scoped(name):
+    """Profiler annotation (SURVEY.md §5.1 rebuild analog): each fused
+    objective shows up as one named block in jax.profiler / Perfetto traces."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            with jax.named_scope(name):
+                return fn(*a, **k)
+        return wrapped
+    return deco
 
-    Returns ``[n, Bp // 128 sublane-rows, 128]`` where consecutive series map
-    to consecutive lanes; the kernel grid walks 8-sublane blocks of axis 1.
-    """
+
+def _time_layout(t: int) -> Tuple[int, int, int]:
+    """-> (padded_t, chunk_len, n_chunks) for a series of length ``t``."""
+    tp8 = t + _pad_to(t, _SUBL)
+    if tp8 <= _CHUNK_T:
+        return tp8, tp8, 1
+    tp = t + _pad_to(t, _CHUNK_T)
+    return tp, _CHUNK_T, tp // _CHUNK_T
+
+
+def _fold(x2d):
+    """``[B, n] -> [n, Bp/128, 128]`` series folding: consecutive series map
+    to consecutive lanes; the kernel grid walks 8-sublane blocks of axis 1."""
     b, n = x2d.shape
     x2d = jnp.pad(x2d, ((0, _pad_to(b, _SBLK)), (0, 0)))
     bp = x2d.shape[0]
@@ -90,9 +118,28 @@ def _unfold(x3d, b: int):
     return x3d.reshape(n, -1).T[:b]
 
 
-def _blockspec(n0: int):
-    """Whole axis 0, one [8, 128] series block of axis 1/2 per grid step."""
-    return pl.BlockSpec((n0, _SUBL, _LANES), lambda blk: (0, blk, 0))
+def _bs(n0: int, imap):
+    return pl.BlockSpec((n0, _SUBL, _LANES), imap)
+
+
+def _cur(blk, c):  # current time chunk
+    return (c, blk, 0)
+
+
+def _prev(blk, c):  # previous time chunk (clamped; guarded by global-t checks)
+    return (jnp.maximum(c - 1, 0), blk, 0)
+
+
+def _fixed(blk, c):  # chunk-invariant block (params, seeds, reductions)
+    return (0, blk, 0)
+
+
+def _rev(nchunk):  # walk time chunks last-to-first
+    return lambda blk, c: (nchunk - 1 - c, blk, 0)
+
+
+def _rev_prev(nchunk):  # previous TIME chunk while walking backward
+    return lambda blk, c: (jnp.maximum(nchunk - 2 - c, 0), blk, 0)
 
 
 # ---------------------------------------------------------------------------
@@ -109,53 +156,111 @@ def _blockspec(n0: int):
 #   dL/dc       = -sum_t a_t
 #   dL/dphi_i   = -sum_t y_{t-i} * a_t
 #   dL/dtheta_j = -sum_t e_{t-j} * a_t
+#
+# Cross-chunk state: the forward carries the last q errors (scratch); the
+# backward carries the adjoints of the first q positions of the next-later
+# chunk (scratch) and accumulates the k parameter gradients in the revisited
+# output block.
 
 
-def _css_fwd_kernel(p, q, t_limit, n_t, y_ref, par_ref, zb_ref, e_ref):
+def _css_fwd_kernel(p, q, t_limit, cs, hp, *refs):
+    if hp:
+        y_ref, yp_ref, par_ref, zb_ref, e_ref, ce_ref = refs
+    else:  # single time chunk: no cross-chunk lag reads, no neighbor stream
+        y_ref, par_ref, zb_ref, e_ref, ce_ref = refs
+        yp_ref = None
+    c = pl.program_id(1)
+    base = c * cs
     zb = zb_ref[0]
 
-    def body(t, _):
+    @pl.when(c == 0)
+    def _():
+        for j in range(max(q, 1)):
+            ce_ref[j] = _ZERO()
+
+    def body(tl, _):
+        t = base + tl
         pred = par_ref[0]
         for i in range(1, p + 1):
-            yi = y_ref[jnp.maximum(t - i, 0)]
-            pred += par_ref[i] * jnp.where(t - i >= 0, yi, 0.0)
+            far = yp_ref[jnp.clip(cs + tl - i, 0, cs - 1)] if hp else 0.0
+            yv = jnp.where(tl - i >= 0, y_ref[jnp.maximum(tl - i, 0)], far)
+            pred += par_ref[i] * jnp.where(t - i >= 0, yv, 0.0)
         for j in range(1, q + 1):
-            ej = e_ref[jnp.maximum(t - j, 0)]
-            pred += par_ref[p + j] * jnp.where(t - j >= 0, ej, 0.0)
+            ev = jnp.where(
+                tl - j >= 0,
+                e_ref[jnp.maximum(tl - j, 0)],
+                ce_ref[jnp.clip(q + tl - j, 0, max(q - 1, 0))],
+            )
+            pred += par_ref[p + j] * jnp.where(t - j >= 0, ev, 0.0)
         live = (t.astype(jnp.float32) >= zb) & (t < t_limit)
-        e_ref[t] = jnp.where(live, y_ref[t] - pred, 0.0)
+        e_ref[tl] = jnp.where(live, y_ref[tl] - pred, 0.0)
         return 0
 
-    lax.fori_loop(0, n_t, body, 0)
+    lax.fori_loop(0, cs, body, 0)
+    # slot s holds e at global (base + cs) - q + s for the next chunk
+    for j in range(q):
+        ce_ref[j] = e_ref[cs - q + j]
 
 
-def _css_bwd_kernel(p, q, t_limit, n_t,
-                    y_ref, e_ref, par_ref, zb_ref, g_ref, gpar_ref, adj_ref):
-    adj_ref[:] = g_ref[:]
+def _css_bwd_kernel(p, q, t_limit, cs, nchunk, hp, *refs):
+    if hp:
+        (y_ref, yp_ref, e_ref, ep_ref, par_ref, zb_ref, g_ref,
+         gpar_ref, adj_ref, ca_ref) = refs
+    else:
+        (y_ref, e_ref, par_ref, zb_ref, g_ref,
+         gpar_ref, adj_ref, ca_ref) = refs
+        yp_ref = ep_ref = None
+    c = pl.program_id(1)
+    base = (nchunk - 1 - c) * cs
     zb = zb_ref[0]
     k = 1 + p + q
-    zero = jnp.zeros((_SUBL, _LANES), jnp.float32)
+
+    @pl.when(c == 0)
+    def _():
+        for j in range(max(q, 1)):
+            ca_ref[j] = _ZERO()
+        for r in range(k):
+            gpar_ref[r] = _ZERO()
+
+    adj_ref[:] = g_ref[:]
 
     def body(i, accs):
-        t = n_t - 1 - i
+        tl = cs - 1 - i
+        t = base + tl
         live = (t.astype(jnp.float32) >= zb) & (t < t_limit)
-        a = jnp.where(live, adj_ref[t], 0.0)
+        aval = adj_ref[tl]
+        # contributions from a_{t+j} that live in the next-later chunk
         for j in range(1, q + 1):
-            idx = jnp.maximum(t - j, 0)
-            contrib = jnp.where(t - j >= 0, par_ref[p + j] * a, 0.0)
+            aval = aval - jnp.where(
+                tl + j >= cs,
+                par_ref[p + j] * ca_ref[jnp.clip(tl + j - cs, 0, max(q - 1, 0))],
+                0.0,
+            )
+        a = jnp.where(live, aval, 0.0)
+        for j in range(1, q + 1):
+            idx = jnp.maximum(tl - j, 0)
+            contrib = jnp.where(tl - j >= 0, par_ref[p + j] * a, 0.0)
             adj_ref[idx] = adj_ref[idx] - contrib
         new = [accs[0] - a]
         for i_ in range(1, p + 1):
-            yi = jnp.where(t - i_ >= 0, y_ref[jnp.maximum(t - i_, 0)], 0.0)
-            new.append(accs[i_] - yi * a)
+            far = yp_ref[jnp.clip(cs + tl - i_, 0, cs - 1)] if hp else 0.0
+            yv = jnp.where(tl - i_ >= 0, y_ref[jnp.maximum(tl - i_, 0)], far)
+            yv = jnp.where(t - i_ >= 0, yv, 0.0)
+            new.append(accs[i_] - yv * a)
         for j in range(1, q + 1):
-            ej = jnp.where(t - j >= 0, e_ref[jnp.maximum(t - j, 0)], 0.0)
-            new.append(accs[p + j] - ej * a)
+            far = ep_ref[jnp.clip(cs + tl - j, 0, cs - 1)] if hp else 0.0
+            ev = jnp.where(tl - j >= 0, e_ref[jnp.maximum(tl - j, 0)], far)
+            ev = jnp.where(t - j >= 0, ev, 0.0)
+            new.append(accs[p + j] - ev * a)
+        # stash a for the chunk below: writes hit tl < q, reads need
+        # tl >= cs - q; disjoint because cs >= 2q
+        cur = ca_ref[jnp.clip(tl, 0, max(q - 1, 0))]
+        ca_ref[jnp.clip(tl, 0, max(q - 1, 0))] = jnp.where(tl < q, a, cur)
         return tuple(new)
 
-    accs = lax.fori_loop(0, n_t, body, tuple(zero for _ in range(k)))
+    accs = lax.fori_loop(0, cs, body, tuple(_ZERO() for _ in range(k)))
     for r in range(k):
-        gpar_ref[r] = accs[r]
+        gpar_ref[r] = gpar_ref[r] + accs[r]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
@@ -176,20 +281,23 @@ def _css_errors_fwd(p, q, interpret, params, yd, zb):
     b, t = yd.shape
     k = 1 + p + q
     assert params.shape == (b, k), (params.shape, (b, k))
-    tp = t + _pad_to(t, _SUBL)
+    tp, cs, nchunk = _time_layout(t)
     y3 = _fold(jnp.pad(yd, ((0, 0), (0, tp - t))))
     par3 = _fold(params)
     zb3 = _fold(zb.astype(yd.dtype)[:, None])
     nblk = y3.shape[1] // _SUBL
+    hp = nchunk > 1
     e3 = pl.pallas_call(
-        functools.partial(_css_fwd_kernel, p, q, t, tp),
-        grid=(nblk,),
-        in_specs=[_blockspec(tp), _blockspec(k), _blockspec(1)],
-        out_specs=_blockspec(tp),
+        functools.partial(_css_fwd_kernel, p, q, t, cs, hp),
+        grid=(nblk, nchunk),
+        in_specs=([_bs(cs, _cur)] + ([_bs(cs, _prev)] if hp else [])
+                  + [_bs(k, _fixed), _bs(1, _fixed)]),
+        out_specs=_bs(cs, _cur),
         out_shape=jax.ShapeDtypeStruct(y3.shape, yd.dtype),
+        scratch_shapes=[pltpu.VMEM((max(q, 1), _SUBL, _LANES), jnp.float32)],
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
-    )(y3, par3, zb3)
+    )(*((y3, y3) if hp else (y3,)), par3, zb3)
     return _unfold(e3, b)[:, :t], (y3, par3, zb3, e3)
 
 
@@ -198,18 +306,32 @@ def _css_errors_bwd(p, q, interpret, res, g):
     tp = y3.shape[0]
     b, t = g.shape
     k = 1 + p + q
+    _, cs, nchunk = _time_layout(t)
     g3 = _fold(jnp.pad(g, ((0, 0), (0, tp - t))))
     nblk = y3.shape[1] // _SUBL
+    hp = nchunk > 1
+    if hp:
+        ins = [_bs(cs, _rev(nchunk)), _bs(cs, _rev_prev(nchunk)),
+               _bs(cs, _rev(nchunk)), _bs(cs, _rev_prev(nchunk)),
+               _bs(k, _fixed), _bs(1, _fixed), _bs(cs, _rev(nchunk))]
+        args = (y3, y3, e3, e3, par3, zb3, g3)
+    else:
+        ins = [_bs(cs, _rev(nchunk)), _bs(cs, _rev(nchunk)),
+               _bs(k, _fixed), _bs(1, _fixed), _bs(cs, _rev(nchunk))]
+        args = (y3, e3, par3, zb3, g3)
     gpar3 = pl.pallas_call(
-        functools.partial(_css_bwd_kernel, p, q, t, tp),
-        grid=(nblk,),
-        in_specs=[_blockspec(tp)] * 2 + [_blockspec(k), _blockspec(1), _blockspec(tp)],
-        out_specs=_blockspec(k),
+        functools.partial(_css_bwd_kernel, p, q, t, cs, nchunk, hp),
+        grid=(nblk, nchunk),
+        in_specs=ins,
+        out_specs=_bs(k, _fixed),
         out_shape=jax.ShapeDtypeStruct(par3.shape, g.dtype),
-        scratch_shapes=[pltpu.VMEM((tp, _SUBL, _LANES), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((cs, _SUBL, _LANES), jnp.float32),
+            pltpu.VMEM((max(q, 1), _SUBL, _LANES), jnp.float32),
+        ],
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
-    )(y3, e3, par3, zb3, g3)
+    )(*args)
     gparams = _unfold(gpar3, b)
     # observations and the mask boundary are constants of the fit objective
     return gparams, jnp.zeros((b, t), g.dtype), jnp.zeros((b,), g.dtype)
@@ -218,6 +340,7 @@ def _css_errors_bwd(p, q, interpret, res, g):
 css_errors.defvjp(_css_errors_fwd, _css_errors_bwd)
 
 
+@_scoped("pallas.css_neg_loglik")
 def css_neg_loglik(params, yd, order: Order, include_intercept: bool,
                    n_valid=None, *, interpret: bool = False):
     """Batched CSS negative log-likelihood ``[B]`` on the fused kernel.
@@ -264,69 +387,100 @@ def css_neg_loglik(params, yd, order: Order, include_intercept: bool,
 # gradients; ``zb`` is a constant of the objective.
 
 
-def _garch_fwd_kernel(t_limit, n_t, r2_ref, par_ref, h0_ref, zb_ref, h_ref):
+def _garch_fwd_kernel(t_limit, cs, hp, *refs):
+    if hp:
+        r2_ref, r2p_ref, par_ref, h0_ref, zb_ref, h_ref, ch_ref = refs
+    else:
+        r2_ref, par_ref, h0_ref, zb_ref, h_ref, ch_ref = refs
+        r2p_ref = None
+    c = pl.program_id(1)
+    base = c * cs
     zb = zb_ref[0]
     h0 = h0_ref[0]
 
-    def body(t, _):
+    @pl.when(c == 0)
+    def _():
+        ch_ref[0] = h0
+
+    def body(tl, _):
+        t = base + tl
         tf = t.astype(jnp.float32)
-        hp = h_ref[jnp.maximum(t - 1, 0)]
-        hp = jnp.where(t - 1 >= 0, hp, h0)
-        r2p = jnp.where(t - 1 >= 0, r2_ref[jnp.maximum(t - 1, 0)], 0.0)
+        hprev = jnp.where(tl - 1 >= 0, h_ref[jnp.maximum(tl - 1, 0)], ch_ref[0])
+        far = r2p_ref[cs - 1] if hp else 0.0
+        r2p = jnp.where(tl - 1 >= 0, r2_ref[jnp.maximum(tl - 1, 0)], far)
+        r2p = jnp.where(t - 1 >= 0, r2p, 0.0)
         # the first live step seeds with h0 standing in for r_{start-1}^2
         # (matching models.garch.variances)
         r2p = jnp.where(tf == zb, h0, r2p)
-        h = par_ref[0] + par_ref[1] * r2p + par_ref[2] * hp
+        h = par_ref[0] + par_ref[1] * r2p + par_ref[2] * hprev
         live = (tf >= zb) & (t < t_limit)
-        h_ref[t] = jnp.where(live, h, h0)
+        h_ref[tl] = jnp.where(live, h, h0)
         return 0
 
-    lax.fori_loop(0, n_t, body, 0)
+    lax.fori_loop(0, cs, body, 0)
+    ch_ref[0] = h_ref[cs - 1]
 
 
-def _garch_bwd_kernel(t_limit, n_t, r2_ref, par_ref, h0_ref, zb_ref, h_ref,
-                      g_ref, gpar_ref, gr2_ref, gh0_ref):
+def _garch_bwd_kernel(t_limit, cs, nchunk, hpv, *refs):
+    if hpv:
+        (r2_ref, r2p_ref, par_ref, h0_ref, zb_ref, h_ref, hp_ref,
+         g_ref, gpar_ref, gr2_ref, gh0_ref, cl_ref) = refs
+    else:
+        (r2_ref, par_ref, h0_ref, zb_ref, h_ref,
+         g_ref, gpar_ref, gr2_ref, gh0_ref, cl_ref) = refs
+        r2p_ref = hp_ref = None
+    c = pl.program_id(1)
+    base = (nchunk - 1 - c) * cs
     zb = zb_ref[0]
     h0 = h0_ref[0]
     alpha = par_ref[1]
     beta = par_ref[2]
-    zero = jnp.zeros((_SUBL, _LANES), jnp.float32)
+
+    @pl.when(c == 0)
+    def _():
+        cl_ref[0] = _ZERO()
+        for r in range(3):
+            gpar_ref[r] = _ZERO()
+        gh0_ref[0] = _ZERO()
 
     def body(i, carry):
         lam_next, dw, da, db, dh0 = carry
-        t = n_t - 1 - i
+        tl = cs - 1 - i
+        t = base + tl
         tf = t.astype(jnp.float32)
         live = (tf >= zb) & (t < t_limit)
-        lam = g_ref[t] + beta * lam_next
+        # r2_t feeds h_{t+1} unless t+1 is the seed (which uses h0 instead)
+        next_live = (tf + 1.0 > zb) & (t + 1 < t_limit)
+        gr2_ref[tl] = jnp.where(next_live, alpha * lam_next, 0.0)
+        lam = g_ref[tl] + beta * lam_next
         lam = jnp.where(live, lam, 0.0)
         # dead positions emit h0 directly
-        dh0 = dh0 + jnp.where(live, 0.0, g_ref[t])
+        dh0 = dh0 + jnp.where(live, 0.0, g_ref[tl])
         seed = tf == zb
-        hp = jnp.where(t - 1 >= 0, h_ref[jnp.maximum(t - 1, 0)], h0)
-        r2p = jnp.where(t - 1 >= 0, r2_ref[jnp.maximum(t - 1, 0)], 0.0)
+        hfar = hp_ref[cs - 1] if hpv else 0.0
+        hprev = jnp.where(tl - 1 >= 0, h_ref[jnp.maximum(tl - 1, 0)], hfar)
+        hprev = jnp.where(t - 1 >= 0, hprev, h0)
+        rfar = r2p_ref[cs - 1] if hpv else 0.0
+        r2p = jnp.where(tl - 1 >= 0, r2_ref[jnp.maximum(tl - 1, 0)], rfar)
+        r2p = jnp.where(t - 1 >= 0, r2p, 0.0)
         r2p_eff = jnp.where(seed, h0, r2p)
         dw = dw + lam
         da = da + lam * r2p_eff
-        db = db + lam * hp
+        db = db + lam * hprev
         # h0 enters the seed step through BOTH recursion inputs
         hp_is_h0 = tf - 1.0 < zb
         dh0 = dh0 + jnp.where(live & seed, alpha * lam, 0.0)
         dh0 = dh0 + jnp.where(live & hp_is_h0, beta * lam, 0.0)
-        # r2_{t-1} feeds h_t except at the seed (which uses h0 instead)
-        cur = gr2_ref[jnp.maximum(t - 1, 0)]
-        val = jnp.where(live & ~seed, alpha * lam, 0.0)
-        gr2_ref[jnp.maximum(t - 1, 0)] = jnp.where(t - 1 >= 0, val, cur)
         return lam, dw, da, db, dh0
 
-    # slot T-1 of gr2 is never the (t-1) of any step; clear it up front
-    gr2_ref[n_t - 1] = zero
-    _, dw, da, db, dh0 = lax.fori_loop(
-        0, n_t, body, (zero, zero, zero, zero, zero)
+    lam, dw, da, db, dh0 = lax.fori_loop(
+        0, cs, body, (cl_ref[0], _ZERO(), _ZERO(), _ZERO(), _ZERO())
     )
-    gpar_ref[0] = dw
-    gpar_ref[1] = da
-    gpar_ref[2] = db
-    gh0_ref[0] = dh0
+    cl_ref[0] = lam
+    gpar_ref[0] = gpar_ref[0] + dw
+    gpar_ref[1] = gpar_ref[1] + da
+    gpar_ref[2] = gpar_ref[2] + db
+    gh0_ref[0] = gh0_ref[0] + dh0
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
@@ -337,43 +491,58 @@ def _garch_h(interpret: bool, params, r2, h0, zb):
 
 def _garch_h_fwd(interpret, params, r2, h0, zb):
     b, t = r2.shape
-    tp = t + _pad_to(t, _SUBL)
+    tp, cs, nchunk = _time_layout(t)
     r23 = _fold(jnp.pad(r2, ((0, 0), (0, tp - t))))
     par3 = _fold(params)
     h03 = _fold(h0[:, None].astype(r2.dtype))
     zb3 = _fold(zb.astype(r2.dtype)[:, None])
     nblk = r23.shape[1] // _SUBL
+    hp = nchunk > 1
     h3 = pl.pallas_call(
-        functools.partial(_garch_fwd_kernel, t, tp),
-        grid=(nblk,),
-        in_specs=[_blockspec(tp), _blockspec(3), _blockspec(1), _blockspec(1)],
-        out_specs=_blockspec(tp),
+        functools.partial(_garch_fwd_kernel, t, cs, hp),
+        grid=(nblk, nchunk),
+        in_specs=([_bs(cs, _cur)] + ([_bs(cs, _prev)] if hp else [])
+                  + [_bs(3, _fixed), _bs(1, _fixed), _bs(1, _fixed)]),
+        out_specs=_bs(cs, _cur),
         out_shape=jax.ShapeDtypeStruct(r23.shape, r2.dtype),
+        scratch_shapes=[pltpu.VMEM((1, _SUBL, _LANES), jnp.float32)],
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
-    )(r23, par3, h03, zb3)
+    )(*((r23, r23) if hp else (r23,)), par3, h03, zb3)
     return _unfold(h3, b)[:, :t], (r23, par3, h03, zb3, h3, b, t)
 
 
 def _garch_h_bwd(interpret, res, g):
     r23, par3, h03, zb3, h3, b, t = res
     tp = r23.shape[0]
+    _, cs, nchunk = _time_layout(t)
     g3 = _fold(jnp.pad(g, ((0, 0), (0, tp - t))))
     nblk = r23.shape[1] // _SUBL
+    hp = nchunk > 1
+    if hp:
+        ins = [_bs(cs, _rev(nchunk)), _bs(cs, _rev_prev(nchunk)),
+               _bs(3, _fixed), _bs(1, _fixed), _bs(1, _fixed),
+               _bs(cs, _rev(nchunk)), _bs(cs, _rev_prev(nchunk)),
+               _bs(cs, _rev(nchunk))]
+        args = (r23, r23, par3, h03, zb3, h3, h3, g3)
+    else:
+        ins = [_bs(cs, _rev(nchunk)), _bs(3, _fixed), _bs(1, _fixed),
+               _bs(1, _fixed), _bs(cs, _rev(nchunk)), _bs(cs, _rev(nchunk))]
+        args = (r23, par3, h03, zb3, h3, g3)
     gpar3, gr23, gh03 = pl.pallas_call(
-        functools.partial(_garch_bwd_kernel, t, tp),
-        grid=(nblk,),
-        in_specs=[_blockspec(tp), _blockspec(3), _blockspec(1), _blockspec(1),
-                  _blockspec(tp), _blockspec(tp)],
-        out_specs=[_blockspec(3), _blockspec(tp), _blockspec(1)],
+        functools.partial(_garch_bwd_kernel, t, cs, nchunk, hp),
+        grid=(nblk, nchunk),
+        in_specs=ins,
+        out_specs=[_bs(3, _fixed), _bs(cs, _rev(nchunk)), _bs(1, _fixed)],
         out_shape=[
             jax.ShapeDtypeStruct(par3.shape, g.dtype),
             jax.ShapeDtypeStruct(r23.shape, g.dtype),
             jax.ShapeDtypeStruct(h03.shape, g.dtype),
         ],
+        scratch_shapes=[pltpu.VMEM((1, _SUBL, _LANES), jnp.float32)],
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
-    )(r23, par3, h03, zb3, h3, g3)
+    )(*args)
     return (
         _unfold(gpar3, b),
         _unfold(gr23, b)[:, :t],
@@ -396,6 +565,7 @@ def garch_variances(params, r, h0, zb, *, interpret: bool = False):
     return _garch_h(interpret, params, r * r, h0, zb)
 
 
+@_scoped("pallas.garch_neg_loglik")
 def garch_neg_loglik(params, r, n_valid=None, *, interpret: bool = False):
     """Batched GARCH(1,1) Gaussian negative log-likelihood ``[B]``.
 
@@ -435,42 +605,65 @@ def garch_neg_loglik(params, r, n_valid=None, *, interpret: bool = False):
 #   dL/dalpha = sum_{t > zb} lam_t * (x_t - s_{t-1})
 
 
-def _ewma_fwd_kernel(t_limit, n_t, x_ref, a_ref, zb_ref, s_ref):
+def _ewma_fwd_kernel(t_limit, cs, x_ref, a_ref, zb_ref, s_ref, cs_ref):
+    c = pl.program_id(1)
+    base = c * cs
     zb = zb_ref[0]
     a = a_ref[0]
 
-    def body(t, _):
+    @pl.when(c == 0)
+    def _():
+        cs_ref[0] = _ZERO()
+
+    def body(tl, _):
+        t = base + tl
         tf = t.astype(jnp.float32)
-        sp = jnp.where(t - 1 >= 0, s_ref[jnp.maximum(t - 1, 0)], 0.0)
-        s = a * x_ref[t] + (1.0 - a) * sp
-        s = jnp.where(tf == zb, x_ref[t], s)
+        sp = jnp.where(tl - 1 >= 0, s_ref[jnp.maximum(tl - 1, 0)], cs_ref[0])
+        s = a * x_ref[tl] + (1.0 - a) * sp
+        s = jnp.where(tf == zb, x_ref[tl], s)
         live = (tf >= zb) & (t < t_limit)
-        s_ref[t] = jnp.where(live, s, 0.0)
+        s_ref[tl] = jnp.where(live, s, 0.0)
         return 0
 
-    lax.fori_loop(0, n_t, body, 0)
+    lax.fori_loop(0, cs, body, 0)
+    cs_ref[0] = s_ref[cs - 1]
 
 
-def _ewma_bwd_kernel(t_limit, n_t, x_ref, a_ref, zb_ref, s_ref, g_ref, ga_ref):
+def _ewma_bwd_kernel(t_limit, cs, nchunk, hp, *refs):
+    if hp:
+        x_ref, a_ref, zb_ref, s_ref, sp_ref, g_ref, ga_ref, cl_ref = refs
+    else:
+        x_ref, a_ref, zb_ref, s_ref, g_ref, ga_ref, cl_ref = refs
+        sp_ref = None
+    c = pl.program_id(1)
+    base = (nchunk - 1 - c) * cs
     zb = zb_ref[0]
     a = a_ref[0]
-    zero = jnp.zeros((_SUBL, _LANES), jnp.float32)
+
+    @pl.when(c == 0)
+    def _():
+        cl_ref[0] = _ZERO()
+        ga_ref[0] = _ZERO()
 
     def body(i, carry):
         lam_next, da = carry
-        t = n_t - 1 - i
+        tl = cs - 1 - i
+        t = base + tl
         tf = t.astype(jnp.float32)
         live = (tf >= zb) & (t < t_limit)
-        lam = g_ref[t] + (1.0 - a) * lam_next
+        lam = g_ref[tl] + (1.0 - a) * lam_next
         lam = jnp.where(live, lam, 0.0)
-        sp = jnp.where(t - 1 >= 0, s_ref[jnp.maximum(t - 1, 0)], 0.0)
-        da = da + jnp.where(live & (tf > zb), lam * (x_ref[t] - sp), 0.0)
+        far = sp_ref[cs - 1] if hp else 0.0
+        sp = jnp.where(tl - 1 >= 0, s_ref[jnp.maximum(tl - 1, 0)], far)
+        sp = jnp.where(t - 1 >= 0, sp, 0.0)
+        da = da + jnp.where(live & (tf > zb), lam * (x_ref[tl] - sp), 0.0)
         # the seed step s_zb = x_zb does not read s_{zb-1}
         lam_out = jnp.where(tf > zb, lam, 0.0)
         return lam_out, da
 
-    _, da = lax.fori_loop(0, n_t, body, (zero, zero))
-    ga_ref[0] = da
+    lam, da = lax.fori_loop(0, cs, body, (cl_ref[0], _ZERO()))
+    cl_ref[0] = lam
+    ga_ref[0] = ga_ref[0] + da
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
@@ -481,17 +674,18 @@ def _ewma_s(interpret: bool, alpha, x, zb):
 
 def _ewma_s_fwd(interpret, alpha, x, zb):
     b, t = x.shape
-    tp = t + _pad_to(t, _SUBL)
+    tp, cs, nchunk = _time_layout(t)
     x3 = _fold(jnp.pad(x, ((0, 0), (0, tp - t))))
     a3 = _fold(alpha[:, None].astype(x.dtype))
     zb3 = _fold(zb.astype(x.dtype)[:, None])
     nblk = x3.shape[1] // _SUBL
     s3 = pl.pallas_call(
-        functools.partial(_ewma_fwd_kernel, t, tp),
-        grid=(nblk,),
-        in_specs=[_blockspec(tp), _blockspec(1), _blockspec(1)],
-        out_specs=_blockspec(tp),
+        functools.partial(_ewma_fwd_kernel, t, cs),
+        grid=(nblk, nchunk),
+        in_specs=[_bs(cs, _cur), _bs(1, _fixed), _bs(1, _fixed)],
+        out_specs=_bs(cs, _cur),
         out_shape=jax.ShapeDtypeStruct(x3.shape, x.dtype),
+        scratch_shapes=[pltpu.VMEM((1, _SUBL, _LANES), jnp.float32)],
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
     )(x3, a3, zb3)
@@ -501,18 +695,29 @@ def _ewma_s_fwd(interpret, alpha, x, zb):
 def _ewma_s_bwd(interpret, res, g):
     x3, a3, zb3, s3, b, t = res
     tp = x3.shape[0]
+    _, cs, nchunk = _time_layout(t)
     g3 = _fold(jnp.pad(g, ((0, 0), (0, tp - t))))
     nblk = x3.shape[1] // _SUBL
+    hp = nchunk > 1
+    if hp:
+        ins = [_bs(cs, _rev(nchunk)), _bs(1, _fixed), _bs(1, _fixed),
+               _bs(cs, _rev(nchunk)), _bs(cs, _rev_prev(nchunk)),
+               _bs(cs, _rev(nchunk))]
+        args = (x3, a3, zb3, s3, s3, g3)
+    else:
+        ins = [_bs(cs, _rev(nchunk)), _bs(1, _fixed), _bs(1, _fixed),
+               _bs(cs, _rev(nchunk)), _bs(cs, _rev(nchunk))]
+        args = (x3, a3, zb3, s3, g3)
     ga3 = pl.pallas_call(
-        functools.partial(_ewma_bwd_kernel, t, tp),
-        grid=(nblk,),
-        in_specs=[_blockspec(tp), _blockspec(1), _blockspec(1),
-                  _blockspec(tp), _blockspec(tp)],
-        out_specs=_blockspec(1),
+        functools.partial(_ewma_bwd_kernel, t, cs, nchunk, hp),
+        grid=(nblk, nchunk),
+        in_specs=ins,
+        out_specs=_bs(1, _fixed),
         out_shape=jax.ShapeDtypeStruct(a3.shape, g.dtype),
+        scratch_shapes=[pltpu.VMEM((1, _SUBL, _LANES), jnp.float32)],
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
-    )(x3, a3, zb3, s3, g3)
+    )(*args)
     return (
         _unfold(ga3, b)[:, 0],
         jnp.zeros((b, t), g.dtype),
@@ -532,6 +737,7 @@ def ewma_smooth(alpha, x, zb, *, interpret: bool = False):
     return _ewma_s(interpret, alpha, x, zb)
 
 
+@_scoped("pallas.ewma_sse")
 def ewma_sse(alpha, x, n_valid=None, *, interpret: bool = False):
     """Batched one-step-ahead EWMA SSE ``[B]`` (matches ``models.ewma.sse``)."""
     b, n = x.shape
@@ -560,11 +766,11 @@ def ewma_sse(alpha, x, n_valid=None, *, interpret: bool = False):
 #   T_t    = b (L_t - L_{t-1}) + (1-b) T_{t-1}
 #   ring[t mod m] = g (y_t - L_t) + (1-g) S_t
 #   e_t    = [t >= m] * (y_t - pred_t)
-# The seasonal ring lives in a [m, 8, 128] VMEM scratch.  Seeds (L_0, T_0,
-# ring init) are computed OUTSIDE the kernel from the first two seasons —
-# they depend on the data only, so the adjoint propagates to the three
-# smoothing parameters alone.  Reverse pass replays saved (L, T, S_old)
-# trajectories with a ring of seasonal adjoints:
+# The seasonal ring lives in a [m, 8, 128] VMEM scratch and simply persists
+# across time chunks.  Seeds (L_0, T_0, ring init) are computed OUTSIDE the
+# kernel from the first two seasons — they depend on the data only, so the
+# adjoint propagates to the three smoothing parameters alone.  Reverse pass
+# replays saved (L, T, S_old) trajectories with a ring of seasonal adjoints:
 #   vL        = uL + b uT - g uS
 #   da       += (y_t - S_t - L_{t-1} - T_{t-1}) vL
 #   db       += (L_t - L_{t-1} - T_{t-1}) uT
@@ -572,56 +778,87 @@ def ewma_sse(alpha, x, n_valid=None, *, interpret: bool = False):
 #   uL'       = -b uT + (1-a) vL + gp
 #   uT'       = (1-b) uT + (1-a) vL + gp
 #   rho[slot] = (1-g) uS - a vL + gp          with gp = -[t >= m] gbar_t
+# Level/trend carries cross chunks through 1-slot scratches; both rings
+# (seasonal state forward, seasonal adjoint backward) persist untouched.
 
 
-def _hw_fwd_kernel(m, t_limit, n_t, y_ref, par_ref, l0_ref, t0_ref, s0_ref,
-                   e_ref, lv_ref, tr_ref, so_ref, seas_ref):
-    for j in range(m):
-        seas_ref[j] = s0_ref[j]
+def _hw_fwd_kernel(m, t_limit, cs, y_ref, par_ref, l0_ref, t0_ref, s0_ref,
+                   e_ref, lv_ref, tr_ref, so_ref, seas_ref, clt_ref):
+    c = pl.program_id(1)
+    base = c * cs
     a = par_ref[0]
     b = par_ref[1]
     g = par_ref[2]
 
-    def body(t, carry):
+    @pl.when(c == 0)
+    def _():
+        for j in range(m):
+            seas_ref[j] = s0_ref[j]
+        clt_ref[0] = l0_ref[0]
+        clt_ref[1] = t0_ref[0]
+
+    def body(tl, carry):
         level, trend = carry
-        slot = lax.rem(t, m)
+        t = base + tl
+        slot = lax.rem(t, jnp.asarray(m, t.dtype))
         s = seas_ref[slot]
         pred = level + trend + s
-        e_ref[t] = jnp.where((t >= m) & (t < t_limit), y_ref[t] - pred, 0.0)
-        so_ref[t] = s
-        yt = y_ref[t]
+        e_ref[tl] = jnp.where((t >= m) & (t < t_limit), y_ref[tl] - pred, 0.0)
+        so_ref[tl] = s
+        yt = y_ref[tl]
         nl = a * (yt - s) + (1.0 - a) * (level + trend)
         nt = b * (nl - level) + (1.0 - b) * trend
         seas_ref[slot] = g * (yt - nl) + (1.0 - g) * s
-        lv_ref[t] = nl
-        tr_ref[t] = nt
+        lv_ref[tl] = nl
+        tr_ref[tl] = nt
         return nl, nt
 
-    lax.fori_loop(0, n_t, body, (l0_ref[0], t0_ref[0]))
+    level, trend = lax.fori_loop(0, cs, body, (clt_ref[0], clt_ref[1]))
+    clt_ref[0] = level
+    clt_ref[1] = trend
 
 
-def _hw_bwd_kernel(m, t_limit, n_t, y_ref, par_ref, l0_ref, t0_ref,
-                   lv_ref, tr_ref, so_ref, g_ref, gpar_ref, rho_ref):
-    zero = jnp.zeros((_SUBL, _LANES), jnp.float32)
-    for j in range(m):
-        rho_ref[j] = zero
+def _hw_bwd_kernel(m, t_limit, cs, nchunk, hp, *refs):
+    if hp:
+        (y_ref, par_ref, l0_ref, t0_ref, lv_ref, lvp_ref, tr_ref, trp_ref,
+         so_ref, g_ref, gpar_ref, rho_ref, clam_ref) = refs
+    else:
+        (y_ref, par_ref, l0_ref, t0_ref, lv_ref, tr_ref,
+         so_ref, g_ref, gpar_ref, rho_ref, clam_ref) = refs
+        lvp_ref = trp_ref = None
+    c = pl.program_id(1)
+    base = (nchunk - 1 - c) * cs
     a = par_ref[0]
     b = par_ref[1]
     g = par_ref[2]
 
+    @pl.when(c == 0)
+    def _():
+        for j in range(m):
+            rho_ref[j] = _ZERO()
+        clam_ref[0] = _ZERO()
+        clam_ref[1] = _ZERO()
+        for r in range(3):
+            gpar_ref[r] = _ZERO()
+
     def body(i, carry):
         lamL, lamT, da, db, dg = carry
-        t = n_t - 1 - i
-        slot = lax.rem(t, m)
+        tl = cs - 1 - i
+        t = base + tl
+        slot = lax.rem(t, jnp.asarray(m, t.dtype))
         uS = rho_ref[slot]
         uL = lamL
         uT = lamT
-        gp = jnp.where((t >= m) & (t < t_limit), -g_ref[t], 0.0)
-        lp = jnp.where(t - 1 >= 0, lv_ref[jnp.maximum(t - 1, 0)], l0_ref[0])
-        tp_ = jnp.where(t - 1 >= 0, tr_ref[jnp.maximum(t - 1, 0)], t0_ref[0])
-        so = so_ref[t]
-        lt = lv_ref[t]
-        yt = y_ref[t]
+        gp = jnp.where((t >= m) & (t < t_limit), -g_ref[tl], 0.0)
+        lfar = lvp_ref[cs - 1] if hp else 0.0
+        lp = jnp.where(tl - 1 >= 0, lv_ref[jnp.maximum(tl - 1, 0)], lfar)
+        lp = jnp.where(t - 1 >= 0, lp, l0_ref[0])
+        tfar = trp_ref[cs - 1] if hp else 0.0
+        tp_ = jnp.where(tl - 1 >= 0, tr_ref[jnp.maximum(tl - 1, 0)], tfar)
+        tp_ = jnp.where(t - 1 >= 0, tp_, t0_ref[0])
+        so = so_ref[tl]
+        lt = lv_ref[tl]
+        yt = y_ref[tl]
         vL = uL + b * uT - g * uS
         da = da + (yt - so - lp - tp_) * vL
         db = db + (lt - lp - tp_) * uT
@@ -631,10 +868,14 @@ def _hw_bwd_kernel(m, t_limit, n_t, y_ref, par_ref, l0_ref, t0_ref,
         rho_ref[slot] = (1.0 - g) * uS - a * vL + gp
         return new_lamL, new_lamT, da, db, dg
 
-    _, _, da, db, dg = lax.fori_loop(0, n_t, body, (zero, zero, zero, zero, zero))
-    gpar_ref[0] = da
-    gpar_ref[1] = db
-    gpar_ref[2] = dg
+    lamL, lamT, da, db, dg = lax.fori_loop(
+        0, cs, body, (clam_ref[0], clam_ref[1], _ZERO(), _ZERO(), _ZERO())
+    )
+    clam_ref[0] = lamL
+    clam_ref[1] = lamT
+    gpar_ref[0] = gpar_ref[0] + da
+    gpar_ref[1] = gpar_ref[1] + db
+    gpar_ref[2] = gpar_ref[2] + dg
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
@@ -645,7 +886,7 @@ def _hw_e(interpret: bool, m: int, params, y, l0, t0, s0):
 
 def _hw_e_fwd(interpret, m, params, y, l0, t0, s0):
     b, t = y.shape
-    tp = t + _pad_to(t, _SUBL)
+    tp, cs, nchunk = _time_layout(t)
     y3 = _fold(jnp.pad(y, ((0, 0), (0, tp - t))))
     par3 = _fold(params)
     l03 = _fold(l0[:, None].astype(y.dtype))
@@ -653,13 +894,16 @@ def _hw_e_fwd(interpret, m, params, y, l0, t0, s0):
     s03 = _fold(s0)
     nblk = y3.shape[1] // _SUBL
     e3, lv3, tr3, so3 = pl.pallas_call(
-        functools.partial(_hw_fwd_kernel, m, t, tp),
-        grid=(nblk,),
-        in_specs=[_blockspec(tp), _blockspec(3), _blockspec(1), _blockspec(1),
-                  _blockspec(m)],
-        out_specs=[_blockspec(tp)] * 4,
+        functools.partial(_hw_fwd_kernel, m, t, cs),
+        grid=(nblk, nchunk),
+        in_specs=[_bs(cs, _cur), _bs(3, _fixed), _bs(1, _fixed),
+                  _bs(1, _fixed), _bs(m, _fixed)],
+        out_specs=[_bs(cs, _cur)] * 4,
         out_shape=[jax.ShapeDtypeStruct(y3.shape, y.dtype)] * 4,
-        scratch_shapes=[pltpu.VMEM((m, _SUBL, _LANES), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((m, _SUBL, _LANES), jnp.float32),
+            pltpu.VMEM((2, _SUBL, _LANES), jnp.float32),
+        ],
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
     )(y3, par3, l03, t03, s03)
@@ -669,19 +913,35 @@ def _hw_e_fwd(interpret, m, params, y, l0, t0, s0):
 def _hw_e_bwd(interpret, m, res, g):
     y3, par3, l03, t03, lv3, tr3, so3, b, t = res
     tp = y3.shape[0]
+    _, cs, nchunk = _time_layout(t)
     g3 = _fold(jnp.pad(g, ((0, 0), (0, tp - t))))
     nblk = y3.shape[1] // _SUBL
+    hp = nchunk > 1
+    if hp:
+        ins = [_bs(cs, _rev(nchunk)), _bs(3, _fixed), _bs(1, _fixed),
+               _bs(1, _fixed),
+               _bs(cs, _rev(nchunk)), _bs(cs, _rev_prev(nchunk)),
+               _bs(cs, _rev(nchunk)), _bs(cs, _rev_prev(nchunk)),
+               _bs(cs, _rev(nchunk)), _bs(cs, _rev(nchunk))]
+        args = (y3, par3, l03, t03, lv3, lv3, tr3, tr3, so3, g3)
+    else:
+        ins = [_bs(cs, _rev(nchunk)), _bs(3, _fixed), _bs(1, _fixed),
+               _bs(1, _fixed), _bs(cs, _rev(nchunk)), _bs(cs, _rev(nchunk)),
+               _bs(cs, _rev(nchunk)), _bs(cs, _rev(nchunk))]
+        args = (y3, par3, l03, t03, lv3, tr3, so3, g3)
     gpar3 = pl.pallas_call(
-        functools.partial(_hw_bwd_kernel, m, t, tp),
-        grid=(nblk,),
-        in_specs=[_blockspec(tp), _blockspec(3), _blockspec(1), _blockspec(1),
-                  _blockspec(tp), _blockspec(tp), _blockspec(tp), _blockspec(tp)],
-        out_specs=_blockspec(3),
+        functools.partial(_hw_bwd_kernel, m, t, cs, nchunk, hp),
+        grid=(nblk, nchunk),
+        in_specs=ins,
+        out_specs=_bs(3, _fixed),
         out_shape=jax.ShapeDtypeStruct(par3.shape, g.dtype),
-        scratch_shapes=[pltpu.VMEM((m, _SUBL, _LANES), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((m, _SUBL, _LANES), jnp.float32),
+            pltpu.VMEM((2, _SUBL, _LANES), jnp.float32),
+        ],
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
-    )(y3, par3, l03, t03, lv3, tr3, so3, g3)
+    )(*args)
     return (
         _unfold(gpar3, b),
         jnp.zeros((b, t), g.dtype),
@@ -694,6 +954,7 @@ def _hw_e_bwd(interpret, m, res, g):
 _hw_e.defvjp(_hw_e_fwd, _hw_e_bwd)
 
 
+@_scoped("pallas.hw_additive_sse")
 def hw_additive_sse(params, y, period: int, *, interpret: bool = False):
     """Batched Holt-Winters additive one-step-ahead SSE ``[B]`` on a fused
     kernel (dense panels only — matches ``models.holtwinters.sse`` with a

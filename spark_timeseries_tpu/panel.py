@@ -142,7 +142,11 @@ def _cached_batched(fn: Callable, *args) -> Callable:
         hit = _BATCH_CACHE.get(key)
         if hit is not None:
             return hit
-    compiled = jax.jit(jax.vmap(lambda v: fn(v, *args)))
+    def _scoped_fn(v):
+        with jax.named_scope("panel.map_series"):
+            return fn(v, *args)
+
+    compiled = jax.jit(jax.vmap(_scoped_fn))
     if key is None:
         return compiled
 
@@ -579,12 +583,14 @@ class TimeSeriesPanel:
                 "(missing index metadata)"
             )
         index = dtix.from_string(enc.decode())
-        col = table.column("values").combine_chunks()
-        if isinstance(col, pa.ChunkedArray):  # zero-chunk tables stay chunked
-            col = col.chunk(0) if col.num_chunks else pa.array([], pa.list_(pa.float32(), 0))
+        vtype = table.schema.field("values").type
+        t = vtype.list_size
         n = len(table)
-        t = col.type.list_size
-        vals = np.asarray(col.flatten()).reshape(n, t)
+        if n:
+            col = table.column("values").combine_chunks()
+            vals = np.asarray(col.flatten()).reshape(n, t)
+        else:
+            vals = np.empty((0, t), np.dtype(vtype.value_type.to_pandas_dtype()))
         keys = table.column("key").to_pylist()
         return TimeSeriesPanel(index, keys, vals, mesh=mesh)
 

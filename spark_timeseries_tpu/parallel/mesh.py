@@ -94,3 +94,82 @@ def shard_series(values: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
 @functools.lru_cache(maxsize=None)
 def single_device_mesh() -> Mesh:
     return Mesh(np.asarray(jax.devices()[:1]), (SERIES_AXIS,))
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Initialize the multi-host process group and return the global mesh.
+
+    The reference rides Spark's driver/executor runtime for multi-machine
+    work (Netty shuffle + TorrentBroadcast — SURVEY.md §5.8); the TPU-native
+    equivalent is ``jax.distributed``: one Python process per host, every
+    process calls this once before any other jax API, and the returned 1-D
+    ``(series,)`` mesh spans ALL processes' devices — panels built with it
+    shard over the full slice, with XLA routing collectives over ICI within
+    a host's chips and DCN across hosts.
+
+    On Cloud TPU (e.g. a v5e-8 pod slice) every argument is discovered from
+    the environment, so the whole recipe is::
+
+        # same script started on every host of the slice, e.g. with
+        #   gcloud compute tpus tpu-vm ssh $TPU --worker=all \\
+        #     --command="python train.py"
+        from spark_timeseries_tpu.parallel import mesh as meshlib
+        mesh = meshlib.init_distributed()          # no args on Cloud TPU
+        panel = sts.from_observations(..., mesh=mesh)   # sharded ingest
+        fit = arima.fit(panel.series_values(), (1, 1, 1))
+
+    Elsewhere (CPU/GPU clusters, tests) pass the coordinator explicitly::
+
+        mesh = meshlib.init_distributed("10.0.0.1:8476", num_processes=2,
+                                        process_id=int(os.environ["RANK"]))
+
+    Safe to call when already initialized (returns the mesh without
+    re-initializing); single-process callers get the local-devices mesh,
+    so code written against this entry point runs unchanged on one chip.
+    """
+    try:
+        initialized = jax.distributed.is_initialized()
+    except AttributeError:  # very old jax
+        initialized = False
+    explicit = coordinator_address is not None or num_processes is not None
+    if not initialized and (explicit or _on_cloud_tpu_pod()):
+        kwargs = {}
+        if coordinator_address is not None:
+            kwargs["coordinator_address"] = coordinator_address
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        if process_id is not None:
+            kwargs["process_id"] = process_id
+        if local_device_ids is not None:
+            kwargs["local_device_ids"] = list(local_device_ids)
+        try:
+            jax.distributed.initialize(**kwargs)
+        except (ValueError, RuntimeError):
+            if explicit:  # the caller described a topology that failed: loud
+                raise
+            # pod-like env vars without a discoverable coordinator (single
+            # host with TPU env leakage): fall back to the local mesh
+            import warnings
+
+            warnings.warn(
+                "init_distributed: pod-like environment detected but "
+                "jax.distributed could not auto-discover a coordinator; "
+                "continuing single-process on local devices",
+                stacklevel=2,
+            )
+    return default_mesh()
+
+
+def _on_cloud_tpu_pod() -> bool:
+    """True when MULTI-host TPU slice metadata is present (args
+    discoverable).  Single-host TPU VMs set ``TPU_WORKER_HOSTNAMES=localhost``
+    — one hostname is not a pod."""
+    import os
+
+    hostnames = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    return len(hostnames) > 1 or bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))

@@ -271,15 +271,18 @@ def minimize_lbfgs_batched(
     def step(carry):
         state, iters = carry
         done = state.converged | state.failed
-        direction = -two_loop_b(
-            state.g, state.s_hist, state.y_hist, state.rho_hist, state.k, m
-        )
+        with jax.named_scope("optim.lbfgs_batched.two_loop"):
+            direction = -two_loop_b(
+                state.g, state.s_hist, state.y_hist, state.rho_hist, state.k, m
+            )
         descent = rowdot(state.g, direction) < 0.0
         direction = jnp.where(descent[:, None], direction, -state.g)
 
-        t, ok = linesearch(state.x, state.f, state.g, direction, done)
+        with jax.named_scope("optim.lbfgs_batched.linesearch"):
+            t, ok = linesearch(state.x, state.f, state.g, direction, done)
         x_new = state.x + t[:, None] * direction
-        f_new, g_new = vg(x_new)
+        with jax.named_scope("optim.lbfgs_batched.value_and_grad"):
+            f_new, g_new = vg(x_new)
 
         s = x_new - state.x
         y = g_new - state.g

@@ -192,7 +192,9 @@ class TestHoltWinters:
         y = gen_seasonal(10, 8 * 12)
         res = holtwinters.fit(jnp.asarray(y), period=12)
         p = np.asarray(res.params)
-        assert ((p > 0) & (p < 1)).all()
+        # bounds are CLOSED: a flat SSE direction legitimately saturates at
+        # 0/1, exactly as the reference's box-bounded BOBYQA would return
+        assert ((p >= 0) & (p <= 1)).all()
         fc = holtwinters.forecast(res.params, jnp.asarray(y), 12, 24)
         assert fc.shape == (24,)
         # forecast continues the trend+seasonality: compare to truth pattern

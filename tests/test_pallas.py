@@ -382,3 +382,107 @@ def test_hw_fit_pallas_rejects_nan_and_multiplicative():
     y[0, 0] = np.nan
     with pytest.raises(ValueError, match="dense"):
         hw.fit(jnp.asarray(y), 6, "additive", backend="pallas-interpret")
+
+
+# ---------------------------------------------------------------------------
+# Time-chunked grids: series longer than one chunk (_CHUNK_T) must agree
+# with the scan references across chunk boundaries (values AND adjoints).
+# ---------------------------------------------------------------------------
+
+
+def test_chunked_css_matches_scan_long_series():
+    assert pk._CHUNK_T >= 512  # chunk-boundary sizes below assume >= 512
+    order = (2, 0, 2)
+    b, t = 3, 2100  # 3 chunks; boundary lags cross chunks
+    y = _arma_panel(b, t, seed=41)
+    rng = np.random.default_rng(42)
+    params = jnp.asarray(rng.normal(size=(b, 5)).astype(np.float32) * 0.25)
+    nv = jnp.asarray([t, t - 37, t - 1400], jnp.int32)
+
+    ref = jax.vmap(
+        lambda pr, v, n: arima.css_neg_loglik(pr, v, order, True, n)
+    )(params, y, nv)
+    got = pk.css_neg_loglik(params, y, order, True, nv, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=3e-5)
+
+    def loss_scan(P):
+        return jnp.sum(jax.vmap(
+            lambda pr, v, n: arima.css_neg_loglik(pr, v, order, True, n)
+        )(P, y, nv))
+
+    def loss_pal(P):
+        return jnp.sum(pk.css_neg_loglik(P, y, order, True, nv, interpret=True))
+
+    g_ref = jax.grad(loss_scan)(params)
+    g_got = jax.grad(loss_pal)(params)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), rtol=2e-4, atol=2e-4)
+
+
+def test_chunked_garch_matches_scan_long_series():
+    from spark_timeseries_tpu.models import garch
+
+    b, t = 3, 2100
+    r = _returns_panel(b, t, seed=43)
+    params = jnp.asarray(
+        np.tile([[0.02, 0.1, 0.8]], (b, 1)).astype(np.float32)
+    )
+    nv = jnp.asarray([t, t - 1200, t - 41], jnp.int32)
+    start = (t - nv).astype(jnp.float32)
+    rz = jnp.where(jnp.arange(t)[None, :] >= start[:, None], r, 0.0)
+
+    ref = jax.vmap(lambda pr, rv, n: garch.neg_log_likelihood(pr, rv, n))(
+        params, rz, nv
+    )
+    got = pk.garch_neg_loglik(params, rz, nv, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=3e-5)
+
+    def loss_scan(P):
+        return jnp.sum(jax.vmap(
+            lambda pr, rv, n: garch.neg_log_likelihood(pr, rv, n)
+        )(P, rz, nv))
+
+    def loss_pal(P):
+        return jnp.sum(pk.garch_neg_loglik(P, rz, nv, interpret=True))
+
+    g_ref = jax.grad(loss_scan)(params)
+    g_got = jax.grad(loss_pal)(params)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), rtol=3e-4, atol=3e-4)
+
+
+def test_chunked_ewma_matches_scan_long_series():
+    from spark_timeseries_tpu.models import ewma
+
+    b, t = 3, 2100
+    rng = np.random.default_rng(44)
+    x = jnp.asarray(rng.normal(size=(b, t)).astype(np.float32))
+    nv = jnp.asarray([t, t - 1100, t - 13], jnp.int32)
+    start = (t - nv).astype(jnp.float32)
+    xz = jnp.where(jnp.arange(t)[None, :] >= start[:, None], x, 0.0)
+    alpha = jnp.asarray(rng.uniform(0.1, 0.9, b).astype(np.float32))
+
+    ref = jax.vmap(lambda a, v, n: ewma.sse(a, v, n))(alpha, xz, nv)
+    got = pk.ewma_sse(alpha, xz, nv, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=3e-5)
+
+    g_ref = jax.grad(lambda A: jnp.sum(
+        jax.vmap(lambda a, v, n: ewma.sse(a, v, n))(A, xz, nv)))(alpha)
+    g_got = jax.grad(lambda A: jnp.sum(pk.ewma_sse(A, xz, nv, interpret=True)))(alpha)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), rtol=2e-4, atol=2e-4)
+
+
+def test_chunked_hw_matches_scan_long_series():
+    from spark_timeseries_tpu.models import holtwinters as hw
+
+    b, t, m = 2, 2112, 24  # 2112 = 88 seasons; > 2 chunks
+    y = _seasonal_panel(b, t, m, seed=45)
+    rng = np.random.default_rng(46)
+    params = jnp.asarray(rng.uniform(0.05, 0.9, (b, 3)).astype(np.float32))
+
+    ref = jax.vmap(lambda pr, v: hw.sse(pr, v, m, False))(params, y)
+    got = pk.hw_additive_sse(params, y, m, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=5e-4)
+
+    g_ref = jax.grad(lambda P: jnp.sum(
+        jax.vmap(lambda pr, v: hw.sse(pr, v, m, False))(P, y)))(params)
+    g_got = jax.grad(lambda P: jnp.sum(pk.hw_additive_sse(P, y, m, interpret=True)))(params)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), rtol=2e-3, atol=5e-2)

@@ -1,0 +1,31 @@
+"""Mesh utilities and the multi-host entry point (single-process paths)."""
+
+from spark_timeseries_tpu.parallel import mesh as meshlib
+
+
+class TestInitDistributed:
+    def test_single_process_returns_mesh(self, monkeypatch):
+        # no coordinator configured, not on a pod slice: must not try to
+        # initialize jax.distributed, just hand back the local mesh
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.delenv("CLOUD_TPU_TASK_ID", raising=False)
+        m = meshlib.init_distributed()
+        assert meshlib.SERIES_AXIS in m.axis_names
+        assert m.devices.size >= 1
+
+    def test_pod_detection_is_env_driven(self, monkeypatch):
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.delenv("CLOUD_TPU_TASK_ID", raising=False)
+        assert not meshlib._on_cloud_tpu_pod()
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+        assert not meshlib._on_cloud_tpu_pod()  # single host is not a pod
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+        assert meshlib._on_cloud_tpu_pod()
+
+    def test_default_mesh_axes(self):
+        m = meshlib.default_mesh()
+        assert m.axis_names == (meshlib.SERIES_AXIS,)
+        m2 = meshlib.default_mesh(time_shards=2)
+        assert m2.axis_names == (meshlib.SERIES_AXIS, meshlib.TIME_AXIS)

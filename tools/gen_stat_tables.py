@@ -26,8 +26,10 @@ PROBS = np.array(
      0.60, 0.70, 0.80, 0.90, 0.95, 0.975, 0.99]
 )
 NS = np.array([25, 50, 100, 250, 500, 2000])
-MAX_LAG = 0  # DF statistic; the ADF lag augmentation is asymptotically
-# negligible for the tau distribution (MacKinnon tables are likewise DF-based)
+MAX_LAG = 0  # DF statistic (MacKinnon tables are likewise DF-based).  The
+# consumer maps an AUGMENTED regression onto these rows through its row
+# count: stats.tests.adftest passes n_eff = regression rows + 1, so lag
+# augmentation shrinks the effective sample exactly as it shrinks dof.
 
 # published asymptotic checks (prob -> tau), Fuller 1976 / MacKinnon 2010
 _DF_ASY = {
@@ -142,7 +144,7 @@ def main():
     for reg, checks in _KPSS_ASY.items():
         for p, want in checks.items():
             got = kpss_tables[reg][-1, np.argmin(np.abs(PROBS - (1 - p)))]
-            assert abs(got - want) < 0.05 * max(1.0, want / 0.1), (reg, p, got, want)
+            assert abs(got - want) < 0.07 * want, (reg, p, got, want)
     print("asymptotic validation passed")
 
     def fmt(a):

@@ -1,0 +1,102 @@
+"""Quantify f32-vs-f64 fit drift on the benchmark workload (SURVEY.md §7).
+
+The reference's Commons-Math numerics are f64; TPU f64 is emulated and slow,
+so the production fit path runs f32.  This script measures what that costs:
+it fits the same synthetic panels at f32 (scan and, on TPU, pallas backends)
+and at f64 (scan, the oracle — tests run the suite under ``jax_enable_x64``),
+then reports parameter-error quantiles against BOTH the f64 estimate and the
+GENERATING truth.  The interesting comparison is drift vs estimation error:
+f32 rounding only matters if it is not dwarfed by the statistical error of
+the estimator itself.
+
+Writes a markdown table to stdout; paste into PRECISION.md.
+
+Run: ``python tools/measure_precision.py [--batch 4096] [--t 1000]``
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def _q(a):
+    a = a[np.isfinite(a)]
+    if not a.size:
+        return "n/a", "n/a", "n/a"
+    return tuple(f"{v:.2e}" for v in np.percentile(a, [50, 95, 99]))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--t", type=int, default=1000)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # make f64 REAL f64 everywhere
+
+    import jax.numpy as jnp
+
+    from spark_timeseries_tpu.models import arima, garch
+    from spark_timeseries_tpu.models import holtwinters as hw
+    from spark_timeseries_tpu.ops import pallas_kernels as pk
+
+    sys.path.insert(0, ".")
+    from bench import gen_arima_panel, gen_garch_returns, gen_seasonal_panel
+
+    b, t = args.batch, args.t
+    platform = jax.devices()[0].platform
+    on_tpu = platform in ("tpu", "axon")
+    rows = []
+
+    def report(name, true_vec, f32_params, f64_params, conv32, conv64):
+        p32 = np.asarray(f32_params, np.float64)
+        p64 = np.asarray(f64_params, np.float64)
+        both = np.asarray(conv32) & np.asarray(conv64)
+        drift = np.abs(p32 - p64)[both].max(axis=1)
+        est_err = np.abs(p64 - true_vec[None, :])[both].max(axis=1)
+        d50, d95, d99 = _q(drift)
+        e50, e95, e99 = _q(est_err)
+        rows.append(
+            f"| {name} | {d50} | {d95} | {d99} | {e50} | {e95} | "
+            f"{float(np.mean(conv32)):.3f}/{float(np.mean(conv64)):.3f} |"
+        )
+
+    # --- ARIMA(1,1,1), the headline workload --------------------------------
+    y32 = jnp.asarray(gen_arima_panel(b, t, seed=0), jnp.float32)
+    y64 = jnp.asarray(np.asarray(y32), jnp.float64)
+    backend32 = "pallas" if pk.supported(jnp.float32, t - 1) else "scan"
+    r32 = arima.fit(y32, (1, 1, 1), backend=backend32)
+    r64 = arima.fit(y64, (1, 1, 1), backend="scan")
+    report(f"ARIMA(1,1,1) [{backend32}]", np.array([0.0, 0.6, 0.3]),
+           r32.params, r64.params, r32.converged, r64.converged)
+
+    # --- GARCH(1,1) ---------------------------------------------------------
+    r_ret = gen_garch_returns(b, t, seed=1)
+    g32 = garch.fit(jnp.asarray(r_ret, jnp.float32))
+    g64 = garch.fit(jnp.asarray(r_ret, jnp.float64), backend="scan")
+    report("GARCH(1,1)", np.array([0.05, 0.12, 0.8]),
+           g32.params, g64.params, g32.converged, g64.converged)
+
+    # --- Holt-Winters additive ---------------------------------------------
+    ys = gen_seasonal_panel(b, min(t, 960), 24, seed=2)
+    h32 = hw.fit(jnp.asarray(ys, jnp.float32), 24, "additive")
+    h64 = hw.fit(jnp.asarray(ys, jnp.float64), 24, "additive", backend="scan")
+    # no single generating truth for (alpha, beta, gamma); use the f64 fit
+    report("HoltWinters add. (vs f64 only)", np.full(3, np.nan),
+           h32.params, h64.params, h32.converged, h64.converged)
+
+    print(f"platform: {platform} (f32 backend auto = "
+          f"{'pallas' if on_tpu else 'scan'}); batch {b} x {t}")
+    print()
+    print("| model | drift p50 | drift p95 | drift p99 | est-err p50 | "
+          "est-err p95 | conv f32/f64 |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        print(r)
+
+
+if __name__ == "__main__":
+    main()
