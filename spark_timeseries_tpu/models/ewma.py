@@ -94,6 +94,9 @@ def _fit_program(max_iters, tol, backend):
         ya, nv = jax.vmap(align_right)(yb)
 
         u0 = jnp.zeros((yb.shape[0], 1), yb.dtype)
+        # optimize the MEAN squared error (see models.arima: same argmin,
+        # O(1) gradients); the reported objective is the unscaled SSE
+        n_eff = jnp.maximum(nv - 1, 1).astype(yb.dtype)
         if backend in ("pallas", "pallas-interpret"):
             from ..ops import pallas_kernels as pk
 
@@ -101,22 +104,22 @@ def _fit_program(max_iters, tol, backend):
 
             def fb(u):
                 alpha = optim.sigmoid_to_interval(u[:, 0], 0.0, 1.0)
-                return pk.ewma_sse(alpha, ya, nv, interpret=interp)
+                return pk.ewma_sse(alpha, ya, nv, interpret=interp) / n_eff
 
             res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
         else:
             def objective(u, data):
-                x, n = data
-                return sse(optim.sigmoid_to_interval(u[0], 0.0, 1.0), x, n)
+                x, n, ne = data
+                return sse(optim.sigmoid_to_interval(u[0], 0.0, 1.0), x, n) / ne
 
             res = optim.batched_minimize(
-                objective, u0, (ya, nv), max_iters=max_iters, tol=tol
+                objective, u0, (ya, nv, n_eff), max_iters=max_iters, tol=tol
             )
         alpha = optim.sigmoid_to_interval(res.x, 0.0, 1.0)
         ok = nv >= 3
         return FitResult(
             jnp.where(ok[:, None], alpha, jnp.nan),
-            jnp.where(ok, res.f, jnp.nan),
+            jnp.where(ok, res.f * n_eff, jnp.nan),
             res.converged & ok,
             res.iters,
         )

@@ -147,6 +147,9 @@ def _fit_program(max_iters, tol, backend):
              jnp.full_like(var0, 0.8)], axis=1
         )
         u0 = jax.vmap(_from_natural)(nat0)
+        # optimize the MEAN nll (see models.arima: same argmin, O(1)
+        # gradients keep the relative stopping rule reachable at f32)
+        n_eff = jnp.maximum(nv, 1).astype(ra.dtype)
         if backend in ("pallas", "pallas-interpret"):
             from ..ops import pallas_kernels as pk
 
@@ -154,21 +157,21 @@ def _fit_program(max_iters, tol, backend):
 
             def fb(u):
                 nat = jax.vmap(_to_natural)(u)
-                return pk.garch_neg_loglik(nat, ra, nv, interpret=interp)
+                return pk.garch_neg_loglik(nat, ra, nv, interpret=interp) / n_eff
 
             res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
         else:
             def objective(u, data):
-                rv, n = data
-                return neg_log_likelihood(_to_natural(u), rv, n)
+                rv, n, ne = data
+                return neg_log_likelihood(_to_natural(u), rv, n) / ne
 
             res = optim.batched_minimize(
-                objective, u0, (ra, nv), max_iters=max_iters, tol=tol
+                objective, u0, (ra, nv, n_eff), max_iters=max_iters, tol=tol
             )
         ok = nv >= 10  # GARCH needs a handful of observations to identify
         return FitResult(
             jnp.where(ok[:, None], jax.vmap(_to_natural)(res.x), jnp.nan),
-            jnp.where(ok, res.f, jnp.nan),
+            jnp.where(ok, res.f * n_eff, jnp.nan),
             res.converged & ok,
             res.iters,
         )
@@ -279,10 +282,6 @@ def _fit_argarch_program(max_iters, tol, backend):
     def run(yb):
         ya, nv = jax.vmap(align_right)(yb)
 
-        def objective(u, data):
-            yv, n = data
-            return argarch_neg_log_likelihood(_argarch_to_natural(u), yv, n)
-
         # init: OLS-ish AR(1) by autocorrelation, then GARCH moments on resid
         # (masked over each right-aligned valid span)
         T = ya.shape[1]
@@ -308,6 +307,7 @@ def _fit_argarch_program(max_iters, tol, backend):
             axis=1,
         )
         u0 = jax.vmap(_argarch_from_natural)(nat0)
+        n_eff = jnp.maximum(nv - 1, 1).astype(ya.dtype)
         if backend in ("pallas", "pallas-interpret"):
             from ..ops import pallas_kernels as pk
 
@@ -323,17 +323,21 @@ def _fit_argarch_program(max_iters, tol, backend):
                 # condition on the first valid observation (see
                 # argarch_neg_log_likelihood): its residual is excluded
                 r = jnp.where(t_idx[None, :] <= start[:, None], 0.0, r)
-                return pk.garch_neg_loglik(nat[:, 2:], r, nv - 1, interpret=interp)
+                return pk.garch_neg_loglik(nat[:, 2:], r, nv - 1, interpret=interp) / n_eff
 
             res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
         else:
+            def obj_scaled(u, data):
+                yv, n, ne = data
+                return argarch_neg_log_likelihood(_argarch_to_natural(u), yv, n) / ne
+
             res = optim.batched_minimize(
-                objective, u0, (ya, nv), max_iters=max_iters, tol=tol
+                obj_scaled, u0, (ya, nv, n_eff), max_iters=max_iters, tol=tol
             )
         ok = nv >= 12
         return FitResult(
             jnp.where(ok[:, None], jax.vmap(_argarch_to_natural)(res.x), jnp.nan),
-            jnp.where(ok, res.f, jnp.nan),
+            jnp.where(ok, res.f * n_eff, jnp.nan),
             res.converged & ok,
             res.iters,
         )

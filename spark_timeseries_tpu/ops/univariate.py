@@ -249,8 +249,9 @@ def _next_valid_idx(valid: jax.Array) -> jax.Array:
 
 
 def _carry_valid_vals(valid: jax.Array, x: jax.Array, reverse: bool = False):
-    """Value of the nearest valid position at-or-before t (``reverse=False``)
-    or at-or-after t (``reverse=True``); 0.0 where no such position exists.
+    """-> (value, seen): value of the nearest valid position at-or-before t
+    (``reverse=False``) or at-or-after t (``reverse=True``) with 0.0 where
+    none exists, and the boolean "some valid position exists on that side".
 
     Expressed as an associative "rightmost-valid-wins" scan over
     (value, seen-valid) pairs instead of ``x[prev_idx]`` gathers: batched
@@ -264,25 +265,21 @@ def _carry_valid_vals(valid: jax.Array, x: jax.Array, reverse: bool = False):
         bv, bf = b
         return jnp.where(bf, bv, av), af | bf
 
-    v, _ = lax.associative_scan(comb, (vals, valid), reverse=reverse)
-    return v
+    return lax.associative_scan(comb, (vals, valid), reverse=reverse)
 
 
 def fill_previous(x: jax.Array) -> jax.Array:
     """Forward fill (last observation carried forward); leading NaNs remain."""
     valid = _isvalid(x)
-    ip = _prev_valid_idx(valid)
-    prev_val = _carry_valid_vals(valid, x)
-    return jnp.where(ip >= 0, prev_val, _nan(x.dtype))
+    prev_val, seen = _carry_valid_vals(valid, x)
+    return jnp.where(seen, prev_val, _nan(x.dtype))
 
 
 def fill_next(x: jax.Array) -> jax.Array:
     """Backward fill (next observation carried backward); trailing NaNs remain."""
     valid = _isvalid(x)
-    n = x.shape[0]
-    inx = _next_valid_idx(valid)
-    next_val = _carry_valid_vals(valid, x, reverse=True)
-    return jnp.where(inx < n, next_val, _nan(x.dtype))
+    next_val, seen = _carry_valid_vals(valid, x, reverse=True)
+    return jnp.where(seen, next_val, _nan(x.dtype))
 
 
 def fill_nearest(x: jax.Array) -> jax.Array:
@@ -295,8 +292,8 @@ def fill_nearest(x: jax.Array) -> jax.Array:
     dp = jnp.where(ip >= 0, t - ip, n + 1)
     dn = jnp.where(inx < n, inx - t, n + 1)
     pick_prev = dp <= dn
-    prev_val = _carry_valid_vals(valid, x)
-    next_val = _carry_valid_vals(valid, x, reverse=True)
+    prev_val, _ = _carry_valid_vals(valid, x)
+    next_val, _ = _carry_valid_vals(valid, x, reverse=True)
     filled = jnp.where(pick_prev, prev_val, next_val)
     any_side = (ip >= 0) | (inx < n)
     return jnp.where(valid, x, jnp.where(any_side, filled, _nan(x.dtype)))
@@ -314,8 +311,8 @@ def fill_linear(x: jax.Array) -> jax.Array:
     in_c = jnp.minimum(inx, n - 1)
     span = jnp.maximum(in_c - ip_c, 1).astype(x.dtype)
     w = (t - ip_c).astype(x.dtype) / span
-    prev_val = _carry_valid_vals(valid, x)
-    next_val = _carry_valid_vals(valid, x, reverse=True)
+    prev_val, _ = _carry_valid_vals(valid, x)
+    next_val, _ = _carry_valid_vals(valid, x, reverse=True)
     interp = prev_val * (1.0 - w) + next_val * w
     return jnp.where(valid, x, jnp.where(interior, interp, _nan(x.dtype)))
 

@@ -91,6 +91,7 @@ def minimize_lbfgs(
     max_iters: int = 50,
     history: int = 8,
     tol: float = 1e-6,
+    ftol: float | None = None,
     max_linesearch: int = 20,
     c1: float = 1e-4,
 ) -> LBFGSResult:
@@ -99,10 +100,19 @@ def minimize_lbfgs(
     Designed for ``vmap``: all shapes static, all control flow ``lax``.
     Non-finite objective values are treated as +inf by the line search, so
     transformed-parameter models can guard invalid regions with ``jnp.where``.
+
+    Convergence is EITHER the relative gradient-norm test (``tol``) OR an
+    accepted step whose relative objective decrease falls below ``ftol``
+    (scipy/Commons-Math style): at f32 the gradient of a long-series
+    objective bottoms out on its accumulation noise floor while the
+    objective itself has visibly stopped moving.  ``ftol=None`` picks
+    1e-6 (f32) / 1e-9 (f64).
     """
     d = x0.shape[0]
     m = history
     dtype = x0.dtype
+    if ftol is None:
+        ftol = 1e-9 if dtype == jnp.float64 else 1e-6
 
     value_and_grad = jax.value_and_grad(fun)
 
@@ -171,6 +181,9 @@ def minimize_lbfgs(
         f_out = jnp.where(accept, f_new2, state.f)
         g_out = jnp.where(accept, g_new, state.g)
         conv = jnp.linalg.norm(g_out) < tol * jnp.maximum(1.0, jnp.linalg.norm(x_out))
+        conv = conv | (
+            accept & (state.f - f_new2 <= ftol * jnp.maximum(1.0, jnp.abs(f_new2)))
+        )
         return _State(
             k=state.k + 1,
             x=x_out,
@@ -203,6 +216,7 @@ def minimize_lbfgs_batched(
     max_iters: int = 50,
     history: int = 8,
     tol: float = 1e-6,
+    ftol: float | None = None,
     max_linesearch: int = 20,
     c1: float = 1e-4,
 ) -> LBFGSResult:
@@ -220,6 +234,8 @@ def minimize_lbfgs_batched(
     bsz, d = x0.shape
     m = history
     dtype = x0.dtype
+    if ftol is None:
+        ftol = 1e-9 if dtype == jnp.float64 else 1e-6
 
     def vg(x):
         f, pullback = jax.vjp(fun_batched, x)
@@ -306,6 +322,9 @@ def minimize_lbfgs_batched(
         g_out = jnp.where(accept[:, None], g_new, state.g)
         conv = state.converged | (
             rownorm(g_out) < tol * jnp.maximum(1.0, rownorm(x_out))
+        )
+        conv = conv | (
+            accept & (state.f - f_new <= ftol * jnp.maximum(1.0, jnp.abs(f_new)))
         )
         new_state = _State(
             k=state.k + 1,

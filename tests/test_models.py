@@ -274,3 +274,33 @@ class TestEwmaUnsmoothGuard:
         x = jnp.asarray([1.0, 3.0, 2.0, 5.0])
         s = ewma.smooth(0.4, x)
         np.testing.assert_allclose(np.asarray(ewma.unsmooth(0.4, s)), np.asarray(x), atol=1e-6)
+
+
+class TestArgarchLikelihoodPinned:
+    """Pin the ARGARCH likelihood convention (ADVICE round 1): with a full
+    series the objective conditions on the FIRST observation — nv-1 residuals
+    enter both the variance seed and the likelihood sum, matching the ragged
+    path at n_valid = n exactly."""
+
+    def test_full_series_matches_explicit_masked_form(self):
+        rng = np.random.default_rng(77)
+        n = 60
+        y = jnp.asarray(np.cumsum(rng.normal(size=n)) * 0.1 + rng.normal(size=n))
+        params = jnp.asarray([0.05, 0.3, 0.02, 0.1, 0.7])
+        got = garch.argarch_neg_log_likelihood(params, y)
+        # explicit construction: residuals r_t = y_t - c - phi y_{t-1} for
+        # t >= 1, r_0 excluded; GARCH nll over the remaining n-1 residuals
+        c, phi = params[0], params[1]
+        r = np.asarray(y[1:]) - float(c) - float(phi) * np.asarray(y[:-1])
+        rz = jnp.asarray(np.concatenate([[0.0], r]))
+        exp = garch.neg_log_likelihood(params[2:], rz, jnp.asarray(n - 1))
+        np.testing.assert_allclose(float(got), float(exp), rtol=1e-10)
+
+    def test_full_equals_ragged_at_full_length(self):
+        rng = np.random.default_rng(78)
+        n = 55
+        y = jnp.asarray(rng.normal(size=n))
+        params = jnp.asarray([0.01, 0.2, 0.05, 0.15, 0.6])
+        a = garch.argarch_neg_log_likelihood(params, y)
+        b = garch.argarch_neg_log_likelihood(params, y, jnp.asarray(n))
+        np.testing.assert_allclose(float(a), float(b), rtol=1e-12)

@@ -15,9 +15,12 @@ Run: ``python tools/measure_precision.py [--batch 4096] [--t 1000]``
 """
 
 import argparse
+import os
 import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _q(a):
@@ -43,7 +46,6 @@ def main():
     from spark_timeseries_tpu.models import holtwinters as hw
     from spark_timeseries_tpu.ops import pallas_kernels as pk
 
-    sys.path.insert(0, ".")
     from bench import gen_arima_panel, gen_garch_returns, gen_seasonal_panel
 
     b, t = args.batch, args.t
