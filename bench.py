@@ -345,11 +345,11 @@ def bench_fill_chain(jnp, quick, on_tpu):
 
     from spark_timeseries_tpu.ops import univariate as uv
 
-    # 100k x 1k streamed in fixed-size chunks: ONE compiled program reused
-    # per chunk (compiling the gather-heavy fill at the full batch size
-    # overflows the remote compile helper)
-    chunk = 2048 if quick or not on_tpu else 16_384
-    n_chunks = 1 if quick or not on_tpu else 6  # 98304 ~ "100k keys"
+    # one dispatch over the whole panel: the gather-free fill scans keep
+    # the 100k x 1k compile tractable, and a single call avoids paying the
+    # tunnel round-trip latency once per chunk
+    chunk = 2048 if quick or not on_tpu else 98_304
+    n_chunks = 1
     t = 200 if quick else 1000
     total = chunk * n_chunks
 

@@ -64,6 +64,12 @@ _VMEM_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
 _ZERO = lambda: jnp.zeros((_SUBL, _LANES), jnp.float32)  # noqa: E731
 
 
+def _fori(n, body, init):
+    """fori_loop with int32 bounds: under jax_enable_x64 python-int bounds
+    trace as int64 indices, which pallas ref indexing cannot lower."""
+    return lax.fori_loop(jnp.int32(0), jnp.int32(n), body, init)
+
+
 def supported(dtype, n_time: int) -> bool:
     """True when the fused kernels can run natively on this platform/dtype.
 
@@ -196,7 +202,7 @@ def _css_fwd_kernel(p, q, t_limit, cs, hp, *refs):
         e_ref[tl] = jnp.where(live, y_ref[tl] - pred, 0.0)
         return 0
 
-    lax.fori_loop(0, cs, body, 0)
+    _fori(cs, body, 0)
     # slot s holds e at global (base + cs) - q + s for the next chunk
     for j in range(q):
         ce_ref[j] = e_ref[cs - q + j]
@@ -258,7 +264,7 @@ def _css_bwd_kernel(p, q, t_limit, cs, nchunk, hp, *refs):
         ca_ref[jnp.clip(tl, 0, max(q - 1, 0))] = jnp.where(tl < q, a, cur)
         return tuple(new)
 
-    accs = lax.fori_loop(0, cs, body, tuple(_ZERO() for _ in range(k)))
+    accs = _fori(cs, body, tuple(_ZERO() for _ in range(k)))
     for r in range(k):
         gpar_ref[r] = gpar_ref[r] + accs[r]
 
@@ -417,7 +423,7 @@ def _garch_fwd_kernel(t_limit, cs, hp, *refs):
         h_ref[tl] = jnp.where(live, h, h0)
         return 0
 
-    lax.fori_loop(0, cs, body, 0)
+    _fori(cs, body, 0)
     ch_ref[0] = h_ref[cs - 1]
 
 
@@ -625,7 +631,7 @@ def _ewma_fwd_kernel(t_limit, cs, x_ref, a_ref, zb_ref, s_ref, cs_ref):
         s_ref[tl] = jnp.where(live, s, 0.0)
         return 0
 
-    lax.fori_loop(0, cs, body, 0)
+    _fori(cs, body, 0)
     cs_ref[0] = s_ref[cs - 1]
 
 
@@ -661,7 +667,7 @@ def _ewma_bwd_kernel(t_limit, cs, nchunk, hp, *refs):
         lam_out = jnp.where(tf > zb, lam, 0.0)
         return lam_out, da
 
-    lam, da = lax.fori_loop(0, cs, body, (cl_ref[0], _ZERO()))
+    lam, da = _fori(cs, body, (cl_ref[0], _ZERO()))
     cl_ref[0] = lam
     ga_ref[0] = ga_ref[0] + da
 
@@ -813,7 +819,7 @@ def _hw_fwd_kernel(m, t_limit, cs, y_ref, par_ref, l0_ref, t0_ref, s0_ref,
         tr_ref[tl] = nt
         return nl, nt
 
-    level, trend = lax.fori_loop(0, cs, body, (clt_ref[0], clt_ref[1]))
+    level, trend = _fori(cs, body, (clt_ref[0], clt_ref[1]))
     clt_ref[0] = level
     clt_ref[1] = trend
 

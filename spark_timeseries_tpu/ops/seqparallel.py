@@ -140,6 +140,56 @@ def sp_differences(block: jax.Array, k_lag: int = 1) -> jax.Array:
     return jnp.where(gpos[None, :] < k_lag, jnp.nan, out)
 
 
+def sp_ewma_smooth(block: jax.Array, alpha: jax.Array) -> jax.Array:
+    """EWMA smoothing of time-sharded series (matches ``ewma.smooth`` on
+    unsharded data; seeds ``s_0 = x_0``).
+
+    A first-order linear recurrence is an AFFINE map of its entering carry:
+    within a shard, ``s_t = (1-a)^(t+1) * s_in + p_t`` with ``p`` the local
+    scan from a zero carry.  Each shard therefore exports one (multiplier,
+    offset) pair; the entering carries come from a tiny ``lax.scan`` over
+    the all-gathered pairs (nshards elements — negligible), generalizing
+    :func:`sp_cumsum`'s offset trick to model recursions.  ``alpha``:
+    ``[keys_local]`` smoothing weights (one per series).
+
+    Assumes dense data (fill first) — the seed position is global t = 0.
+    """
+    k, tl = block.shape
+    a = alpha[:, None]
+    idx = _axis_index()
+    first = idx == 0
+    # local pass from a zero entering carry; the first shard seeds s_0 = x_0
+    x0 = jnp.where(first, block[:, :1], a * block[:, :1])
+    rest = a * block[:, 1:]
+    drive = jnp.concatenate([x0, rest], axis=1)
+
+    def step(s, d):
+        s = d + (1.0 - a[:, 0]) * s
+        return s, s
+
+    _, p = lax.scan(step, jnp.zeros_like(drive[:, 0]), drive.T)
+    p = p.T  # [k, tl] local partials (zero carry)
+    decay = (1.0 - a) ** jnp.arange(1, tl + 1)[None, :]  # s_in multiplier
+    # the first shard's seed overrides the recursion: no carry dependence
+    m_exit = jnp.where(first, jnp.zeros_like(a), decay[:, -1:])  # [k, 1]
+    b_exit = p[:, -1:]
+    gm = lax.all_gather(m_exit, TIME_AXIS, axis=1, tiled=True)  # [k, nshards]
+    gb = lax.all_gather(b_exit, TIME_AXIS, axis=1, tiled=True)
+
+    def fold(c, mb):
+        m, b = mb
+        c = m * c + b
+        return c, c
+
+    _, carries = lax.scan(fold, jnp.zeros_like(gm[:, 0]), (gm.T, gb.T))
+    carries = carries.T  # [k, nshards]: carry EXITING each shard
+    entering = jnp.where(
+        first, jnp.zeros_like(carries[:, 0]), carries[:, jnp.maximum(idx - 1, 0)]
+    )
+    out = jnp.where(first, p, decay * entering[:, None] + p)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Mesh-bound wrappers
 # ---------------------------------------------------------------------------
@@ -169,3 +219,15 @@ def sp_cumsum_sharded(mesh: Mesh, values: jax.Array) -> jax.Array:
 def sp_differences_sharded(mesh: Mesh, values: jax.Array, k_lag: int = 1) -> jax.Array:
     fn = _bind(mesh, functools.partial(sp_differences, k_lag=k_lag), P(SERIES_AXIS, TIME_AXIS))
     return jax.jit(fn)(values)
+
+
+def sp_ewma_smooth_sharded(mesh: Mesh, values: jax.Array, alpha: jax.Array) -> jax.Array:
+    """EWMA smoothing of a ``[keys, time]`` panel time-sharded on a 2-D mesh;
+    ``alpha``: ``[keys]``."""
+    fn = shard_map(
+        sp_ewma_smooth,
+        mesh=mesh,
+        in_specs=(P(SERIES_AXIS, TIME_AXIS), P(SERIES_AXIS)),
+        out_specs=P(SERIES_AXIS, TIME_AXIS),
+    )
+    return jax.jit(fn)(values, alpha)

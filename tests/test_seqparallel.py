@@ -66,3 +66,31 @@ class TestSeqParallel:
         d = p.differences(1)
         exp = np.diff(np.asarray(p.series_values()), axis=1)
         np.testing.assert_allclose(np.asarray(d.series_values())[:, 1:], exp, rtol=1e-6)
+
+
+class TestSeqParallelEwma:
+    def test_matches_unsharded_smooth(self, cpu_devices):
+        from spark_timeseries_tpu.models import ewma
+
+        mesh = meshlib.default_mesh(time_shards=4)
+        k, t = 8, 64
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(np.cumsum(rng.normal(size=(k, t)), axis=1))
+        alpha = jnp.asarray(rng.uniform(0.1, 0.9, k))
+        vals = jax.device_put(x, meshlib.series_sharding(mesh))
+        got = sp.sp_ewma_smooth_sharded(mesh, vals, alpha)
+        ref = jax.vmap(lambda a, v: ewma.smooth(a, v))(alpha, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6, atol=1e-9)
+
+    def test_extreme_alpha(self, cpu_devices):
+        from spark_timeseries_tpu.models import ewma
+
+        mesh = meshlib.default_mesh(time_shards=8)
+        k, t = 4, 96
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(k, t)))
+        alpha = jnp.asarray([0.999, 0.5, 0.05, 0.0001])
+        vals = jax.device_put(x, meshlib.series_sharding(mesh))
+        got = sp.sp_ewma_smooth_sharded(mesh, vals, alpha)
+        ref = jax.vmap(lambda a, v: ewma.smooth(a, v))(alpha, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-8)

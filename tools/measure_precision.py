@@ -2,102 +2,145 @@
 
 The reference's Commons-Math numerics are f64; TPU f64 is emulated and slow,
 so the production fit path runs f32.  This script measures what that costs:
-it fits the same synthetic panels at f32 (scan and, on TPU, pallas backends)
-and at f64 (scan, the oracle — tests run the suite under ``jax_enable_x64``),
-then reports parameter-error quantiles against BOTH the f64 estimate and the
-GENERATING truth.  The interesting comparison is drift vs estimation error:
-f32 rounding only matters if it is not dwarfed by the statistical error of
-the estimator itself.
+it fits the same synthetic panels at f32 (the production path, fused Pallas
+kernels on TPU) and at f64 (the oracle: scan backend under
+``jax_enable_x64``), then reports parameter-error quantiles against BOTH the
+f64 estimate and the GENERATING truth.  The interesting comparison is drift
+vs estimation error: f32 rounding only matters if it is not dwarfed by the
+statistical error of the estimator itself.
+
+The f64 oracle runs in a SUBPROCESS: ``jax_enable_x64`` is a process-global
+switch, and x64 tracing of the f32 Pallas kernels trips a jax
+dtype-promotion recursion — two processes keep each world clean.
 
 Writes a markdown table to stdout; paste into PRECISION.md.
 
-Run: ``python tools/measure_precision.py [--batch 4096] [--t 1000]``
+Run: ``python tools/measure_precision.py [--batch 1024] [--t 1000]``
 """
 
 import argparse
 import os
+import subprocess
 import sys
+import tempfile
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def _gen(batch, t):
+    from bench import gen_arima_panel, gen_garch_returns, gen_seasonal_panel
+
+    return {
+        "arima": gen_arima_panel(batch, t, seed=0).astype(np.float32),
+        "garch": gen_garch_returns(batch, t, seed=1),
+        "hw": gen_seasonal_panel(batch, min(t, 960), 24, seed=2),
+    }
+
+
+def _fit_all(data, backend_hint, x64):
+    import jax
+
+    if x64:
+        jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from spark_timeseries_tpu.models import arima, garch
+    from spark_timeseries_tpu.models import holtwinters as hw
+
+    dtype = jnp.float64 if x64 else jnp.float32
+    backend = "scan" if x64 else backend_hint
+    out = {}
+    r = arima.fit(jnp.asarray(data["arima"], dtype), (1, 1, 1), backend=backend)
+    out["arima"] = (np.asarray(r.params), np.asarray(r.converged))
+    r = garch.fit(jnp.asarray(data["garch"], dtype), backend=backend)
+    out["garch"] = (np.asarray(r.params), np.asarray(r.converged))
+    r = hw.fit(jnp.asarray(data["hw"], dtype), 24, "additive", backend=backend)
+    out["hw"] = (np.asarray(r.params), np.asarray(r.converged))
+    return out
+
+
+def _worker(args):
+    # the oracle must run on CPU: TPU has no f64 LU path for the batched
+    # OLS solves, and f64 is emulated there anyway
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    data = dict(np.load(args.data))
+    out = _fit_all(data, "scan", x64=True)
+    np.savez(args.out, **{f"{k}_{i}": v for k, (p, c) in out.items()
+                          for i, v in (("p", p), ("c", c))})
 
 
 def _q(a):
     a = a[np.isfinite(a)]
     if not a.size:
-        return "n/a", "n/a", "n/a"
+        return ("n/a",) * 3
     return tuple(f"{v:.2e}" for v in np.percentile(a, [50, 95, 99]))
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--t", type=int, default=1000)
+    ap.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--data", help=argparse.SUPPRESS)
+    ap.add_argument("--out", help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args._worker:
+        return _worker(args)
+
+    data = _gen(args.batch, args.t)
+
+    with tempfile.TemporaryDirectory() as td:
+        dpath = os.path.join(td, "data.npz")
+        opath = os.path.join(td, "f64.npz")
+        np.savez(dpath, **data)
+        # f64 oracle first, in its own x64 process
+        subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--_worker",
+             "--data", dpath, "--out", opath],
+            check=True, cwd=_ROOT,
+        )
+        z = np.load(opath)
+        f64 = {k: (z[f"{k}_p"], z[f"{k}_c"]) for k in ("arima", "garch", "hw")}
 
     import jax
 
-    jax.config.update("jax_enable_x64", True)  # make f64 REAL f64 everywhere
-
-    import jax.numpy as jnp
-
-    from spark_timeseries_tpu.models import arima, garch
-    from spark_timeseries_tpu.models import holtwinters as hw
-    from spark_timeseries_tpu.ops import pallas_kernels as pk
-
-    from bench import gen_arima_panel, gen_garch_returns, gen_seasonal_panel
-
-    b, t = args.batch, args.t
     platform = jax.devices()[0].platform
-    on_tpu = platform in ("tpu", "axon")
-    rows = []
+    f32 = _fit_all(data, "auto", x64=False)
 
-    def report(name, true_vec, f32_params, f64_params, conv32, conv64):
-        p32 = np.asarray(f32_params, np.float64)
-        p64 = np.asarray(f64_params, np.float64)
-        both = np.asarray(conv32) & np.asarray(conv64)
-        drift = np.abs(p32 - p64)[both].max(axis=1)
-        est_err = np.abs(p64 - true_vec[None, :])[both].max(axis=1)
-        d50, d95, d99 = _q(drift)
-        e50, e95, e99 = _q(est_err)
-        rows.append(
-            f"| {name} | {d50} | {d95} | {d99} | {e50} | {e95} | "
-            f"{float(np.mean(conv32)):.3f}/{float(np.mean(conv64)):.3f} |"
-        )
-
-    # --- ARIMA(1,1,1), the headline workload --------------------------------
-    y32 = jnp.asarray(gen_arima_panel(b, t, seed=0), jnp.float32)
-    y64 = jnp.asarray(np.asarray(y32), jnp.float64)
-    backend32 = "pallas" if pk.supported(jnp.float32, t - 1) else "scan"
-    r32 = arima.fit(y32, (1, 1, 1), backend=backend32)
-    r64 = arima.fit(y64, (1, 1, 1), backend="scan")
-    report(f"ARIMA(1,1,1) [{backend32}]", np.array([0.0, 0.6, 0.3]),
-           r32.params, r64.params, r32.converged, r64.converged)
-
-    # --- GARCH(1,1) ---------------------------------------------------------
-    r_ret = gen_garch_returns(b, t, seed=1)
-    g32 = garch.fit(jnp.asarray(r_ret, jnp.float32))
-    g64 = garch.fit(jnp.asarray(r_ret, jnp.float64), backend="scan")
-    report("GARCH(1,1)", np.array([0.05, 0.12, 0.8]),
-           g32.params, g64.params, g32.converged, g64.converged)
-
-    # --- Holt-Winters additive ---------------------------------------------
-    ys = gen_seasonal_panel(b, min(t, 960), 24, seed=2)
-    h32 = hw.fit(jnp.asarray(ys, jnp.float32), 24, "additive")
-    h64 = hw.fit(jnp.asarray(ys, jnp.float64), 24, "additive", backend="scan")
-    # no single generating truth for (alpha, beta, gamma); use the f64 fit
-    report("HoltWinters add. (vs f64 only)", np.full(3, np.nan),
-           h32.params, h64.params, h32.converged, h64.converged)
-
-    print(f"platform: {platform} (f32 backend auto = "
-          f"{'pallas' if on_tpu else 'scan'}); batch {b} x {t}")
+    truth = {
+        "arima": np.array([0.0, 0.6, 0.3]),
+        "garch": np.array([0.05, 0.12, 0.8]),
+        "hw": None,  # no single generating truth for (alpha, beta, gamma)
+    }
+    names = {
+        "arima": "ARIMA(1,1,1)",
+        "garch": "GARCH(1,1)",
+        "hw": "HoltWinters additive (vs f64 only)",
+    }
+    print(f"platform: {platform}; batch {args.batch} x {args.t}; "
+          "f32 = production path (pallas on TPU), f64 = scan oracle under x64")
     print()
     print("| model | drift p50 | drift p95 | drift p99 | est-err p50 | "
           "est-err p95 | conv f32/f64 |")
     print("|---|---|---|---|---|---|---|")
-    for r in rows:
-        print(r)
+    for k in ("arima", "garch", "hw"):
+        p32, c32 = f32[k]
+        p64, c64 = f64[k]
+        both = c32 & c64
+        drift = np.abs(p32.astype(np.float64) - p64)[both].max(axis=1)
+        d50, d95, d99 = _q(drift)
+        if truth[k] is not None:
+            est = np.abs(p64 - truth[k][None, :])[both].max(axis=1)
+            e50, e95, _ = _q(est)
+        else:
+            e50 = e95 = "n/a"
+        print(f"| {names[k]} | {d50} | {d95} | {d99} | {e50} | {e95} | "
+              f"{c32.mean():.3f}/{c64.mean():.3f} |")
 
 
 if __name__ == "__main__":
