@@ -55,6 +55,9 @@ MonthFrequency = dtix.MonthFrequency
 YearFrequency = dtix.YearFrequency
 WeekFrequency = dtix.WeekFrequency
 
+from_string = dtix.from_string
+uniform_from_interval = dtix.uniform_from_interval
+
 
 # ---------------------------------------------------------------------------
 # timeseriesrdd.py surface

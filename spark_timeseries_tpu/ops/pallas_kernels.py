@@ -65,9 +65,16 @@ _ZERO = lambda: jnp.zeros((_SUBL, _LANES), jnp.float32)  # noqa: E731
 
 
 def _fori(n, body, init):
-    """fori_loop with int32 bounds: under jax_enable_x64 python-int bounds
-    trace as int64 indices, which pallas ref indexing cannot lower."""
-    return lax.fori_loop(jnp.int32(0), jnp.int32(n), body, init)
+    """Sequential time loop with the index coerced to int32: under
+    ``jax_enable_x64`` the loop variable would otherwise trace as int64,
+    which pallas ref indexing cannot lower.  (Unrolling was measured to buy
+    nothing — the recursion's true data dependencies, not loop overhead,
+    bound each step.)"""
+
+    def body32(i, carry):
+        return body(jnp.asarray(i, jnp.int32), carry)
+
+    return lax.fori_loop(0, n, body32, init)
 
 
 def supported(dtype, n_time: int) -> bool:
@@ -202,6 +209,9 @@ def _css_fwd_kernel(p, q, t_limit, cs, hp, *refs):
         e_ref[tl] = jnp.where(live, y_ref[tl] - pred, 0.0)
         return 0
 
+    # (a guarded-prologue / unguarded-steady-state split was measured to buy
+    # nothing: the recursion's serial data dependency, not the boundary
+    # selects, bounds each step)
     _fori(cs, body, 0)
     # slot s holds e at global (base + cs) - q + s for the next chunk
     for j in range(q):

@@ -145,12 +145,13 @@ def sp_ewma_smooth(block: jax.Array, alpha: jax.Array) -> jax.Array:
     unsharded data; seeds ``s_0 = x_0``).
 
     A first-order linear recurrence is an AFFINE map of its entering carry:
-    within a shard, ``s_t = (1-a)^(t+1) * s_in + p_t`` with ``p`` the local
-    scan from a zero carry.  Each shard therefore exports one (multiplier,
-    offset) pair; the entering carries come from a tiny ``lax.scan`` over
-    the all-gathered pairs (nshards elements — negligible), generalizing
-    :func:`sp_cumsum`'s offset trick to model recursions.  ``alpha``:
-    ``[keys_local]`` smoothing weights (one per series).
+    every step is ``s -> m*s + b`` with ``(m, b) = (1-a, a*x_t)`` (and the
+    global seed ``s_0 = x_0`` is just ``(0, x_0)``), and affine maps compose
+    associatively — so BOTH levels parallelize: inside a shard a log-depth
+    ``associative_scan`` over the (m, b) pairs, across shards one tiny fold
+    of each shard's composed exit pair over the all-gathered values
+    (generalizing :func:`sp_cumsum`'s offset trick to model recursions).
+    ``alpha``: ``[keys_local]`` smoothing weights (one per series).
 
     Assumes dense data (fill first) — the seed position is global t = 0.
     """
@@ -158,20 +159,19 @@ def sp_ewma_smooth(block: jax.Array, alpha: jax.Array) -> jax.Array:
     a = alpha[:, None]
     idx = _axis_index()
     first = idx == 0
-    # local pass from a zero entering carry; the first shard seeds s_0 = x_0
-    x0 = jnp.where(first, block[:, :1], a * block[:, :1])
-    rest = a * block[:, 1:]
-    drive = jnp.concatenate([x0, rest], axis=1)
+    pos0 = jnp.arange(tl)[None, :] == 0
+    seed = first & pos0  # global t = 0: s = x_0 regardless of the carry
+    m_elem = jnp.where(seed, 0.0, jnp.broadcast_to(1.0 - a, (k, tl)))
+    b_elem = jnp.where(seed, block, a * block)
 
-    def step(s, d):
-        s = d + (1.0 - a[:, 0]) * s
-        return s, s
+    def comp(l, r):  # apply l then r: r(l(s)) = (rm*lm) s + (rb + rm*lb)
+        lm, lb = l
+        rm, rb = r
+        return lm * rm, rb + rm * lb
 
-    _, p = lax.scan(step, jnp.zeros_like(drive[:, 0]), drive.T)
-    p = p.T  # [k, tl] local partials (zero carry)
-    decay = (1.0 - a) ** jnp.arange(1, tl + 1)[None, :]  # s_in multiplier
-    # the first shard's seed overrides the recursion: no carry dependence
-    m_exit = jnp.where(first, jnp.zeros_like(a), decay[:, -1:])  # [k, 1]
+    decay, p = lax.associative_scan(comp, (m_elem, b_elem), axis=1)
+    # s_t = decay_t * s_in + p_t; the first shard's seed zeroes decay
+    m_exit = decay[:, -1:]
     b_exit = p[:, -1:]
     gm = lax.all_gather(m_exit, TIME_AXIS, axis=1, tiled=True)  # [k, nshards]
     gb = lax.all_gather(b_exit, TIME_AXIS, axis=1, tiled=True)
@@ -186,8 +186,7 @@ def sp_ewma_smooth(block: jax.Array, alpha: jax.Array) -> jax.Array:
     entering = jnp.where(
         first, jnp.zeros_like(carries[:, 0]), carries[:, jnp.maximum(idx - 1, 0)]
     )
-    out = jnp.where(first, p, decay * entering[:, None] + p)
-    return out
+    return decay * entering[:, None] + p
 
 
 # ---------------------------------------------------------------------------
