@@ -44,4 +44,9 @@ from . import models
 from . import stats
 from . import compat
 
-__version__ = "0.2.0"
+try:  # single-sourced from pyproject.toml via package metadata
+    from importlib.metadata import version as _pkg_version
+
+    __version__ = _pkg_version("spark-timeseries-tpu")
+except Exception:  # not installed (e.g. run from a bare checkout)
+    __version__ = "0.0.0+uninstalled"

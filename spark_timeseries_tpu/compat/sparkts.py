@@ -258,6 +258,48 @@ class _ModelBase:
     def coefficients(self) -> np.ndarray:
         return np.asarray(self.params)
 
+    # -- persistence -----------------------------------------------------
+    # The reference's fitted models are plain serializable case classes
+    # (SURVEY.md §5.4); here the analog is an ``.npz`` holding the parameter
+    # vector plus each class's hyperparameters.
+
+    def _meta(self) -> dict:
+        return {}
+
+    @classmethod
+    def _from_saved(cls, params, meta: dict) -> "_ModelBase":
+        return cls(params)
+
+    def save(self, path: str) -> None:
+        np.savez(_npz_path(path), _class=type(self).__name__,
+                 params=np.asarray(self.params), **self._meta())
+
+    @classmethod
+    def load(cls, path: str) -> "_ModelBase":
+        model = load_model(path)
+        if type(model) is not cls:
+            raise ValueError(
+                f"{path!r} holds a {type(model).__name__}, not a {cls.__name__}"
+            )
+        return model
+
+
+def _npz_path(path: str) -> str:
+    # np.savez silently appends ".npz"; normalize so save/load agree
+    return path if str(path).endswith(".npz") else str(path) + ".npz"
+
+
+def load_model(path: str) -> "_ModelBase":
+    """Load any saved model, dispatching on the class recorded in the file."""
+    with np.load(_npz_path(path)) as z:
+        name = str(z["_class"])
+        klass = globals().get(name)
+        if klass is None or not (isinstance(klass, type)
+                                 and issubclass(klass, _ModelBase)):
+            raise ValueError(f"{path!r} holds unknown model class {name!r}")
+        meta = {k: z[k] for k in z.files if k not in ("_class", "params")}
+        return klass._from_saved(jnp.asarray(z["params"]), meta)
+
 
 class ARIMAModel(_ModelBase):
     def __init__(self, p, d, q, params, has_intercept=True):
@@ -268,6 +310,14 @@ class ARIMAModel(_ModelBase):
     @property
     def order(self):
         return (self.p, self.d, self.q)
+
+    def _meta(self) -> dict:
+        return dict(p=self.p, d=self.d, q=self.q, has_intercept=self.has_intercept)
+
+    @classmethod
+    def _from_saved(cls, params, meta):
+        return cls(int(meta["p"]), int(meta["d"]), int(meta["q"]), params,
+                   bool(meta["has_intercept"]))
 
     def forecast(self, ts, n_future: int):
         return np.asarray(
@@ -333,6 +383,13 @@ class ARModel(_ModelBase):
     @property
     def c(self) -> float:
         return float(self.params[0])
+
+    def _meta(self) -> dict:
+        return dict(max_lag=self.max_lag)
+
+    @classmethod
+    def _from_saved(cls, params, meta):
+        return cls(params, int(meta["max_lag"]))
 
     def forecast(self, ts, n_future: int):
         return np.asarray(
@@ -429,6 +486,13 @@ class HoltWintersModel(_ModelBase):
         super().__init__(params)
         self.period = period
         self.model_type = model_type
+
+    def _meta(self) -> dict:
+        return dict(period=self.period, model_type=self.model_type)
+
+    @classmethod
+    def _from_saved(cls, params, meta):
+        return cls(params, int(meta["period"]), str(meta["model_type"]))
 
     def forecast(self, ts, n_future: int):
         return np.asarray(

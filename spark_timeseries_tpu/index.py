@@ -222,44 +222,47 @@ class YearFrequency(MonthFrequency):
 
 
 class BusinessDayFrequency(Frequency):
-    """Business days (Mon-Fri), vectorized closed-form day-of-week arithmetic.
+    """Business days, vectorized closed-form day-of-week arithmetic.
 
-    ``first_day_of_week`` follows the reference API (0 = Monday) but only
-    Monday-start weeks (Sat/Sun weekend) are supported.
+    ``first_day_of_week`` follows the reference API (0 = Monday .. 6 =
+    Sunday); the week's first five days are business days and its last two
+    the weekend, so e.g. ``first_day_of_week=6`` gives a Sunday-Thursday
+    business week with a Friday/Saturday weekend.
     """
 
     def __init__(self, days: int = 1, first_day_of_week: int = 0):
-        if first_day_of_week != 0:
-            raise NotImplementedError("only Monday-start weeks are supported")
+        if not 0 <= int(first_day_of_week) <= 6:
+            raise ValueError("first_day_of_week must be in 0..6 (0 = Monday)")
         self.days = int(days)
         self.first_day_of_week = int(first_day_of_week)
 
-    @staticmethod
-    def _to_bday_ordinal(nanos) -> Tuple[np.ndarray, np.ndarray]:
+    def _to_bday_ordinal(self, nanos) -> Tuple[np.ndarray, np.ndarray]:
         """Map timestamps to (business-day ordinal, intra-day nanos).
 
-        Weekend timestamps map to the preceding Friday's ordinal at
+        Weekend timestamps map to the preceding last-business-day ordinal at
         end-of-day (intra = NANOS_PER_DAY), so the (ordinal, intra) pair —
         and hence ``difference``/``insertion_loc`` — stays monotone in time:
-        Saturday sorts after any Friday instant and before any Monday one.
+        a weekend instant sorts after any instant of the last business day
+        and before any instant of the next one.
         """
         nanos = np.asarray(nanos, dtype=np.int64)
         days = np.floor_divide(nanos, NANOS_PER_DAY)
         intra = nanos - days * NANOS_PER_DAY
-        wd = _weekday(nanos)  # 0=Mon..6=Sun
-        # align to a Monday-based week number
-        weeks = np.floor_divide(days + 3, 7)
+        # epoch day 0 (1970-01-01) is a Thursday (weekday 3, 0=Mon); align
+        # week numbers so the first_day_of_week-th weekday starts a week
+        shifted = days + 3 - self.first_day_of_week
+        weeks = np.floor_divide(shifted, 7)
+        wd = shifted - weeks * 7  # 0..6 relative to the week start
         is_weekend = wd > 4
         ordinal = weeks * 5 + np.minimum(wd, 4)
         intra = np.where(is_weekend, NANOS_PER_DAY, intra)
         return ordinal, intra
 
-    @staticmethod
-    def _from_bday_ordinal(ordinal, intra) -> np.ndarray:
+    def _from_bday_ordinal(self, ordinal, intra) -> np.ndarray:
         ordinal = np.asarray(ordinal, dtype=np.int64)
         weeks = np.floor_divide(ordinal, 5)
         wd = ordinal - weeks * 5
-        days = weeks * 7 + wd - 3
+        days = weeks * 7 + wd - 3 + self.first_day_of_week
         return days * NANOS_PER_DAY + np.asarray(intra, dtype=np.int64)
 
     def advance(self, nanos, n):

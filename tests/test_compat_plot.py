@@ -169,6 +169,43 @@ class TestSparktsCompat:
         hw = sparkts.HoltWinters.fit_model(yhw, 12)
         assert hw.forecast(yhw, 6).shape == (6,)
 
+    def test_model_save_load_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(7)
+        y = rng.normal(size=64).cumsum() + 20.0
+        models = {
+            "arima": sparkts.ARIMAModel(1, 1, 1, [0.1, 0.4, 0.2], has_intercept=True),
+            "ar": sparkts.ARModel([0.5, 0.3, 0.1], max_lag=2),
+            "ewma": sparkts.EWMAModel([0.35]),
+            "garch": sparkts.GARCHModel([0.1, 0.2, 0.6]),
+            "argarch": sparkts.ARGARCHModel([0.05, 0.3, 0.1, 0.2, 0.6]),
+            "hw": sparkts.HoltWintersModel([0.3, 0.1, 0.2], period=12,
+                                           model_type="multiplicative"),
+            "regarima": sparkts.RegressionARIMAModel([1.0, 2.0, -0.5]),
+        }
+        for name, m in models.items():
+            path = str(tmp_path / f"{name}.npz")
+            m.save(path)
+            back = type(m).load(path)
+            np.testing.assert_array_equal(back.coefficients, m.coefficients)
+            also = sparkts.load_model(path)  # class-dispatching loader
+            assert type(also) is type(m)
+        # hyperparameters survive and behavior is identical post-load
+        arima2 = sparkts.ARIMAModel.load(str(tmp_path / "arima.npz"))
+        assert arima2.order == (1, 1, 1) and arima2.has_intercept is True
+        np.testing.assert_allclose(arima2.forecast(y, 4),
+                                   models["arima"].forecast(y, 4))
+        hw2 = sparkts.HoltWintersModel.load(str(tmp_path / "hw.npz"))
+        assert hw2.period == 12 and hw2.model_type == "multiplicative"
+        ar2 = sparkts.ARModel.load(str(tmp_path / "ar.npz"))
+        assert ar2.max_lag == 2
+        with pytest.raises(ValueError):
+            sparkts.EWMAModel.load(str(tmp_path / "garch.npz"))
+        # suffix-less paths round-trip too (np.savez appends ".npz")
+        models["ewma"].save(str(tmp_path / "bare"))
+        bare = sparkts.EWMAModel.load(str(tmp_path / "bare"))
+        np.testing.assert_array_equal(bare.coefficients,
+                                      models["ewma"].coefficients)
+
     def test_stat_tests_exposed(self):
         rng = np.random.default_rng(2)
         x = rng.normal(size=300)

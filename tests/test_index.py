@@ -107,6 +107,38 @@ class TestBusinessDay:
             adv = int(f.advance(start, n))
             assert int(f.difference(start, adv)) == n
 
+    @pytest.mark.parametrize("fdow", range(7))
+    def test_week_start_vs_numpy_busday(self, fdow):
+        # business days are the first five days of a week starting on
+        # weekday `fdow` (0=Mon); numpy weekmask is Mon..Sun booleans
+        mask = [((d - fdow) % 7) < 5 for d in range(7)]
+        f = dtix.BusinessDayFrequency(1, first_day_of_week=fdow)
+        # find a start date that is a business day under this mask
+        start_d = np.busday_offset("2021-03-01", 0, roll="forward", weekmask=mask)
+        start = dtix.to_nanos(str(start_d))
+        for n in [0, 1, 2, 5, 7, 13, 60]:
+            adv = int(f.advance(start, n))
+            want = np.busday_offset(start_d, n, weekmask=mask)
+            got = dtix.nanos_to_datetime64(adv).astype("datetime64[D]")
+            assert got == want, (fdow, n)
+            assert int(f.difference(start, adv)) == n
+
+    def test_sunday_start_week(self):
+        # Middle-East convention: Sun-Thu business week, Fri/Sat weekend
+        ix = dtix.uniform("2021-03-07", 7, dtix.BusinessDayFrequency(1, 6))  # a Sunday
+        got = ix.datetimes().astype("datetime64[D]").astype(str).tolist()
+        assert got == ["2021-03-07", "2021-03-08", "2021-03-09", "2021-03-10",
+                       "2021-03-11", "2021-03-14", "2021-03-15"]
+        assert ix.loc_at_datetime("2021-03-12") == -1  # Friday off
+        assert ix.loc_at_datetime("2021-03-13") == -1  # Saturday off
+        # round-trips through the string codec with the week start intact
+        rt = dtix.frequency_from_string(ix.frequency.to_string())
+        assert rt.first_day_of_week == 6
+
+    def test_bad_week_start_rejected(self):
+        with pytest.raises(ValueError):
+            dtix.BusinessDayFrequency(1, first_day_of_week=7)
+
 
 class TestIrregular:
     def test_basic(self):
