@@ -214,7 +214,10 @@ def fit(
     if tol is None:
         # f32 gradients of a ~1k-term CSS bottom out near 1e-4 relative noise
         tol = 1e-6 if yb.dtype == jnp.float64 else 1e-4
-    backend = resolve_backend(backend, yb.dtype, yb.shape[1] - d)
+    from ..ops import pallas_kernels as pk
+
+    backend = resolve_backend(backend, yb.dtype, yb.shape[1] - d,
+                              structural_ok=pk.css_structural_ok(p, q))
 
     run = _fit_program(
         order, include_intercept, method, backend, max_iters, float(tol),

@@ -43,13 +43,17 @@ def jit_program(builder):
     return functools.wraps(builder)(lambda *static: cached(*map(norm, static)))
 
 
-def resolve_backend(backend: str, dtype, n_time: int) -> str:
+def resolve_backend(backend: str, dtype, n_time: int,
+                    structural_ok: bool = True) -> str:
     """Validate a fit ``backend`` and resolve ``"auto"``.
 
     ``auto`` picks the fused Pallas objective when the platform/dtype/length
-    allow (``ops.pallas_kernels.supported``), else the portable ``lax.scan``
-    path.  Shared by every model family so the backend vocabulary cannot
-    drift between them.
+    allow (``ops.pallas_kernels.supported``) AND the model's structural
+    parameters fit the kernel's chunked layout (``structural_ok`` — e.g.
+    ``pk.css_structural_ok(p, q)``), else the portable ``lax.scan`` path.
+    An explicitly requested ``"pallas"`` with violating structure raises at
+    the kernel entry point instead.  Shared by every model family so the
+    backend vocabulary cannot drift between them.
     """
     if backend not in ("auto", "scan", "pallas", "pallas-interpret"):
         raise ValueError(f"unknown backend {backend!r}")
@@ -57,7 +61,7 @@ def resolve_backend(backend: str, dtype, n_time: int) -> str:
         return backend
     from ..ops import pallas_kernels as pk
 
-    return "pallas" if pk.supported(dtype, n_time) else "scan"
+    return "pallas" if structural_ok and pk.supported(dtype, n_time) else "scan"
 
 
 class FitResult(NamedTuple):

@@ -121,7 +121,10 @@ def fit(
         tol = 1e-7 if yb.dtype == jnp.float64 else 1e-4
     was_auto = backend == "auto"
     traced = isinstance(yb, jax.core.Tracer)  # fit() called under jit/vmap
-    backend = resolve_backend(backend, yb.dtype, yb.shape[1])
+    from ..ops import pallas_kernels as pk
+
+    backend = resolve_backend(backend, yb.dtype, yb.shape[1],
+                              structural_ok=pk.hw_structural_ok(period))
     if backend in ("pallas", "pallas-interpret"):
         # the fused kernel is additive-only and needs a dense panel; density
         # of traced data cannot be inspected, so auto falls back to the

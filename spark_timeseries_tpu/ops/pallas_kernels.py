@@ -91,6 +91,21 @@ def supported(dtype, n_time: int) -> bool:
     return platform in ("tpu", "axon") and jnp.dtype(dtype) == jnp.dtype(jnp.float32)
 
 
+def css_structural_ok(p: int, q: int) -> bool:
+    """The CSS kernels' chunked layout: lag reads reach back at most one
+    chunk (the neighbor input block) and the cross-chunk trailing-error
+    stash holds ``q`` slots, so both orders must stay under ``_CHUNK_T``."""
+    return 0 <= p < _CHUNK_T and 0 <= q < _CHUNK_T
+
+
+def hw_structural_ok(period: int) -> bool:
+    """The Holt-Winters kernels keep two whole ``[period, 8, 128]`` seasonal
+    rings in VMEM scratch beside the chunk blocks; periods past one chunk
+    blow the scoped-VMEM budget with an opaque Mosaic error, so they are
+    rejected up front (use the scan backend)."""
+    return 0 < period <= _CHUNK_T
+
+
 def _pad_to(n: int, m: int) -> int:
     return (-n) % m
 
@@ -289,6 +304,11 @@ def css_errors(p: int, q: int, interpret: bool, params, yd, zb):
     errors before this position are forced to zero (``start + p`` for the
     conditional likelihood).  Gradients flow to ``params`` only.
     """
+    if not css_structural_ok(p, q):
+        raise ValueError(
+            f"fused CSS kernel supports p, q < {_CHUNK_T} (got p={p}, q={q}); "
+            "use backend='scan'"
+        )
     e, _ = _css_errors_fwd(p, q, interpret, params, yd, zb)
     return e
 
@@ -978,6 +998,11 @@ def hw_additive_sse(params, y, period: int, *, interpret: bool = False):
     seeds come from the first two seasons and are constants of the objective.
     """
     m = period
+    if not hw_structural_ok(m):
+        raise ValueError(
+            f"fused Holt-Winters kernel supports period <= {_CHUNK_T} "
+            f"(got {m}); use backend='scan'"
+        )
     l0 = jnp.mean(y[:, :m], axis=1)
     t0 = (jnp.mean(y[:, m : 2 * m], axis=1) - l0) / m
     s0 = y[:, :m] - l0[:, None]

@@ -70,7 +70,14 @@ class _ArrayIdKey:
     kernel (module constant, closure cell, default).  Holding the reference
     pins the id so it cannot be recycled; equality is identity — a REBOUND
     capture produces a different key, while the same array keeps hitting the
-    cache (jax arrays are immutable, so identity implies equal contents)."""
+    cache (jax arrays are immutable, so identity implies equal contents).
+
+    Memory note (ADVICE round 2): a cache ENTRY retains the captured device
+    buffer regardless of this key — the cached compiled program's closure
+    (and its traced constants) hold the array strongly — so a weak key here
+    would add id-recycling complexity without freeing anything.  Captured-
+    panel memory is bounded by ``_BATCH_CACHE_MAX`` FIFO eviction; callers
+    holding very large captured panels can ``_BATCH_CACHE.clear()``."""
 
     __slots__ = ("arr",)
 

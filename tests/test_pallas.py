@@ -486,3 +486,23 @@ def test_chunked_hw_matches_scan_long_series():
         jax.vmap(lambda pr, v: hw.sse(pr, v, m, False))(P, y)))(params)
     g_got = jax.grad(lambda P: jnp.sum(pk.hw_additive_sse(P, y, m, interpret=True)))(params)
     np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), rtol=2e-3, atol=5e-2)
+
+
+def test_structural_guards():
+    # the chunked layouts have static bounds (ADVICE round 2): large orders /
+    # periods must raise a clear ValueError at the kernel entry, and the
+    # auto backend must resolve to scan instead of tripping them
+    from spark_timeseries_tpu.models.base import resolve_backend
+
+    assert pk.css_structural_ok(1, 1)
+    assert not pk.css_structural_ok(2048, 1)
+    assert pk.hw_structural_ok(24)
+    assert not pk.hw_structural_ok(5000)
+    with pytest.raises(ValueError, match="fused CSS"):
+        pk.css_errors(2048, 1, True, jnp.zeros((1, 2050)), jnp.zeros((1, 8)),
+                      jnp.zeros((1,)))
+    with pytest.raises(ValueError, match="fused Holt-Winters"):
+        pk.hw_additive_sse(jnp.zeros((1, 3)), jnp.zeros((1, 16)), 5000,
+                           interpret=True)
+    # auto never picks pallas for a structurally unsupported config
+    assert resolve_backend("auto", jnp.float32, 100, structural_ok=False) == "scan"
