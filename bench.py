@@ -303,7 +303,7 @@ def bench_autocorr(jnp, quick):
     from spark_timeseries_tpu.ops import univariate as uv
 
     b, t, lags = (256, 200, 5) if quick else (1024, 1000, 10)
-    kern = uv.batch_autocorr(lags)
+    kern = uv.batch_autocorr(lags)  # jitted internally, both backends
     panels = [
         np.cumsum(np.random.default_rng(s).normal(size=(b, t)), axis=1).astype(np.float32)
         for s in range(4)
@@ -325,7 +325,7 @@ def bench_autocorr_at_scale(jnp, quick, on_tpu):
     from spark_timeseries_tpu.ops import univariate as uv
 
     b, t, lags = (2048, 200, 5) if quick or not on_tpu else (131_072, 1000, 10)
-    kern = uv.batch_autocorr(lags)
+    kern = uv.batch_autocorr(lags)  # jitted internally, both backends
     panels = [
         np.cumsum(np.random.default_rng(s).normal(size=(b, t)), axis=1).astype(np.float32)
         for s in range(3)
@@ -345,17 +345,16 @@ def bench_fill_chain(jnp, quick, on_tpu):
 
     from spark_timeseries_tpu.ops import univariate as uv
 
-    # one dispatch over the whole panel: the gather-free fill scans keep
-    # the 100k x 1k compile tractable, and a single call avoids paying the
+    # one dispatch over the whole panel: the fused two-sweep Pallas chain
+    # (falling back to the gather-free fill scans off-TPU) keeps the
+    # 100k x 1k compile tractable, and a single call avoids paying the
     # tunnel round-trip latency once per chunk
     b = 2048 if quick or not on_tpu else 98_304
     t = 200 if quick else 1000
 
     @jax.jit
     def chain(v):
-        f = jax.vmap(uv.fill_linear)(v)
-        d = jax.vmap(lambda x: uv.differences_at_lag(x, 1))(f)
-        lagged = jax.vmap(lambda x: uv.lag(x, 1))(f)
+        f, d, lagged = uv.batch_fill_linear_chain(v)
         # ONE scalar sync point covering both outputs (the outputs still
         # materialize — they are jit results — but the host waits once)
         s = jnp.sum(jnp.nan_to_num(d)) + jnp.sum(jnp.nan_to_num(lagged))
@@ -463,6 +462,8 @@ def check_backend_parity(jnp, on_tpu):
     portable scan objectives ON DEVICE before any timing (ADVICE round 1)."""
     if not on_tpu:
         return {"checked": False, "reason": "no TPU; scan backend is the oracle"}
+    import jax
+
     from spark_timeseries_tpu.models import arima, ewma, garch
     from spark_timeseries_tpu.models import holtwinters as hw
 
@@ -516,6 +517,25 @@ def check_backend_parity(jnp, on_tpu):
     dh_frac_big = float((rel > 0.05).mean()) if rel.size else 0.0
     dh_conv = abs(float(jnp.mean(hs.converged)) - float(jnp.mean(hp.converged)))
     dh_med = float(jnp.nanmedian(jnp.abs(hs.params - hp.params)))
+    # transform kernels (no fit in the loop): exact parity expected
+    from spark_timeseries_tpu.ops import univariate as uv
+
+    g = jnp.asarray(gen_gappy_panel(1024, 200, seed=11))
+    f_ref, d_ref, l_ref = uv.batch_fill_linear_chain(g, backend="scan")
+    f_pal, d_pal, l_pal = uv.batch_fill_linear_chain(g)
+    dfill = float(jnp.max(jnp.where(jnp.isnan(f_ref) | jnp.isnan(f_pal),
+                                    0.0, jnp.abs(f_ref - f_pal))))
+    dfill = max(dfill, float(jnp.max(jnp.abs(jnp.nan_to_num(d_ref - d_pal)))))
+    dfill = max(dfill, float(jnp.max(jnp.abs(jnp.nan_to_num(l_ref - l_pal)))))
+    dfill_nan = float(jnp.sum(jnp.isnan(f_ref) != jnp.isnan(f_pal)))
+    dfill_nan += float(jnp.sum(jnp.isnan(d_ref) != jnp.isnan(d_pal)))
+    dfill_nan += float(jnp.sum(jnp.isnan(l_ref) != jnp.isnan(l_pal)))
+    ac_ref = uv.batch_autocorr(10, backend="scan")(g)
+    ac_pal = uv.batch_autocorr(10)(g)
+    dac = float(jnp.max(jnp.abs(jnp.nan_to_num(ac_ref - ac_pal))))
+    _gate(dfill < 1e-4, f"fill_linear pallas/scan divergence on device: {dfill}")
+    _gate(dfill_nan == 0, f"fill_linear pallas/scan NaN-mask mismatch: {dfill_nan}")
+    _gate(dac < 1e-3, f"batch_autocorr pallas/scan divergence on device: {dac}")
     _gate(da < 5e-2, f"ARIMA pallas/scan divergence on device: {da}")
     _gate(dg < 5e-2, f"GARCH pallas/scan divergence on device: {dg}")
     _gate(de < 1e-2, f"EWMA pallas/scan divergence on device: {de}")
@@ -524,6 +544,7 @@ def check_backend_parity(jnp, on_tpu):
     _gate(dh_conv < 0.05, f"HoltWinters pallas/scan converged-fraction gap: {dh_conv}")
     _gate(dh_med < 1e-2, f"HoltWinters pallas/scan median param divergence: {dh_med}")
     return {"checked": True, "arima_max_abs_diff": da, "garch_max_abs_diff": dg,
+            "fill_chain_max_abs_diff": dfill, "autocorr_max_abs_diff": dac,
             "ewma_max_abs_diff": de, "hw_obj_p99_rel_diff": dh,
             "hw_frac_rows_gt5pct": dh_frac_big,
             "hw_converged_frac_gap": dh_conv,

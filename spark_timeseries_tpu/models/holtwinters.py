@@ -105,9 +105,9 @@ def fit(
 ) -> FitResult:
     """Fit (alpha, beta, gamma) per series -> params ``[batch?, 3]``.
 
-    ``backend``: ``"scan"`` (portable, all model types / ragged panels),
-    ``"pallas"`` (fused TPU kernel — additive model on dense panels only), or
-    ``"auto"`` (pallas when the platform, model type, and data allow).
+    ``backend``: ``"scan"`` (portable), ``"pallas"`` (fused TPU kernel —
+    additive and multiplicative, ragged panels via the right-aligned span),
+    or ``"auto"`` (pallas whenever the platform/dtype/period allow).
     """
     if model_type not in ("additive", "multiplicative"):
         raise ValueError(f"model_type must be additive|multiplicative, got {model_type!r}")
@@ -119,27 +119,10 @@ def fit(
         )
     if tol is None:
         tol = 1e-7 if yb.dtype == jnp.float64 else 1e-4
-    was_auto = backend == "auto"
-    traced = isinstance(yb, jax.core.Tracer)  # fit() called under jit/vmap
     from ..ops import pallas_kernels as pk
 
     backend = resolve_backend(backend, yb.dtype, yb.shape[1],
                               structural_ok=pk.hw_structural_ok(period))
-    if backend in ("pallas", "pallas-interpret"):
-        # the fused kernel is additive-only and needs a dense panel; density
-        # of traced data cannot be inspected, so auto falls back to the
-        # portable path rather than guessing (explicit pallas under jit is
-        # the caller asserting density)
-        has_nan = False if traced else bool(jnp.any(jnp.isnan(yb)))
-        if multiplicative or (was_auto and (traced or has_nan)):
-            if not was_auto:
-                raise ValueError("pallas backend supports the additive model only")
-            backend = "scan"
-        elif has_nan:
-            raise ValueError(
-                "pallas backend needs a dense panel (no NaNs); fill first or "
-                "use backend='scan'"
-            )
     return debatch(
         _fit_program(period, multiplicative, max_iters, float(tol), backend)(yb),
         single,
@@ -166,7 +149,9 @@ def _fit_program(period, multiplicative, max_iters, tol, backend):
 
             def fb(u):
                 nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
-                return pk.hw_additive_sse(nat, ya, period, interpret=interp) / n_err
+                return pk.hw_sse(
+                    nat, ya, period, multiplicative, nv, interpret=interp
+                ) / n_err
 
             res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
         else:

@@ -792,39 +792,55 @@ def ewma_sse(alpha, x, n_valid=None, *, interpret: bool = False):
 
 
 # ---------------------------------------------------------------------------
-# Holt-Winters additive smoothing (forward + hand-derived adjoint)
+# Holt-Winters smoothing, additive & multiplicative, ragged-aware
+# (forward + hand-derived adjoint)
 # ---------------------------------------------------------------------------
 #
-# Per series (reference HoltWinters.scala, additive; matches
-# models.holtwinters._run on a dense panel):
+# Per series (reference HoltWinters.scala; matches models.holtwinters._run
+# with a right-aligned valid span starting at zb).  Additive:
 #   pred_t = L_{t-1} + T_{t-1} + S_t          with S_t = ring[t mod m]
 #   L_t    = a (y_t - S_t) + (1-a)(L_{t-1} + T_{t-1})
 #   T_t    = b (L_t - L_{t-1}) + (1-b) T_{t-1}
 #   ring[t mod m] = g (y_t - L_t) + (1-g) S_t
-#   e_t    = [t >= m] * (y_t - pred_t)
-# The seasonal ring lives in a [m, 8, 128] VMEM scratch and simply persists
-# across time chunks.  Seeds (L_0, T_0, ring init) are computed OUTSIDE the
-# kernel from the first two seasons — they depend on the data only, so the
+# Multiplicative:
+#   pred_t = (L_{t-1} + T_{t-1}) * S_t
+#   L_t    = a y_t / S_t + (1-a)(L_{t-1} + T_{t-1})
+#   ring[t mod m] = g y_t / L_t + (1-g) S_t        (denominators eps-clamped)
+#   e_t    = [zb + m <= t < t_limit] * (y_t - pred_t)
+# State is frozen outside [zb, t_limit): the recursion effectively starts at
+# the first valid observation.  The ring is indexed by t mod m with PER-ROW
+# zb, so the caller pre-rotates the seed ring (seed element j lands at slot
+# (zb + j) mod m) — scratch indices must be scalar per block.
+#
+# The seasonal ring lives in a [m, 8, 128] VMEM scratch and persists across
+# time chunks.  Seeds (L_0, T_0, ring init) are computed OUTSIDE the kernel
+# from the first two valid seasons — they depend on the data only, so the
 # adjoint propagates to the three smoothing parameters alone.  Reverse pass
-# replays saved (L, T, S_old) trajectories with a ring of seasonal adjoints:
+# replays saved (L, T, S_old) trajectories with a ring of seasonal adjoints.
+# Additive (gp = -[live-err] gbar_t):
 #   vL        = uL + b uT - g uS
 #   da       += (y_t - S_t - L_{t-1} - T_{t-1}) vL
 #   db       += (L_t - L_{t-1} - T_{t-1}) uT
 #   dg       += (y_t - L_t - S_t) uS
 #   uL'       = -b uT + (1-a) vL + gp
 #   uT'       = (1-b) uT + (1-a) vL + gp
-#   rho[slot] = (1-g) uS - a vL + gp          with gp = -[t >= m] gbar_t
+#   rho[slot] = (1-g) uS - a vL + gp
+# Multiplicative replaces the pred/level/seasonal partials with the product
+# and quotient rules (S_t gp into the level/trend adjoints, (L+T) gp into
+# the ring, -a y/S^2 and -g y/L^2 quotient terms, eps-clamp subgradients).
 # Level/trend carries cross chunks through 1-slot scratches; both rings
 # (seasonal state forward, seasonal adjoint backward) persist untouched.
 
 
-def _hw_fwd_kernel(m, t_limit, cs, y_ref, par_ref, l0_ref, t0_ref, s0_ref,
-                   e_ref, lv_ref, tr_ref, so_ref, seas_ref, clt_ref):
+def _hw_fwd_kernel(m, mult, t_limit, cs, y_ref, par_ref, l0_ref, t0_ref,
+                   s0_ref, zb_ref, e_ref, lv_ref, tr_ref, so_ref, seas_ref,
+                   clt_ref):
     c = pl.program_id(1)
     base = c * cs
     a = par_ref[0]
     b = par_ref[1]
     g = par_ref[2]
+    zb = zb_ref[0]
 
     @pl.when(c == 0)
     def _():
@@ -836,30 +852,42 @@ def _hw_fwd_kernel(m, t_limit, cs, y_ref, par_ref, l0_ref, t0_ref, s0_ref,
     def body(tl, carry):
         level, trend = carry
         t = base + tl
+        tf = t.astype(jnp.float32)
         slot = lax.rem(t, jnp.asarray(m, t.dtype))
         s = seas_ref[slot]
-        pred = level + trend + s
-        e_ref[tl] = jnp.where((t >= m) & (t < t_limit), y_ref[tl] - pred, 0.0)
-        so_ref[tl] = s
         yt = y_ref[tl]
-        nl = a * (yt - s) + (1.0 - a) * (level + trend)
+        live = (tf >= zb) & (t < t_limit)
+        live_err = (tf >= zb + m) & (t < t_limit)
+        lt_sum = level + trend
+        if mult:
+            pred = lt_sum * s
+            nl = a * yt / jnp.maximum(s, 1e-12) + (1.0 - a) * lt_sum
+            snew = g * yt / jnp.maximum(nl, 1e-12) + (1.0 - g) * s
+        else:
+            pred = lt_sum + s
+            nl = a * (yt - s) + (1.0 - a) * lt_sum
+            snew = g * (yt - nl) + (1.0 - g) * s
         nt = b * (nl - level) + (1.0 - b) * trend
-        seas_ref[slot] = g * (yt - nl) + (1.0 - g) * s
-        lv_ref[tl] = nl
-        tr_ref[tl] = nt
-        return nl, nt
+        e_ref[tl] = jnp.where(live_err, yt - pred, 0.0)
+        so_ref[tl] = s
+        nl_o = jnp.where(live, nl, level)
+        nt_o = jnp.where(live, nt, trend)
+        seas_ref[slot] = jnp.where(live, snew, s)
+        lv_ref[tl] = nl_o
+        tr_ref[tl] = nt_o
+        return nl_o, nt_o
 
     level, trend = _fori(cs, body, (clt_ref[0], clt_ref[1]))
     clt_ref[0] = level
     clt_ref[1] = trend
 
 
-def _hw_bwd_kernel(m, t_limit, cs, nchunk, hp, *refs):
+def _hw_bwd_kernel(m, mult, t_limit, cs, nchunk, hp, *refs):
     if hp:
-        (y_ref, par_ref, l0_ref, t0_ref, lv_ref, lvp_ref, tr_ref, trp_ref,
-         so_ref, g_ref, gpar_ref, rho_ref, clam_ref) = refs
+        (y_ref, par_ref, l0_ref, t0_ref, zb_ref, lv_ref, lvp_ref, tr_ref,
+         trp_ref, so_ref, g_ref, gpar_ref, rho_ref, clam_ref) = refs
     else:
-        (y_ref, par_ref, l0_ref, t0_ref, lv_ref, tr_ref,
+        (y_ref, par_ref, l0_ref, t0_ref, zb_ref, lv_ref, tr_ref,
          so_ref, g_ref, gpar_ref, rho_ref, clam_ref) = refs
         lvp_ref = trp_ref = None
     c = pl.program_id(1)
@@ -867,6 +895,7 @@ def _hw_bwd_kernel(m, t_limit, cs, nchunk, hp, *refs):
     a = par_ref[0]
     b = par_ref[1]
     g = par_ref[2]
+    zb = zb_ref[0]
 
     @pl.when(c == 0)
     def _():
@@ -881,11 +910,14 @@ def _hw_bwd_kernel(m, t_limit, cs, nchunk, hp, *refs):
         lamL, lamT, da, db, dg = carry
         tl = cs - 1 - i
         t = base + tl
+        tf = t.astype(jnp.float32)
         slot = lax.rem(t, jnp.asarray(m, t.dtype))
+        live = (tf >= zb) & (t < t_limit)
+        live_err = (tf >= zb + m) & (t < t_limit)
         uS = rho_ref[slot]
         uL = lamL
         uT = lamT
-        gp = jnp.where((t >= m) & (t < t_limit), -g_ref[tl], 0.0)
+        gp = jnp.where(live_err, -g_ref[tl], 0.0)
         lfar = lvp_ref[cs - 1] if hp else 0.0
         lp = jnp.where(tl - 1 >= 0, lv_ref[jnp.maximum(tl - 1, 0)], lfar)
         lp = jnp.where(t - 1 >= 0, lp, l0_ref[0])
@@ -895,14 +927,37 @@ def _hw_bwd_kernel(m, t_limit, cs, nchunk, hp, *refs):
         so = so_ref[tl]
         lt = lv_ref[tl]
         yt = y_ref[tl]
-        vL = uL + b * uT - g * uS
-        da = da + (yt - so - lp - tp_) * vL
-        db = db + (lt - lp - tp_) * uT
-        dg = dg + (yt - lt - so) * uS
-        new_lamL = -b * uT + (1.0 - a) * vL + gp
-        new_lamT = (1.0 - b) * uT + (1.0 - a) * vL + gp
-        rho_ref[slot] = (1.0 - g) * uS - a * vL + gp
-        return new_lamL, new_lamT, da, db, dg
+        if mult:
+            sc = jnp.maximum(so, 1e-12)
+            ltc = jnp.maximum(lt, 1e-12)
+            # eps-clamp subgradients: no flow through a clamped denominator
+            s_pass = (so >= 1e-12).astype(jnp.float32)
+            l_pass = (lt >= 1e-12).astype(jnp.float32)
+            vL = uL + b * uT - g * (yt / (ltc * ltc)) * uS * l_pass
+            da_t = (yt / sc - lp - tp_) * vL
+            dg_t = (yt / ltc - so) * uS
+            new_lamL = -b * uT + (1.0 - a) * vL + so * gp
+            new_lamT = (1.0 - b) * uT + (1.0 - a) * vL + so * gp
+            rho_new = (
+                (1.0 - g) * uS
+                - a * (yt / (sc * sc)) * vL * s_pass
+                + (lp + tp_) * gp
+            )
+        else:
+            vL = uL + b * uT - g * uS
+            da_t = (yt - so - lp - tp_) * vL
+            dg_t = (yt - lt - so) * uS
+            new_lamL = -b * uT + (1.0 - a) * vL + gp
+            new_lamT = (1.0 - b) * uT + (1.0 - a) * vL + gp
+            rho_new = (1.0 - g) * uS - a * vL + gp
+        db_t = (lt - lp - tp_) * uT
+        da = da + jnp.where(live, da_t, 0.0)
+        db = db + jnp.where(live, db_t, 0.0)
+        dg = dg + jnp.where(live, dg_t, 0.0)
+        lamL_o = jnp.where(live, new_lamL, uL)
+        lamT_o = jnp.where(live, new_lamT, uT)
+        rho_ref[slot] = jnp.where(live, rho_new, uS)
+        return lamL_o, lamT_o, da, db, dg
 
     lamL, lamT, da, db, dg = lax.fori_loop(
         0, cs, body, (clam_ref[0], clam_ref[1], _ZERO(), _ZERO(), _ZERO())
@@ -914,13 +969,13 @@ def _hw_bwd_kernel(m, t_limit, cs, nchunk, hp, *refs):
     gpar_ref[2] = gpar_ref[2] + dg
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
-def _hw_e(interpret: bool, m: int, params, y, l0, t0, s0):
-    e, _ = _hw_e_fwd(interpret, m, params, y, l0, t0, s0)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _hw_e(interpret: bool, m: int, mult: bool, params, y, l0, t0, s0, zb):
+    e, _ = _hw_e_fwd(interpret, m, mult, params, y, l0, t0, s0, zb)
     return e
 
 
-def _hw_e_fwd(interpret, m, params, y, l0, t0, s0):
+def _hw_e_fwd(interpret, m, mult, params, y, l0, t0, s0, zb):
     b, t = y.shape
     tp, cs, nchunk = _time_layout(t)
     y3 = _fold(jnp.pad(y, ((0, 0), (0, tp - t))))
@@ -928,12 +983,13 @@ def _hw_e_fwd(interpret, m, params, y, l0, t0, s0):
     l03 = _fold(l0[:, None].astype(y.dtype))
     t03 = _fold(t0[:, None].astype(y.dtype))
     s03 = _fold(s0)
+    zb3 = _fold(zb.astype(y.dtype)[:, None])
     nblk = y3.shape[1] // _SUBL
     e3, lv3, tr3, so3 = pl.pallas_call(
-        functools.partial(_hw_fwd_kernel, m, t, cs),
+        functools.partial(_hw_fwd_kernel, m, mult, t, cs),
         grid=(nblk, nchunk),
         in_specs=[_bs(cs, _cur), _bs(3, _fixed), _bs(1, _fixed),
-                  _bs(1, _fixed), _bs(m, _fixed)],
+                  _bs(1, _fixed), _bs(m, _fixed), _bs(1, _fixed)],
         out_specs=[_bs(cs, _cur)] * 4,
         out_shape=[jax.ShapeDtypeStruct(y3.shape, y.dtype)] * 4,
         scratch_shapes=[
@@ -942,12 +998,12 @@ def _hw_e_fwd(interpret, m, params, y, l0, t0, s0):
         ],
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
-    )(y3, par3, l03, t03, s03)
-    return _unfold(e3, b)[:, :t], (y3, par3, l03, t03, lv3, tr3, so3, b, t)
+    )(y3, par3, l03, t03, s03, zb3)
+    return _unfold(e3, b)[:, :t], (y3, par3, l03, t03, zb3, lv3, tr3, so3, b, t)
 
 
-def _hw_e_bwd(interpret, m, res, g):
-    y3, par3, l03, t03, lv3, tr3, so3, b, t = res
+def _hw_e_bwd(interpret, m, mult, res, g):
+    y3, par3, l03, t03, zb3, lv3, tr3, so3, b, t = res
     tp = y3.shape[0]
     _, cs, nchunk = _time_layout(t)
     g3 = _fold(jnp.pad(g, ((0, 0), (0, tp - t))))
@@ -955,18 +1011,19 @@ def _hw_e_bwd(interpret, m, res, g):
     hp = nchunk > 1
     if hp:
         ins = [_bs(cs, _rev(nchunk)), _bs(3, _fixed), _bs(1, _fixed),
-               _bs(1, _fixed),
+               _bs(1, _fixed), _bs(1, _fixed),
                _bs(cs, _rev(nchunk)), _bs(cs, _rev_prev(nchunk)),
                _bs(cs, _rev(nchunk)), _bs(cs, _rev_prev(nchunk)),
                _bs(cs, _rev(nchunk)), _bs(cs, _rev(nchunk))]
-        args = (y3, par3, l03, t03, lv3, lv3, tr3, tr3, so3, g3)
+        args = (y3, par3, l03, t03, zb3, lv3, lv3, tr3, tr3, so3, g3)
     else:
         ins = [_bs(cs, _rev(nchunk)), _bs(3, _fixed), _bs(1, _fixed),
-               _bs(1, _fixed), _bs(cs, _rev(nchunk)), _bs(cs, _rev(nchunk)),
-               _bs(cs, _rev(nchunk)), _bs(cs, _rev(nchunk))]
-        args = (y3, par3, l03, t03, lv3, tr3, so3, g3)
+               _bs(1, _fixed), _bs(1, _fixed), _bs(cs, _rev(nchunk)),
+               _bs(cs, _rev(nchunk)), _bs(cs, _rev(nchunk)),
+               _bs(cs, _rev(nchunk))]
+        args = (y3, par3, l03, t03, zb3, lv3, tr3, so3, g3)
     gpar3 = pl.pallas_call(
-        functools.partial(_hw_bwd_kernel, m, t, cs, nchunk, hp),
+        functools.partial(_hw_bwd_kernel, m, mult, t, cs, nchunk, hp),
         grid=(nblk, nchunk),
         in_specs=ins,
         out_specs=_bs(3, _fixed),
@@ -984,18 +1041,232 @@ def _hw_e_bwd(interpret, m, res, g):
         jnp.zeros((b,), g.dtype),
         jnp.zeros((b,), g.dtype),
         jnp.zeros((b, m), g.dtype),
+        jnp.zeros((b,), g.dtype),
     )
 
 
 _hw_e.defvjp(_hw_e_fwd, _hw_e_bwd)
 
 
-@_scoped("pallas.hw_additive_sse")
-def hw_additive_sse(params, y, period: int, *, interpret: bool = False):
-    """Batched Holt-Winters additive one-step-ahead SSE ``[B]`` on a fused
-    kernel (dense panels only — matches ``models.holtwinters.sse`` with a
-    full valid span).  Differentiable in ``params``; the level/trend/seasonal
-    seeds come from the first two seasons and are constants of the objective.
+# ---------------------------------------------------------------------------
+# Fused fill-linear feature chain (forward-only transform, no adjoint)
+# ---------------------------------------------------------------------------
+#
+# The portable fills (ops.univariate.fill_linear) are built from FOUR
+# log2(T)-step associative scans — ~40 full-panel HBM round trips for the
+# fillLinear -> difference -> lag feature chain that the reference runs as
+# one per-series pass (UnivariateTimeSeries.fillLinear, SURVEY.md §2.1).
+# These kernels do what the reference's loop does, batched: ONE backward
+# sweep materializing (next-valid value, next-valid index) and ONE forward
+# sweep carrying (prev-valid value, prev-valid index, fill[t-1]) in VMEM,
+# emitting the filled series, its lag-1 difference, and its lag-1 shift in
+# the same pass — ~7 sequential array passes total, all gather-free.
+
+
+def _nextvalid_kernel(t_limit, cs, nchunk, y_ref, nv_ref, ni_ref, c_ref):
+    c = pl.program_id(1)
+    base = (nchunk - 1 - c) * cs
+
+    @pl.when(c == 0)
+    def _():
+        c_ref[0] = _ZERO()  # next-valid value (0 until one is seen)
+        c_ref[1] = jnp.full((_SUBL, _LANES), 1e30, jnp.float32)  # next index
+
+    def body(i, carry):
+        cnv, cni = carry
+        tl = cs - 1 - i
+        t = base + tl
+        yt = y_ref[tl]
+        valid = (yt == yt) & (t < t_limit)  # NaN != NaN
+        tf = t.astype(jnp.float32)
+        cnv = jnp.where(valid, yt, cnv)
+        cni = jnp.where(valid, tf, cni)
+        nv_ref[tl] = cnv
+        ni_ref[tl] = cni
+        return cnv, cni
+
+    cnv, cni = _fori(cs, body, (c_ref[0], c_ref[1]))
+    c_ref[0] = cnv
+    c_ref[1] = cni
+
+
+def _fillchain_kernel(t_limit, cs, chain, *refs):
+    if chain:
+        y_ref, nv_ref, ni_ref, f_ref, d_ref, l_ref, c_ref = refs
+    else:  # fill-only variant: skip the difference/lag stores entirely
+        y_ref, nv_ref, ni_ref, f_ref, c_ref = refs
+        d_ref = l_ref = None
+    c = pl.program_id(1)
+    base = c * cs
+    nan = jnp.float32(jnp.nan)
+
+    @pl.when(c == 0)
+    def _():
+        c_ref[0] = _ZERO()  # prev-valid value
+        c_ref[1] = jnp.full((_SUBL, _LANES), -1e30, jnp.float32)  # prev index
+        c_ref[2] = jnp.full((_SUBL, _LANES), nan, jnp.float32)  # fill[t-1]
+
+    def body(tl, carry):
+        pv, pi, fprev = carry
+        t = base + tl
+        tf = t.astype(jnp.float32)
+        yt = y_ref[tl]
+        valid = (yt == yt) & (t < t_limit)
+        interior = (pi >= 0.0) & (ni_ref[tl] < t_limit)
+        span = jnp.maximum(ni_ref[tl] - pi, 1.0)
+        w = (tf - pi) / span
+        interp = pv * (1.0 - w) + nv_ref[tl] * w
+        fill = jnp.where(valid, yt, jnp.where(interior, interp, nan))
+        f_ref[tl] = fill
+        if chain:
+            d_ref[tl] = fill - fprev  # NaN fprev poisons t=0 as required
+            l_ref[tl] = fprev
+        pv = jnp.where(valid, yt, pv)
+        pi = jnp.where(valid, tf, pi)
+        return pv, pi, fill
+
+    pv, pi, fprev = _fori(cs, body, (c_ref[0], c_ref[1], c_ref[2]))
+    c_ref[0] = pv
+    c_ref[1] = pi
+    c_ref[2] = fprev
+
+
+def _fill_linear_call(y, chain: bool, interpret: bool):
+    b, t = y.shape
+    tp, cs, nchunk = _time_layout(t)
+    # pad with NaN so padded tail positions read as invalid
+    y3 = _fold(jnp.pad(y, ((0, 0), (0, tp - t)), constant_values=jnp.nan))
+    nblk = y3.shape[1] // _SUBL
+    nv3, ni3 = pl.pallas_call(
+        functools.partial(_nextvalid_kernel, t, cs, nchunk),
+        grid=(nblk, nchunk),
+        in_specs=[_bs(cs, _rev(nchunk))],
+        out_specs=[_bs(cs, _rev(nchunk))] * 2,
+        out_shape=[jax.ShapeDtypeStruct(y3.shape, jnp.float32)] * 2,
+        scratch_shapes=[pltpu.VMEM((2, _SUBL, _LANES), jnp.float32)],
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(y3)
+    n_out = 3 if chain else 1
+    outs = pl.pallas_call(
+        functools.partial(_fillchain_kernel, t, cs, chain),
+        grid=(nblk, nchunk),
+        in_specs=[_bs(cs, _cur)] * 3,
+        out_specs=[_bs(cs, _cur)] * n_out,
+        out_shape=[jax.ShapeDtypeStruct(y3.shape, jnp.float32)] * n_out,
+        scratch_shapes=[pltpu.VMEM((3, _SUBL, _LANES), jnp.float32)],
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(y3, nv3, ni3)
+    outs = outs if chain else [outs]
+    return tuple(_unfold(o, b)[:, :t] for o in outs)
+
+
+@_scoped("pallas.fill_linear_chain")
+def fill_linear_chain(y, *, interpret: bool = False):
+    """Fused fillLinear -> (filled, lag-1 difference, lag-1 shift) on ``[B, T]``.
+
+    Matches ``vmap(fill_linear)``, ``vmap(differences_at_lag(., 1))`` and
+    ``vmap(lag(., 1))`` composed (same NaN semantics: edge NaNs survive the
+    fill; position 0 of the difference and the shift is NaN).
+    """
+    return _fill_linear_call(y, True, interpret)
+
+
+@_scoped("pallas.fill_linear")
+def fill_linear(y, *, interpret: bool = False):
+    """Batched linear-interpolation fill ``[B, T]`` on the fused kernel
+    (fill output only — no difference/lag stores)."""
+    return _fill_linear_call(y, False, interpret)[0]
+
+
+# ---------------------------------------------------------------------------
+# Fused multi-lag autocorrelation (forward-only transform, no adjoint)
+# ---------------------------------------------------------------------------
+#
+# autocorr(num_lags) reads the panel once: d_t = valid ? x_t - mean : 0 is
+# computed on the fly, the last ``num_lags`` d values stay in a VMEM ring,
+# and num_lags+1 accumulators (lag products + denominator) land in a
+# revisited output block — versus ~num_lags full-panel passes for the XLA
+# lowering of the vmapped kernel (ops.univariate.autocorr).  The mean is a
+# single cheap XLA reduction beforehand (it must complete before any
+# product term, so fusing it would force a second sequential sweep anyway).
+
+
+def _autocorr_kernel(nl, t_limit, cs, y_ref, mean_ref, acc_ref, dring_ref):
+    c = pl.program_id(1)
+    base = c * cs
+    mean = mean_ref[0]
+
+    @pl.when(c == 0)
+    def _():
+        for r in range(nl + 1):
+            acc_ref[r] = _ZERO()
+        for j in range(nl):
+            dring_ref[j] = _ZERO()
+
+    def body(tl, accs):
+        t = base + tl
+        yt = y_ref[tl]
+        valid = (yt == yt) & (t < t_limit)
+        d = jnp.where(valid, yt - mean, 0.0)
+        new = [accs[0] + d * d]  # denominator
+        for k_ in range(1, nl + 1):
+            # d_{t-k}: ring slot (t - k) mod nl; zero for t < k
+            dk = dring_ref[lax.rem(t - k_ + nl, jnp.asarray(nl, t.dtype))]
+            dk = jnp.where(t - k_ >= 0, dk, 0.0)
+            new.append(accs[k_] + d * dk)
+        dring_ref[lax.rem(t, jnp.asarray(nl, t.dtype))] = d
+        return tuple(new)
+
+    accs = _fori(cs, body, tuple(acc_ref[r] for r in range(nl + 1)))
+    for r in range(nl + 1):
+        acc_ref[r] = accs[r]
+
+
+@_scoped("pallas.batch_autocorr")
+def batch_autocorr(y, num_lags: int, *, interpret: bool = False):
+    """Batched sample autocorrelation ``[B, num_lags]`` on a fused kernel.
+
+    Matches ``vmap(ops.univariate.autocorr)`` (valid-sample mean/denominator
+    convention) to float tolerance.
+    """
+    if not 0 < num_lags < _CHUNK_T:
+        raise ValueError(f"num_lags must be in (0, {_CHUNK_T}), got {num_lags}")
+    b, t = y.shape
+    tp, cs, nchunk = _time_layout(t)
+    valid = ~jnp.isnan(y)
+    n = jnp.sum(valid, axis=1)
+    mean = jnp.sum(jnp.where(valid, y, 0.0), axis=1) / jnp.maximum(n, 1)
+    y3 = _fold(jnp.pad(y, ((0, 0), (0, tp - t)), constant_values=jnp.nan))
+    m3 = _fold(mean[:, None].astype(jnp.float32))
+    nblk = y3.shape[1] // _SUBL
+    acc3 = pl.pallas_call(
+        functools.partial(_autocorr_kernel, num_lags, t, cs),
+        grid=(nblk, nchunk),
+        in_specs=[_bs(cs, _cur), _bs(1, _fixed)],
+        out_specs=_bs(num_lags + 1, _fixed),
+        out_shape=jax.ShapeDtypeStruct(
+            (num_lags + 1, y3.shape[1], _LANES), jnp.float32
+        ),
+        scratch_shapes=[pltpu.VMEM((num_lags, _SUBL, _LANES), jnp.float32)],
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(y3, m3)
+    acc = _unfold(acc3, b)  # [B, num_lags + 1]
+    return acc[:, 1:] / acc[:, :1]
+
+
+@_scoped("pallas.hw_sse")
+def hw_sse(params, y, period: int, multiplicative: bool = False,
+           n_valid=None, *, interpret: bool = False):
+    """Batched Holt-Winters one-step-ahead SSE ``[B]`` on a fused kernel.
+
+    Matches ``models.holtwinters.sse`` (vmapped) for additive AND
+    multiplicative seasonality with a right-aligned valid span (``n_valid``,
+    see ``base.align_right``: the invalid prefix must already be zeroed).
+    Differentiable in ``params``; the level/trend/seasonal seeds come from
+    the first two valid seasons and are constants of the objective.
     """
     m = period
     if not hw_structural_ok(m):
@@ -1003,8 +1274,29 @@ def hw_additive_sse(params, y, period: int, *, interpret: bool = False):
             f"fused Holt-Winters kernel supports period <= {_CHUNK_T} "
             f"(got {m}); use backend='scan'"
         )
-    l0 = jnp.mean(y[:, :m], axis=1)
-    t0 = (jnp.mean(y[:, m : 2 * m], axis=1) - l0) / m
-    s0 = y[:, :m] - l0[:, None]
-    e = _hw_e(interpret, m, params, y, l0, t0, s0)
+    b, t = y.shape
+    if n_valid is None:
+        start = jnp.zeros((b,), jnp.int32)
+    else:
+        start = (t - n_valid).astype(jnp.int32)
+
+    # the ONE seed scheme (first two valid seasons) shared with the scan
+    # path — pallas/scan fit parity depends on these being identical
+    from ..models.holtwinters import _init_state
+
+    l0, t0, s0 = jax.vmap(
+        lambda yv, st: _init_state(yv, m, multiplicative, st)
+    )(y, start)
+    # the kernel's ring is indexed by t mod m (scratch indices are scalar per
+    # block, zb is per row): pre-rotate so seed element j sits at slot
+    # (start + j) mod m, i.e. ring[p] = s0[(p - start) mod m]
+    pos = (jnp.arange(m)[None, :] - start[:, None]) % m
+    s0r = jnp.take_along_axis(s0, pos, axis=1)
+    e = _hw_e(interpret, m, multiplicative, params, y, l0, t0, s0r,
+              start.astype(y.dtype))
     return jnp.sum(e * e, axis=1)
+
+
+def hw_additive_sse(params, y, period: int, *, interpret: bool = False):
+    """Additive dense-panel entry (kept for compatibility): see :func:`hw_sse`."""
+    return hw_sse(params, y, period, False, None, interpret=interpret)

@@ -51,6 +51,7 @@ __all__ = [
     "batched",
     "batch_autocorr",
     "batch_fill",
+    "batch_fill_linear_chain",
 ]
 
 
@@ -461,5 +462,67 @@ def batched(fn: Callable, *static_args, **static_kwargs) -> Callable:
     return jax.jit(lifted)
 
 
-batch_autocorr = functools.partial(batched, autocorr)
-batch_fill = lambda method: batched(fillts, method)  # noqa: E731
+def batch_autocorr(num_lags: int, backend: str = "auto") -> Callable:
+    """``[keys, time] -> [keys, num_lags]`` autocorrelation.
+
+    ``backend="auto"`` uses the fused single-pass Pallas kernel on TPU/f32
+    panels (``ops.pallas_kernels.batch_autocorr``; ~num_lags fewer HBM
+    passes than the vmapped lowering) and falls back to ``vmap(autocorr)``
+    everywhere else.  Both paths agree to float tolerance.
+    """
+    vmapped = batched(autocorr, num_lags)
+    if backend == "scan":
+        return vmapped
+
+    def run(panel):
+        from . import pallas_kernels as pk
+
+        if (
+            getattr(panel, "ndim", 0) == 2
+            and 0 < num_lags < pk._CHUNK_T
+            and pk.supported(panel.dtype, panel.shape[1])
+        ):
+            return pk.batch_autocorr(panel, num_lags)
+        return vmapped(panel)
+
+    # the branch reads only static shape/dtype/platform, so it resolves at
+    # trace time: callers get one compiled program either way
+    return jax.jit(run)
+
+
+def batch_fill(method: str, backend: str = "auto") -> Callable:
+    """``[keys, time] -> [keys, time]`` fill; pallas fast path for linear."""
+    vmapped = batched(fillts, method)
+    if method != "linear" or backend == "scan":
+        return vmapped
+
+    def run(panel):
+        from . import pallas_kernels as pk
+
+        if getattr(panel, "ndim", 0) == 2 and pk.supported(panel.dtype, panel.shape[1]):
+            return pk.fill_linear(panel)
+        return vmapped(panel)
+
+    return jax.jit(run)
+
+
+def batch_fill_linear_chain(panel, backend: str = "auto"):
+    """Fused fillLinear -> (filled, lag-1 difference, lag-1 shift) on a panel.
+
+    The feature-prep chain of SURVEY.md Section 6 config 2 as ONE device
+    program: the Pallas path (TPU/f32) does two sequential array sweeps
+    instead of four log2(T)-step associative scans plus three elementwise
+    passes; elsewhere the same chain runs as the composed portable kernels.
+    """
+    from . import pallas_kernels as pk
+
+    if (
+        backend != "scan"
+        and getattr(panel, "ndim", 0) == 2
+        and pk.supported(panel.dtype, panel.shape[1])
+    ):
+        return pk.fill_linear_chain(panel)
+    f = jax.vmap(fill_linear)(panel)
+    d = jax.vmap(lambda v: differences_at_lag(v, 1))(f)
+    lagged = jax.vmap(lambda v: lag(v, 1))(f)
+    return f, d, lagged

@@ -10,7 +10,11 @@ the first code path through ``init_distributed`` that actually executes
 only monkeypatched the environment detection).
 """
 
+import pathlib
 import sys
+
+# launched as a script: sys.path[0] is tests/, not the repo root
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 proc_id, nproc = int(sys.argv[1]), int(sys.argv[2])
 coordinator, out_path = sys.argv[3], sys.argv[4]

@@ -373,15 +373,74 @@ def test_hw_fit_backend_pallas_matches_scan():
     )
 
 
-def test_hw_fit_pallas_rejects_nan_and_multiplicative():
+def test_hw_multiplicative_sse_and_grad_matches_scan():
     from spark_timeseries_tpu.models import holtwinters as hw
 
-    y = np.array(_seasonal_panel(3, 60, 6, seed=34))
-    with pytest.raises(ValueError, match="additive"):
-        hw.fit(jnp.asarray(y), 6, "multiplicative", backend="pallas-interpret")
-    y[0, 0] = np.nan
-    with pytest.raises(ValueError, match="dense"):
-        hw.fit(jnp.asarray(y), 6, "additive", backend="pallas-interpret")
+    b, t, m = 4, 73, 7
+    y = _seasonal_panel(b, t, m, seed=35) + 25.0  # positive level
+    rng = np.random.default_rng(36)
+    params = jnp.asarray(rng.uniform(0.05, 0.9, (b, 3)).astype(np.float32))
+
+    ref = jax.vmap(lambda pr, v: hw.sse(pr, v, m, True))(params, y)
+    got = pk.hw_sse(params, y, m, True, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=1e-3)
+
+    def loss_scan(P):
+        return jnp.sum(jax.vmap(lambda pr, v: hw.sse(pr, v, m, True))(P, y))
+
+    def loss_pal(P):
+        return jnp.sum(pk.hw_sse(P, y, m, True, interpret=True))
+
+    g_ref = jax.grad(loss_scan)(params)
+    g_got = jax.grad(loss_pal)(params)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), rtol=1e-3, atol=1e-2)
+
+
+@pytest.mark.parametrize("mult", [False, True])
+def test_hw_ragged_sse_and_grad_matches_scan(mult):
+    from spark_timeseries_tpu.models import holtwinters as hw
+
+    b, t, m = 4, 80, 6
+    y = _seasonal_panel(b, t, m, seed=37) + (25.0 if mult else 0.0)
+    nv = jnp.asarray([t, t - 11, t - 29, t - 3], jnp.int32)
+    # right-aligned convention: zero the invalid prefix (align_right output)
+    tt = jnp.arange(t)[None, :]
+    y = jnp.where(tt >= (t - nv)[:, None], y, 0.0)
+    rng = np.random.default_rng(38)
+    params = jnp.asarray(rng.uniform(0.05, 0.9, (b, 3)).astype(np.float32))
+
+    ref = jax.vmap(lambda pr, v, n: hw.sse(pr, v, m, mult, n))(params, y, nv)
+    got = pk.hw_sse(params, y, m, mult, nv, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=1e-3)
+
+    def loss_scan(P):
+        return jnp.sum(jax.vmap(
+            lambda pr, v, n: hw.sse(pr, v, m, mult, n))(P, y, nv))
+
+    def loss_pal(P):
+        return jnp.sum(pk.hw_sse(P, y, m, mult, nv, interpret=True))
+
+    g_ref = jax.grad(loss_scan)(params)
+    g_got = jax.grad(loss_pal)(params)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), rtol=1e-3, atol=1e-2)
+
+
+def test_hw_fit_multiplicative_and_ragged_pallas_matches_scan():
+    from spark_timeseries_tpu.models import holtwinters as hw
+
+    b, t, m = 5, 96, 8
+    y = np.array(_seasonal_panel(b, t, m, seed=39)) + 25.0
+    y[1, :13] = np.nan  # ragged head
+    y[3, -9:] = np.nan  # ragged tail
+    y = jnp.asarray(y)
+    r_scan = hw.fit(y, m, "multiplicative", backend="scan", max_iters=40)
+    r_pal = hw.fit(y, m, "multiplicative", backend="pallas-interpret", max_iters=40)
+    both = np.asarray(r_scan.converged & r_pal.converged)
+    assert both.mean() > 0.5
+    np.testing.assert_allclose(
+        np.asarray(r_pal.params)[both], np.asarray(r_scan.params)[both],
+        rtol=5e-2, atol=5e-2,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -506,3 +565,64 @@ def test_structural_guards():
                            interpret=True)
     # auto never picks pallas for a structurally unsupported config
     assert resolve_backend("auto", jnp.float32, 100, structural_ok=False) == "scan"
+
+
+def _gappy(b, t, seed=0, edge_nans=True):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(b, t)).cumsum(axis=1).astype(np.float32)
+    gaps = rng.random(size=(b, t)) < 0.25
+    x[gaps] = np.nan
+    if edge_nans:
+        x[0, :3] = np.nan   # leading edge
+        x[1, -4:] = np.nan  # trailing edge
+        x[2, :] = np.nan    # all-NaN series
+    return jnp.asarray(x)
+
+
+@pytest.mark.parametrize("t", [37, 200])
+def test_fill_linear_chain_matches_portable(t):
+    from spark_timeseries_tpu.ops import univariate as uv
+
+    y = _gappy(6, t, seed=11)
+    f_ref = jax.vmap(uv.fill_linear)(y)
+    d_ref = jax.vmap(lambda v: uv.differences_at_lag(v, 1))(f_ref)
+    l_ref = jax.vmap(lambda v: uv.lag(v, 1))(f_ref)
+    f, d, lg = pk.fill_linear_chain(y, interpret=True)
+    np.testing.assert_allclose(np.asarray(f), np.asarray(f_ref), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), rtol=1e-6, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(l_ref), rtol=1e-6, atol=1e-6)
+
+
+def test_fill_linear_chain_chunked_long_series():
+    from spark_timeseries_tpu.ops import univariate as uv
+
+    # time axis spanning multiple VMEM chunks: carries must cross boundaries
+    y = _gappy(3, 2 * pk._CHUNK_T + 57, seed=12)
+    f_ref = jax.vmap(uv.fill_linear)(y)
+    f, d, lg = pk.fill_linear_chain(y, interpret=True)
+    np.testing.assert_allclose(np.asarray(f), np.asarray(f_ref), rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(d[:, 1:]), np.asarray((f_ref[:, 1:] - f_ref[:, :-1])),
+        rtol=1e-6, atol=1e-5,
+    )
+    np.testing.assert_allclose(np.asarray(lg[:, 1:]), np.asarray(f_ref[:, :-1]),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("t", [64, 333])
+def test_batch_autocorr_matches_portable(t):
+    from spark_timeseries_tpu.ops import univariate as uv
+
+    y = _gappy(5, t, seed=13, edge_nans=False)
+    ref = uv.batch_autocorr(7, backend="scan")(y)
+    got = pk.batch_autocorr(y, 7, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_batch_autocorr_chunked_long_series():
+    y = _gappy(3, pk._CHUNK_T + 100, seed=14, edge_nans=False)
+    from spark_timeseries_tpu.ops import univariate as uv
+
+    ref = uv.batch_autocorr(5, backend="scan")(y)
+    got = pk.batch_autocorr(y, 5, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
