@@ -462,8 +462,6 @@ def check_backend_parity(jnp, on_tpu):
     portable scan objectives ON DEVICE before any timing (ADVICE round 1)."""
     if not on_tpu:
         return {"checked": False, "reason": "no TPU; scan backend is the oracle"}
-    import jax
-
     from spark_timeseries_tpu.models import arima, ewma, garch
     from spark_timeseries_tpu.models import holtwinters as hw
 

@@ -147,10 +147,15 @@ def _fit_program(period, multiplicative, max_iters, tol, backend):
 
             interp = backend == "pallas-interpret"
 
+            # seeds are data-only: compute ONCE, not per objective call
+            # (vmapped seed slices are batched gathers — recomputed inside
+            # the loop they dominate an objective evaluation at panel scale)
+            seeds = pk.hw_seeds(ya, period, multiplicative, nv)
+
             def fb(u):
                 nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
-                return pk.hw_sse(
-                    nat, ya, period, multiplicative, nv, interpret=interp
+                return pk.hw_sse_seeded(
+                    nat, ya, seeds, period, multiplicative, interpret=interp
                 ) / n_err
 
             res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)

@@ -1257,16 +1257,47 @@ def batch_autocorr(y, num_lags: int, *, interpret: bool = False):
     return acc[:, 1:] / acc[:, :1]
 
 
+def hw_seeds(y, period: int, multiplicative: bool = False, n_valid=None):
+    """Level/trend/seasonal-ring seeds for :func:`hw_sse_seeded`.
+
+    Returns ``(l0, t0, s0r, zb)``: the first-two-valid-seasons seed scheme
+    shared with the scan path (``models.holtwinters._init_state`` — pallas/
+    scan fit parity depends on these being identical), with the seasonal
+    ring PRE-ROTATED for the kernel's ``t mod m`` indexing (scratch indices
+    are scalar per block, ``zb`` is per row): seed element ``j`` sits at
+    slot ``(start + j) mod m``, i.e. ``ring[p] = s0[(p - start) mod m]``.
+
+    Seeds depend on the data only — they are constants of the fit objective.
+    Compute them ONCE per fit and close over them: the vmapped dynamic
+    slices lower to batched gathers, expensive enough at panel scale to
+    dominate an objective evaluation if recomputed inside the optimizer.
+    """
+    m = period
+    b, t = y.shape
+    if n_valid is None:
+        start = jnp.zeros((b,), jnp.int32)
+    else:
+        start = (t - n_valid).astype(jnp.int32)
+    from ..models.holtwinters import _init_state
+
+    l0, t0, s0 = jax.vmap(
+        lambda yv, st: _init_state(yv, m, multiplicative, st)
+    )(y, start)
+    pos = (jnp.arange(m)[None, :] - start[:, None]) % m
+    s0r = jnp.take_along_axis(s0, pos, axis=1)
+    return l0, t0, s0r, start.astype(y.dtype)
+
+
 @_scoped("pallas.hw_sse")
-def hw_sse(params, y, period: int, multiplicative: bool = False,
-           n_valid=None, *, interpret: bool = False):
-    """Batched Holt-Winters one-step-ahead SSE ``[B]`` on a fused kernel.
+def hw_sse_seeded(params, y, seeds, period: int,
+                  multiplicative: bool = False, *, interpret: bool = False):
+    """Batched Holt-Winters one-step-ahead SSE ``[B]`` on a fused kernel,
+    with precomputed :func:`hw_seeds` — the fit-loop entry point.
 
     Matches ``models.holtwinters.sse`` (vmapped) for additive AND
-    multiplicative seasonality with a right-aligned valid span (``n_valid``,
-    see ``base.align_right``: the invalid prefix must already be zeroed).
-    Differentiable in ``params``; the level/trend/seasonal seeds come from
-    the first two valid seasons and are constants of the objective.
+    multiplicative seasonality with a right-aligned valid span (the invalid
+    prefix of ``y`` must already be zeroed — ``base.align_right``).
+    Differentiable in ``params``; the seeds are constants of the objective.
     """
     m = period
     if not hw_structural_ok(m):
@@ -1274,27 +1305,23 @@ def hw_sse(params, y, period: int, multiplicative: bool = False,
             f"fused Holt-Winters kernel supports period <= {_CHUNK_T} "
             f"(got {m}); use backend='scan'"
         )
-    b, t = y.shape
-    if n_valid is None:
-        start = jnp.zeros((b,), jnp.int32)
-    else:
-        start = (t - n_valid).astype(jnp.int32)
-
-    # the ONE seed scheme (first two valid seasons) shared with the scan
-    # path — pallas/scan fit parity depends on these being identical
-    from ..models.holtwinters import _init_state
-
-    l0, t0, s0 = jax.vmap(
-        lambda yv, st: _init_state(yv, m, multiplicative, st)
-    )(y, start)
-    # the kernel's ring is indexed by t mod m (scratch indices are scalar per
-    # block, zb is per row): pre-rotate so seed element j sits at slot
-    # (start + j) mod m, i.e. ring[p] = s0[(p - start) mod m]
-    pos = (jnp.arange(m)[None, :] - start[:, None]) % m
-    s0r = jnp.take_along_axis(s0, pos, axis=1)
-    e = _hw_e(interpret, m, multiplicative, params, y, l0, t0, s0r,
-              start.astype(y.dtype))
+    l0, t0, s0r, zb = seeds
+    e = _hw_e(interpret, m, multiplicative, params, y, l0, t0, s0r, zb)
     return jnp.sum(e * e, axis=1)
+
+
+def hw_sse(params, y, period: int, multiplicative: bool = False,
+           n_valid=None, *, interpret: bool = False):
+    """One-shot entry: compute seeds then the SSE (tests / single calls).
+    Inside an optimizer loop use :func:`hw_seeds` + :func:`hw_sse_seeded`."""
+    if not hw_structural_ok(period):  # before seeds: a clear error, not a
+        raise ValueError(             # dynamic_slice TypeError from the seed
+            f"fused Holt-Winters kernel supports period <= {_CHUNK_T} "
+            f"(got {period}); use backend='scan'"
+        )
+    seeds = hw_seeds(y, period, multiplicative, n_valid)
+    return hw_sse_seeded(params, y, seeds, period, multiplicative,
+                         interpret=interpret)
 
 
 def hw_additive_sse(params, y, period: int, *, interpret: bool = False):

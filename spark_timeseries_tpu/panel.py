@@ -168,6 +168,18 @@ def _cached_batched(fn: Callable, *args) -> Callable:
     return call_then_cache
 
 
+@functools.lru_cache(maxsize=8)
+def _fused_fill_linear() -> Callable:
+    """Memoized backend-dispatching linear fill (one jitted callable)."""
+    return uv.batch_fill("linear")
+
+
+@functools.lru_cache(maxsize=32)
+def _fused_autocorr(num_lags: int) -> Callable:
+    """Memoized backend-dispatching autocorrelation (one per lag count)."""
+    return uv.batch_autocorr(num_lags)
+
+
 class TimeSeriesPanel:
     """A collection of series sharing one ``DateTimeIndex``.
 
@@ -289,6 +301,11 @@ class TimeSeriesPanel:
         return self._like(out, index=idx)
 
     def fill(self, method: str, value=None) -> "TimeSeriesPanel":
+        # single-host linear fill takes the fused Pallas sweep when the
+        # platform supports it (the dispatcher falls back to the vmapped
+        # kernel otherwise); sharded panels keep the GSPMD vmap path
+        if method == "linear" and self.mesh is None:
+            return self._like(_fused_fill_linear()(self.values))
         return self._apply(uv.fillts, method, value)
 
     def differences(self, lag: int = 1) -> "TimeSeriesPanel":
@@ -305,7 +322,10 @@ class TimeSeriesPanel:
 
     def autocorr(self, num_lags: int) -> jax.Array:
         """``[n_series, num_lags]`` sample autocorrelations."""
-        out = _cached_batched(uv.autocorr, num_lags)(self.values)
+        if self.mesh is None:  # fused single-pass kernel where supported
+            out = _fused_autocorr(num_lags)(self.values)
+        else:
+            out = _cached_batched(uv.autocorr, num_lags)(self.values)
         return out[: self.n_series]
 
     def pacf(self, num_lags: int) -> jax.Array:

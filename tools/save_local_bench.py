@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Capture a local bench run as ``BENCH_LOCAL.json``.
+
+Pipe a full ``bench.py`` run through this to record its output in the same
+``{"tail": ...}`` shape as the driver's ``BENCH_r*.json`` artifacts, so
+``tools/gen_readme_perf.py`` can regenerate the README table from
+current-code numbers between driver rounds (provenance is labeled in the
+generated table):
+
+    python bench.py 2>&1 | python tools/save_local_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main():
+    text = sys.stdin.read()
+    sys.stdout.write(text)  # pass through for the terminal
+    # record the actual platform so the README provenance cannot claim TPU
+    # numbers for a CPU run
+    on_tpu = bool(re.search(r"platform=(tpu|axon)", text))
+    out = ROOT / "BENCH_LOCAL.json"
+    out.write_text(json.dumps({
+        "provenance": "local builder run (not a driver artifact)",
+        "platform": "tpu" if on_tpu else "cpu-or-unknown",
+        "cmd": "python bench.py",
+        "tail": text[-8192:],
+    }, indent=2) + "\n")
+    print(f"[save_local_bench] wrote {out.name} (platform="
+          f"{'tpu' if on_tpu else 'cpu-or-unknown'})", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
