@@ -87,6 +87,52 @@ def debatch(x, single: bool):
     return jax.tree.map(lambda a: a[0], x) if single else x
 
 
+def align_mode_on_host(yb) -> str:
+    """Static alignment mode for a fit program: how much work the per-row
+    right-alignment actually needs on THIS panel.
+
+    - ``"dense"``: no NaNs anywhere — alignment is the identity.
+    - ``"no-trailing"``: every series is valid at the last position, so the
+      valid span already ENDS at T-1 (leading-NaN ragged series, the common
+      different-start-dates panel): alignment is just prefix zeroing —
+      no roll.
+    - ``"general"``: trailing NaNs exist somewhere — the full per-row roll.
+
+    Decided OUTSIDE the jitted program because the roll is the expensive
+    part: vmapped ``jnp.roll`` lowers to a batched gather that costs more at
+    panel scale (~0.4 s at 100k x 1k) than the entire L-BFGS loop.  The
+    check is one fused reduction + one host sync.  Traced inputs (``fit``
+    called under jit) can't be inspected and take the general path.
+    """
+    if isinstance(yb, jax.core.Tracer):
+        return "general"
+    nan_any, nan_last = _nan_probe(yb)
+    if not bool(nan_any):
+        return "dense"
+    return "no-trailing" if not bool(nan_last) else "general"
+
+
+@jax.jit  # module-level: one compile per shape, not per call
+def _nan_probe(v):
+    return jnp.any(jnp.isnan(v)), jnp.any(jnp.isnan(v[:, -1]))
+
+
+def maybe_align(yb, mode: str):
+    """``(aligned, n_valid)`` under a static :func:`align_mode_on_host` mode."""
+    if mode == "dense":
+        return yb, jnp.full((yb.shape[0],), yb.shape[1], jnp.int32)
+    if mode == "no-trailing":
+        valid = ~jnp.isnan(yb)
+        # interior NaNs are zero-filled exactly as align_right does
+        first = jnp.argmax(valid, axis=1)
+        nv = yb.shape[1] - first
+        t = jnp.arange(yb.shape[1])[None, :]
+        ya = jnp.where(t >= first[:, None], jnp.nan_to_num(yb), 0.0)
+        return ya, nv.astype(jnp.int32)
+    ya, nv = jax.vmap(align_right)(yb)
+    return ya, nv.astype(jnp.int32)
+
+
 def align_right(y: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Shift a series' valid span to END at the last position -> ``(y', n_valid)``.
 

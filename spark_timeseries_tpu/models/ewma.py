@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import (FitResult, align_right, debatch, ensure_batched,
+from .base import (FitResult, align_mode_on_host, align_right, debatch,
+                   ensure_batched, maybe_align,
                    jit_program, resolve_backend)
 
 
@@ -85,13 +86,16 @@ def fit(y, *, max_iters: int = 40, tol: Optional[float] = None,
     if tol is None:
         tol = 1e-8 if yb.dtype == jnp.float64 else 1e-4
     backend = resolve_backend(backend, yb.dtype, yb.shape[1])
-    return debatch(_fit_program(max_iters, float(tol), backend)(yb), single)
+    return debatch(
+        _fit_program(max_iters, float(tol), backend, align_mode_on_host(yb))(yb),
+        single,
+    )
 
 
 @jit_program
-def _fit_program(max_iters, tol, backend):
+def _fit_program(max_iters, tol, backend, align_mode="general"):
     def run(yb):
-        ya, nv = jax.vmap(align_right)(yb)
+        ya, nv = maybe_align(yb, align_mode)
 
         u0 = jnp.zeros((yb.shape[0], 1), yb.dtype)
         # optimize the MEAN squared error (see models.arima: same argmin,

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import (FitResult, align_right, debatch, ensure_batched,
+from .base import (FitResult, align_mode_on_host, align_right, debatch,
+                   ensure_batched, maybe_align,
                    jit_program, resolve_backend)
 
 
@@ -132,13 +133,16 @@ def fit(r, *, max_iters: int = 80, tol: Optional[float] = None,
     if tol is None:
         tol = 1e-7 if rb.dtype == jnp.float64 else 1e-4
     backend = resolve_backend(backend, rb.dtype, rb.shape[1])
-    return debatch(_fit_program(max_iters, float(tol), backend)(rb), single)
+    return debatch(
+        _fit_program(max_iters, float(tol), backend, align_mode_on_host(rb))(rb),
+        single,
+    )
 
 
 @jit_program
-def _fit_program(max_iters, tol, backend):
+def _fit_program(max_iters, tol, backend, align_mode="general"):
     def run(rb):
-        ra, nv = jax.vmap(align_right)(rb)
+        ra, nv = maybe_align(rb, align_mode)
 
         # moment-ish start: omega = 0.1*var, alpha=0.1, beta=0.8
         var0 = jax.vmap(_masked_var)(ra, nv)

@@ -21,7 +21,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import (FitResult, align_right, debatch, ensure_batched,
+from .base import (FitResult, align_mode_on_host, align_right, debatch,
+                   ensure_batched, maybe_align,
                    jit_program, resolve_backend)
 
 
@@ -124,15 +125,17 @@ def fit(
     backend = resolve_backend(backend, yb.dtype, yb.shape[1],
                               structural_ok=pk.hw_structural_ok(period))
     return debatch(
-        _fit_program(period, multiplicative, max_iters, float(tol), backend)(yb),
+        _fit_program(period, multiplicative, max_iters, float(tol), backend,
+                     align_mode_on_host(yb))(yb),
         single,
     )
 
 
 @jit_program
-def _fit_program(period, multiplicative, max_iters, tol, backend):
+def _fit_program(period, multiplicative, max_iters, tol, backend,
+                 align_mode="general"):
     def run(yb):
-        ya, nv = jax.vmap(align_right)(yb)
+        ya, nv = maybe_align(yb, align_mode)
 
         nat0 = jnp.asarray([0.3, 0.1, 0.1], yb.dtype)
         u0 = jnp.broadcast_to(

@@ -1181,6 +1181,190 @@ def fill_linear(y, *, interpret: bool = False):
 
 
 # ---------------------------------------------------------------------------
+# Fused Hannan-Rissanen moment kernels (forward-only, no adjoint)
+# ---------------------------------------------------------------------------
+#
+# The ARIMA fit's startup values come from two weighted OLS stages
+# (models.arima.hannan_rissanen).  Their normal equations need only masked
+# lagged inner products of the series (and of the stage-1 residuals) — a
+# handful of [B] moments.  The XLA construction (hannan_rissanen_batched)
+# assembles them from ~30 shifted-elementwise-reduce passes over the panel;
+# here each stage is ONE sweep with lag rings in VMEM and the moment
+# accumulators in a revisited output block, after which XLA solves the tiny
+# [k, k] systems.  Stage 2 recomputes the stage-1 residuals on the fly from
+# beta1 (no [B, T] residual array ever lands in HBM).
+
+
+def _hr_kernel(lag_y, lag_e, intercept, woff, beta_m, t_limit, cs, *refs):
+    """Shared moment-sweep body.  Column streams at step t:
+    ``[1 (if intercept), y_{t-1}..y_{t-lag_y}, e_{t-1}..e_{t-lag_e}]``
+    where ``e`` is the on-the-fly AR(beta_m) residual (stage 2 only,
+    ``lag_e > 0``).  Accumulates sum(w * c_a * c_b) for a <= b and
+    sum(w * c_a * y_t) with w = [zb + woff <= t < t_limit]."""
+    if lag_e:
+        y_ref, zb_ref, beta_ref, acc_ref, yring_ref, ering_ref = refs
+    else:
+        y_ref, zb_ref, acc_ref, yring_ref = refs
+        beta_ref = ering_ref = None
+    c = pl.program_id(1)
+    base = c * cs
+    zb = zb_ref[0]
+    ncols = int(intercept) + lag_y + lag_e
+    nacc = ncols * (ncols + 1) // 2 + ncols
+    ydepth = max(lag_y, beta_m, 1)
+    edepth = max(lag_e, 1)
+
+    @pl.when(c == 0)
+    def _():
+        for r_ in range(nacc):
+            acc_ref[r_] = _ZERO()
+        for j in range(ydepth):
+            yring_ref[j] = _ZERO()
+        if lag_e:
+            for j in range(edepth):
+                ering_ref[j] = _ZERO()
+
+    def body(tl, accs):
+        t = base + tl
+        tf = t.astype(jnp.float32)
+        yt = y_ref[tl]
+        w = ((tf >= zb + woff) & (t < t_limit)).astype(jnp.float32)
+
+        def ylag(i):
+            v = yring_ref[lax.rem(t - i + ydepth, jnp.asarray(ydepth, t.dtype))]
+            return jnp.where(t - i >= 0, v, 0.0)
+
+        cols = []
+        if intercept:
+            cols.append(None)  # the constant-1 stream, handled symbolically
+        for i in range(1, lag_y + 1):
+            cols.append(ylag(i))
+        if lag_e:
+            # stage-1 residual at t (zero outside its own live window)
+            w1 = ((tf >= zb + beta_m) & (t < t_limit)).astype(jnp.float32)
+            pred = beta_ref[0]
+            for i in range(1, beta_m + 1):
+                pred += beta_ref[i] * ylag(i)
+            et = w1 * (yt - pred)
+            for j in range(1, lag_e + 1):
+                v = ering_ref[lax.rem(t - j + edepth, jnp.asarray(edepth, t.dtype))]
+                cols.append(jnp.where(t - j >= 0, v, 0.0))
+
+        def cval(a):
+            return 1.0 if cols[a] is None else cols[a]
+
+        new = []
+        r_ = 0
+        for a in range(ncols):
+            for b_ in range(a, ncols):
+                ca, cb = cval(a), cval(b_)
+                prod = w if (cols[a] is None and cols[b_] is None) else (
+                    w * cb if cols[a] is None else
+                    (w * ca if cols[b_] is None else w * ca * cb)
+                )
+                new.append(accs[r_] + prod)
+                r_ += 1
+        for a in range(ncols):
+            ca = cval(a)
+            prod = w * yt if cols[a] is None else w * ca * yt
+            new.append(accs[r_] + prod)
+            r_ += 1
+
+        yring_ref[lax.rem(t, jnp.asarray(ydepth, t.dtype))] = yt
+        if lag_e:
+            ering_ref[lax.rem(t, jnp.asarray(edepth, t.dtype))] = et
+        return tuple(new)
+
+    accs = _fori(cs, body, tuple(acc_ref[r_] for r_ in range(nacc)))
+    for r_ in range(nacc):
+        acc_ref[r_] = accs[r_]
+
+
+def _hr_moments(y3, zb3, t, cs, nchunk, nblk, lag_y, lag_e, intercept,
+                woff, beta_m, beta3, interpret):
+    ncols = int(intercept) + lag_y + lag_e
+    nacc = ncols * (ncols + 1) // 2 + ncols
+    ydepth = max(lag_y, beta_m, 1)
+    ins = [_bs(cs, _cur), _bs(1, _fixed)]
+    args = [y3, zb3]
+    scratch = [pltpu.VMEM((ydepth, _SUBL, _LANES), jnp.float32)]
+    if lag_e:
+        ins.append(_bs(beta_m + 1, _fixed))
+        args.append(beta3)
+        scratch.append(pltpu.VMEM((max(lag_e, 1), _SUBL, _LANES), jnp.float32))
+    return pl.pallas_call(
+        functools.partial(_hr_kernel, lag_y, lag_e, intercept, woff, beta_m,
+                          t, cs),
+        grid=(nblk, nchunk),
+        in_specs=ins,
+        out_specs=_bs(nacc, _fixed),
+        out_shape=jax.ShapeDtypeStruct((nacc, y3.shape[1], _LANES), jnp.float32),
+        scratch_shapes=scratch,
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(*args)
+
+
+def _solve_moments(acc, ncols, dtype, ridge=1e-8):
+    """[B, nacc] moment rows -> ridge-stabilized OLS solutions [B, ncols]
+    (the ONE stabilization rule: utils.linalg.ridge_solve)."""
+    from ..utils.linalg import ridge_solve
+
+    b = acc.shape[0]
+    XtX = jnp.zeros((b, ncols, ncols), dtype)
+    r_ = 0
+    for a in range(ncols):
+        for b_ in range(a, ncols):
+            XtX = XtX.at[:, a, b_].set(acc[:, r_])
+            if a != b_:
+                XtX = XtX.at[:, b_, a].set(acc[:, r_])
+            r_ += 1
+    Xty = acc[:, r_ : r_ + ncols]
+    return ridge_solve(XtX, Xty, ridge)
+
+
+def hr_structural_ok(p: int, q: int) -> bool:
+    """Ring depths must stay tiny (VMEM planes grow O((p+q)^2))."""
+    return 0 <= p <= 8 and 0 <= q <= 8
+
+
+@_scoped("pallas.hr_init")
+def hr_init(yd, order: Order, include_intercept: bool, n_valid=None, *,
+            interpret: bool = False):
+    """Batched Hannan-Rissanen startup values ``[B, k]`` on fused kernels.
+
+    Matches ``models.arima.hannan_rissanen_batched`` (identical weighted
+    normal equations and ridge stabilization) in two panel sweeps: stage-1
+    AR(m) moments -> solve -> stage-2 moments with on-the-fly residuals ->
+    solve.  ``yd``: differenced panel with the invalid prefix zeroed.
+    """
+    p, _, q = order
+    if not hr_structural_ok(p, q):
+        raise ValueError(f"fused HR kernel supports p, q <= 8 (got {p}, {q})")
+    b, t = yd.shape
+    n = t
+    m = min(p + q + 1, max(n // 4, 1))
+    nv = jnp.full((b,), n, jnp.int32) if n_valid is None else n_valid
+    zb = (n - nv).astype(yd.dtype)
+    tp, cs, nchunk = _time_layout(t)
+    y3 = _fold(jnp.pad(yd, ((0, 0), (0, tp - t))))
+    zb3 = _fold(zb[:, None])
+    nblk = y3.shape[1] // _SUBL
+
+    acc1 = _hr_moments(y3, zb3, t, cs, nchunk, nblk, m, 0, True, m, 0, None,
+                       interpret)
+    beta1 = _solve_moments(_unfold(acc1, b), m + 1, yd.dtype)  # [B, m+1]
+
+    ncols2 = int(include_intercept) + p + q
+    if ncols2 == 0:
+        return jnp.zeros((b, 0), yd.dtype)
+    beta3 = _fold(beta1)
+    acc2 = _hr_moments(y3, zb3, t, cs, nchunk, nblk, p, q, include_intercept,
+                       m + q, m, beta3, interpret)
+    return _solve_moments(_unfold(acc2, b), ncols2, yd.dtype)
+
+
+# ---------------------------------------------------------------------------
 # Fused multi-lag autocorrelation (forward-only transform, no adjoint)
 # ---------------------------------------------------------------------------
 #

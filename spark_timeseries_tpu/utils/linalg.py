@@ -11,6 +11,22 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def ridge_solve(XtX, Xty, ridge: float = 1e-8):
+    """Solve normal equations with THE scaled-ridge stabilization rule.
+
+    Single source of ``scale = max(trace/k, 1)``; every OLS construction in
+    the tree (design-matrix, shifted-column, and pallas-moment paths) must
+    funnel through here so the backends stay numerically identical.
+    Supports leading batch dims: ``XtX [..., k, k]``, ``Xty [..., k]``.
+    """
+    k = XtX.shape[-1]
+    scale = jnp.maximum(jnp.trace(XtX, axis1=-2, axis2=-1) / k, 1.0)
+    eye = jnp.eye(k, dtype=XtX.dtype)
+    return jnp.linalg.solve(
+        XtX + (ridge * scale)[..., None, None] * eye, Xty[..., None]
+    )[..., 0]
+
+
 def ols(X, y, ridge: float = 1e-8):
     """OLS coefficients via ridge-stabilized normal equations.
 
@@ -18,7 +34,4 @@ def ols(X, y, ridge: float = 1e-8):
     solve is far cheaper than SVD-based lstsq and batches perfectly under
     vmap; the scaled ridge keeps rank-deficient designs finite.
     """
-    XtX = X.T @ X
-    k = XtX.shape[0]
-    scale = jnp.maximum(jnp.trace(XtX) / k, 1.0)
-    return jnp.linalg.solve(XtX + ridge * scale * jnp.eye(k, dtype=X.dtype), X.T @ y)
+    return ridge_solve(X.T @ X, X.T @ y, ridge)

@@ -170,3 +170,29 @@ class TestForecastEffects:
         res = arima.fit(jnp.asarray(y), (1, 0, 0))
         aic = float(arima.approx_aic(res.params, jnp.asarray(y), (1, 0, 0), True))
         assert np.isfinite(aic)
+
+
+def test_hannan_rissanen_batched_matches_vmapped():
+    # the whole-batch lagged-product construction must reproduce the
+    # per-series design-matrix OLS exactly (same weighted normal equations)
+    from spark_timeseries_tpu.models.arima import (hannan_rissanen,
+                                                   hannan_rissanen_batched)
+
+    rng = np.random.default_rng(5)
+    b, t = 6, 120
+    e = rng.normal(size=(b, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, t):
+        y[:, i] = 0.55 * y[:, i - 1] + e[:, i] + 0.25 * e[:, i - 1]
+    nvd = jnp.asarray([t, t - 7, t - 23, t, t - 1, t - 50], jnp.int32)
+    tt = jnp.arange(t)[None, :]
+    yz = jnp.where(tt >= (t - nvd)[:, None], jnp.asarray(y), 0.0)
+
+    for order, intercept in [((1, 0, 1), True), ((2, 0, 1), False), ((1, 0, 0), True)]:
+        ref = jax.vmap(
+            lambda v, n: hannan_rissanen(v, order, intercept, n)
+        )(yz, nvd)
+        got = hannan_rissanen_batched(yz, order, intercept, nvd)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
