@@ -189,6 +189,112 @@ def sp_ewma_smooth(block: jax.Array, alpha: jax.Array) -> jax.Array:
     return decay * entering[:, None] + p
 
 
+def _carry_fold_across_shards(exit_v, exit_i, exit_f, reverse: bool):
+    """Combine per-shard "latest valid (value, index)" summaries into the
+    carry ENTERING each shard: a tiny fold over the all-gathered exits
+    (``nshards`` elements per series), rightmost-valid-wins — or
+    leftmost-valid-wins when walking ``reverse`` for the next-valid side."""
+    # exits arrive as [k, 1] columns -> gathered [k, nshards] in shard order
+    gv = lax.all_gather(exit_v, TIME_AXIS, axis=1, tiled=True)
+    gi = lax.all_gather(exit_i, TIME_AXIS, axis=1, tiled=True)
+    gf = lax.all_gather(exit_f, TIME_AXIS, axis=1, tiled=True)
+    if reverse:
+        gv, gi, gf = gv[:, ::-1], gi[:, ::-1], gf[:, ::-1]
+
+    def fold(c, x):
+        cv, ci, cf = c
+        xv, xi, xf = x
+        nv = jnp.where(xf, xv, cv)
+        ni = jnp.where(xf, xi, ci)
+        nf = xf | cf
+        return (nv, ni, nf), (nv, ni, nf)
+
+    _, (cv, ci, cf) = lax.scan(
+        fold,
+        (jnp.zeros_like(gv[:, 0]), jnp.zeros_like(gi[:, 0]),
+         jnp.zeros_like(gf[:, 0])),
+        (gv.T, gi.T, gf.T),
+    )
+    # carries[j] = combined summary of shards 0..j (walk order); entering
+    # shard j is carries[j-1] (none for the walk's first shard)
+    cv, ci, cf = cv.T, ci.T, cf.T  # [k, nshards]
+    idx = _axis_index()
+    nshards = _axis_size()
+    pos = (nshards - 1 - idx) if reverse else idx
+    first = pos == 0
+    prev = jnp.maximum(pos - 1, 0)
+    ev = jnp.where(first, jnp.zeros_like(cv[:, 0]), cv[:, prev])
+    ei = jnp.where(first, jnp.zeros_like(ci[:, 0]), ci[:, prev])
+    ef = jnp.where(first, False, cf[:, prev])
+    return ev, ei, ef
+
+
+def sp_fill_linear(block: jax.Array) -> jax.Array:
+    """Linear-interpolation fill of time-sharded series (matches
+    ``univariate.fill_linear`` on unsharded data: interior NaN gaps are
+    interpolated between the GLOBAL bracketing valid points — which may live
+    on other shards — and edge NaNs survive).
+
+    Per shard: the gather-free prev/next-valid associative scans of the
+    unsharded kernel run locally with global indices; each shard's exit
+    summary (latest/earliest valid value + index) is all-gathered and folded
+    into the entering carry — the prefix-combine trick of :func:`sp_cumsum`
+    generalized to the "nearest valid observation" semigroup.
+    """
+    k, tl = block.shape
+    idx = _axis_index()
+    t0 = idx * tl
+    # indices stay int32 end to end: f32 cannot represent positions beyond
+    # 2^24, exactly the long-series regime this module exists for — only
+    # the SMALL differences (t - prev_idx, span) are cast for the weights
+    gpos = (t0 + jnp.arange(tl, dtype=jnp.int32))[None, :]
+    valid = ~jnp.isnan(block)
+    vals = jnp.where(valid, jnp.nan_to_num(block), 0.0)
+    gidx = jnp.where(valid, jnp.broadcast_to(gpos, (k, tl)), 0)
+
+    def comb(a, b):
+        av, ai, af = a
+        bv, bi, bf = b
+        return (jnp.where(bf, bv, av), jnp.where(bf, bi, ai), af | bf)
+
+    pv, pi, pf = lax.associative_scan(comb, (vals, gidx, valid), axis=1)
+    nv, ni, nf = lax.associative_scan(comb, (vals, gidx, valid), axis=1, reverse=True)
+
+    epv, epi, epf = _carry_fold_across_shards(
+        pv[:, -1:], pi[:, -1:], pf[:, -1:], False
+    )
+    env, eni, enf = _carry_fold_across_shards(
+        nv[:, :1], ni[:, :1], nf[:, :1], True
+    )
+
+    pv = jnp.where(pf, pv, epv[:, None])
+    pi = jnp.where(pf, pi, epi[:, None])
+    pf = pf | epf[:, None]
+    nv = jnp.where(nf, nv, env[:, None])
+    ni = jnp.where(nf, ni, eni[:, None])
+    nf = nf | enf[:, None]
+
+    interior = pf & nf
+    span = jnp.maximum(ni - pi, 1).astype(block.dtype)
+    w = (gpos - pi).astype(block.dtype) / span
+    interp = pv * (1.0 - w) + nv * w
+    nan = jnp.asarray(jnp.nan, block.dtype)
+    return jnp.where(valid, block, jnp.where(interior, interp, nan))
+
+
+def sp_fill_linear_chain(block: jax.Array):
+    """Time-sharded fillLinear -> (filled, lag-1 difference, lag-1 shift):
+    the distributed form of ``univariate.batch_fill_linear_chain`` (the lag
+    crosses shard boundaries through a 1-column halo exchange)."""
+    f = sp_fill_linear(block)
+    halo = _halo_from_left(f, 1)
+    lagged = jnp.concatenate([halo, f], axis=1)[:, : block.shape[1]]
+    t0 = _axis_index() * block.shape[1]
+    gpos = t0 + jnp.arange(block.shape[1])
+    lagged = jnp.where(gpos[None, :] < 1, jnp.nan, lagged)
+    return f, f - lagged, lagged
+
+
 # ---------------------------------------------------------------------------
 # Mesh-bound wrappers
 # ---------------------------------------------------------------------------
@@ -217,6 +323,16 @@ def sp_cumsum_sharded(mesh: Mesh, values: jax.Array) -> jax.Array:
 
 def sp_differences_sharded(mesh: Mesh, values: jax.Array, k_lag: int = 1) -> jax.Array:
     fn = _bind(mesh, functools.partial(sp_differences, k_lag=k_lag), P(SERIES_AXIS, TIME_AXIS))
+    return jax.jit(fn)(values)
+
+
+def sp_fill_linear_sharded(mesh: Mesh, values: jax.Array) -> jax.Array:
+    fn = _bind(mesh, sp_fill_linear, P(SERIES_AXIS, TIME_AXIS))
+    return jax.jit(fn)(values)
+
+
+def sp_fill_linear_chain_sharded(mesh: Mesh, values: jax.Array):
+    fn = _bind(mesh, sp_fill_linear_chain, (P(SERIES_AXIS, TIME_AXIS),) * 3)
     return jax.jit(fn)(values)
 
 

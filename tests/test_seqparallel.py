@@ -94,3 +94,31 @@ class TestSeqParallelEwma:
         got = sp.sp_ewma_smooth_sharded(mesh, vals, alpha)
         ref = jax.vmap(lambda a, v: ewma.smooth(a, v))(alpha, x)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-8)
+
+
+class TestSpFillLinear:
+    def test_fill_matches_unsharded(self, mesh2d):
+        rng = np.random.default_rng(21)
+        v = rng.normal(size=(8, 64)).cumsum(axis=1).astype(np.float32)
+        v[rng.random((8, 64)) < 0.3] = np.nan  # gaps spanning shard boundaries
+        v[0, :5] = np.nan   # leading edge
+        v[1, -6:] = np.nan  # trailing edge
+        v[2, 20:50] = np.nan  # one gap covering a whole middle shard span
+        v[3, :] = np.nan    # all NaN
+        vals = jax.device_put(jnp.asarray(v), meshlib.series_sharding(mesh2d))
+        got = np.asarray(sp.sp_fill_linear_sharded(mesh2d, vals))
+        ref = np.asarray(jax.vmap(uv.fill_linear)(jnp.asarray(v)))
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+    def test_chain_matches_unsharded(self, mesh2d):
+        rng = np.random.default_rng(22)
+        v = rng.normal(size=(8, 64)).cumsum(axis=1).astype(np.float32)
+        v[rng.random((8, 64)) < 0.25] = np.nan
+        vals = jax.device_put(jnp.asarray(v), meshlib.series_sharding(mesh2d))
+        f, d, lagged = sp.sp_fill_linear_chain_sharded(mesh2d, vals)
+        f_ref, d_ref, l_ref = uv.batch_fill_linear_chain(
+            jnp.asarray(v), backend="scan"
+        )
+        np.testing.assert_allclose(np.asarray(f), np.asarray(f_ref), rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), rtol=1e-6, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(lagged), np.asarray(l_ref), rtol=1e-6, atol=1e-6)
