@@ -191,12 +191,24 @@ def _rev_prev(nchunk):  # previous TIME chunk while walking backward
 # output block.
 
 
-def _css_fwd_kernel(p, q, t_limit, cs, hp, *refs):
-    if hp:
-        y_ref, yp_ref, par_ref, zb_ref, e_ref, ce_ref = refs
-    else:  # single time chunk: no cross-chunk lag reads, no neighbor stream
-        y_ref, par_ref, zb_ref, e_ref, ce_ref = refs
-        yp_ref = None
+def _css_fwd_kernel(p, q, t_limit, cs, hp, mode, *refs):
+    # mode "e":    errors out (the css_errors vjp building block)
+    # mode "sum":  ONLY the per-series sum of squares leaves the kernel
+    #              (linesearch evaluations: the [B, T] error write + re-read
+    #              is the pass's HBM bill); errors live in a VMEM scratch
+    # mode "both": errors out AND the sum, accumulated in the SAME order as
+    #              "sum" (the optimizer compares f across both paths; mixed
+    #              accumulation orders stall rows at the noise floor)
+    refs = list(refs)
+    y_ref = refs.pop(0)
+    yp_ref = refs.pop(0) if hp else None
+    par_ref = refs.pop(0)
+    zb_ref = refs.pop(0)
+    e_ref = refs.pop(0) if mode != "sum" else None
+    css_ref = refs.pop(0) if mode != "e" else None
+    if mode == "sum" and q > 0:
+        e_ref = refs.pop(0)  # scratch: lag reads still need recent errors
+    ce_ref = refs.pop(0)
     c = pl.program_id(1)
     base = c * cs
     zb = zb_ref[0]
@@ -205,8 +217,10 @@ def _css_fwd_kernel(p, q, t_limit, cs, hp, *refs):
     def _():
         for j in range(max(q, 1)):
             ce_ref[j] = _ZERO()
+        if mode != "e":
+            css_ref[0] = _ZERO()
 
-    def body(tl, _):
+    def body(tl, acc):
         t = base + tl
         pred = par_ref[0]
         for i in range(1, p + 1):
@@ -221,13 +235,17 @@ def _css_fwd_kernel(p, q, t_limit, cs, hp, *refs):
             )
             pred += par_ref[p + j] * jnp.where(t - j >= 0, ev, 0.0)
         live = (t.astype(jnp.float32) >= zb) & (t < t_limit)
-        e_ref[tl] = jnp.where(live, y_ref[tl] - pred, 0.0)
-        return 0
+        e = jnp.where(live, y_ref[tl] - pred, 0.0)
+        if e_ref is not None:  # sum mode with q == 0 never reads errors back
+            e_ref[tl] = e
+        return (acc + e * e) if mode != "e" else acc
 
     # (a guarded-prologue / unguarded-steady-state split was measured to buy
     # nothing: the recursion's serial data dependency, not the boundary
     # selects, bounds each step)
-    _fori(cs, body, 0)
+    acc = _fori(cs, body, _ZERO() if mode != "e" else 0)
+    if mode != "e":
+        css_ref[0] = css_ref[0] + acc
     # slot s holds e at global (base + cs) - q + s for the next chunk
     for j in range(q):
         ce_ref[j] = e_ref[cs - q + j]
@@ -313,7 +331,7 @@ def css_errors(p: int, q: int, interpret: bool, params, yd, zb):
     return e
 
 
-def _css_errors_fwd(p, q, interpret, params, yd, zb):
+def _css_fwd_call(p, q, interpret, mode, params, yd, zb):
     b, t = yd.shape
     k = 1 + p + q
     assert params.shape == (b, k), (params.shape, (b, k))
@@ -323,18 +341,72 @@ def _css_errors_fwd(p, q, interpret, params, yd, zb):
     zb3 = _fold(zb.astype(yd.dtype)[:, None])
     nblk = y3.shape[1] // _SUBL
     hp = nchunk > 1
-    e3 = pl.pallas_call(
-        functools.partial(_css_fwd_kernel, p, q, t, cs, hp),
+    out_specs, out_shape = [], []
+    if mode != "sum":
+        out_specs.append(_bs(cs, _cur))
+        out_shape.append(jax.ShapeDtypeStruct(y3.shape, yd.dtype))
+    if mode != "e":
+        out_specs.append(_bs(1, _fixed))
+        out_shape.append(
+            jax.ShapeDtypeStruct((1, y3.shape[1], _LANES), yd.dtype)
+        )
+    scratch = []
+    if mode == "sum" and q > 0:  # errors live in VMEM only (lag reads)
+        scratch.append(pltpu.VMEM((cs, _SUBL, _LANES), jnp.float32))
+    scratch.append(pltpu.VMEM((max(q, 1), _SUBL, _LANES), jnp.float32))
+    outs = pl.pallas_call(
+        functools.partial(_css_fwd_kernel, p, q, t, cs, hp, mode),
         grid=(nblk, nchunk),
         in_specs=([_bs(cs, _cur)] + ([_bs(cs, _prev)] if hp else [])
                   + [_bs(k, _fixed), _bs(1, _fixed)]),
-        out_specs=_bs(cs, _cur),
-        out_shape=jax.ShapeDtypeStruct(y3.shape, yd.dtype),
-        scratch_shapes=[pltpu.VMEM((max(q, 1), _SUBL, _LANES), jnp.float32)],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
     )(*((y3, y3) if hp else (y3,)), par3, zb3)
+    return outs, (y3, par3, zb3)
+
+
+def _css_errors_fwd(p, q, interpret, params, yd, zb):
+    b, t = yd.shape
+    (e3,), (y3, par3, zb3) = _css_fwd_call(p, q, interpret, "e", params, yd, zb)
     return _unfold(e3, b)[:, :t], (y3, par3, zb3, e3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _css_ss(p: int, q: int, interpret: bool, params, yd, zb):
+    """Per-series CSS sum of squared errors ``[B]``.
+
+    Primal path uses the sum-only kernel (errors never leave VMEM — a
+    linesearch objective evaluation pays one panel READ, not a read plus a
+    full error write and re-read); the vjp path saves the errors and reuses
+    the hand-derived adjoint, with the VALUE accumulated in the identical
+    in-kernel order (mixed accumulation orders stall noise-floor rows).
+    """
+    b, t = yd.shape
+    (css3,), _ = _css_fwd_call(p, q, interpret, "sum", params, yd, zb)
+    return _unfold(css3, b)[:, 0]
+
+
+def _css_ss_fwd(p, q, interpret, params, yd, zb):
+    b, t = yd.shape
+    (e3, css3), (y3, par3, zb3) = _css_fwd_call(
+        p, q, interpret, "both", params, yd, zb
+    )
+    # save only the folded errors: the unfolded view is recomputed in the
+    # bwd pass instead of pinning a second full error panel until then
+    return _unfold(css3, b)[:, 0], (y3, par3, zb3, e3, b, t)
+
+
+def _css_ss_bwd(p, q, interpret, resid, gbar):
+    y3, par3, zb3, e3, b, t = resid
+    e = _unfold(e3, b)[:, :t]
+    g_e = 2.0 * e * gbar[:, None]
+    return _css_errors_bwd(p, q, interpret, (y3, par3, zb3, e3), g_e)
+
+
+_css_ss.defvjp(_css_ss_fwd, _css_ss_bwd)
 
 
 def _css_errors_bwd(p, q, interpret, res, g):
@@ -396,9 +468,8 @@ def css_neg_loglik(params, yd, order: Order, include_intercept: bool,
         params_k = jnp.concatenate(
             [jnp.zeros((b, 1), params.dtype), params], axis=1
         )
-    e = css_errors(p, q, interpret, params_k, ydz, start + p)
+    css = _css_ss(p, q, interpret, params_k, ydz, start + p)
     n_eff = nv - p
-    css = jnp.sum(e * e, axis=1)
     sigma2 = css / n_eff
     return 0.5 * n_eff * (jnp.log(2.0 * jnp.pi * sigma2) + 1.0)
 
@@ -832,9 +903,14 @@ def ewma_sse(alpha, x, n_valid=None, *, interpret: bool = False):
 # (seasonal state forward, seasonal adjoint backward) persist untouched.
 
 
-def _hw_fwd_kernel(m, mult, t_limit, cs, y_ref, par_ref, l0_ref, t0_ref,
-                   s0_ref, zb_ref, e_ref, lv_ref, tr_ref, so_ref, seas_ref,
-                   clt_ref):
+def _hw_fwd_kernel(m, mult, save_resid, t_limit, cs, y_ref, par_ref, l0_ref,
+                   t0_ref, s0_ref, zb_ref, *refs):
+    if save_resid:  # vjp path: trajectories for the adjoint + the SSE,
+        # accumulated in the same in-kernel order as the primal variant
+        e_ref, lv_ref, tr_ref, so_ref, ss_ref, seas_ref, clt_ref = refs
+    else:  # primal path (linesearch evals): ONLY the per-series SSE leaves
+        ss_ref, seas_ref, clt_ref = refs  # the kernel — the error/trajectory
+        e_ref = lv_ref = tr_ref = so_ref = None  # stores are the HBM bill
     c = pl.program_id(1)
     base = c * cs
     a = par_ref[0]
@@ -848,9 +924,10 @@ def _hw_fwd_kernel(m, mult, t_limit, cs, y_ref, par_ref, l0_ref, t0_ref,
             seas_ref[j] = s0_ref[j]
         clt_ref[0] = l0_ref[0]
         clt_ref[1] = t0_ref[0]
+        ss_ref[0] = _ZERO()
 
     def body(tl, carry):
-        level, trend = carry
+        level, trend, acc = carry
         t = base + tl
         tf = t.astype(jnp.float32)
         slot = lax.rem(t, jnp.asarray(m, t.dtype))
@@ -868,18 +945,21 @@ def _hw_fwd_kernel(m, mult, t_limit, cs, y_ref, par_ref, l0_ref, t0_ref,
             nl = a * (yt - s) + (1.0 - a) * lt_sum
             snew = g * (yt - nl) + (1.0 - g) * s
         nt = b * (nl - level) + (1.0 - b) * trend
-        e_ref[tl] = jnp.where(live_err, yt - pred, 0.0)
-        so_ref[tl] = s
+        e = jnp.where(live_err, yt - pred, 0.0)
         nl_o = jnp.where(live, nl, level)
         nt_o = jnp.where(live, nt, trend)
         seas_ref[slot] = jnp.where(live, snew, s)
-        lv_ref[tl] = nl_o
-        tr_ref[tl] = nt_o
-        return nl_o, nt_o
+        if save_resid:
+            e_ref[tl] = e
+            so_ref[tl] = s
+            lv_ref[tl] = nl_o
+            tr_ref[tl] = nt_o
+        return nl_o, nt_o, acc + e * e
 
-    level, trend = _fori(cs, body, (clt_ref[0], clt_ref[1]))
+    level, trend, acc = _fori(cs, body, (clt_ref[0], clt_ref[1], _ZERO()))
     clt_ref[0] = level
     clt_ref[1] = trend
+    ss_ref[0] = ss_ref[0] + acc
 
 
 def _hw_bwd_kernel(m, mult, t_limit, cs, nchunk, hp, *refs):
@@ -969,13 +1049,7 @@ def _hw_bwd_kernel(m, mult, t_limit, cs, nchunk, hp, *refs):
     gpar_ref[2] = gpar_ref[2] + dg
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
-def _hw_e(interpret: bool, m: int, mult: bool, params, y, l0, t0, s0, zb):
-    e, _ = _hw_e_fwd(interpret, m, mult, params, y, l0, t0, s0, zb)
-    return e
-
-
-def _hw_e_fwd(interpret, m, mult, params, y, l0, t0, s0, zb):
+def _hw_fwd_call(interpret, m, mult, save_resid, params, y, l0, t0, s0, zb):
     b, t = y.shape
     tp, cs, nchunk = _time_layout(t)
     y3 = _fold(jnp.pad(y, ((0, 0), (0, tp - t))))
@@ -985,13 +1059,21 @@ def _hw_e_fwd(interpret, m, mult, params, y, l0, t0, s0, zb):
     s03 = _fold(s0)
     zb3 = _fold(zb.astype(y.dtype)[:, None])
     nblk = y3.shape[1] // _SUBL
-    e3, lv3, tr3, so3 = pl.pallas_call(
-        functools.partial(_hw_fwd_kernel, m, mult, t, cs),
+    ss_spec = _bs(1, _fixed)
+    ss_shape = jax.ShapeDtypeStruct((1, y3.shape[1], _LANES), y.dtype)
+    if save_resid:  # e + replay trajectories for the adjoint + the SSE
+        out_specs = [_bs(cs, _cur)] * 4 + [ss_spec]
+        out_shape = [jax.ShapeDtypeStruct(y3.shape, y.dtype)] * 4 + [ss_shape]
+    else:  # per-series SSE only
+        out_specs = [ss_spec]
+        out_shape = [ss_shape]
+    outs = pl.pallas_call(
+        functools.partial(_hw_fwd_kernel, m, mult, save_resid, t, cs),
         grid=(nblk, nchunk),
         in_specs=[_bs(cs, _cur), _bs(3, _fixed), _bs(1, _fixed),
                   _bs(1, _fixed), _bs(m, _fixed), _bs(1, _fixed)],
-        out_specs=[_bs(cs, _cur)] * 4,
-        out_shape=[jax.ShapeDtypeStruct(y3.shape, y.dtype)] * 4,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((m, _SUBL, _LANES), jnp.float32),
             pltpu.VMEM((2, _SUBL, _LANES), jnp.float32),
@@ -999,7 +1081,38 @@ def _hw_e_fwd(interpret, m, mult, params, y, l0, t0, s0, zb):
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
     )(y3, par3, l03, t03, s03, zb3)
-    return _unfold(e3, b)[:, :t], (y3, par3, l03, t03, zb3, lv3, tr3, so3, b, t)
+    return outs, (y3, par3, l03, t03, zb3, b, t)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _hw_ss(interpret: bool, m: int, mult: bool, params, y, l0, t0, s0, zb):
+    """Per-series one-step-ahead SSE ``[B]``.
+
+    Primal (no-gradient) path: sum-only kernel — a linesearch objective
+    evaluation pays one panel read and no error/trajectory stores.  The vjp
+    path saves the replay trajectories and reuses the hand-derived adjoint.
+    """
+    (ss3,), (_, _, _, _, _, b, t) = _hw_fwd_call(
+        interpret, m, mult, False, params, y, l0, t0, s0, zb
+    )
+    return _unfold(ss3, b)[:, 0]
+
+
+def _hw_ss_fwd(interpret, m, mult, params, y, l0, t0, s0, zb):
+    (e3, lv3, tr3, so3, ss3), (y3, par3, l03, t03, zb3, b, t) = _hw_fwd_call(
+        interpret, m, mult, True, params, y, l0, t0, s0, zb
+    )
+    e = _unfold(e3, b)[:, :t]
+    res = (y3, par3, l03, t03, zb3, lv3, tr3, so3, b, t)
+    # the value is accumulated in the same in-kernel order as the primal
+    # variant — see _css_ss_fwd: mixed accumulation orders stall rows
+    return _unfold(ss3, b)[:, 0], (res, e)
+
+
+def _hw_ss_bwd(interpret, m, mult, resid, gbar):
+    res, e = resid
+    g_e = 2.0 * e * gbar[:, None]
+    return _hw_e_bwd(interpret, m, mult, res, g_e)
 
 
 def _hw_e_bwd(interpret, m, mult, res, g):
@@ -1045,7 +1158,7 @@ def _hw_e_bwd(interpret, m, mult, res, g):
     )
 
 
-_hw_e.defvjp(_hw_e_fwd, _hw_e_bwd)
+_hw_ss.defvjp(_hw_ss_fwd, _hw_ss_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -1158,7 +1271,7 @@ def _fill_linear_call(y, chain: bool, interpret: bool):
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
     )(y3, nv3, ni3)
-    outs = outs if chain else [outs]
+    # pallas_call with a list out_shape returns a sequence, singleton included
     return tuple(_unfold(o, b)[:, :t] for o in outs)
 
 
@@ -1490,8 +1603,7 @@ def hw_sse_seeded(params, y, seeds, period: int,
             f"(got {m}); use backend='scan'"
         )
     l0, t0, s0r, zb = seeds
-    e = _hw_e(interpret, m, multiplicative, params, y, l0, t0, s0r, zb)
-    return jnp.sum(e * e, axis=1)
+    return _hw_ss(interpret, m, multiplicative, params, y, l0, t0, s0r, zb)
 
 
 def hw_sse(params, y, period: int, multiplicative: bool = False,

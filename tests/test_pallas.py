@@ -660,3 +660,14 @@ def test_hr_init_chunked_long_series():
     got = pk.hr_init(yz, order, True, nv, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_fill_linear_fill_only_matches_portable():
+    # the singleton-output variant (no difference/lag stores) — regression
+    # for the pallas_call sequence-return handling
+    from spark_timeseries_tpu.ops import univariate as uv
+
+    y = _gappy(5, 90, seed=15)
+    f = pk.fill_linear(y, interpret=True)
+    ref = jax.vmap(uv.fill_linear)(y)
+    np.testing.assert_allclose(np.asarray(f), np.asarray(ref), rtol=1e-6, atol=1e-6)
