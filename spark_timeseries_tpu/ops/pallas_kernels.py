@@ -494,12 +494,19 @@ def css_neg_loglik(params, yd, order: Order, include_intercept: bool,
 # gradients; ``zb`` is a constant of the objective.
 
 
-def _garch_fwd_kernel(t_limit, cs, hp, *refs):
-    if hp:
-        r2_ref, r2p_ref, par_ref, h0_ref, zb_ref, h_ref, ch_ref = refs
-    else:
-        r2_ref, par_ref, h0_ref, zb_ref, h_ref, ch_ref = refs
-        r2p_ref = None
+def _garch_fwd_kernel(t_limit, cs, hp, mode, *refs):
+    # mode "e": conditional variances out; "sum": only the per-series
+    # Gaussian log-likelihood sum leaves the kernel (linesearch evals);
+    # "both": variances AND the sum, accumulated in the identical order
+    refs = list(refs)
+    r2_ref = refs.pop(0)
+    r2p_ref = refs.pop(0) if hp else None
+    par_ref = refs.pop(0)
+    h0_ref = refs.pop(0)
+    zb_ref = refs.pop(0)
+    h_ref = refs.pop(0) if mode != "sum" else None
+    ll_ref = refs.pop(0) if mode != "e" else None
+    ch_ref = refs.pop(0)
     c = pl.program_id(1)
     base = c * cs
     zb = zb_ref[0]
@@ -508,11 +515,14 @@ def _garch_fwd_kernel(t_limit, cs, hp, *refs):
     @pl.when(c == 0)
     def _():
         ch_ref[0] = h0
+        if mode != "e":
+            ll_ref[0] = _ZERO()
 
-    def body(tl, _):
+    def body(tl, carry):
+        hprev_c, acc = carry
         t = base + tl
         tf = t.astype(jnp.float32)
-        hprev = jnp.where(tl - 1 >= 0, h_ref[jnp.maximum(tl - 1, 0)], ch_ref[0])
+        hprev = jnp.where(tl - 1 >= 0, hprev_c, ch_ref[0])
         far = r2p_ref[cs - 1] if hp else 0.0
         r2p = jnp.where(tl - 1 >= 0, r2_ref[jnp.maximum(tl - 1, 0)], far)
         r2p = jnp.where(t - 1 >= 0, r2p, 0.0)
@@ -521,11 +531,20 @@ def _garch_fwd_kernel(t_limit, cs, hp, *refs):
         r2p = jnp.where(tf == zb, h0, r2p)
         h = par_ref[0] + par_ref[1] * r2p + par_ref[2] * hprev
         live = (tf >= zb) & (t < t_limit)
-        h_ref[tl] = jnp.where(live, h, h0)
-        return 0
+        hval = jnp.where(live, h, h0)
+        if mode != "sum":
+            h_ref[tl] = hval
+        if mode != "e":
+            hc = jnp.maximum(hval, 1e-12)
+            acc = acc + jnp.where(
+                live, jnp.log(2.0 * jnp.pi * hc) + r2_ref[tl] / hc, 0.0
+            )
+        return hval, acc
 
-    _fori(cs, body, 0)
-    ch_ref[0] = h_ref[cs - 1]
+    hlast, acc = _fori(cs, body, (ch_ref[0], _ZERO()))
+    ch_ref[0] = hlast
+    if mode != "e":
+        ll_ref[0] = ll_ref[0] + acc
 
 
 def _garch_bwd_kernel(t_limit, cs, nchunk, hpv, *refs):
@@ -590,13 +609,7 @@ def _garch_bwd_kernel(t_limit, cs, nchunk, hpv, *refs):
     gh0_ref[0] = gh0_ref[0] + dh0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _garch_h(interpret: bool, params, r2, h0, zb):
-    h, _ = _garch_h_fwd(interpret, params, r2, h0, zb)
-    return h
-
-
-def _garch_h_fwd(interpret, params, r2, h0, zb):
+def _garch_fwd_call(interpret, mode, params, r2, h0, zb):
     b, t = r2.shape
     tp, cs, nchunk = _time_layout(t)
     r23 = _fold(jnp.pad(r2, ((0, 0), (0, tp - t))))
@@ -605,17 +618,40 @@ def _garch_h_fwd(interpret, params, r2, h0, zb):
     zb3 = _fold(zb.astype(r2.dtype)[:, None])
     nblk = r23.shape[1] // _SUBL
     hp = nchunk > 1
-    h3 = pl.pallas_call(
-        functools.partial(_garch_fwd_kernel, t, cs, hp),
+    out_specs, out_shape = [], []
+    if mode != "sum":
+        out_specs.append(_bs(cs, _cur))
+        out_shape.append(jax.ShapeDtypeStruct(r23.shape, r2.dtype))
+    if mode != "e":
+        out_specs.append(_bs(1, _fixed))
+        out_shape.append(
+            jax.ShapeDtypeStruct((1, r23.shape[1], _LANES), r2.dtype)
+        )
+    outs = pl.pallas_call(
+        functools.partial(_garch_fwd_kernel, t, cs, hp, mode),
         grid=(nblk, nchunk),
         in_specs=([_bs(cs, _cur)] + ([_bs(cs, _prev)] if hp else [])
                   + [_bs(3, _fixed), _bs(1, _fixed), _bs(1, _fixed)]),
-        out_specs=_bs(cs, _cur),
-        out_shape=jax.ShapeDtypeStruct(r23.shape, r2.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((1, _SUBL, _LANES), jnp.float32)],
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
     )(*((r23, r23) if hp else (r23,)), par3, h03, zb3)
+    return outs, (r23, par3, h03, zb3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _garch_h(interpret: bool, params, r2, h0, zb):
+    h, _ = _garch_h_fwd(interpret, params, r2, h0, zb)
+    return h
+
+
+def _garch_h_fwd(interpret, params, r2, h0, zb):
+    b, t = r2.shape
+    (h3,), (r23, par3, h03, zb3) = _garch_fwd_call(
+        interpret, "e", params, r2, h0, zb
+    )
     return _unfold(h3, b)[:, :t], (r23, par3, h03, zb3, h3, b, t)
 
 
@@ -672,6 +708,51 @@ def garch_variances(params, r, h0, zb, *, interpret: bool = False):
     return _garch_h(interpret, params, r * r, h0, zb)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _garch_ll(interpret: bool, params, rz, h0, zb):
+    """Unscaled Gaussian log-likelihood sum ``[B]`` of the GARCH recursion:
+    ``sum_t mask (log 2 pi h_t + r_t^2 / h_t)``.
+
+    Primal path: sum-only kernel (the variance path never reaches HBM);
+    vjp path saves the variances and chains the likelihood partials into
+    the hand-derived recursion adjoint, with the VALUE accumulated in the
+    identical in-kernel order (see ``_css_ss``).
+    """
+    b, t = rz.shape
+    (ll3,), _ = _garch_fwd_call(interpret, "sum", params, rz * rz, h0, zb)
+    return _unfold(ll3, b)[:, 0]
+
+
+def _garch_ll_fwd(interpret, params, rz, h0, zb):
+    b, t = rz.shape
+    (h3, ll3), (r23, par3, h03, zb3) = _garch_fwd_call(
+        interpret, "both", params, rz * rz, h0, zb
+    )
+    return _unfold(ll3, b)[:, 0], (r23, par3, h03, zb3, h3, rz, zb, b, t)
+
+
+def _garch_ll_bwd(interpret, resid, gbar):
+    r23, par3, h03, zb3, h3, rz, zb, b, t = resid
+    h = _unfold(h3, b)[:, :t]
+    t_idx = jnp.arange(t, dtype=rz.dtype)
+    mask = t_idx[None, :] >= zb[:, None]
+    hc = jnp.maximum(h, 1e-12)
+    # d ll_t / d h_t = 1/h - r^2/h^2 (zero through the eps clamp)
+    g_h = jnp.where(mask & (h >= 1e-12),
+                    gbar[:, None] * (1.0 / hc - (rz * rz) / (hc * hc)), 0.0)
+    gpar, g_r2, g_h0, _ = _garch_h_bwd(
+        interpret, (r23, par3, h03, zb3, h3, b, t), g_h
+    )
+    # r feeds the likelihood through the recursion (r^2 chain) AND directly
+    g_rz = g_r2 * 2.0 * rz + jnp.where(
+        mask, gbar[:, None] * 2.0 * rz / hc, 0.0
+    )
+    return gpar, g_rz, g_h0, jnp.zeros_like(zb)
+
+
+_garch_ll.defvjp(_garch_ll_fwd, _garch_ll_bwd)
+
+
 @_scoped("pallas.garch_neg_loglik")
 def garch_neg_loglik(params, r, n_valid=None, *, interpret: bool = False):
     """Batched GARCH(1,1) Gaussian negative log-likelihood ``[B]``.
@@ -694,10 +775,7 @@ def garch_neg_loglik(params, r, n_valid=None, *, interpret: bool = False):
     nvf = jnp.maximum(nv, 1).astype(r.dtype)
     mean = jnp.sum(rz, axis=1) / nvf
     h0 = jnp.sum(jnp.where(mask, (rz - mean[:, None]) ** 2, 0.0), axis=1) / nvf
-    h = garch_variances(params, rz, h0, start, interpret=interpret)
-    h = jnp.maximum(h, 1e-12)
-    ll_t = jnp.where(mask, jnp.log(2.0 * jnp.pi * h) + (rz * rz) / h, 0.0)
-    return 0.5 * jnp.sum(ll_t, axis=1)
+    return 0.5 * _garch_ll(interpret, params, rz, h0, start)
 
 
 # ---------------------------------------------------------------------------
