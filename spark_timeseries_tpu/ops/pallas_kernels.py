@@ -790,7 +790,17 @@ def garch_neg_loglik(params, r, n_valid=None, *, interpret: bool = False):
 #   dL/dalpha = sum_{t > zb} lam_t * (x_t - s_{t-1})
 
 
-def _ewma_fwd_kernel(t_limit, cs, x_ref, a_ref, zb_ref, s_ref, cs_ref):
+def _ewma_fwd_kernel(t_limit, cs, mode, *refs):
+    # mode "e": smoothed series out; "sum": only the one-step-ahead SSE
+    # leaves the kernel (linesearch evals); "both": series AND the SSE,
+    # accumulated in the identical order
+    refs = list(refs)
+    x_ref = refs.pop(0)
+    a_ref = refs.pop(0)
+    zb_ref = refs.pop(0)
+    s_ref = refs.pop(0) if mode != "sum" else None
+    ss_ref = refs.pop(0) if mode != "e" else None
+    cs_ref = refs.pop(0)
     c = pl.program_id(1)
     base = c * cs
     zb = zb_ref[0]
@@ -799,19 +809,31 @@ def _ewma_fwd_kernel(t_limit, cs, x_ref, a_ref, zb_ref, s_ref, cs_ref):
     @pl.when(c == 0)
     def _():
         cs_ref[0] = _ZERO()
+        if mode != "e":
+            ss_ref[0] = _ZERO()
 
-    def body(tl, _):
+    def body(tl, carry):
+        sprev_c, acc = carry
         t = base + tl
         tf = t.astype(jnp.float32)
-        sp = jnp.where(tl - 1 >= 0, s_ref[jnp.maximum(tl - 1, 0)], cs_ref[0])
-        s = a * x_ref[tl] + (1.0 - a) * sp
-        s = jnp.where(tf == zb, x_ref[tl], s)
+        xt = x_ref[tl]
+        sp = jnp.where(tl - 1 >= 0, sprev_c, cs_ref[0])
+        s = a * xt + (1.0 - a) * sp
+        s = jnp.where(tf == zb, xt, s)
         live = (tf >= zb) & (t < t_limit)
-        s_ref[tl] = jnp.where(live, s, 0.0)
-        return 0
+        sval = jnp.where(live, s, 0.0)
+        if mode != "sum":
+            s_ref[tl] = sval
+        if mode != "e":
+            # one-step-ahead error x_t - s_{t-1}, live strictly after seed
+            e = jnp.where((tf > zb) & (t < t_limit), xt - sp, 0.0)
+            acc = acc + e * e
+        return sval, acc
 
-    _fori(cs, body, 0)
-    cs_ref[0] = s_ref[cs - 1]
+    sval, acc = _fori(cs, body, (cs_ref[0], _ZERO()))
+    cs_ref[0] = sval
+    if mode != "e":
+        ss_ref[0] = ss_ref[0] + acc
 
 
 def _ewma_bwd_kernel(t_limit, cs, nchunk, hp, *refs):
@@ -851,6 +873,33 @@ def _ewma_bwd_kernel(t_limit, cs, nchunk, hp, *refs):
     ga_ref[0] = ga_ref[0] + da
 
 
+def _ewma_fwd_call(interpret, mode, alpha, x, zb):
+    b, t = x.shape
+    tp, cs, nchunk = _time_layout(t)
+    x3 = _fold(jnp.pad(x, ((0, 0), (0, tp - t))))
+    a3 = _fold(alpha[:, None].astype(x.dtype))
+    zb3 = _fold(zb.astype(x.dtype)[:, None])
+    nblk = x3.shape[1] // _SUBL
+    out_specs, out_shape = [], []
+    if mode != "sum":
+        out_specs.append(_bs(cs, _cur))
+        out_shape.append(jax.ShapeDtypeStruct(x3.shape, x.dtype))
+    if mode != "e":
+        out_specs.append(_bs(1, _fixed))
+        out_shape.append(jax.ShapeDtypeStruct((1, x3.shape[1], _LANES), x.dtype))
+    outs = pl.pallas_call(
+        functools.partial(_ewma_fwd_kernel, t, cs, mode),
+        grid=(nblk, nchunk),
+        in_specs=[_bs(cs, _cur), _bs(1, _fixed), _bs(1, _fixed)],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((1, _SUBL, _LANES), jnp.float32)],
+        compiler_params=_VMEM_PARAMS,
+        interpret=interpret,
+    )(x3, a3, zb3)
+    return outs, (x3, a3, zb3)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _ewma_s(interpret: bool, alpha, x, zb):
     s, _ = _ewma_s_fwd(interpret, alpha, x, zb)
@@ -859,21 +908,7 @@ def _ewma_s(interpret: bool, alpha, x, zb):
 
 def _ewma_s_fwd(interpret, alpha, x, zb):
     b, t = x.shape
-    tp, cs, nchunk = _time_layout(t)
-    x3 = _fold(jnp.pad(x, ((0, 0), (0, tp - t))))
-    a3 = _fold(alpha[:, None].astype(x.dtype))
-    zb3 = _fold(zb.astype(x.dtype)[:, None])
-    nblk = x3.shape[1] // _SUBL
-    s3 = pl.pallas_call(
-        functools.partial(_ewma_fwd_kernel, t, cs),
-        grid=(nblk, nchunk),
-        in_specs=[_bs(cs, _cur), _bs(1, _fixed), _bs(1, _fixed)],
-        out_specs=_bs(cs, _cur),
-        out_shape=jax.ShapeDtypeStruct(x3.shape, x.dtype),
-        scratch_shapes=[pltpu.VMEM((1, _SUBL, _LANES), jnp.float32)],
-        compiler_params=_VMEM_PARAMS,
-        interpret=interpret,
-    )(x3, a3, zb3)
+    (s3,), (x3, a3, zb3) = _ewma_fwd_call(interpret, "e", alpha, x, zb)
     return _unfold(s3, b)[:, :t], (x3, a3, zb3, s3, b, t)
 
 
@@ -922,9 +957,47 @@ def ewma_smooth(alpha, x, zb, *, interpret: bool = False):
     return _ewma_s(interpret, alpha, x, zb)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _ewma_ssq(interpret: bool, alpha, xz, zb):
+    """One-step-ahead SSE ``[B]`` of the EWMA recursion.
+
+    Primal path: sum-only kernel (the smoothed series never reaches HBM);
+    vjp path saves it and chains the error partials into the hand-derived
+    smoothing adjoint, with the VALUE accumulated in the identical
+    in-kernel order (see ``_css_ss``).
+    """
+    b, t = xz.shape
+    (ss3,), _ = _ewma_fwd_call(interpret, "sum", alpha, xz, zb)
+    return _unfold(ss3, b)[:, 0]
+
+
+def _ewma_ssq_fwd(interpret, alpha, xz, zb):
+    b, t = xz.shape
+    (s3, ss3), (x3, a3, zb3) = _ewma_fwd_call(interpret, "both", alpha, xz, zb)
+    return _unfold(ss3, b)[:, 0], (x3, a3, zb3, s3, xz, zb, b, t)
+
+
+def _ewma_ssq_bwd(interpret, resid, gbar):
+    x3, a3, zb3, s3, xz, zb, b, t = resid
+    s = _unfold(s3, b)[:, :t]
+    t_idx = jnp.arange(t, dtype=xz.dtype)
+    live_e = t_idx[None, 1:] > zb[:, None]  # err_t = x_t - s_{t-1}, t > seed
+    err = jnp.where(live_e, xz[:, 1:] - s[:, :-1], 0.0)
+    # d sse / d s_{t-1} = -2 err_t; the last position feeds no error
+    g_s = jnp.concatenate(
+        [-2.0 * err * gbar[:, None], jnp.zeros((b, 1), xz.dtype)], axis=1
+    )
+    g_alpha, _, _ = _ewma_s_bwd(interpret, (x3, a3, zb3, s3, b, t), g_s)
+    return g_alpha, jnp.zeros_like(xz), jnp.zeros_like(zb)
+
+
+_ewma_ssq.defvjp(_ewma_ssq_fwd, _ewma_ssq_bwd)
+
+
 @_scoped("pallas.ewma_sse")
 def ewma_sse(alpha, x, n_valid=None, *, interpret: bool = False):
-    """Batched one-step-ahead EWMA SSE ``[B]`` (matches ``models.ewma.sse``)."""
+    """Batched one-step-ahead EWMA SSE ``[B]`` (matches ``models.ewma.sse``).
+    Differentiable in ``alpha``."""
     b, n = x.shape
     nv = (
         jnp.full((b,), n, jnp.int32)
@@ -934,10 +1007,7 @@ def ewma_sse(alpha, x, n_valid=None, *, interpret: bool = False):
     start = (n - nv).astype(x.dtype)
     t_idx = jnp.arange(n, dtype=x.dtype)
     xz = jnp.where(t_idx[None, :] >= start[:, None], x, 0.0)
-    s = ewma_smooth(alpha, xz, start, interpret=interpret)
-    err = xz[:, 1:] - s[:, :-1]
-    err = jnp.where(t_idx[None, 1:] > start[:, None], err, 0.0)
-    return jnp.sum(err * err, axis=1)
+    return _ewma_ssq(interpret, alpha, xz, start)
 
 
 # ---------------------------------------------------------------------------
