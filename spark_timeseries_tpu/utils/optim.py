@@ -248,7 +248,8 @@ def minimize_lbfgs_batched(
     ftol: float | None = None,
     max_linesearch: int = 20,
     c1: float = 1e-4,
-) -> LBFGSResult:
+    count_evals: bool = False,
+) -> "LBFGSResult | tuple[LBFGSResult, jax.Array]":
     """Jointly minimize ``B`` independent problems with ONE batched objective.
 
     ``fun_batched(x[B, d]) -> f[B]`` evaluates every problem at once — the
@@ -259,6 +260,12 @@ def minimize_lbfgs_batched(
     gradient of ``sum(f)`` is exactly the per-row gradient.  All rows step in
     lockstep (as they do under ``vmap`` of a ``while_loop``); finished rows
     freeze their state.
+
+    ``count_evals=True`` (diagnostics, e.g. ``tools/profile_headline.py``)
+    additionally returns ``(result, ls_evals_per_iter)`` where the second
+    array ``[max_iters] int32`` holds the number of full-batch linesearch
+    objective evaluations each outer iteration performed — the profiler
+    instruments the REAL optimizer instead of maintaining a fork of it.
     """
     bsz, d = x0.shape
     m = history
@@ -322,11 +329,11 @@ def minimize_lbfgs_batched(
             _, ok, j = carry
             return jnp.any(~ok) & (j < max_linesearch)
 
-        t, ok, _ = lax.while_loop(cond, body, (t0, done, 0))
-        return t, ok
+        t, ok, n_ls = lax.while_loop(cond, body, (t0, done, 0))
+        return t, ok, n_ls
 
     def step(carry):
-        state, iters = carry
+        state, iters, ls_hist = carry
         done = state.converged | state.failed
         with jax.named_scope("optim.lbfgs_batched.two_loop"):
             direction = -two_loop_b(
@@ -348,7 +355,7 @@ def minimize_lbfgs_batched(
             1.0 / jnp.maximum(1.0, rownorm(direction)),
         ).astype(dtype)
         with jax.named_scope("optim.lbfgs_batched.linesearch"):
-            t, ok = linesearch(state.x, state.f, state.g, direction, done, t0)
+            t, ok, n_ls = linesearch(state.x, state.f, state.g, direction, done, t0)
         x_new = state.x + t[:, None] * direction
         with jax.named_scope("optim.lbfgs_batched.value_and_grad"):
             f_new, g_new = vg(x_new)
@@ -396,20 +403,24 @@ def minimize_lbfgs_batched(
             tprev=jnp.where(accept, t, state.tprev),
         )
         iters = jnp.where(done, iters, state.k + 1)
-        return new_state, iters
+        if ls_hist is not None:
+            ls_hist = ls_hist.at[state.k].set(n_ls)
+        return new_state, iters, ls_hist
 
     def cond(carry):
-        state, _ = carry
+        state, _, _ = carry
         return (state.k < max_iters) & jnp.any(~(state.converged | state.failed))
 
-    final, iters = lax.while_loop(cond, step, (init, iters0))
-    return LBFGSResult(
+    ls0 = jnp.zeros((max_iters,), jnp.int32) if count_evals else None
+    final, iters, ls_hist = lax.while_loop(cond, step, (init, iters0, ls0))
+    result = LBFGSResult(
         x=final.x,
         f=final.f,
         converged=final.converged & jnp.isfinite(final.f),
         iters=iters,
         grad_norm=rownorm(final.g),
     )
+    return (result, ls_hist) if count_evals else result
 
 
 def batched_minimize(

@@ -2,8 +2,10 @@
 iteration and measure per-pass costs on the real chip.
 
 Diagnostic only (VERDICT round 2, next-round item 1a): quantify where the
-1.25s headline latency goes so the optimizer levers (linesearch evals,
-fused fwd+bwd, converged-row compaction) are applied where they pay.
+headline latency goes so the optimizer levers (linesearch evals, fused
+fwd+bwd, converged-row compaction) are applied where they pay.  Uses the
+PRODUCTION optimizer's ``count_evals`` instrumentation — there is no forked
+copy of the algorithm to drift out of date.
 
 Usage: python tools/profile_headline.py [--b 25088] [--t 1000] [--iters 60]
 """
@@ -20,129 +22,12 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 from bench import gen_arima_panel
 from spark_timeseries_tpu.models import arima
-from spark_timeseries_tpu.models.base import align_right
+from spark_timeseries_tpu.models.base import maybe_align
 from spark_timeseries_tpu.ops import pallas_kernels as pk
 from spark_timeseries_tpu.utils import optim
-from spark_timeseries_tpu.utils.optim import _State, _two_loop
-
-
-def instrumented_lbfgs(fun_batched, x0, *, max_iters, tol, ftol=None,
-                       max_linesearch=20, c1=1e-4):
-    """minimize_lbfgs_batched with eval counters threaded through the loop.
-
-    Counts every fun_batched call (linesearch) and every value-and-grad call
-    so the profile shows objective passes, not just iterations.
-    """
-    bsz, d = x0.shape
-    m = 8
-    dtype = x0.dtype
-    if ftol is None:
-        ftol = 1e-9 if dtype == jnp.float64 else 1e-6
-
-    def vg(x):
-        f, pullback = jax.vjp(fun_batched, x)
-        (g,) = pullback(jnp.ones_like(f))
-        bad = ~jnp.isfinite(f) | ~jnp.all(jnp.isfinite(g), axis=-1)
-        return jnp.where(bad, jnp.inf, f), jnp.where(bad[:, None], 0.0, g)
-
-    rownorm = lambda v: jnp.linalg.norm(v, axis=-1)
-    rowdot = lambda a, b: jnp.sum(a * b, axis=-1)
-
-    f0, g0 = vg(x0)
-    init = _State(
-        k=jnp.zeros((), jnp.int32), x=x0, f=f0, g=g0,
-        s_hist=jnp.zeros((bsz, m, d), dtype),
-        y_hist=jnp.zeros((bsz, m, d), dtype),
-        rho_hist=jnp.zeros((bsz, m), dtype),
-        converged=(rownorm(g0) < tol) & jnp.isfinite(f0),
-        failed=jnp.isinf(f0),
-        tprev=jnp.ones((bsz,), dtype),
-    )
-    iters0 = jnp.zeros((bsz,), jnp.int32)
-    nls0 = jnp.zeros((), jnp.int32)  # total linesearch evals
-    two_loop_b = jax.vmap(_two_loop, in_axes=(0, 0, 0, 0, None, None))
-
-    def linesearch(x, f, g, direction, done, t0):
-        gd = rowdot(g, direction)
-        eps = ftol * jnp.maximum(1.0, jnp.abs(f))
-
-        def body(carry):
-            t, ok, j = carry
-            fnew = fun_batched(x + t[:, None] * direction)
-            fnew = jnp.where(jnp.isfinite(fnew), fnew, jnp.inf)
-            ok_new = ok | (fnew <= f + c1 * t * gd + eps)
-            tq = -gd * t * t / (2.0 * (fnew - f - gd * t))
-            tq = jnp.where(jnp.isfinite(tq), tq, 0.0)
-            tq = jnp.clip(tq, 0.1 * t, 0.5 * t)
-            return jnp.where(ok_new, t, tq), ok_new, j + 1
-
-        def cond(carry):
-            _, ok, j = carry
-            return jnp.any(~ok) & (j < max_linesearch)
-
-        t, ok, j = lax.while_loop(cond, body, (t0, done, 0))
-        return t, ok, j
-
-    ls_hist0 = jnp.zeros((max_iters,), jnp.int32)  # evals per outer iteration
-
-    def step(carry):
-        state, iters, nls, ls_hist = carry
-        done = state.converged | state.failed
-        direction = -two_loop_b(state.g, state.s_hist, state.y_hist,
-                                state.rho_hist, state.k, m)
-        descent = rowdot(state.g, direction) < 0.0
-        direction = jnp.where(descent[:, None], direction, -state.g)
-        has_hist = jnp.any(state.rho_hist > 0.0, axis=-1)
-        t0 = jnp.where(
-            has_hist & descent,
-            jnp.minimum(1.0, 4.0 * state.tprev),
-            1.0 / jnp.maximum(1.0, rownorm(direction)),
-        ).astype(dtype)
-        t, ok, n_ls = linesearch(state.x, state.f, state.g, direction, done, t0)
-        x_new = state.x + t[:, None] * direction
-        f_new, g_new = vg(x_new)
-        s = x_new - state.x
-        y = g_new - state.g
-        sy = rowdot(s, y)
-        slot = state.k % m
-        accept = (
-            ok
-            & (f_new <= state.f + ftol * jnp.maximum(1.0, jnp.abs(state.f)))
-            & ~done
-        )
-        good_pair = (sy > 1e-10) & accept
-        upd = lambda hist, v: hist.at[:, slot].set(
-            jnp.where(good_pair[:, None], v, hist[:, slot]))
-        s_hist = upd(state.s_hist, s)
-        y_hist = upd(state.y_hist, y)
-        rho_hist = state.rho_hist.at[:, slot].set(
-            jnp.where(good_pair, 1.0 / jnp.maximum(sy, 1e-30),
-                      state.rho_hist[:, slot]))
-        x_out = jnp.where(accept[:, None], x_new, state.x)
-        f_out = jnp.where(accept, f_new, state.f)
-        g_out = jnp.where(accept[:, None], g_new, state.g)
-        conv = state.converged | (rownorm(g_out) < tol * jnp.maximum(1.0, rownorm(x_out)))
-        conv = conv | (accept & (state.f - f_new <= ftol * jnp.maximum(1.0, jnp.abs(f_new))))
-        new_state = _State(
-            k=state.k + 1, x=x_out, f=f_out, g=g_out,
-            s_hist=s_hist, y_hist=y_hist, rho_hist=rho_hist,
-            converged=conv, failed=state.failed | (~ok & ~conv & ~done),
-            tprev=jnp.where(accept, t, state.tprev))
-        iters = jnp.where(done, iters, state.k + 1)
-        ls_hist = ls_hist.at[state.k].set(n_ls)
-        return new_state, iters, nls + n_ls, ls_hist
-
-    def cond(carry):
-        state, _, _, _ = carry
-        return (state.k < max_iters) & jnp.any(~(state.converged | state.failed))
-
-    final, iters, nls, ls_hist = lax.while_loop(
-        cond, step, (init, iters0, nls0, ls_hist0))
-    return final, iters, nls, ls_hist
 
 
 def main():
@@ -158,14 +43,14 @@ def main():
     y = jnp.asarray(gen_arima_panel(b, t, seed=0))
     jax.block_until_ready(y)
 
-    # objective exactly as models.arima._fit_program builds it (pallas path)
+    # objective exactly as models.arima._fit_program builds it (pallas path,
+    # dense panel)
     @jax.jit
     def prep(yb):
-        ya, nv0 = jax.vmap(align_right)(yb)
+        ya, nv0 = maybe_align(yb, "dense")
         yd = jax.vmap(lambda v: arima._difference(v, 1))(ya)
         nvd = nv0 - 1
-        init = jax.vmap(
-            lambda v, n: arima.hannan_rissanen(v, order, True, n))(yd, nvd)
+        init = pk.hr_init(yd, order, True, nvd)
         return yd, nvd, init
 
     yd, nvd, init = prep(y)
@@ -173,14 +58,14 @@ def main():
     t0 = time.perf_counter()
     out = prep(y)
     jax.block_until_ready(out)
-    t_prep = time.perf_counter() - t0
-    print(f"prep (align+diff+HR init): {t_prep*1e3:.1f} ms")
+    print(f"prep (diff + fused HR init): {(time.perf_counter() - t0) * 1e3:.1f} ms"
+          " (includes one dispatch round trip)")
     n_eff = jnp.maximum(nvd - 1, 1).astype(yd.dtype)
 
     def fun_batched(P):
         return pk.css_neg_loglik(P, yd, order, True, nvd) / n_eff
 
-    # -- per-pass costs ----------------------------------------------------
+    # -- per-pass costs (dispatch round trip included) ---------------------
     fwd = jax.jit(lambda P: jnp.sum(fun_batched(P)))
     vgj = jax.jit(lambda P: jax.vjp(fun_batched, P)[1](jnp.ones((b,), yd.dtype))[0])
     fwd(init).block_until_ready()
@@ -196,35 +81,30 @@ def main():
     t_vg = (time.perf_counter() - t0) / N
     print(f"fwd pass: {t_fwd*1e3:.1f} ms   value+grad: {t_vg*1e3:.1f} ms")
 
-    # -- instrumented full fit --------------------------------------------
-    run = jax.jit(lambda x0: instrumented_lbfgs(
-        fun_batched, x0, max_iters=args.iters, tol=1e-4))
+    # -- instrumented full fit (the PRODUCTION optimizer) ------------------
+    run = jax.jit(lambda x0: optim.minimize_lbfgs_batched(
+        fun_batched, x0, max_iters=args.iters, tol=1e-4, count_evals=True))
     out = run(init)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
-    out = run(init)
-    jax.block_until_ready(out)
+    res, ls_hist = run(init)
+    jax.block_until_ready(res.x)
     dt = time.perf_counter() - t0
-    final, iters, nls, ls_hist = out
-    print("ls evals per outer iter:", list(np.asarray(ls_hist)[:int(np.asarray(final.k))]))
-    iters_np = np.asarray(iters)
-    conv = np.asarray(final.converged)
-    outer = int(np.asarray(final.k))
-    n_ls = int(np.asarray(nls))
+    iters_np = np.asarray(res.iters)
+    conv = np.asarray(res.converged)
+    outer = int(iters_np.max())
+    ls = np.asarray(ls_hist)[:outer]
+    n_ls = int(ls.sum())
     print(f"fit wall: {dt:.3f}s  ({b/dt:.0f} series/s raw, "
           f"{b*conv.mean()/dt:.0f} converged-only)")
     print(f"outer iterations run: {outer}  (batch moves in lockstep)")
-    print(f"converged frac: {conv.mean():.4f}  failed: {np.asarray(final.failed).mean():.4f}")
+    print(f"converged frac: {conv.mean():.4f}")
+    print(f"ls evals per outer iter: {ls.tolist()}")
     print(f"linesearch evals total: {n_ls}  (avg {n_ls/max(outer,1):.2f}/iter)")
     print(f"objective passes: {n_ls} fwd (linesearch) + {outer+1} vg")
-    est = n_ls * t_fwd + (outer + 1) * t_vg
-    print(f"pass-cost model: {n_ls}x{t_fwd*1e3:.1f}ms + {outer+1}x{t_vg*1e3:.1f}ms"
-          f" = {est:.3f}s  (measured {dt:.3f}s; rest = optimizer algebra)")
     qs = [50, 75, 90, 95, 99, 100]
     print("per-row iters quantiles:",
           {q: int(np.percentile(iters_np, q)) for q in qs})
-    print("iters hist (converged rows):",
-          np.histogram(iters_np[conv], bins=[0, 10, 20, 30, 40, 50, 60, 1000])[0])
 
 
 if __name__ == "__main__":
