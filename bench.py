@@ -139,17 +139,35 @@ def stage(jnp, arrs):
 # ---------------------------------------------------------------------------
 
 
-def _rate_loop(one_series, panel, budget_s):
-    """Per-series rate: run ``one_series(row)`` until the budget is spent."""
+def _rate_loop(one_series, panel, budget_s, chunk: int = 64):
+    """Per-series rate: run ``one_series(row)`` until the budget is spent.
+
+    The rate is the FASTEST observed per-chunk rate, not the whole-run
+    average: the bench host is shared, and a contended stretch would
+    otherwise understate the CPU oracle (and overstate every speedup) by
+    2x between runs.  Best-of timing gives the CPU its best case — the
+    same convention the device side's min-of-N timing uses.
+    """
     t0 = time.perf_counter()
     done = 0
+    best_rate = 0.0
+    c0, cn = t0, 0
     for row in panel:
         one_series(row)
         done += 1
-        if time.perf_counter() - t0 > budget_s:
+        cn += 1
+        now = time.perf_counter()
+        if cn >= chunk:
+            best_rate = max(best_rate, cn / (now - c0))
+            c0, cn = now, 0
+        if now - t0 > budget_s:
             break
     dt = time.perf_counter() - t0
-    return done / dt, done
+    # fold the partial tail only when it is a meaningful sample: a 1-row
+    # "chunk" would let one cheap row (or timer jitter) set the oracle rate
+    if cn >= max(chunk // 2, 2):
+        best_rate = max(best_rate, cn / (time.perf_counter() - c0))
+    return max(best_rate, done / dt), done
 
 
 @functools.lru_cache(maxsize=8)

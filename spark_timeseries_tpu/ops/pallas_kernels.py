@@ -38,6 +38,19 @@ Everything here is optional: callers gate on :func:`supported` and fall back
 to the ``lax.scan`` implementations (same semantics, cross-checked by
 ``tests/test_pallas.py`` in interpret mode and by the on-device parity gate
 in ``bench.py``).
+
+PROFILED HEADROOM (next round): the per-step recursion loops are bounded by
+loop machinery, not arithmetic — the vectorized (full-tile, static-slice)
+rewrite of the non-recursive kernels here (autocorr, HR moments) measured
+~6x over their per-step forms.  The CSS/GARCH/EWMA recursions with q <= 1
+are LINEAR with per-series constant coefficients, i.e. affine maps of the
+carry, so they admit an in-VMEM log-depth doubling scan over composed
+(m, b) pairs exactly like ``ops.seqparallel.sp_ewma_smooth`` does across
+shards — ~10 full-tile steps instead of ~1000 serial ones, for both the
+forward and the (also affine) adjoint recursion.  One invariant to keep:
+the value-only and residual-saving variants of an objective must emit
+BITWISE-identical values (same accumulation association), so the scan
+rewrite must cover every mode of a kernel at once, not just the hot one.
 """
 
 from __future__ import annotations
@@ -1459,14 +1472,19 @@ def fill_linear(y, *, interpret: bool = False):
 def _hr_kernel(lag_y, lag_e, intercept, woff, beta_m, t_limit, cs, *refs):
     """Shared moment-sweep body.  Column streams at step t:
     ``[1 (if intercept), y_{t-1}..y_{t-lag_y}, e_{t-1}..e_{t-lag_e}]``
-    where ``e`` is the on-the-fly AR(beta_m) residual (stage 2 only,
-    ``lag_e > 0``).  Accumulates sum(w * c_a * c_b) for a <= b and
-    sum(w * c_a * y_t) with w = [zb + woff <= t < t_limit]."""
+    where ``e`` is the AR(beta_m) residual (stage 2 only, ``lag_e > 0``).
+    Accumulates sum(w * c_a * c_b) for a <= b and sum(w * c_a * y_t) with
+    ``w = [zb + woff <= t < t_limit]``.
+
+    Nothing here is recursive, so the whole chunk runs as full-tile VPU ops
+    with STATIC time-axis slices (a per-step loop is bounded by loop
+    machinery, not arithmetic); lag reads crossing the chunk boundary come
+    from halo scratches holding the previous chunk's trailing tiles."""
     if lag_e:
-        y_ref, zb_ref, beta_ref, acc_ref, yring_ref, ering_ref = refs
+        y_ref, zb_ref, beta_ref, acc_ref, yhalo_ref, ehalo_ref = refs
     else:
-        y_ref, zb_ref, acc_ref, yring_ref = refs
-        beta_ref = ering_ref = None
+        y_ref, zb_ref, acc_ref, yhalo_ref = refs
+        beta_ref = ehalo_ref = None
     c = pl.program_id(1)
     base = c * cs
     zb = zb_ref[0]
@@ -1480,65 +1498,61 @@ def _hr_kernel(lag_y, lag_e, intercept, woff, beta_m, t_limit, cs, *refs):
         for r_ in range(nacc):
             acc_ref[r_] = _ZERO()
         for j in range(ydepth):
-            yring_ref[j] = _ZERO()
+            yhalo_ref[j] = _ZERO()  # values before the global start are 0
         if lag_e:
             for j in range(edepth):
-                ering_ref[j] = _ZERO()
+                ehalo_ref[j] = _ZERO()
 
-    def body(tl, accs):
-        t = base + tl
-        tf = t.astype(jnp.float32)
-        yt = y_ref[tl]
-        w = ((tf >= zb + woff) & (t < t_limit)).astype(jnp.float32)
+    y = y_ref[:]  # [cs, 8, 128]
+    t_id = base + lax.broadcasted_iota(jnp.int32, (cs, 1, 1), 0)
+    tf = t_id.astype(jnp.float32)
+    w = ((tf >= zb + woff) & (t_id < t_limit)).astype(jnp.float32)
 
-        def ylag(i):
-            v = yring_ref[lax.rem(t - i + ydepth, jnp.asarray(ydepth, t.dtype))]
-            return jnp.where(t - i >= 0, v, 0.0)
+    def shifted(tile, halo_ref_, depth, k):
+        """tile value at t - k (zero-filled before the global start)."""
+        if k == 0:
+            return tile
+        top = jnp.stack([halo_ref_[depth - k + i] for i in range(k)])
+        return jnp.concatenate([top, tile[: cs - k]], axis=0)
 
-        cols = []
-        if intercept:
-            cols.append(None)  # the constant-1 stream, handled symbolically
-        for i in range(1, lag_y + 1):
-            cols.append(ylag(i))
-        if lag_e:
-            # stage-1 residual at t (zero outside its own live window)
-            w1 = ((tf >= zb + beta_m) & (t < t_limit)).astype(jnp.float32)
-            pred = beta_ref[0]
-            for i in range(1, beta_m + 1):
-                pred += beta_ref[i] * ylag(i)
-            et = w1 * (yt - pred)
-            for j in range(1, lag_e + 1):
-                v = ering_ref[lax.rem(t - j + edepth, jnp.asarray(edepth, t.dtype))]
-                cols.append(jnp.where(t - j >= 0, v, 0.0))
+    cols = []
+    if intercept:
+        cols.append(None)  # the constant-1 stream, handled symbolically
+    for i in range(1, lag_y + 1):
+        cols.append(shifted(y, yhalo_ref, ydepth, i))
+    if lag_e:
+        # stage-1 residual stream (zero outside its own live window)
+        w1 = ((tf >= zb + beta_m) & (t_id < t_limit)).astype(jnp.float32)
+        pred = beta_ref[0][None]
+        for i in range(1, beta_m + 1):
+            pred = pred + beta_ref[i][None] * shifted(y, yhalo_ref, ydepth, i)
+        ehat = w1 * (y - pred)
+        for j in range(1, lag_e + 1):
+            cols.append(shifted(ehat, ehalo_ref, edepth, j))
 
-        def cval(a):
-            return 1.0 if cols[a] is None else cols[a]
+    def cval(a):
+        return 1.0 if cols[a] is None else cols[a]
 
-        new = []
-        r_ = 0
-        for a in range(ncols):
-            for b_ in range(a, ncols):
-                ca, cb = cval(a), cval(b_)
-                prod = w if (cols[a] is None and cols[b_] is None) else (
-                    w * cb if cols[a] is None else
-                    (w * ca if cols[b_] is None else w * ca * cb)
-                )
-                new.append(accs[r_] + prod)
-                r_ += 1
-        for a in range(ncols):
-            ca = cval(a)
-            prod = w * yt if cols[a] is None else w * ca * yt
-            new.append(accs[r_] + prod)
+    r_ = 0
+    for a in range(ncols):
+        for b_ in range(a, ncols):
+            prod = w if (cols[a] is None and cols[b_] is None) else (
+                w * cval(b_) if cols[a] is None else
+                (w * cval(a) if cols[b_] is None else w * cval(a) * cval(b_))
+            )
+            acc_ref[r_] = acc_ref[r_] + jnp.sum(prod, axis=0)
             r_ += 1
+    for a in range(ncols):
+        prod = w * y if cols[a] is None else w * cval(a) * y
+        acc_ref[r_] = acc_ref[r_] + jnp.sum(prod, axis=0)
+        r_ += 1
 
-        yring_ref[lax.rem(t, jnp.asarray(ydepth, t.dtype))] = yt
-        if lag_e:
-            ering_ref[lax.rem(t, jnp.asarray(edepth, t.dtype))] = et
-        return tuple(new)
-
-    accs = _fori(cs, body, tuple(acc_ref[r_] for r_ in range(nacc)))
-    for r_ in range(nacc):
-        acc_ref[r_] = accs[r_]
+    # write halos AFTER all shifted() reads of the previous chunk's tiles
+    for j in range(ydepth):
+        yhalo_ref[j] = y[cs - ydepth + j]
+    if lag_e:
+        for j in range(edepth):
+            ehalo_ref[j] = ehat[cs - edepth + j]
 
 
 def _hr_moments(y3, zb3, t, cs, nchunk, nblk, lag_y, lag_e, intercept,
@@ -1638,7 +1652,12 @@ def hr_init(yd, order: Order, include_intercept: bool, n_valid=None, *,
 # product term, so fusing it would force a second sequential sweep anyway).
 
 
-def _autocorr_kernel(nl, t_limit, cs, y_ref, mean_ref, acc_ref, dring_ref):
+def _autocorr_kernel(nl, t_limit, cs, y_ref, mean_ref, acc_ref, halo_ref):
+    # autocorrelation has NO serial recursion, so the whole chunk runs as
+    # full-tile VPU ops with STATIC time-axis slices — a per-step loop (even
+    # with carried registers) is bounded by loop machinery, not arithmetic.
+    # Cross-chunk lag pairs read the previous chunk's last nl centered
+    # values from a halo scratch (static indices, touched once per chunk).
     c = pl.program_id(1)
     base = c * cs
     mean = mean_ref[0]
@@ -1648,25 +1667,22 @@ def _autocorr_kernel(nl, t_limit, cs, y_ref, mean_ref, acc_ref, dring_ref):
         for r in range(nl + 1):
             acc_ref[r] = _ZERO()
         for j in range(nl):
-            dring_ref[j] = _ZERO()
+            halo_ref[j] = _ZERO()  # d before the global start is zero
 
-    def body(tl, accs):
-        t = base + tl
-        yt = y_ref[tl]
-        valid = (yt == yt) & (t < t_limit)
-        d = jnp.where(valid, yt - mean, 0.0)
-        new = [accs[0] + d * d]  # denominator
-        for k_ in range(1, nl + 1):
-            # d_{t-k}: ring slot (t - k) mod nl; zero for t < k
-            dk = dring_ref[lax.rem(t - k_ + nl, jnp.asarray(nl, t.dtype))]
-            dk = jnp.where(t - k_ >= 0, dk, 0.0)
-            new.append(accs[k_] + d * dk)
-        dring_ref[lax.rem(t, jnp.asarray(nl, t.dtype))] = d
-        return tuple(new)
-
-    accs = _fori(cs, body, tuple(acc_ref[r] for r in range(nl + 1)))
-    for r in range(nl + 1):
-        acc_ref[r] = accs[r]
+    y = y_ref[:]  # [cs, 8, 128]
+    t_id = base + lax.broadcasted_iota(jnp.int32, (cs, 1, 1), 0)
+    valid = (y == y) & (t_id < t_limit)
+    d = jnp.where(valid, y - mean, 0.0)
+    acc_ref[0] = acc_ref[0] + jnp.sum(d * d, axis=0)
+    for k_ in range(1, nl + 1):
+        main = jnp.sum(d[k_:] * d[: cs - k_], axis=0)
+        # boundary pairs: local t < k_ partners with halo[nl - k_ + t]
+        bsum = _ZERO()
+        for t_ in range(k_):
+            bsum = bsum + d[t_] * halo_ref[nl - k_ + t_]
+        acc_ref[k_] = acc_ref[k_] + main + bsum
+    for j in range(nl):
+        halo_ref[j] = d[cs - nl + j]
 
 
 @_scoped("pallas.batch_autocorr")
@@ -1676,9 +1692,12 @@ def batch_autocorr(y, num_lags: int, *, interpret: bool = False):
     Matches ``vmap(ops.univariate.autocorr)`` (valid-sample mean/denominator
     convention) to float tolerance.
     """
-    if not 0 < num_lags < _CHUNK_T:
-        raise ValueError(f"num_lags must be in (0, {_CHUNK_T}), got {num_lags}")
     b, t = y.shape
+    if not 0 < num_lags < min(t, _CHUNK_T):
+        raise ValueError(
+            f"num_lags must be in (0, min(T, {_CHUNK_T})) = "
+            f"(0, {min(t, _CHUNK_T)}), got {num_lags}"
+        )
     tp, cs, nchunk = _time_layout(t)
     valid = ~jnp.isnan(y)
     n = jnp.sum(valid, axis=1)

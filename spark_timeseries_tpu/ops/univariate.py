@@ -111,6 +111,10 @@ def autocorr(x: jax.Array, num_lags: int) -> jax.Array:
     over valid (non-NaN) entries; denominators use the full valid sample.
     Replaces ``UnivariateTimeSeries.autocorr`` (reference used Breeze loops).
     """
+    if not 0 < num_lags < x.shape[0]:
+        raise ValueError(
+            f"num_lags must be in (0, series length {x.shape[0]}), got {num_lags}"
+        )
     valid = _isvalid(x)
     n = jnp.sum(valid)
     xz = jnp.where(valid, x, 0.0)
@@ -479,7 +483,7 @@ def batch_autocorr(num_lags: int, backend: str = "auto") -> Callable:
 
         if (
             getattr(panel, "ndim", 0) == 2
-            and 0 < num_lags < pk._CHUNK_T
+            and 0 < num_lags < min(panel.shape[1], pk._CHUNK_T)
             and pk.supported(panel.dtype, panel.shape[1])
         ):
             return pk.batch_autocorr(panel, num_lags)

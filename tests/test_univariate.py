@@ -214,3 +214,16 @@ class TestReviewRegressions:
 
     def test_resample_exported(self):
         assert "resample" in uv.__all__
+
+
+def test_autocorr_lags_exceeding_length_raise_cleanly():
+    # num_lags >= T is undefined (the per-series kernel would build empty
+    # slices; the fused kernel's static slices cannot express it): both
+    # entry points must raise the same clean ValueError, not a shape crash
+    import numpy as np
+
+    y = jnp.asarray(np.random.default_rng(0).normal(size=(4, 10)).astype(np.float32))
+    with pytest.raises(ValueError, match="num_lags"):
+        uv.autocorr(y[0], 20)
+    with pytest.raises(ValueError, match="num_lags"):
+        uv.batch_autocorr(20)(y)
