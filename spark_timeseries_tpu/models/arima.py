@@ -366,60 +366,117 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
 # ---------------------------------------------------------------------------
 
 
-def forecast(params, y, order: Order, n_future: int, include_intercept: bool = True):
+def forecast(params, y, order: Order, n_future: int, include_intercept: bool = True,
+             *, backend: str = "auto"):
     """Forecast ``n_future`` steps ahead -> ``[batch?, n_future]``.
 
     In-sample errors are rebuilt with the CSS recursion, then the ARMA
     recursion runs forward with future innovations set to zero and the
     order-d differencing is inverted step by step (reference
     ``ARIMAModel.forecast`` semantics).
+
+    ``backend`` mirrors :func:`fit`: the in-sample error rebuild — the whole
+    panel-scale cost of a forecast — runs on the fused Pallas ``css_errors``
+    kernel when available (``"auto"``/``"pallas"``), so fit + forecast share
+    one kernel family; the forward extension and inverse differencing are
+    O(batch * n_future) jnp either way.
     """
     yb, single = ensure_batched(y)
     params_b = jnp.atleast_2d(params)
-    out = _forecast_program(order, n_future, include_intercept)(params_b, yb)
+    p, d, q = order
+    from ..ops import pallas_kernels as pk
+
+    backend = resolve_backend(backend, yb.dtype, yb.shape[1] - d,
+                              structural_ok=pk.css_structural_ok(p, q))
+    out = _forecast_program(order, n_future, include_intercept, backend,
+                            align_mode_on_host(yb))(params_b, yb)
     return out[0] if single else out
 
 
 @jit_program
-def _forecast_program(order, n_future, include_intercept):
+def _forecast_program(order, n_future, include_intercept, backend="scan",
+                      align_mode="general"):
     p, d, q = order
 
     def run(params_b, yb):
-        def one(pr, yv):
-            yv, nv0 = align_right(yv)  # ragged support: NaN head/tail
-            yd = _difference(yv, d)
-            c, phi, theta = _split_params(pr, order, include_intercept)
-            e = _css_errors(pr, yd, order, include_intercept, condition=False,
-                            n_valid=nv0 - d)
-            # carries: last p differenced values (newest first), last q errors
-            ydlast = yd[::-1][:p] if p else jnp.zeros((0,), yd.dtype)
-            elast = e[::-1][: max(q, 1)]
+        b = yb.shape[0]
+        with jax.named_scope("arima.forecast_errors"):
+            ya, nv0 = maybe_align(yb, align_mode)  # ragged: NaN head/tail
+            yd = ya
+            for _ in range(d):
+                yd = yd[:, 1:] - yd[:, :-1]
+            nvd = nv0 - d
+            n = yd.shape[1]
+            start = (n - nvd).astype(yd.dtype)  # [B]
+            # differencing across the padding boundary leaves garbage at
+            # yd[start-1]; zero the prefix (same contract as the fit path)
+            t_idx = jnp.arange(n, dtype=yd.dtype)
+            ydz = jnp.where(t_idx[None, :] >= start[:, None], yd, 0.0)
+            if q == 0:
+                # pure-AR forecasts never read past errors: skip the rebuild
+                elast = jnp.zeros((b, 1), yd.dtype)
+            elif backend in ("pallas", "pallas-interpret"):
+                from ..ops import pallas_kernels as _pk
+
+                if include_intercept:
+                    params_k = params_b
+                else:  # kernel layout always carries an intercept slot
+                    params_k = jnp.concatenate(
+                        [jnp.zeros((b, 1), params_b.dtype), params_b], axis=1
+                    )
+                # zb = start (not start + p) is exactly condition=False;
+                # only the last q errors leave the kernel (read-only pass)
+                tail = _pk.css_last_errors(p, q, backend == "pallas-interpret",
+                                           params_k, ydz, start)
+                elast = tail[:, ::-1]  # newest first
+            else:
+                e = jax.vmap(
+                    lambda pr, v, nv: _css_errors(
+                        pr, v, order, include_intercept, condition=False,
+                        n_valid=nv)
+                )(params_b, ydz, nvd)
+                elast = e[:, ::-1][:, :q]
+        with jax.named_scope("arima.forecast_extend"):
+            i0 = int(include_intercept)
+            c = params_b[:, 0] if include_intercept else jnp.zeros((b,), yd.dtype)
+            phi = params_b[:, i0 : i0 + p]
+            theta = params_b[:, i0 + p : i0 + p + q]
+            # carries: last p differenced values (newest first); elast (the
+            # last q errors, newest first) was built above
+            ydlast = ydz[:, ::-1][:, :p] if p else jnp.zeros((b, 0), yd.dtype)
             # last value of each difference level 0..d-1 for integration
             levels = []
-            lv = yv
+            lv = ya
             for _ in range(d):
-                levels.append(lv[-1])
-                lv = lv[1:] - lv[:-1]
-            levels = jnp.asarray(levels, yd.dtype) if d else jnp.zeros((0,), yd.dtype)
+                levels.append(lv[:, -1])
+                lv = lv[:, 1:] - lv[:, :-1]
+            levels = (jnp.stack(levels, axis=1) if d
+                      else jnp.zeros((b, 0), yd.dtype))
 
             def step(carry, _):
                 ydl, el, lvl = carry
-                pred = c + (jnp.dot(phi, ydl) if p else 0.0) + (jnp.dot(theta, el) if q else 0.0)
-                new_ydl = jnp.concatenate([pred[None], ydl[:-1]]) if p else ydl
-                new_el = jnp.concatenate([jnp.zeros((1,), el.dtype), el[:-1]]) if q else el
+                pred = c
+                if p:
+                    pred = pred + jnp.einsum("bi,bi->b", phi, ydl)
+                if q:
+                    pred = pred + jnp.einsum("bj,bj->b", theta, el)
+                new_ydl = (jnp.concatenate([pred[:, None], ydl[:, :-1]], axis=1)
+                           if p else ydl)
+                new_el = (jnp.concatenate(
+                    [jnp.zeros((b, 1), el.dtype), el[:, :-1]], axis=1)
+                    if q else el)
                 # integrate: v_d = pred; v_i = lvl[i] + v_{i+1}
                 acc = pred
                 new_lvl = lvl
                 for i in reversed(range(d)):
-                    acc = lvl[i] + acc
-                    new_lvl = new_lvl.at[i].set(acc)
+                    acc = lvl[:, i] + acc
+                    new_lvl = new_lvl.at[:, i].set(acc)
                 out = acc if d else pred
                 return (new_ydl, new_el, new_lvl), out
 
-            _, future = lax.scan(step, (ydlast, elast, levels), None, length=n_future)
-            return future
-
-        return jax.vmap(one)(params_b, yb)
+            _, future = lax.scan(step, (ydlast, elast, levels), None,
+                                 length=n_future)
+            return future.T  # [n_future, B] -> [B, n_future]
 
     return run
 

@@ -17,6 +17,7 @@ Conventions:
 from __future__ import annotations
 
 import functools
+import weakref
 from typing import NamedTuple
 
 import jax
@@ -101,15 +102,35 @@ def align_mode_on_host(yb) -> str:
     Decided OUTSIDE the jitted program because the roll is the expensive
     part: vmapped ``jnp.roll`` lowers to a batched gather that costs more at
     panel scale (~0.4 s at 100k x 1k) than the entire L-BFGS loop.  The
-    check is one fused reduction + one host sync.  Traced inputs (``fit``
-    called under jit) can't be inspected and take the general path.
+    check is one fused reduction + one host sync — paid ONCE per array:
+    jax arrays are immutable, so the mode is cached per array identity
+    (a weakref guards against id reuse after GC), and repeated un-jitted
+    ``fit``/``forecast`` calls on the same panel skip the device round-trip
+    (VERDICT r3 item 9).  Traced inputs (``fit`` called under jit) can't be
+    inspected and take the general path.
     """
     if isinstance(yb, jax.core.Tracer):
         return "general"
+    key = id(yb)
+    hit = _align_mode_cache.get(key)
+    if hit is not None and hit[0]() is yb:
+        return hit[1]
     nan_any, nan_last = _nan_probe(yb)
     if not bool(nan_any):
-        return "dense"
-    return "no-trailing" if not bool(nan_last) else "general"
+        mode = "dense"
+    else:
+        mode = "no-trailing" if not bool(nan_last) else "general"
+    try:
+        ref = weakref.ref(yb)
+    except TypeError:  # not weak-referenceable (e.g. plain numpy scalarlike)
+        return mode
+    if len(_align_mode_cache) >= 256:
+        _align_mode_cache.clear()
+    _align_mode_cache[key] = (ref, mode)
+    return mode
+
+
+_align_mode_cache: dict = {}  # id(array) -> (weakref, mode)
 
 
 @jax.jit  # module-level: one compile per shape, not per call

@@ -212,14 +212,18 @@ def _css_fwd_kernel(p, q, t_limit, cs, hp, mode, *refs):
     # mode "both": errors out AND the sum, accumulated in the SAME order as
     #              "sum" (the optimizer compares f across both paths; mixed
     #              accumulation orders stall rows at the noise floor)
+    # mode "tail": ONLY the last q errors leave the kernel (the forecast
+    #              carry rebuild: a read-only pass over y instead of a full
+    #              [B, T] error write the caller immediately discards)
     refs = list(refs)
     y_ref = refs.pop(0)
     yp_ref = refs.pop(0) if hp else None
     par_ref = refs.pop(0)
     zb_ref = refs.pop(0)
-    e_ref = refs.pop(0) if mode != "sum" else None
-    css_ref = refs.pop(0) if mode != "e" else None
-    if mode == "sum" and q > 0:
+    e_ref = refs.pop(0) if mode in ("e", "both") else None
+    css_ref = refs.pop(0) if mode in ("sum", "both") else None
+    tail_ref = refs.pop(0) if mode == "tail" else None
+    if mode in ("sum", "tail") and q > 0:
         e_ref = refs.pop(0)  # scratch: lag reads still need recent errors
     ce_ref = refs.pop(0)
     c = pl.program_id(1)
@@ -230,7 +234,7 @@ def _css_fwd_kernel(p, q, t_limit, cs, hp, mode, *refs):
     def _():
         for j in range(max(q, 1)):
             ce_ref[j] = _ZERO()
-        if mode != "e":
+        if css_ref is not None:
             css_ref[0] = _ZERO()
 
     def body(tl, acc):
@@ -251,14 +255,24 @@ def _css_fwd_kernel(p, q, t_limit, cs, hp, mode, *refs):
         e = jnp.where(live, y_ref[tl] - pred, 0.0)
         if e_ref is not None:  # sum mode with q == 0 never reads errors back
             e_ref[tl] = e
-        return (acc + e * e) if mode != "e" else acc
+        return (acc + e * e) if css_ref is not None else acc
 
     # (a guarded-prologue / unguarded-steady-state split was measured to buy
     # nothing: the recursion's serial data dependency, not the boundary
     # selects, bounds each step)
-    acc = _fori(cs, body, _ZERO() if mode != "e" else 0)
-    if mode != "e":
+    acc = _fori(cs, body, _ZERO() if css_ref is not None else 0)
+    if css_ref is not None:
         css_ref[0] = css_ref[0] + acc
+    if tail_ref is not None:
+        # the last q TRUE errors sit at static global positions
+        # t_limit - q + j; each lands in a statically known chunk/slot
+        for j in range(q):
+            g = t_limit - q + j
+            ci, loc = g // cs, g % cs
+
+            @pl.when(c == ci)
+            def _(j=j, loc=loc):
+                tail_ref[j] = e_ref[loc]
     # slot s holds e at global (base + cs) - q + s for the next chunk
     for j in range(q):
         ce_ref[j] = e_ref[cs - q + j]
@@ -355,16 +369,21 @@ def _css_fwd_call(p, q, interpret, mode, params, yd, zb):
     nblk = y3.shape[1] // _SUBL
     hp = nchunk > 1
     out_specs, out_shape = [], []
-    if mode != "sum":
+    if mode in ("e", "both"):
         out_specs.append(_bs(cs, _cur))
         out_shape.append(jax.ShapeDtypeStruct(y3.shape, yd.dtype))
-    if mode != "e":
+    if mode in ("sum", "both"):
         out_specs.append(_bs(1, _fixed))
         out_shape.append(
             jax.ShapeDtypeStruct((1, y3.shape[1], _LANES), yd.dtype)
         )
+    if mode == "tail":
+        out_specs.append(_bs(max(q, 1), _fixed))
+        out_shape.append(
+            jax.ShapeDtypeStruct((max(q, 1), y3.shape[1], _LANES), yd.dtype)
+        )
     scratch = []
-    if mode == "sum" and q > 0:  # errors live in VMEM only (lag reads)
+    if mode in ("sum", "tail") and q > 0:  # errors live in VMEM only
         scratch.append(pltpu.VMEM((cs, _SUBL, _LANES), jnp.float32))
     scratch.append(pltpu.VMEM((max(q, 1), _SUBL, _LANES), jnp.float32))
     outs = pl.pallas_call(
@@ -385,6 +404,31 @@ def _css_errors_fwd(p, q, interpret, params, yd, zb):
     b, t = yd.shape
     (e3,), (y3, par3, zb3) = _css_fwd_call(p, q, interpret, "e", params, yd, zb)
     return _unfold(e3, b)[:, :t], (y3, par3, zb3, e3)
+
+
+@_scoped("pallas.css_last_errors")
+def css_last_errors(p: int, q: int, interpret: bool, params, yd, zb):
+    """The last ``q`` one-step CSS errors ``[B, q]`` (oldest first).
+
+    The forecast carry rebuild (``models.arima.forecast``) needs only the
+    trailing ``q`` errors; this runs the same recursion as
+    :func:`css_errors` but keeps the error panel in VMEM scratch, so the
+    pass reads ``y`` once and writes O(B * q) — not a ``[B, T]`` panel.
+    Not differentiable (forecasting is a post-fit read-only path; use the
+    scan backend for gradients through forecasts).
+    """
+    if not css_structural_ok(p, q):
+        raise ValueError(
+            f"fused CSS kernel supports p, q < {_CHUNK_T} (got p={p}, q={q}); "
+            "use backend='scan'"
+        )
+    if q == 0:
+        return jnp.zeros((yd.shape[0], 0), yd.dtype)
+    if yd.shape[1] < q:
+        raise ValueError(f"series length {yd.shape[1]} < q={q}")
+    b, t = yd.shape
+    (tail3,), _ = _css_fwd_call(p, q, interpret, "tail", params, yd, zb)
+    return _unfold(tail3, b)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))

@@ -304,3 +304,29 @@ class TestArgarchLikelihoodPinned:
         a = garch.argarch_neg_log_likelihood(params, y)
         b = garch.argarch_neg_log_likelihood(params, y, jnp.asarray(n))
         np.testing.assert_allclose(float(a), float(b), rtol=1e-12)
+
+
+class TestAlignModeCache:
+    def test_probe_runs_once_per_array(self, monkeypatch):
+        from spark_timeseries_tpu.models import base
+
+        calls = []
+        orig = base._nan_probe
+
+        def counting(v):
+            calls.append(1)
+            return orig(v)
+
+        monkeypatch.setattr(base, "_nan_probe", counting)
+        rng = np.random.default_rng(0)
+        y = jnp.asarray(rng.normal(size=(4, 64)).astype(np.float32))
+        assert base.align_mode_on_host(y) == "dense"
+        assert base.align_mode_on_host(y) == "dense"  # cached: no new probe
+        assert len(calls) == 1
+        y2 = np.array(y)
+        y2[1, :7] = np.nan
+        y2 = jnp.asarray(y2)
+        assert base.align_mode_on_host(y2) == "no-trailing"  # new array probes
+        assert len(calls) == 2
+        assert base.align_mode_on_host(y2) == "no-trailing"
+        assert len(calls) == 2

@@ -85,6 +85,31 @@ def test_fit_backend_pallas_matches_scan():
     )
 
 
+@pytest.mark.parametrize("order,intercept", [((1, 1, 1), True),
+                                             ((2, 0, 0), True),
+                                             ((1, 1, 1), False),
+                                             ((0, 1, 2), True)])
+def test_forecast_backend_pallas_matches_scan(order, intercept):
+    # the fused forecast path (in-sample error rebuild on the css_errors
+    # kernel with zb=start, i.e. condition=False) must match the vmapped
+    # scan rebuild, including ragged rows
+    y = np.array(_arma_panel(6, 140, d_int=order[1] > 0, seed=11))
+    y[1, :25] = np.nan  # ragged start
+    y[4, :60] = np.nan
+    r = arima.fit(jnp.asarray(y), order, include_intercept=intercept,
+                  backend="scan", max_iters=30)
+    fs = arima.forecast(r.params, jnp.asarray(y), order, 8,
+                        include_intercept=intercept, backend="scan")
+    fp = arima.forecast(r.params, jnp.asarray(y), order, 8,
+                        include_intercept=intercept,
+                        backend="pallas-interpret")
+    fs, fp = np.asarray(fs), np.asarray(fp)
+    finite = np.isfinite(fs).all(axis=1)  # non-invertible rows blow up in both
+    assert finite.sum() >= 4
+    np.testing.assert_allclose(fp[finite], fs[finite], rtol=2e-4, atol=2e-4)
+    assert np.array_equal(np.isfinite(fp), np.isfinite(fs))
+
+
 def test_fit_backend_pallas_ragged():
     y = np.array(_arma_panel(4, 90, d_int=True, seed=6))
     y[0, :17] = np.nan  # leading NaNs (ragged start)
@@ -444,6 +469,24 @@ def test_hw_fit_multiplicative_and_ragged_pallas_matches_scan():
         np.asarray(r_pal.params)[both], np.asarray(r_scan.params)[both],
         rtol=5e-2, atol=5e-2,
     )
+
+
+@pytest.mark.parametrize("t", [53, 2100])  # single-chunk and 3-chunk grids
+def test_css_last_errors_matches_full(t):
+    p, q = 2, 2
+    b = 5
+    y = _arma_panel(b, t, seed=23)
+    rng = np.random.default_rng(24)
+    params = jnp.asarray(rng.normal(size=(b, 1 + p + q)).astype(np.float32) * 0.25)
+    zb = jnp.asarray([0.0, 3.0, 17.0, 0.0, float(t - q - 1)], jnp.float32)
+    full = pk.css_errors(p, q, True, params, y, zb)
+    tail = pk.css_last_errors(p, q, True, params, y, zb)
+    assert tail.shape == (b, q)
+    np.testing.assert_allclose(np.asarray(tail), np.asarray(full)[:, -q:],
+                               rtol=1e-6, atol=1e-6)
+    # q == 0: no errors to rebuild
+    z = pk.css_last_errors(p, 0, True, params[:, :3], y, zb)
+    assert z.shape == (b, 0)
 
 
 # ---------------------------------------------------------------------------
