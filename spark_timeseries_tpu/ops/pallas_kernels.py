@@ -61,6 +61,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.custom_derivatives import SymbolicZero
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -845,6 +846,10 @@ def garch_neg_loglik(params, r, n_valid=None, *, interpret: bool = False):
 #   lam_t     = gbar_t + (1 - alpha) * lam_{t+1}   (no flow into the seed's
 #                                                   predecessor)
 #   dL/dalpha = sum_{t > zb} lam_t * (x_t - s_{t-1})
+#   dL/dx_t   = alpha * lam_t  (t > zb);  lam_zb at the seed (s_zb = x_zb)
+# The data cotangent costs an extra [B, T] write, so it is emitted only
+# when the caller actually differentiates w.r.t. x (symbolic_zeros on the
+# custom_vjp) — the fit hot path (alpha-only) never pays it (ADVICE r3).
 
 
 def _ewma_fwd_kernel(t_limit, cs, mode, *refs):
@@ -893,12 +898,17 @@ def _ewma_fwd_kernel(t_limit, cs, mode, *refs):
         ss_ref[0] = ss_ref[0] + acc
 
 
-def _ewma_bwd_kernel(t_limit, cs, nchunk, hp, *refs):
-    if hp:
-        x_ref, a_ref, zb_ref, s_ref, sp_ref, g_ref, ga_ref, cl_ref = refs
-    else:
-        x_ref, a_ref, zb_ref, s_ref, g_ref, ga_ref, cl_ref = refs
-        sp_ref = None
+def _ewma_bwd_kernel(t_limit, cs, nchunk, hp, want_gx, *refs):
+    refs = list(refs)
+    x_ref = refs.pop(0)
+    a_ref = refs.pop(0)
+    zb_ref = refs.pop(0)
+    s_ref = refs.pop(0)
+    sp_ref = refs.pop(0) if hp else None
+    g_ref = refs.pop(0)
+    ga_ref = refs.pop(0)
+    gx_ref = refs.pop(0) if want_gx else None
+    cl_ref = refs.pop(0)
     c = pl.program_id(1)
     base = (nchunk - 1 - c) * cs
     zb = zb_ref[0]
@@ -921,6 +931,9 @@ def _ewma_bwd_kernel(t_limit, cs, nchunk, hp, *refs):
         sp = jnp.where(tl - 1 >= 0, s_ref[jnp.maximum(tl - 1, 0)], far)
         sp = jnp.where(t - 1 >= 0, sp, 0.0)
         da = da + jnp.where(live & (tf > zb), lam * (x_ref[tl] - sp), 0.0)
+        if gx_ref is not None:
+            # d s_t / d x_t = alpha past the seed, 1 at it (s_zb = x_zb)
+            gx_ref[tl] = jnp.where(live, jnp.where(tf > zb, a * lam, lam), 0.0)
         # the seed step s_zb = x_zb does not read s_{zb-1}
         lam_out = jnp.where(tf > zb, lam, 0.0)
         return lam_out, da
@@ -957,19 +970,8 @@ def _ewma_fwd_call(interpret, mode, alpha, x, zb):
     return outs, (x3, a3, zb3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _ewma_s(interpret: bool, alpha, x, zb):
-    s, _ = _ewma_s_fwd(interpret, alpha, x, zb)
-    return s
-
-
-def _ewma_s_fwd(interpret, alpha, x, zb):
-    b, t = x.shape
-    (s3,), (x3, a3, zb3) = _ewma_fwd_call(interpret, "e", alpha, x, zb)
-    return _unfold(s3, b)[:, :t], (x3, a3, zb3, s3, b, t)
-
-
-def _ewma_s_bwd(interpret, res, g):
+def _ewma_bwd_call(interpret, res, g, want_gx):
+    """Shared EWMA adjoint dispatch -> ``(g_alpha [B], g_x [B, T] | None)``."""
     x3, a3, zb3, s3, b, t = res
     tp = x3.shape[0]
     _, cs, nchunk = _time_layout(t)
@@ -985,24 +987,58 @@ def _ewma_s_bwd(interpret, res, g):
         ins = [_bs(cs, _rev(nchunk)), _bs(1, _fixed), _bs(1, _fixed),
                _bs(cs, _rev(nchunk)), _bs(cs, _rev(nchunk))]
         args = (x3, a3, zb3, s3, g3)
-    ga3 = pl.pallas_call(
-        functools.partial(_ewma_bwd_kernel, t, cs, nchunk, hp),
+    out_specs = [_bs(1, _fixed)]
+    out_shape = [jax.ShapeDtypeStruct(a3.shape, g.dtype)]
+    if want_gx:
+        out_specs.append(_bs(cs, _rev(nchunk)))
+        out_shape.append(jax.ShapeDtypeStruct(x3.shape, g.dtype))
+    outs = pl.pallas_call(
+        functools.partial(_ewma_bwd_kernel, t, cs, nchunk, hp, want_gx),
         grid=(nblk, nchunk),
         in_specs=ins,
-        out_specs=_bs(1, _fixed),
-        out_shape=jax.ShapeDtypeStruct(a3.shape, g.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((1, _SUBL, _LANES), jnp.float32)],
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
     )(*args)
-    return (
-        _unfold(ga3, b)[:, 0],
-        jnp.zeros((b, t), g.dtype),
-        jnp.zeros((b,), g.dtype),
-    )
+    ga = _unfold(outs[0], b)[:, 0]
+    gx = _unfold(outs[1], b)[:, :t] if want_gx else None
+    return ga, gx
 
 
-_ewma_s.defvjp(_ewma_s_fwd, _ewma_s_bwd)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _ewma_s(interpret: bool, alpha, x, zb):
+    b, t = x.shape
+    (s3,), _ = _ewma_fwd_call(interpret, "e", alpha, x, zb)
+    return _unfold(s3, b)[:, :t]
+
+
+def _ewma_s_fwd(interpret, alpha, x, zb):
+    # symbolic_zeros: args are CustomVJPPrimal; .perturbed says whether the
+    # caller differentiates w.r.t. each input.  The x cotangent is computed
+    # only when x is perturbed (an extra [B, T] kernel output otherwise
+    # wasted on the alpha-only fit path).  The marker is structural
+    # (None vs ()) so the bwd branch is resolved at trace time.
+    alpha_p, x_p, zb_p = alpha.value, x.value, zb.value
+    b, t = x_p.shape
+    (s3,), (x3, a3, zb3) = _ewma_fwd_call(interpret, "e", alpha_p, x_p, zb_p)
+    marker = () if x.perturbed else None
+    return _unfold(s3, b)[:, :t], (x3, a3, zb3, s3, b, t, marker)
+
+
+def _ewma_s_bwd(interpret, res, g):
+    x3, a3, zb3, s3, b, t, marker = res
+    if isinstance(g, SymbolicZero):
+        g = jnp.zeros(g.shape, g.dtype)
+    want_gx = marker is not None
+    ga, gx = _ewma_bwd_call(interpret, (x3, a3, zb3, s3, b, t), g, want_gx)
+    if gx is None:
+        gx = jnp.zeros((b, t), g.dtype)
+    return ga, gx, jnp.zeros((b,), g.dtype)
+
+
+_ewma_s.defvjp(_ewma_s_fwd, _ewma_s_bwd, symbolic_zeros=True)
 
 
 def ewma_smooth(alpha, x, zb, *, interpret: bool = False):
@@ -1029,13 +1065,19 @@ def _ewma_ssq(interpret: bool, alpha, xz, zb):
 
 
 def _ewma_ssq_fwd(interpret, alpha, xz, zb):
-    b, t = xz.shape
-    (s3, ss3), (x3, a3, zb3) = _ewma_fwd_call(interpret, "both", alpha, xz, zb)
-    return _unfold(ss3, b)[:, 0], (x3, a3, zb3, s3, xz, zb, b, t)
+    alpha_p, x_p, zb_p = alpha.value, xz.value, zb.value
+    b, t = x_p.shape
+    (s3, ss3), (x3, a3, zb3) = _ewma_fwd_call(interpret, "both", alpha_p,
+                                              x_p, zb_p)
+    marker = () if xz.perturbed else None  # see _ewma_s_fwd
+    return _unfold(ss3, b)[:, 0], (x3, a3, zb3, s3, x_p, zb_p, b, t, marker)
 
 
 def _ewma_ssq_bwd(interpret, resid, gbar):
-    x3, a3, zb3, s3, xz, zb, b, t = resid
+    x3, a3, zb3, s3, xz, zb, b, t, marker = resid
+    if isinstance(gbar, SymbolicZero):
+        gbar = jnp.zeros(gbar.shape, gbar.dtype)
+    want_gx = marker is not None
     s = _unfold(s3, b)[:, :t]
     t_idx = jnp.arange(t, dtype=xz.dtype)
     live_e = t_idx[None, 1:] > zb[:, None]  # err_t = x_t - s_{t-1}, t > seed
@@ -1044,11 +1086,21 @@ def _ewma_ssq_bwd(interpret, resid, gbar):
     g_s = jnp.concatenate(
         [-2.0 * err * gbar[:, None], jnp.zeros((b, 1), xz.dtype)], axis=1
     )
-    g_alpha, _, _ = _ewma_s_bwd(interpret, (x3, a3, zb3, s3, b, t), g_s)
-    return g_alpha, jnp.zeros_like(xz), jnp.zeros_like(zb)
+    g_alpha, gx_chain = _ewma_bwd_call(
+        interpret, (x3, a3, zb3, s3, b, t), g_s, want_gx
+    )
+    if want_gx:
+        # direct term: d err_t^2 / d x_t = 2 err_t (the smoothing-path term
+        # -2 err_t * d s_{t-1}/dx came through the adjoint kernel above)
+        gx = gx_chain + jnp.concatenate(
+            [jnp.zeros((b, 1), xz.dtype), 2.0 * err * gbar[:, None]], axis=1
+        )
+    else:
+        gx = jnp.zeros_like(xz)
+    return g_alpha, gx, jnp.zeros_like(zb)
 
 
-_ewma_ssq.defvjp(_ewma_ssq_fwd, _ewma_ssq_bwd)
+_ewma_ssq.defvjp(_ewma_ssq_fwd, _ewma_ssq_bwd, symbolic_zeros=True)
 
 
 @_scoped("pallas.ewma_sse")

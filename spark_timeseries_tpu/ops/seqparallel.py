@@ -140,41 +140,26 @@ def sp_differences(block: jax.Array, k_lag: int = 1) -> jax.Array:
     return jnp.where(gpos[None, :] < k_lag, jnp.nan, out)
 
 
-def sp_ewma_smooth(block: jax.Array, alpha: jax.Array) -> jax.Array:
-    """EWMA smoothing of time-sharded series (matches ``ewma.smooth`` on
-    unsharded data; seeds ``s_0 = x_0``).
+def _affine_scan_sharded(m_elem: jax.Array, b_elem: jax.Array) -> jax.Array:
+    """Inclusive scan of the affine recursion ``s_t = m_t * s_{t-1} + b_t``
+    along a time-sharded axis, carry entering the global front = 0.
 
-    A first-order linear recurrence is an AFFINE map of its entering carry:
-    every step is ``s -> m*s + b`` with ``(m, b) = (1-a, a*x_t)`` (and the
-    global seed ``s_0 = x_0`` is just ``(0, x_0)``), and affine maps compose
-    associatively — so BOTH levels parallelize: inside a shard a log-depth
-    ``associative_scan`` over the (m, b) pairs, across shards one tiny fold
-    of each shard's composed exit pair over the all-gathered values
-    (generalizing :func:`sp_cumsum`'s offset trick to model recursions).
-    ``alpha``: ``[keys_local]`` smoothing weights (one per series).
-
-    Assumes dense data (fill first) — the seed position is global t = 0.
+    Affine maps compose associatively, so BOTH levels parallelize: inside a
+    shard a log-depth ``associative_scan`` over the (m, b) pairs, across
+    shards one tiny fold of each shard's composed exit pair over the
+    all-gathered values (generalizing :func:`sp_cumsum`'s offset trick to
+    model recursions).  A global seed or dead prefix is encoded in the
+    ELEMENTS (``m = 0`` cuts the incoming carry).
     """
-    k, tl = block.shape
-    a = alpha[:, None]
-    idx = _axis_index()
-    first = idx == 0
-    pos0 = jnp.arange(tl)[None, :] == 0
-    seed = first & pos0  # global t = 0: s = x_0 regardless of the carry
-    m_elem = jnp.where(seed, 0.0, jnp.broadcast_to(1.0 - a, (k, tl)))
-    b_elem = jnp.where(seed, block, a * block)
-
     def comp(l, r):  # apply l then r: r(l(s)) = (rm*lm) s + (rb + rm*lb)
         lm, lb = l
         rm, rb = r
         return lm * rm, rb + rm * lb
 
     decay, p = lax.associative_scan(comp, (m_elem, b_elem), axis=1)
-    # s_t = decay_t * s_in + p_t; the first shard's seed zeroes decay
-    m_exit = decay[:, -1:]
-    b_exit = p[:, -1:]
-    gm = lax.all_gather(m_exit, TIME_AXIS, axis=1, tiled=True)  # [k, nshards]
-    gb = lax.all_gather(b_exit, TIME_AXIS, axis=1, tiled=True)
+    # s_t = decay_t * s_in + p_t for the carry s_in entering this shard
+    gm = lax.all_gather(decay[:, -1:], TIME_AXIS, axis=1, tiled=True)
+    gb = lax.all_gather(p[:, -1:], TIME_AXIS, axis=1, tiled=True)
 
     def fold(c, mb):
         m, b = mb
@@ -183,10 +168,85 @@ def sp_ewma_smooth(block: jax.Array, alpha: jax.Array) -> jax.Array:
 
     _, carries = lax.scan(fold, jnp.zeros_like(gm[:, 0]), (gm.T, gb.T))
     carries = carries.T  # [k, nshards]: carry EXITING each shard
+    idx = _axis_index()
+    first = idx == 0
     entering = jnp.where(
         first, jnp.zeros_like(carries[:, 0]), carries[:, jnp.maximum(idx - 1, 0)]
     )
     return decay * entering[:, None] + p
+
+
+def sp_ewma_smooth(block: jax.Array, alpha: jax.Array) -> jax.Array:
+    """EWMA smoothing of time-sharded series (matches ``ewma.smooth`` on
+    unsharded data; seeds ``s_0 = x_0``).
+
+    Every step is the affine map ``s -> (1-a) s + a x_t`` (the global seed
+    ``s_0 = x_0`` is just ``(0, x_0)``) — see :func:`_affine_scan_sharded`.
+    ``alpha``: ``[keys_local]`` smoothing weights (one per series).
+
+    Assumes dense data (fill first) — the seed position is global t = 0.
+    """
+    k, tl = block.shape
+    a = alpha[:, None]
+    first = _axis_index() == 0
+    pos0 = jnp.arange(tl)[None, :] == 0
+    seed = first & pos0  # global t = 0: s = x_0 regardless of the carry
+    m_elem = jnp.where(seed, 0.0, jnp.broadcast_to(1.0 - a, (k, tl)))
+    b_elem = jnp.where(seed, block, a * block)
+    return _affine_scan_sharded(m_elem, b_elem)
+
+
+def _shift1_from_left(block: jax.Array) -> jax.Array:
+    """``x_{t-1}`` along the sharded time axis (global position 0 gets 0)."""
+    halo = _halo_from_left(block, 1)
+    return jnp.concatenate([halo, block], axis=1)[:, : block.shape[1]]
+
+
+def _gpos(tl: int):
+    """Global time positions of this shard's columns ``[1, tl]``."""
+    return (_axis_index() * tl + jnp.arange(tl, dtype=jnp.int32))[None, :]
+
+
+def sp_ewma_sse(block: jax.Array, alpha: jax.Array) -> jax.Array:
+    """One-step-ahead EWMA SSE of time-sharded series ``[keys_local]``
+    (matches ``ewma.sse`` on dense unsharded data): the distributed FIT
+    objective — smoothing via the affine scan, the ``s_{t-1}`` lag via a
+    1-column halo, the sum via ``psum`` over the time axis."""
+    s = sp_ewma_smooth(block, alpha)
+    sprev = _shift1_from_left(s)
+    err = jnp.where(_gpos(block.shape[1]) >= 1, block - sprev, 0.0)
+    return lax.psum(jnp.sum(err * err, axis=1), TIME_AXIS)
+
+
+def sp_css_neg_loglik(params: jax.Array, yd: jax.Array, d_dead: int) -> jax.Array:
+    """Conditional-sum-of-squares negative log-likelihood of ARMA(1,1) with
+    intercept on a time-sharded differenced panel -> ``[keys_local]``.
+
+    ``params``: ``[keys_local, 3]`` rows ``[c, phi, theta]``; ``yd``: this
+    shard of the differenced series laid out on the ORIGINAL time grid with
+    the first ``d_dead`` global positions zeroed (order-d differencing keeps
+    shapes static by leaving a dead prefix).  Matches
+    ``models.arima.css_neg_loglik`` with order (1, 0, 1) on the trimmed
+    vector: the error recursion ``e_t = u_t - theta e_{t-1}`` with
+    ``u_t = yd_t - c - phi yd_{t-1}`` is affine in the carry, so it runs as
+    a log-depth :func:`_affine_scan_sharded`; the first valid error (the
+    conditional ``p = 1`` prefix) is zeroed.
+    """
+    tl = yd.shape[1]
+    c = params[:, 0:1]
+    phi = params[:, 1:2]
+    theta = params[:, 2:3]
+    ydprev = _shift1_from_left(yd)
+    u = yd - c - phi * ydprev
+    live = _gpos(tl) >= d_dead + 1  # dead prefix + the conditional p=1 zero
+    m_elem = jnp.where(live, jnp.broadcast_to(-theta, u.shape), 0.0)
+    b_elem = jnp.where(live, u, 0.0)
+    e = _affine_scan_sharded(m_elem, b_elem)
+    css = lax.psum(jnp.sum(e * e, axis=1), TIME_AXIS)
+    n = tl * _axis_size()
+    n_eff = (n - d_dead) - 1
+    sigma2 = css / n_eff
+    return 0.5 * n_eff * (jnp.log(2.0 * jnp.pi * sigma2) + 1.0)
 
 
 def _carry_fold_across_shards(exit_v, exit_i, exit_f, reverse: bool):
@@ -346,3 +406,134 @@ def sp_ewma_smooth_sharded(mesh: Mesh, values: jax.Array, alpha: jax.Array) -> j
         out_specs=P(SERIES_AXIS, TIME_AXIS),
     )
     return jax.jit(fn)(values, alpha)
+
+
+# ---------------------------------------------------------------------------
+# Time-sharded model FITS (SURVEY.md §5.7 stretch: the reference cannot fit
+# a series longer than one executor's memory; here the fit OBJECTIVE itself
+# runs on the 2-D mesh, so the optimizer never materializes a whole series)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _sp_ewma_fit_program(mesh: Mesh, n: int, max_iters: int, tol: float):
+    """One compiled distributed-fit program per (mesh, length, budget) —
+    the ``jit_program`` discipline (``models.base``): without this every
+    call would re-trace and re-compile the whole distributed L-BFGS."""
+    from ..models.base import FitResult
+    from ..utils import optim
+
+    sse_sh = shard_map(
+        sp_ewma_sse, mesh=mesh,
+        in_specs=(P(SERIES_AXIS, TIME_AXIS), P(SERIES_AXIS)),
+        out_specs=P(SERIES_AXIS),
+    )
+    n_eff = float(max(n - 1, 1))
+
+    @jax.jit
+    def run(vals):
+        def fb(u):
+            alpha = optim.sigmoid_to_interval(u[:, 0], 0.0, 1.0)
+            return sse_sh(vals, alpha) / n_eff
+
+        u0 = jnp.zeros((vals.shape[0], 1), vals.dtype)
+        res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
+        alpha = optim.sigmoid_to_interval(res.x, 0.0, 1.0)
+        return FitResult(alpha, res.f * n_eff, res.converged, res.iters)
+
+    return run
+
+
+def sp_ewma_fit(mesh: Mesh, values: jax.Array, *, max_iters: int = 40,
+                tol: float | None = None):
+    """Fit EWMA ``alpha`` per series on a time-sharded dense panel.
+
+    Matches ``models.ewma.fit`` (dense case) to optimizer tolerance: the
+    same sigmoid-transformed mean-SSE objective and batched L-BFGS, with
+    every objective/gradient evaluation a ``shard_map`` program over the
+    2-D mesh (collectives ride ICI).  Returns a ``FitResult`` with
+    ``params [keys, 1]``.
+    """
+    if tol is None:  # same dtype-dependent default as models.ewma.fit
+        tol = 1e-8 if values.dtype == jnp.float64 else 1e-4
+    return _sp_ewma_fit_program(
+        mesh, values.shape[1], max_iters, float(tol)
+    )(values)
+
+
+@functools.lru_cache(maxsize=64)
+def _sp_arima_fit_program(mesh: Mesh, n: int, d: int, max_iters: int,
+                          tol: float):
+    """One compiled distributed ARIMA-fit program per configuration (see
+    :func:`_sp_ewma_fit_program`)."""
+    from ..models.base import FitResult
+    from ..utils import optim
+
+    spec2, spec1 = P(SERIES_AXIS, TIME_AXIS), P(SERIES_AXIS)
+
+    def diff_dead(v):
+        # order-d differencing on the original grid: position t holds
+        # yd_t = sum_j (-1)^j C(d,j) y_{t-j}; the first d positions are dead
+        for _ in range(d):
+            prev = _shift1_from_left(v)
+            v = v - prev
+        return jnp.where(_gpos(v.shape[1]) >= d, v, 0.0)
+
+    def init_local(ydb):
+        # Yule-Walker AR(1) moments over the LIVE span
+        tl = ydb.shape[1]
+        live = (_gpos(tl) >= d).astype(ydb.dtype)
+        cnt = lax.psum(jnp.sum(live, axis=1), TIME_AXIS)
+        mean = lax.psum(jnp.sum(ydb * live, axis=1), TIME_AXIS) / cnt
+        dd = (ydb - mean[:, None]) * live
+        c0 = lax.psum(jnp.sum(dd * dd, axis=1), TIME_AXIS)
+        ddprev = _shift1_from_left(dd)
+        # lag products whose partner is dead contribute zero (dd zeroed)
+        c1 = lax.psum(jnp.sum(dd * ddprev, axis=1), TIME_AXIS)
+        phi0 = jnp.clip(c1 / jnp.maximum(c0, 1e-30), -0.95, 0.95)
+        c_init = mean * (1.0 - phi0)
+        return jnp.stack([c_init, phi0, jnp.zeros_like(phi0)], axis=1)
+
+    diff_sh = shard_map(diff_dead, mesh=mesh, in_specs=(spec2,),
+                        out_specs=spec2)
+    init_sh = shard_map(init_local, mesh=mesh, in_specs=(spec2,),
+                        out_specs=spec1)
+    nll_sh = shard_map(
+        functools.partial(sp_css_neg_loglik, d_dead=d), mesh=mesh,
+        in_specs=(P(SERIES_AXIS, None), spec2),
+        out_specs=spec1,
+    )
+    n_eff = float(max((n - d) - 1, 1))
+
+    @jax.jit
+    def run(vals):
+        yd = diff_sh(vals)
+        p0 = init_sh(yd)
+
+        def fb(params):
+            return nll_sh(params, yd) / n_eff
+
+        res = optim.minimize_lbfgs_batched(fb, p0, max_iters=max_iters, tol=tol)
+        return FitResult(res.x, res.f * n_eff, res.converged, res.iters)
+
+    return run
+
+
+def sp_arima_fit(mesh: Mesh, values: jax.Array, d: int = 1, *,
+                 max_iters: int = 60, tol: float | None = None):
+    """Fit ARIMA(1, d, 1) with intercept per series on a time-sharded dense
+    panel -> ``FitResult`` with ``params [keys, 3]`` rows ``[c, phi, theta]``.
+
+    The headline model family, time-sharded end to end: order-d differencing
+    (halo exchanges, dead prefix kept on the grid), a Yule-Walker-style init
+    from the sharded moments (``phi = autocov_1 / autocov_0``, the
+    distributed stand-in for Hannan-Rissanen), then batched L-BFGS on
+    :func:`sp_css_neg_loglik` — every evaluation one ``shard_map`` program.
+    Matches ``models.arima.fit`` backends to optimizer tolerance on the same
+    panel (both minimize the identical CSS objective).
+    """
+    if tol is None:  # same dtype-dependent default as models.arima.fit
+        tol = 1e-6 if values.dtype == jnp.float64 else 1e-4
+    return _sp_arima_fit_program(
+        mesh, values.shape[1], d, max_iters, float(tol)
+    )(values)

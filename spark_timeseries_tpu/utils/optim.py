@@ -48,6 +48,12 @@ class _State(NamedTuple):
     converged: jax.Array
     failed: jax.Array  # line search broke down
     tprev: jax.Array  # last accepted linesearch step (warm-start)
+    # best-seen iterate: the noise-floor-relaxed accept can adopt a step
+    # that RAISES f by up to ftol*max(1,|f|) (and ftol-convergence then
+    # freezes there), so the returned (x, f) is the best visited point,
+    # guaranteeing f(returned) <= f(x0) (ADVICE r3)
+    bx: jax.Array
+    bf: jax.Array
 
 
 def _two_loop(g, s_hist, y_hist, rho_hist, k, m):
@@ -134,6 +140,8 @@ def minimize_lbfgs(
         converged=(jnp.linalg.norm(g0) < tol) & jnp.isfinite(f0),
         failed=jnp.isinf(f0),
         tprev=jnp.ones((), dtype),
+        bx=x0,
+        bf=f0,
     )
 
     def linesearch(x, f, g, direction, t0):
@@ -212,6 +220,7 @@ def minimize_lbfgs(
         conv = conv | (
             accept & (state.f - f_new2 <= ftol * jnp.maximum(1.0, jnp.abs(f_new2)))
         )
+        better = f_out < state.bf
         return _State(
             k=state.k + 1,
             x=x_out,
@@ -223,16 +232,20 @@ def minimize_lbfgs(
             converged=conv,
             failed=state.failed | (~ok & ~conv),
             tprev=jnp.where(accept, t, state.tprev),
+            bx=jnp.where(better, x_out, state.bx),
+            bf=jnp.where(better, f_out, state.bf),
         )
 
     def cond(state: _State):
         return (state.k < max_iters) & ~state.converged & ~state.failed
 
     final = lax.while_loop(cond, step, init)
+    # (x, f) is the best-seen iterate; grad_norm remains the LAST iterate's
+    # (the two differ by at most the ftol noise floor in f)
     return LBFGSResult(
-        x=final.x,
-        f=final.f,
-        converged=final.converged & jnp.isfinite(final.f),
+        x=final.bx,
+        f=final.bf,
+        converged=final.converged & jnp.isfinite(final.bf),
         iters=final.k,
         grad_norm=jnp.linalg.norm(final.g),
     )
@@ -294,6 +307,8 @@ def minimize_lbfgs_batched(
         converged=(rownorm(g0) < tol) & jnp.isfinite(f0),
         failed=jnp.isinf(f0),
         tprev=jnp.ones((bsz,), dtype),
+        bx=x0,
+        bf=f0,
     )
     iters0 = jnp.zeros((bsz,), jnp.int32)
 
@@ -390,6 +405,7 @@ def minimize_lbfgs_batched(
         conv = conv | (
             accept & (state.f - f_new <= ftol * jnp.maximum(1.0, jnp.abs(f_new)))
         )
+        better = f_out < state.bf
         new_state = _State(
             k=state.k + 1,
             x=x_out,
@@ -401,6 +417,8 @@ def minimize_lbfgs_batched(
             converged=conv,
             failed=state.failed | (~ok & ~conv & ~done),
             tprev=jnp.where(accept, t, state.tprev),
+            bx=jnp.where(better[:, None], x_out, state.bx),
+            bf=jnp.where(better, f_out, state.bf),
         )
         iters = jnp.where(done, iters, state.k + 1)
         if ls_hist is not None:
@@ -413,10 +431,12 @@ def minimize_lbfgs_batched(
 
     ls0 = jnp.zeros((max_iters,), jnp.int32) if count_evals else None
     final, iters, ls_hist = lax.while_loop(cond, step, (init, iters0, ls0))
+    # (x, f) is the best-seen iterate per row; grad_norm remains the LAST
+    # iterate's (the two differ by at most the ftol noise floor in f)
     result = LBFGSResult(
-        x=final.x,
-        f=final.f,
-        converged=final.converged & jnp.isfinite(final.f),
+        x=final.bx,
+        f=final.bf,
+        converged=final.converged & jnp.isfinite(final.bf),
         iters=iters,
         grad_norm=rownorm(final.g),
     )

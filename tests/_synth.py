@@ -1,0 +1,19 @@
+"""Shared synthetic-panel generators for tests.
+
+The 2-process distributed test fits a panel in worker processes and
+regenerates THE SAME panel in the parent for comparison — both sides must
+call one generator (a drifted copy reads as a distributed-correctness bug).
+"""
+
+import numpy as np
+
+
+def gen_arma_panel(b, t, seed=0, phi=0.6, theta=0.3, integrate=True):
+    """ARMA(1,1) innovations panel ``[b, t]`` (float32), optionally
+    integrated once (the d=1 ARIMA test family)."""
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(b, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    for i in range(1, t):
+        y[:, i] = phi * y[:, i - 1] + e[:, i] + theta * e[:, i - 1]
+    return np.cumsum(y, axis=1) if integrate else y

@@ -62,3 +62,29 @@ class TestLBFGS:
         assert float(x.min()) > 0.1 and float(x.max()) < 0.9
         back = optim.interval_to_sigmoid(x, 0.1, 0.9)
         np.testing.assert_allclose(np.asarray(back), np.asarray(u), atol=1e-5)
+
+    def test_returned_f_is_best_seen(self):
+        # ADVICE r3: the noise-floor-relaxed accept may adopt a step that
+        # RAISES f slightly; the returned (x, f) must be the best visited
+        # point, so f(returned) <= f(x0) and f == fun(x) exactly
+        rng = np.random.default_rng(31)
+        targets = jnp.asarray(rng.normal(size=(8, 3)).astype(np.float32))
+
+        def fun_b(X):
+            return jnp.sum((X - targets) ** 2, axis=-1)
+
+        x0 = jnp.asarray(rng.normal(size=(8, 3)).astype(np.float32) * 3)
+        res = optim.minimize_lbfgs_batched(fun_b, x0, max_iters=50)
+        f0 = fun_b(x0)
+        assert bool(jnp.all(res.f <= f0 + 1e-6))
+        np.testing.assert_allclose(
+            np.asarray(fun_b(res.x)), np.asarray(res.f), rtol=1e-6, atol=1e-6
+        )
+        # per-series variant holds the same contract
+        one = optim.minimize_lbfgs(
+            lambda x: jnp.sum((x - targets[0]) ** 2), x0[0], max_iters=50
+        )
+        assert float(one.f) <= float(fun_b(x0)[0]) + 1e-6
+        np.testing.assert_allclose(
+            float(jnp.sum((one.x - targets[0]) ** 2)), float(one.f), rtol=1e-6
+        )

@@ -338,6 +338,48 @@ def test_ewma_sse_and_grad_matches_scan():
     np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref), rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("t", [61, 2100])  # single-chunk and chunked grids
+def test_ewma_data_gradient_matches_scan(t):
+    # ADVICE r3: jax.grad of the fused EWMA objectives w.r.t. the DATA used
+    # to silently return zeros; the adjoint kernel now emits the true x
+    # cotangent when (and only when) x is perturbed
+    from spark_timeseries_tpu.models import ewma
+
+    b = 4
+    rng = np.random.default_rng(23)
+    x = jnp.asarray(np.cumsum(rng.normal(size=(b, t)), axis=1).astype(np.float32))
+    nv = jnp.asarray([t, t - 7, t - 1, max(t - t // 3, 3)], jnp.int32)
+    alpha = jnp.asarray(rng.uniform(0.2, 0.8, b).astype(np.float32))
+    start = (t - nv).astype(jnp.float32)
+    xz = jnp.where(jnp.arange(t)[None, :] >= start[:, None], x, 0.0)
+
+    def sse_scan(x_):
+        return jnp.sum(jax.vmap(lambda a, v, n: ewma.sse(a, v, n))(alpha, x_, nv))
+
+    def sse_pal(x_):
+        return jnp.sum(pk.ewma_sse(alpha, x_, nv, interpret=True))
+
+    gx_ref = jax.grad(sse_scan)(xz)
+    gx_got = jax.grad(sse_pal)(xz)
+    np.testing.assert_allclose(np.asarray(gx_got), np.asarray(gx_ref),
+                               rtol=1e-4, atol=1e-4)
+
+    # the smoothing op's x cotangent (weighted-sum pullback)
+    w = jnp.asarray(rng.normal(size=(b, t)).astype(np.float32))
+
+    def sm_scan(x_):
+        s = jax.vmap(lambda a, v, n: ewma.smooth(a, v, n))(alpha, x_, nv)
+        return jnp.sum(w * s)
+
+    def sm_pal(x_):
+        return jnp.sum(w * pk.ewma_smooth(alpha, x_, start, interpret=True))
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(sm_pal)(xz)), np.asarray(jax.grad(sm_scan)(xz)),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
 def test_ewma_fit_backend_pallas_matches_scan():
     from spark_timeseries_tpu.models import ewma
 

@@ -49,8 +49,9 @@ def _free_port() -> int:
 
 def test_two_process_distributed_fit(tmp_path):
     """Run ``jax.distributed`` FOR REAL: two local processes, one global
-    4-device mesh (2 forced CPU devices each), a sharded EWMA fit — the
-    result must match a single-process fit bit-for-bit in f32 tolerance.
+    4-device mesh (2 forced CPU devices each), a sharded ARIMA(1,1,1) fit
+    (the headline program: differencing + Hannan-Rissanen init + batched
+    L-BFGS) — the result must match a single-process fit in f32 tolerance.
     (VERDICT round 2 item 3: ``jax.distributed.initialize`` had never
     executed; every prior test monkeypatched around it.)"""
     worker = pathlib.Path(__file__).parent / "_distributed_worker.py"
@@ -72,7 +73,9 @@ def test_two_process_distributed_fit(tmp_path):
     logs = []
     try:
         for p in procs:
-            stdout, _ = p.communicate(timeout=180)
+            # the ARIMA program compiles in each worker without a shared
+            # cache (~60-90 s cold on a busy host): budget accordingly
+            stdout, _ = p.communicate(timeout=300)
             logs.append(stdout.decode(errors="replace"))
     except subprocess.TimeoutExpired:
         # skip (not fail) so a slow/overloaded CI host cannot redden the
@@ -97,13 +100,15 @@ def test_two_process_distributed_fit(tmp_path):
         dist_params = z["params"]
         dist_conv = z["converged"]
 
-    # single-process reference on the identical panel — conftest.py pins the
-    # parent pytest process to pure CPU too, so this is like-for-like
-    from spark_timeseries_tpu.models import ewma
+    # single-process reference on the identical panel (same generator the
+    # worker imports) — conftest.py pins the parent pytest process to pure
+    # CPU too, so this is like-for-like
+    from _synth import gen_arma_panel
 
-    rng = np.random.default_rng(0)
-    y = rng.normal(size=(8, 64)).cumsum(axis=1).astype(np.float32)
-    ref = ewma.fit(jnp.asarray(y))
+    from spark_timeseries_tpu.models import arima
+
+    y = gen_arma_panel(8, 96, seed=0)
+    ref = arima.fit(jnp.asarray(y), (1, 1, 1), backend="scan", max_iters=30)
     np.testing.assert_allclose(dist_params, np.asarray(ref.params),
-                               rtol=1e-5, atol=1e-5)
+                               rtol=1e-4, atol=1e-4)
     np.testing.assert_array_equal(dist_conv, np.asarray(ref.converged))
