@@ -54,6 +54,36 @@ CPU_BUDGET_S = 30.0  # max wall time per CPU oracle measurement
 HBM_PEAK_GBPS = 819.0  # TPU v5e HBM bandwidth (roofline denominator)
 
 
+def _marginal(run_k, run_1, k, b, actual_bytes_per_panel, reps=12):
+    """Dispatch-cost-free device throughput (VERDICT r3 item 2): the
+    K-panel dispatch minus the structurally identical 1-panel dispatch,
+    divided by K-1, cancels the fixed dispatch / tunnel-round-trip cost
+    (~100 ms on a tunneled chip — bigger than the kernel itself).
+
+    PAIRED interleaved timing: the two programs alternate and the MEDIAN of
+    per-pair differences is used, so slow host drift cancels and a single
+    jitter spike cannot set the estimate.  A physics clamp rejects draws
+    that would imply the program streamed its actual traffic above HBM
+    peak — such a "measurement" is jitter, not throughput — returning
+    ``(None, None)`` instead of an absurd rate."""
+    tks, t1s = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run_k()
+        tks.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_1()
+        t1s.append(time.perf_counter() - t0)
+    diffs = [a - c for a, c in zip(tks, t1s)]
+    # two estimators, take the more CONSERVATIVE (larger) one: the median of
+    # paired diffs (drift-cancelling) and the difference of per-program
+    # floors (spike-resistant); min-of-diffs is biased fast and not used
+    per = max(float(np.median(diffs)), min(tks) - min(t1s)) / (k - 1)
+    if per <= 0 or actual_bytes_per_panel / per > 1.1 * HBM_PEAK_GBPS * 1e9:
+        return None, None
+    return per, b / per
+
+
 def _roofline(bytes_moved, seconds):
     """Roofline accounting for a memory-bound transform (VERDICT r3 item 2).
 
@@ -467,32 +497,54 @@ def bench_autocorr_at_scale(jnp, quick, on_tpu):
     K = 2 if quick else 8
     kern = uv.batch_autocorr(lags)  # jitted internally, both backends
 
-    @jax.jit
-    def many(v):
-        s = 0.0
-        for i in range(K):
-            s = s + jnp.sum(kern(v + 0.1 * i))  # distinct input per call
-        return s
+    def make_many(k):
+        @jax.jit
+        def many(v):
+            s = 0.0
+            for i in range(k):
+                s = s + jnp.sum(kern(v + 0.1 * i))  # distinct input per call
+            return s
+
+        return many
+
+    many, many1 = make_many(K), make_many(1)
 
     panels = [
         np.cumsum(np.random.default_rng(s).normal(size=(b, t)), axis=1).astype(np.float32)
         for s in range(3)
     ]
     dev = stage(jnp, panels)
-    times = time_calls(lambda v: float(many(v)), dev)
+    times = time_calls(lambda v: float(many(v)), dev * 2)
     rate = K * b / min(times)
     # ADVICE r3: also publish the single-dispatch rate so cross-round
     # comparisons can't silently mix amortized and unamortized methodology
-    times1 = time_calls(lambda v: float(jnp.sum(kern(v))), dev)
+    times1 = time_calls(lambda v: float(many1(v)), dev * 2)
     rate1 = b / min(times1)
+    per_marg, rate_marg = _marginal(
+        lambda: float(many(dev[0])), lambda: float(many1(dev[0])),
+        K, b, 3 * b * t * 4)
     cpu_rate, n_done = cpu_rate_autocorr(t, lags, 2.0 if quick else CPU_BUDGET_S / 3)
     return _speedup_line(
         f"config1b: autocorr({lags}) at scale, {b}x{t} "
-        f"({K} panels per dispatch)",
+        f"({K} panels per dispatch; marginal = dispatch-cost-free device "
+        "throughput)",
         rate, "series/sec", cpu_rate, n_done,
         extra={"per_dispatch_s": round(min(times), 4), "panels_per_dispatch": K,
                "per_dispatch_s_single": round(min(times1), 4),
                "series_per_sec_single_dispatch": round(rate1, 1),
+               "per_panel_s_marginal":
+                   None if per_marg is None else round(per_marg, 5),
+               "series_per_sec_marginal":
+                   None if rate_marg is None else round(rate_marg, 1),
+               "roofline_marginal":
+                   None if per_marg is None else _roofline(b * t * 4, per_marg),
+               # the compiled program also moves the series->lane fold
+               # (transpose write + read): the real streamed traffic; its
+               # rate shows the kernel is bandwidth-fed, and the interface
+               # gap is the layout conversion
+               "roofline_marginal_actual_moved":
+                   None if per_marg is None else _roofline(
+                       3 * b * t * 4, per_marg),
                **_roofline(K * b * t * 4, min(times))},
     )
 
@@ -511,13 +563,18 @@ def bench_fill_chain(jnp, quick, on_tpu):
     K = 2 if quick else 8  # panels per dispatch: amortizes host round-trips
     # the outputs materialize (jit results), one scalar sync per dispatch
 
-    @jax.jit
-    def chain(v):
-        s = 0.0
-        for i in range(K):
-            f, d, lagged = uv.batch_fill_linear_chain(v + 0.25 * i)
-            s = s + jnp.sum(jnp.nan_to_num(d)) + jnp.sum(jnp.nan_to_num(lagged))
-        return s
+    def make_chain(k):
+        @jax.jit
+        def chain(v):
+            s = 0.0
+            for i in range(k):
+                f, d, lagged = uv.batch_fill_linear_chain(v + 0.25 * i)
+                s = s + jnp.sum(jnp.nan_to_num(d)) + jnp.sum(jnp.nan_to_num(lagged))
+            return s
+
+        return chain
+
+    chain, chain1 = make_chain(K), make_chain(1)
 
     def run(v):
         return float(chain(v))
@@ -529,28 +586,44 @@ def bench_fill_chain(jnp, quick, on_tpu):
     variants = [base + 0.25 * K * (i + 1) for i in range(3)]
     for v in variants:
         jax.block_until_ready(v)
-    times = time_calls(run, variants)
+    times = time_calls(run, variants * 2)
     rate = K * b / min(times)
 
-    # ADVICE r3: single-dispatch companion rate (unamortized methodology)
-    @jax.jit
-    def chain1(v):
-        f, d, lagged = uv.batch_fill_linear_chain(v)
-        return jnp.sum(jnp.nan_to_num(d)) + jnp.sum(jnp.nan_to_num(lagged))
-
-    times1 = time_calls(lambda v: float(chain1(v)), variants)
+    # ADVICE r3: single-dispatch companion rate (unamortized methodology;
+    # structurally identical program with K=1, so the marginal difference
+    # isolates exactly K-1 extra kernel passes)
+    times1 = time_calls(lambda v: float(chain1(v)), variants * 2)
     rate1 = b / min(times1)
+    per_marg, rate_marg = _marginal(
+        lambda: float(chain(variants[0])), lambda: float(chain1(variants[0])),
+        K, b, 13 * b * t * 4)
     cpu_rate, n_done = cpu_rate_fill_chain(t, 2.0 if quick else CPU_BUDGET_S / 3)
     # interface-required traffic: read the gappy panel once, write the three
-    # outputs (filled, difference, lag) once
+    # outputs (filled, difference, lag) once.  The interface-% understates
+    # how well the silicon is fed: the compiled program also moves the
+    # series->lane fold and the next-valid/next-index intermediates between
+    # the two kernel phases (~13 panel passes total), and THAT traffic
+    # streams at ~60% of HBM peak — the binding limit is the extra passes
+    # (layout conversion + inter-phase intermediates), not kernel stalls
     return _speedup_line(
         f"config2: fillLinear+difference+lag chain, {b}x{t} "
-        f"({K} panels per dispatch, min over 3 device-derived variants)",
+        f"({K} panels per dispatch, min over 3 device-derived variants; "
+        "marginal = dispatch-cost-free device throughput)",
         rate, "series/sec", cpu_rate, n_done,
         extra={"per_dispatch_s": [round(x, 4) for x in times],
                "panels_per_dispatch": K,
                "per_dispatch_s_single": round(min(times1), 4),
                "series_per_sec_single_dispatch": round(rate1, 1),
+               "per_panel_s_marginal":
+                   None if per_marg is None else round(per_marg, 5),
+               "series_per_sec_marginal":
+                   None if rate_marg is None else round(rate_marg, 1),
+               "roofline_marginal":
+                   None if per_marg is None else _roofline(
+                       4 * b * t * 4, per_marg),
+               "roofline_marginal_actual_moved":
+                   None if per_marg is None else _roofline(
+                       13 * b * t * 4, per_marg),
                **_roofline(K * 4 * b * t * 4, min(times))},
     )
 

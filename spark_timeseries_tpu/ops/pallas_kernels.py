@@ -1029,8 +1029,9 @@ def _ewma_s_fwd(interpret, alpha, x, zb):
 
 def _ewma_s_bwd(interpret, res, g):
     x3, a3, zb3, s3, b, t, marker = res
-    if isinstance(g, SymbolicZero):
-        g = jnp.zeros(g.shape, g.dtype)
+    if isinstance(g, SymbolicZero):  # output provably unused: all-zero grads
+        return (jnp.zeros((b,), g.dtype), jnp.zeros((b, t), g.dtype),
+                jnp.zeros((b,), g.dtype))
     want_gx = marker is not None
     ga, gx = _ewma_bwd_call(interpret, (x3, a3, zb3, s3, b, t), g, want_gx)
     if gx is None:
@@ -1045,7 +1046,8 @@ def ewma_smooth(alpha, x, zb, *, interpret: bool = False):
     """Batched EWMA smoothing ``[B, T]`` on a fused kernel.
 
     ``alpha``: ``[B]``; ``x``: ``[B, T]`` with the invalid prefix zeroed;
-    ``zb``: ``[B]`` first live position.  Differentiable in ``alpha``.
+    ``zb``: ``[B]`` first live position.  Differentiable in ``alpha`` AND
+    ``x`` (the data cotangent is computed only when x is perturbed).
     """
     return _ewma_s(interpret, alpha, x, zb)
 
@@ -1075,8 +1077,9 @@ def _ewma_ssq_fwd(interpret, alpha, xz, zb):
 
 def _ewma_ssq_bwd(interpret, resid, gbar):
     x3, a3, zb3, s3, xz, zb, b, t, marker = resid
-    if isinstance(gbar, SymbolicZero):
-        gbar = jnp.zeros(gbar.shape, gbar.dtype)
+    if isinstance(gbar, SymbolicZero):  # output provably unused
+        return (jnp.zeros((b,), xz.dtype), jnp.zeros_like(xz),
+                jnp.zeros_like(zb))
     want_gx = marker is not None
     s = _unfold(s3, b)[:, :t]
     t_idx = jnp.arange(t, dtype=xz.dtype)
@@ -1106,7 +1109,8 @@ _ewma_ssq.defvjp(_ewma_ssq_fwd, _ewma_ssq_bwd, symbolic_zeros=True)
 @_scoped("pallas.ewma_sse")
 def ewma_sse(alpha, x, n_valid=None, *, interpret: bool = False):
     """Batched one-step-ahead EWMA SSE ``[B]`` (matches ``models.ewma.sse``).
-    Differentiable in ``alpha``."""
+    Differentiable in ``alpha`` AND ``x`` (the data cotangent is computed
+    only when x is perturbed, so the alpha-only fit path pays nothing)."""
     b, n = x.shape
     nv = (
         jnp.full((b,), n, jnp.int32)
@@ -1748,15 +1752,24 @@ def hr_init(yd, order: Order, include_intercept: bool, n_valid=None, *,
 # product term, so fusing it would force a second sequential sweep anyway).
 
 
-def _autocorr_kernel(nl, t_limit, cs, y_ref, mean_ref, acc_ref, halo_ref):
+def _autocorr_kernel(nl, t_limit, cs, mean_inside, *refs):
     # autocorrelation has NO serial recursion, so the whole chunk runs as
     # full-tile VPU ops with STATIC time-axis slices — a per-step loop (even
     # with carried registers) is bounded by loop machinery, not arithmetic.
     # Cross-chunk lag pairs read the previous chunk's last nl centered
     # values from a halo scratch (static indices, touched once per chunk).
+    # (A fold-free lane-layout variant — series on sublanes, time on lanes,
+    # no transpose — was measured 2-3x SLOWER on a v5e: the misaligned lane
+    # slices for the lag products relayout on every term, while this
+    # layout's time-axis shifts are free register re-indexing.)
+    if mean_inside:  # single-chunk: the tile IS the series; fuse the mean
+        y_ref, acc_ref, halo_ref = refs
+        mean = None
+    else:
+        y_ref, mean_ref, acc_ref, halo_ref = refs
+        mean = mean_ref[0]
     c = pl.program_id(1)
     base = c * cs
-    mean = mean_ref[0]
 
     @pl.when(c == 0)
     def _():
@@ -1768,6 +1781,10 @@ def _autocorr_kernel(nl, t_limit, cs, y_ref, mean_ref, acc_ref, halo_ref):
     y = y_ref[:]  # [cs, 8, 128]
     t_id = base + lax.broadcasted_iota(jnp.int32, (cs, 1, 1), 0)
     valid = (y == y) & (t_id < t_limit)
+    if mean_inside:
+        vf = valid.astype(jnp.float32)
+        n = jnp.sum(vf, axis=0)
+        mean = jnp.sum(jnp.where(valid, y, 0.0), axis=0) / jnp.maximum(n, 1.0)
     d = jnp.where(valid, y - mean, 0.0)
     acc_ref[0] = acc_ref[0] + jnp.sum(d * d, axis=0)
     for k_ in range(1, nl + 1):
@@ -1795,16 +1812,22 @@ def batch_autocorr(y, num_lags: int, *, interpret: bool = False):
             f"(0, {min(t, _CHUNK_T)}), got {num_lags}"
         )
     tp, cs, nchunk = _time_layout(t)
-    valid = ~jnp.isnan(y)
-    n = jnp.sum(valid, axis=1)
-    mean = jnp.sum(jnp.where(valid, y, 0.0), axis=1) / jnp.maximum(n, 1)
     y3 = _fold(jnp.pad(y, ((0, 0), (0, tp - t)), constant_values=jnp.nan))
-    m3 = _fold(mean[:, None].astype(jnp.float32))
+    mean_inside = nchunk == 1  # the tile holds the whole series: fuse the
+    # mean into the kernel (saves one full XLA panel pass)
+    args = [y3]
+    ins = [_bs(cs, _cur)]
+    if not mean_inside:
+        valid = ~jnp.isnan(y)
+        n = jnp.sum(valid, axis=1)
+        mean = jnp.sum(jnp.where(valid, y, 0.0), axis=1) / jnp.maximum(n, 1)
+        args.append(_fold(mean[:, None].astype(jnp.float32)))
+        ins.append(_bs(1, _fixed))
     nblk = y3.shape[1] // _SUBL
     acc3 = pl.pallas_call(
-        functools.partial(_autocorr_kernel, num_lags, t, cs),
+        functools.partial(_autocorr_kernel, num_lags, t, cs, mean_inside),
         grid=(nblk, nchunk),
-        in_specs=[_bs(cs, _cur), _bs(1, _fixed)],
+        in_specs=ins,
         out_specs=_bs(num_lags + 1, _fixed),
         out_shape=jax.ShapeDtypeStruct(
             (num_lags + 1, y3.shape[1], _LANES), jnp.float32
@@ -1812,7 +1835,7 @@ def batch_autocorr(y, num_lags: int, *, interpret: bool = False):
         scratch_shapes=[pltpu.VMEM((num_lags, _SUBL, _LANES), jnp.float32)],
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
-    )(y3, m3)
+    )(*args)
     acc = _unfold(acc3, b)  # [B, num_lags + 1]
     return acc[:, 1:] / acc[:, :1]
 
