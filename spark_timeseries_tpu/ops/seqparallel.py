@@ -218,6 +218,36 @@ def sp_ewma_sse(block: jax.Array, alpha: jax.Array) -> jax.Array:
     return lax.psum(jnp.sum(err * err, axis=1), TIME_AXIS)
 
 
+def sp_garch_neg_loglik(params: jax.Array, r: jax.Array,
+                        h0: jax.Array) -> jax.Array:
+    """Gaussian GARCH(1,1) negative log-likelihood on a time-sharded dense
+    returns panel -> ``[keys_local]`` (matches ``models.garch.
+    neg_log_likelihood``).
+
+    ``params``: ``[keys_local, 3]`` natural rows ``[omega, alpha, beta]``;
+    ``h0``: ``[keys_local]`` per-series sample variance (the seed, which
+    also stands in for the unobserved ``r_{-1}^2``).  The variance
+    recursion ``h_t = omega + alpha r^2_{t-1} + beta h_{t-1}`` is affine in
+    the carry, so it runs as a log-depth :func:`_affine_scan_sharded`; the
+    seed is folded into the t = 0 element.
+    """
+    omega = params[:, 0:1]
+    alpha = params[:, 1:2]
+    beta = params[:, 2:3]
+    rsq = r * r
+    rsq_prev = _shift1_from_left(rsq)
+    gp = _gpos(r.shape[1])
+    first = gp == 0
+    rsq_prev = jnp.where(first, h0[:, None], rsq_prev)
+    b_elem = omega + alpha * rsq_prev
+    # t = 0 absorbs the seed carry: h_0 = omega + alpha h0 + beta h0
+    b_elem = jnp.where(first, b_elem + beta * h0[:, None], b_elem)
+    m_elem = jnp.where(first, 0.0, jnp.broadcast_to(beta, b_elem.shape))
+    h = jnp.maximum(_affine_scan_sharded(m_elem, b_elem), 1e-12)
+    ll_t = jnp.log(2.0 * jnp.pi * h) + rsq / h
+    return 0.5 * lax.psum(jnp.sum(ll_t, axis=1), TIME_AXIS)
+
+
 def sp_css_neg_loglik(params: jax.Array, yd: jax.Array, d_dead: int) -> jax.Array:
     """Conditional-sum-of-squares negative log-likelihood of ARMA(1,1) with
     intercept on a time-sharded differenced panel -> ``[keys_local]``.
@@ -457,6 +487,77 @@ def sp_ewma_fit(mesh: Mesh, values: jax.Array, *, max_iters: int = 40,
     if tol is None:  # same dtype-dependent default as models.ewma.fit
         tol = 1e-8 if values.dtype == jnp.float64 else 1e-4
     return _sp_ewma_fit_program(
+        mesh, values.shape[1], max_iters, float(tol)
+    )(values)
+
+
+@functools.lru_cache(maxsize=64)
+def _sp_garch_fit_program(mesh: Mesh, n: int, max_iters: int, tol: float):
+    """One compiled distributed GARCH-fit program per configuration (see
+    :func:`_sp_ewma_fit_program`)."""
+    from ..models import garch as _garch
+    from ..models.base import FitResult
+    from ..utils import optim
+
+    spec2, spec1 = P(SERIES_AXIS, TIME_AXIS), P(SERIES_AXIS)
+
+    def var_local(rb):
+        # population variance (the dense-case seed, models.garch.variances)
+        mean = lax.psum(jnp.sum(rb, axis=1), TIME_AXIS) / n
+        return lax.psum(jnp.sum((rb - mean[:, None]) ** 2, axis=1),
+                        TIME_AXIS) / n
+
+    var_sh = shard_map(var_local, mesh=mesh, in_specs=(spec2,),
+                       out_specs=spec1)
+    nll_sh = shard_map(
+        sp_garch_neg_loglik, mesh=mesh,
+        in_specs=(P(SERIES_AXIS, None), spec2, spec1),
+        out_specs=spec1,
+    )
+
+    @jax.jit
+    def run(vals):
+        var0 = var_sh(vals)
+        nat0 = jnp.stack(
+            [0.1 * jnp.maximum(var0, 1e-10), jnp.full_like(var0, 0.1),
+             jnp.full_like(var0, 0.8)], axis=1,
+        )
+        u0 = jax.vmap(_garch._from_natural)(nat0)
+
+        def fb(u):
+            nat = jax.vmap(_garch._to_natural)(u)
+            return nll_sh(nat, vals, var0) / n
+
+        res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters,
+                                           tol=tol)
+        nat = jax.vmap(_garch._to_natural)(res.x)
+        if n >= 10:  # static length: the whole panel shares one verdict
+            return FitResult(nat, res.f * n, res.converged, res.iters)
+        # same identifiability gate as models.garch.fit (nv >= 10): short
+        # panels come back NaN / not-converged, not unidentified params
+        b = vals.shape[0]
+        return FitResult(
+            jnp.full_like(nat, jnp.nan),
+            jnp.full((b,), jnp.nan, vals.dtype),
+            jnp.zeros((b,), bool),
+            res.iters,
+        )
+
+    return run
+
+
+def sp_garch_fit(mesh: Mesh, values: jax.Array, *, max_iters: int = 80,
+                 tol: float | None = None):
+    """Fit GARCH(1,1) per series on a time-sharded dense returns panel ->
+    ``FitResult`` with natural ``params [keys, 3]`` (omega, alpha, beta).
+
+    Same transform-parameterized mean-NLL objective and batched L-BFGS as
+    ``models.garch.fit`` (dense case), with every evaluation a
+    ``shard_map`` program on the 2-D mesh via :func:`sp_garch_neg_loglik`.
+    """
+    if tol is None:  # same dtype-dependent default as models.garch.fit
+        tol = 1e-7 if values.dtype == jnp.float64 else 1e-4
+    return _sp_garch_fit_program(
         mesh, values.shape[1], max_iters, float(tol)
     )(values)
 

@@ -219,3 +219,42 @@ class TestTimeShardedFits:
             np.asarray(r_sh.neg_log_likelihood)[both],
             np.asarray(r_ref.neg_log_likelihood)[both], rtol=1e-5,
         )
+
+    def test_sp_garch_nll_and_fit_match_unsharded(self, mesh2d):
+        from jax import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from spark_timeseries_tpu.models import garch
+
+        B, T = 8, 256
+        R = jnp.stack([
+            garch.sample(jnp.asarray([0.1, 0.15, 0.75]), jax.random.key(i), T)
+            for i in range(B)
+        ])
+        Rd = jax.device_put(R, meshlib.series_sharding(mesh2d))
+        params = jnp.asarray(np.tile([0.08, 0.12, 0.8], (B, 1)))
+        pd_ = jax.device_put(
+            params, NamedSharding(mesh2d, P(meshlib.SERIES_AXIS, None))
+        )
+        h0 = jnp.var(R, axis=1)
+        h0d = jax.device_put(h0, NamedSharding(mesh2d, P(meshlib.SERIES_AXIS)))
+        fn = jax.jit(shard_map(
+            sp.sp_garch_neg_loglik, mesh=mesh2d,
+            in_specs=(P(meshlib.SERIES_AXIS, None),
+                      P(meshlib.SERIES_AXIS, meshlib.TIME_AXIS),
+                      P(meshlib.SERIES_AXIS)),
+            out_specs=P(meshlib.SERIES_AXIS),
+        ))
+        got = np.asarray(fn(pd_, Rd, h0d))
+        ref = np.asarray(jax.vmap(
+            lambda p, v: garch.neg_log_likelihood(p, v))(params, R))
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+        r_sh = sp.sp_garch_fit(mesh2d, Rd)
+        r_ref = garch.fit(R, backend="scan")
+        both = np.asarray(r_sh.converged & r_ref.converged)
+        assert both.mean() > 0.7
+        np.testing.assert_allclose(
+            np.asarray(r_sh.params)[both], np.asarray(r_ref.params)[both],
+            atol=1e-3,
+        )
