@@ -444,22 +444,30 @@ def bench_autocorr(jnp, quick):
 
     # device-time companion (VERDICT r3 item 7): one wall dispatch at this
     # size is ~all tunnel round-trip; difference K-chained kernels in one
-    # jitted program against the single dispatch so the fixed round-trip
-    # cancels and what remains is per-kernel on-device time
+    # jitted program against a structurally identical single-kernel program
+    # (paired interleaved timing, _marginal) so the fixed round-trip cancels
+    # and what remains is per-kernel on-device time
     KD = 33
 
-    @jax.jit
-    def chained(v):
-        s = 0.0
-        for i in range(KD):
-            s = s + jnp.sum(kern(v + 0.1 * i))
-        return s
+    def make_chained(k):
+        @jax.jit
+        def chained(v):
+            s = 0.0
+            for i in range(k):
+                s = s + jnp.sum(kern(v + 0.1 * i))
+            return s
 
-    times_k = time_calls(lambda v: float(chained(v)), dev)
-    device_time = max(min(times_k) - min(times), 0.0) / (KD - 1)
-    # device_time can clamp to 0 when tunnel jitter exceeds the kernels'
-    # total device time; emit nulls rather than Infinity (invalid JSON)
-    device_rate = b / device_time if device_time > 0 else None
+        return chained
+
+    chained, chained1 = make_chained(KD), make_chained(1)
+    float(chained(dev[0]))  # warm/compile outside the paired timing
+    float(chained1(dev[0]))
+    device_time, device_rate_ = _marginal(
+        lambda: float(chained(dev[0])), lambda: float(chained1(dev[0])),
+        KD, b, 3 * b * t * 4)  # real streamed traffic per marginal kernel:
+    # the v+0.1*i materialization (write + read) plus the kernel's read —
+    # same accounting as config1b's physics clamp
+    device_rate = device_rate_
 
     cpu_rate, n_done = cpu_rate_autocorr(t, lags, 2.0 if quick else CPU_BUDGET_S / 3)
     n_cores = os.cpu_count() or 1
@@ -471,7 +479,8 @@ def bench_autocorr(jnp, quick):
         "rate)",
         rate, "series/sec", cpu_rate, n_done,
         extra={
-            "device_time_s_est": round(device_time, 6),
+            "device_time_s_est":
+                None if device_time is None else round(device_time, 6),
             "device_series_per_sec":
                 None if device_rate is None else round(device_rate, 1),
             "device_speedup_vs_cpu_allcore":

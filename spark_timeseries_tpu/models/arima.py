@@ -298,17 +298,22 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
             ya, nv0 = maybe_align(yb, align_mode)  # ragged: NaN head/tail
             yd = jax.vmap(lambda v: _difference(v, d))(ya)
             nvd = nv0 - d  # valid length after differencing
-        with jax.named_scope("arima.hannan_rissanen_init"):
-            from ..ops import pallas_kernels as _pk
+        from ..ops import pallas_kernels as _pk
 
+        y3 = zb3 = None
+        if backend in ("pallas", "pallas-interpret"):
+            # fold ONCE per fit: the init sweeps and every optimizer
+            # evaluation share this layout (css_prefold)
+            y3, zb3 = _pk.css_prefold(yd, order, nvd)
+        with jax.named_scope("arima.hannan_rissanen_init"):
             if has_init:
                 init = jnp.broadcast_to(init_params, (yd.shape[0], k))
-            elif (backend in ("pallas", "pallas-interpret")
-                  and _pk.hr_structural_ok(p, q)):
+            elif y3 is not None and _pk.hr_structural_ok(p, q):
                 # fused two-sweep moment kernels: same normal equations,
                 # ~15x less HBM traffic than the shifted-reduce construction
                 init = _pk.hr_init(yd, order, include_intercept, nvd,
-                                   interpret=backend == "pallas-interpret")
+                                   interpret=backend == "pallas-interpret",
+                                   y3=y3)
             else:
                 init = hannan_rissanen_batched(yd, order, include_intercept, nvd)
         # too-short series cannot be fit: need lags + a few dof
@@ -332,12 +337,11 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
         # noise floor of a ~1k-term sum (the reported nll is unscaled)
         n_eff = jnp.maximum(nvd - p, 1).astype(yd.dtype)
         if backend in ("pallas", "pallas-interpret"):
-            from ..ops import pallas_kernels as _pk
-
             interp = backend == "pallas-interpret"
             res = optim.minimize_lbfgs_batched(
-                lambda P: _pk.css_neg_loglik(
-                    P, yd, order, include_intercept, nvd, interpret=interp
+                lambda P: _pk.css_neg_loglik_folded(
+                    P, y3, zb3, yd.shape[1], order, include_intercept, nvd,
+                    interpret=interp
                 ) / n_eff,
                 init,
                 max_iters=max_iters,

@@ -365,23 +365,34 @@ def _css_fwd_call(p, q, interpret, mode, params, yd, zb):
     assert params.shape == (b, k), (params.shape, (b, k))
     tp, cs, nchunk = _time_layout(t)
     y3 = _fold(jnp.pad(yd, ((0, 0), (0, tp - t))))
-    par3 = _fold(params)
     zb3 = _fold(zb.astype(yd.dtype)[:, None])
+    return _css_fwd_call_f(p, q, interpret, mode, params, y3, zb3, t)
+
+
+def _css_fwd_call_f(p, q, interpret, mode, params, y3, zb3, t):
+    # pre-FOLDED entry: y3/zb3 already in kernel layout.  The fit objective
+    # is evaluated hundreds of times inside one lax.while_loop, and XLA does
+    # not reliably hoist the [B, T] zero-mask + fold transpose out of the
+    # loop body — callers that fold once (css_prefold) skip that cost on
+    # every evaluation.
+    k = 1 + p + q
+    par3 = _fold(params)  # [B, k]: trivially small
+    tp, cs, nchunk = _time_layout(t)
     nblk = y3.shape[1] // _SUBL
     hp = nchunk > 1
     out_specs, out_shape = [], []
     if mode in ("e", "both"):
         out_specs.append(_bs(cs, _cur))
-        out_shape.append(jax.ShapeDtypeStruct(y3.shape, yd.dtype))
+        out_shape.append(jax.ShapeDtypeStruct(y3.shape, y3.dtype))
     if mode in ("sum", "both"):
         out_specs.append(_bs(1, _fixed))
         out_shape.append(
-            jax.ShapeDtypeStruct((1, y3.shape[1], _LANES), yd.dtype)
+            jax.ShapeDtypeStruct((1, y3.shape[1], _LANES), y3.dtype)
         )
     if mode == "tail":
         out_specs.append(_bs(max(q, 1), _fixed))
         out_shape.append(
-            jax.ShapeDtypeStruct((max(q, 1), y3.shape[1], _LANES), yd.dtype)
+            jax.ShapeDtypeStruct((max(q, 1), y3.shape[1], _LANES), y3.dtype)
         )
     scratch = []
     if mode in ("sum", "tail") and q > 0:  # errors live in VMEM only
@@ -432,39 +443,83 @@ def css_last_errors(p: int, q: int, interpret: bool, params, yd, zb):
     return _unfold(tail3, b)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
-def _css_ss(p: int, q: int, interpret: bool, params, yd, zb):
-    """Per-series CSS sum of squared errors ``[B]``.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _css_ss_f(p: int, q: int, interpret: bool, t: int, b: int,
+              params, y3, zb3):
+    """Per-series CSS sum of squared errors ``[B]`` from the FOLDED layout
+    (gradients flow to ``params`` only; ``t``/``b`` are the true unpadded
+    lengths).
 
     Primal path uses the sum-only kernel (errors never leave VMEM — a
     linesearch objective evaluation pays one panel READ, not a read plus a
     full error write and re-read); the vjp path saves the errors and reuses
     the hand-derived adjoint, with the VALUE accumulated in the identical
     in-kernel order (mixed accumulation orders stall noise-floor rows).
-    """
-    b, t = yd.shape
-    (css3,), _ = _css_fwd_call(p, q, interpret, "sum", params, yd, zb)
+    The unfolded API (:func:`css_neg_loglik`) is a thin fold-then-delegate
+    wrapper, so there is exactly ONE adjoint implementation."""
+    (css3,), _ = _css_fwd_call_f(p, q, interpret, "sum", params, y3, zb3, t)
     return _unfold(css3, b)[:, 0]
 
 
-def _css_ss_fwd(p, q, interpret, params, yd, zb):
-    b, t = yd.shape
-    (e3, css3), (y3, par3, zb3) = _css_fwd_call(
-        p, q, interpret, "both", params, yd, zb
+def _css_ss_f_fwd(p, q, interpret, t, b, params, y3, zb3):
+    (e3, css3), (y3_, par3, zb3_) = _css_fwd_call_f(
+        p, q, interpret, "both", params, y3, zb3, t
     )
-    # save only the folded errors: the unfolded view is recomputed in the
-    # bwd pass instead of pinning a second full error panel until then
-    return _unfold(css3, b)[:, 0], (y3, par3, zb3, e3, b, t)
+    return _unfold(css3, b)[:, 0], (y3_, par3, zb3_, e3)
 
 
-def _css_ss_bwd(p, q, interpret, resid, gbar):
-    y3, par3, zb3, e3, b, t = resid
+def _css_ss_f_bwd(p, q, interpret, t, b, resid, gbar):
+    y3, par3, zb3, e3 = resid
     e = _unfold(e3, b)[:, :t]
     g_e = 2.0 * e * gbar[:, None]
-    return _css_errors_bwd(p, q, interpret, (y3, par3, zb3, e3), g_e)
+    gparams, _, _ = _css_errors_bwd(p, q, interpret, (y3, par3, zb3, e3), g_e)
+    return gparams, jnp.zeros(y3.shape, y3.dtype), jnp.zeros(zb3.shape, zb3.dtype)
 
 
-_css_ss.defvjp(_css_ss_fwd, _css_ss_bwd)
+_css_ss_f.defvjp(_css_ss_f_fwd, _css_ss_f_bwd)
+
+
+def css_prefold(yd, order: Order, n_valid=None):
+    """Fold a differenced panel into the CSS kernel layout ONCE ->
+    ``(y3, zb3)`` for :func:`css_neg_loglik_folded`.
+
+    The fit objective runs hundreds of evaluations inside one
+    ``lax.while_loop``; folding outside the loop keeps the [B, T]
+    zero-mask + layout transpose off every evaluation (XLA does not
+    reliably hoist them out of the loop body).
+    """
+    p, _, q = order
+    b, n = yd.shape
+    nv = jnp.full((b,), n, yd.dtype) if n_valid is None else n_valid.astype(yd.dtype)
+    start = n - nv
+    t_idx = jnp.arange(n, dtype=yd.dtype)
+    ydz = jnp.where(t_idx[None, :] >= start[:, None], yd, 0.0)
+    tp, _, _ = _time_layout(n)
+    y3 = _fold(jnp.pad(ydz, ((0, 0), (0, tp - n))))
+    zb3 = _fold((start + p).astype(yd.dtype)[:, None])
+    return y3, zb3
+
+
+@_scoped("pallas.css_neg_loglik")
+def css_neg_loglik_folded(params, y3, zb3, n: int, order: Order,
+                          include_intercept: bool, n_valid=None, *,
+                          interpret: bool = False):
+    """Batched CSS negative log-likelihood from a pre-folded panel
+    (:func:`css_prefold`).  Matches :func:`css_neg_loglik` exactly."""
+    p, _, q = order
+    b = params.shape[0]
+    nv = (jnp.full((b,), n, params.dtype) if n_valid is None
+          else n_valid.astype(params.dtype))
+    if include_intercept:
+        params_k = params
+    else:  # kernel layout always carries an intercept slot
+        params_k = jnp.concatenate(
+            [jnp.zeros((b, 1), params.dtype), params], axis=1
+        )
+    css = _css_ss_f(p, q, interpret, n, b, params_k, y3, zb3)
+    n_eff = nv - p
+    sigma2 = css / n_eff
+    return 0.5 * n_eff * (jnp.log(2.0 * jnp.pi * sigma2) + 1.0)
 
 
 def _css_errors_bwd(p, q, interpret, res, g):
@@ -514,22 +569,10 @@ def css_neg_loglik(params, yd, order: Order, include_intercept: bool,
     Matches ``models.arima.css_neg_loglik`` (vmapped) to float tolerance;
     differentiable in ``params`` via the hand-derived adjoint.
     """
-    p, _, q = order
-    b, n = yd.shape
-    nv = jnp.full((b,), n, yd.dtype) if n_valid is None else n_valid.astype(yd.dtype)
-    start = n - nv
-    t_idx = jnp.arange(n, dtype=yd.dtype)
-    ydz = jnp.where(t_idx[None, :] >= start[:, None], yd, 0.0)
-    if include_intercept:
-        params_k = params
-    else:  # kernel layout always carries an intercept slot
-        params_k = jnp.concatenate(
-            [jnp.zeros((b, 1), params.dtype), params], axis=1
-        )
-    css = _css_ss(p, q, interpret, params_k, ydz, start + p)
-    n_eff = nv - p
-    sigma2 = css / n_eff
-    return 0.5 * n_eff * (jnp.log(2.0 * jnp.pi * sigma2) + 1.0)
+    y3, zb3 = css_prefold(yd, order, n_valid)
+    return css_neg_loglik_folded(params, y3, zb3, yd.shape[1], order,
+                                 include_intercept, n_valid,
+                                 interpret=interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -774,7 +817,7 @@ def _garch_ll(interpret: bool, params, rz, h0, zb):
     Primal path: sum-only kernel (the variance path never reaches HBM);
     vjp path saves the variances and chains the likelihood partials into
     the hand-derived recursion adjoint, with the VALUE accumulated in the
-    identical in-kernel order (see ``_css_ss``).
+    identical in-kernel order (see ``_css_ss_f``).
     """
     b, t = rz.shape
     (ll3,), _ = _garch_fwd_call(interpret, "sum", params, rz * rz, h0, zb)
@@ -1059,7 +1102,7 @@ def _ewma_ssq(interpret: bool, alpha, xz, zb):
     Primal path: sum-only kernel (the smoothed series never reaches HBM);
     vjp path saves it and chains the error partials into the hand-derived
     smoothing adjoint, with the VALUE accumulated in the identical
-    in-kernel order (see ``_css_ss``).
+    in-kernel order (see ``_css_ss_f``).
     """
     b, t = xz.shape
     (ss3,), _ = _ewma_fwd_call(interpret, "sum", alpha, xz, zb)
@@ -1705,13 +1748,17 @@ def hr_structural_ok(p: int, q: int) -> bool:
 
 @_scoped("pallas.hr_init")
 def hr_init(yd, order: Order, include_intercept: bool, n_valid=None, *,
-            interpret: bool = False):
+            interpret: bool = False, y3=None):
     """Batched Hannan-Rissanen startup values ``[B, k]`` on fused kernels.
 
     Matches ``models.arima.hannan_rissanen_batched`` (identical weighted
     normal equations and ridge stabilization) in two panel sweeps: stage-1
     AR(m) moments -> solve -> stage-2 moments with on-the-fly residuals ->
     solve.  ``yd``: differenced panel with the invalid prefix zeroed.
+
+    ``y3``: optionally the already-folded panel (:func:`css_prefold`'s
+    first output — its extra zero at ``start - 1`` is never read by a
+    weighted row), so one fit folds the panel exactly once.
     """
     p, _, q = order
     if not hr_structural_ok(p, q):
@@ -1722,7 +1769,8 @@ def hr_init(yd, order: Order, include_intercept: bool, n_valid=None, *,
     nv = jnp.full((b,), n, jnp.int32) if n_valid is None else n_valid
     zb = (n - nv).astype(yd.dtype)
     tp, cs, nchunk = _time_layout(t)
-    y3 = _fold(jnp.pad(yd, ((0, 0), (0, tp - t))))
+    if y3 is None:
+        y3 = _fold(jnp.pad(yd, ((0, 0), (0, tp - t))))
     zb3 = _fold(zb[:, None])
     nblk = y3.shape[1] // _SUBL
 

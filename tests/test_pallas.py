@@ -46,6 +46,29 @@ def test_css_neg_loglik_matches_scan(order, intercept):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("order", [(1, 0, 1), (0, 0, 2)])
+def test_css_neg_loglik_folded_matches_unfolded(order):
+    # the pre-folded objective (css_prefold + css_neg_loglik_folded) is the
+    # fit hot path; it must agree with the fold-per-call API bit-for-bit
+    b, t = 6, 53
+    y = _arma_panel(b, t, seed=9)
+    p, _, q = order
+    rng = np.random.default_rng(10)
+    params = jnp.asarray(rng.normal(size=(b, 1 + p + q)).astype(np.float32) * 0.3)
+    nv = jnp.asarray([t, t - 4, t - 9, t, t - 1, t - 2], jnp.int32)
+    ref = pk.css_neg_loglik(params, y, order, True, nv, interpret=True)
+    y3, zb3 = pk.css_prefold(y, order, nv)
+    got = pk.css_neg_loglik_folded(params, y3, zb3, t, order, True, nv,
+                                   interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    g_ref = jax.grad(lambda P: jnp.sum(
+        pk.css_neg_loglik(P, y, order, True, nv, interpret=True)))(params)
+    g_got = jax.grad(lambda P: jnp.sum(pk.css_neg_loglik_folded(
+        P, y3, zb3, t, order, True, nv, interpret=True)))(params)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                               rtol=1e-6, atol=1e-6)
+
+
 @pytest.mark.parametrize("order", [(1, 0, 1), (2, 0, 2)])
 def test_css_gradient_matches_autodiff_of_scan(order):
     p, _, q = order
