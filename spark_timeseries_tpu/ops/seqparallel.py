@@ -218,18 +218,20 @@ def sp_ewma_sse(block: jax.Array, alpha: jax.Array) -> jax.Array:
     return lax.psum(jnp.sum(err * err, axis=1), TIME_AXIS)
 
 
-def sp_garch_neg_loglik(params: jax.Array, r: jax.Array,
-                        h0: jax.Array) -> jax.Array:
+def sp_garch_neg_loglik(params: jax.Array, r: jax.Array, h0: jax.Array,
+                        start: int = 0) -> jax.Array:
     """Gaussian GARCH(1,1) negative log-likelihood on a time-sharded dense
     returns panel -> ``[keys_local]`` (matches ``models.garch.
     neg_log_likelihood``).
 
     ``params``: ``[keys_local, 3]`` natural rows ``[omega, alpha, beta]``;
     ``h0``: ``[keys_local]`` per-series sample variance (the seed, which
-    also stands in for the unobserved ``r_{-1}^2``).  The variance
+    also stands in for the unobserved ``r_{start-1}^2``).  The variance
     recursion ``h_t = omega + alpha r^2_{t-1} + beta h_{t-1}`` is affine in
     the carry, so it runs as a log-depth :func:`_affine_scan_sharded`; the
-    seed is folded into the t = 0 element.
+    seed is folded into the element at global position ``start`` (a static
+    dead prefix — ARGARCH excludes the first residual; positions before
+    ``start`` contribute nothing).
     """
     omega = params[:, 0:1]
     alpha = params[:, 1:2]
@@ -237,14 +239,15 @@ def sp_garch_neg_loglik(params: jax.Array, r: jax.Array,
     rsq = r * r
     rsq_prev = _shift1_from_left(rsq)
     gp = _gpos(r.shape[1])
-    first = gp == 0
+    first = gp == start
     rsq_prev = jnp.where(first, h0[:, None], rsq_prev)
     b_elem = omega + alpha * rsq_prev
-    # t = 0 absorbs the seed carry: h_0 = omega + alpha h0 + beta h0
+    # the seed step absorbs the carry: h_start = omega + (alpha + beta) h0
     b_elem = jnp.where(first, b_elem + beta * h0[:, None], b_elem)
-    m_elem = jnp.where(first, 0.0, jnp.broadcast_to(beta, b_elem.shape))
+    b_elem = jnp.where(gp < start, 0.0, b_elem)
+    m_elem = jnp.where(gp <= start, 0.0, jnp.broadcast_to(beta, b_elem.shape))
     h = jnp.maximum(_affine_scan_sharded(m_elem, b_elem), 1e-12)
-    ll_t = jnp.log(2.0 * jnp.pi * h) + rsq / h
+    ll_t = jnp.where(gp >= start, jnp.log(2.0 * jnp.pi * h) + rsq / h, 0.0)
     return 0.5 * lax.psum(jnp.sum(ll_t, axis=1), TIME_AXIS)
 
 
@@ -558,6 +561,105 @@ def sp_garch_fit(mesh: Mesh, values: jax.Array, *, max_iters: int = 80,
     if tol is None:  # same dtype-dependent default as models.garch.fit
         tol = 1e-7 if values.dtype == jnp.float64 else 1e-4
     return _sp_garch_fit_program(
+        mesh, values.shape[1], max_iters, float(tol)
+    )(values)
+
+
+@functools.lru_cache(maxsize=64)
+def _sp_argarch_fit_program(mesh: Mesh, n: int, max_iters: int, tol: float):
+    """One compiled distributed ARGARCH-fit program per configuration (see
+    :func:`_sp_ewma_fit_program`)."""
+    from ..models import garch as _garch
+    from ..models.base import FitResult
+    from ..utils import optim
+
+    spec2, spec1 = P(SERIES_AXIS, TIME_AXIS), P(SERIES_AXIS)
+
+    def init_local(yb):
+        # AR(1) moments (matches models.garch._fit_argarch_program, dense)
+        mean = lax.psum(jnp.sum(yb, axis=1), TIME_AXIS) / n
+        yc = yb - mean[:, None]
+        ycprev = _shift1_from_left(yc)
+        num = lax.psum(jnp.sum(yc * ycprev, axis=1), TIME_AXIS)
+        den = lax.psum(jnp.sum(yc * yc, axis=1), TIME_AXIS)
+        phi0 = jnp.clip(num / jnp.maximum(den, 1e-12), -0.95, 0.95)
+        c0 = mean * (1.0 - phi0)
+        prev = _shift1_from_left(yb)
+        gp = _gpos(yb.shape[1])
+        r = jnp.where(gp < 1, 0.0, yb - c0[:, None] - phi0[:, None] * prev)
+        rvar = lax.psum(jnp.sum(r * r, axis=1), TIME_AXIS) / n
+        return jnp.stack(
+            [c0, phi0, 0.1 * jnp.maximum(rvar, 1e-8),
+             jnp.full_like(c0, 0.1), jnp.full_like(c0, 0.8)], axis=1)
+
+    def nll_local(nat, yb, prev):
+        # ``prev`` (the 1-column lag halo, a ppermute) is loop-invariant and
+        # hoisted by the caller: XLA does not reliably lift collectives out
+        # of the optimizer's while_loop body (same lesson as css_prefold)
+        c, phi = nat[:, 0:1], nat[:, 1:2]
+        gp = _gpos(yb.shape[1])
+        live = (gp >= 1).astype(yb.dtype)
+        r = jnp.where(gp < 1, 0.0, yb - c - phi * prev)
+        # masked population variance of the residuals over t >= 1 — the
+        # GARCH seed is recomputed from the CURRENT (c, phi) every
+        # evaluation, exactly as the unsharded objective does
+        nv = n - 1
+        mean = lax.psum(jnp.sum(r * live, axis=1), TIME_AXIS) / nv
+        h0 = lax.psum(jnp.sum(live * (r - mean[:, None]) ** 2, axis=1),
+                      TIME_AXIS) / nv
+        return sp_garch_neg_loglik(nat[:, 2:], r, h0, start=1)
+
+    init_sh = shard_map(init_local, mesh=mesh, in_specs=(spec2,),
+                        out_specs=spec1)
+    prev_sh = shard_map(_shift1_from_left, mesh=mesh, in_specs=(spec2,),
+                        out_specs=spec2)
+    nll_sh = shard_map(nll_local, mesh=mesh,
+                       in_specs=(P(SERIES_AXIS, None), spec2, spec2),
+                       out_specs=spec1)
+    n_eff = float(max(n - 1, 1))
+
+    @jax.jit
+    def run(vals):
+        nat0 = init_sh(vals)
+        u0 = jax.vmap(_garch._argarch_from_natural)(nat0)
+        prev = prev_sh(vals)
+
+        def fb(u):
+            nat = jax.vmap(_garch._argarch_to_natural)(u)
+            return nll_sh(nat, vals, prev) / n_eff
+
+        res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters,
+                                           tol=tol)
+        nat = jax.vmap(_garch._argarch_to_natural)(res.x)
+        if n >= 12:  # AR(1) + GARCH needs a few more rows than GARCH alone
+            return FitResult(nat, res.f * n_eff, res.converged, res.iters)
+        b = vals.shape[0]
+        return FitResult(
+            jnp.full_like(nat, jnp.nan),
+            jnp.full((b,), jnp.nan, vals.dtype),
+            jnp.zeros((b,), bool),
+            res.iters,
+        )
+
+    return run
+
+
+def sp_argarch_fit(mesh: Mesh, values: jax.Array, *, max_iters: int = 100,
+                   tol: float | None = None):
+    """Fit AR(1)+GARCH(1,1) per series on a time-sharded dense panel ->
+    ``FitResult`` with natural ``params [keys, 5]``
+    ``[c, phi, omega, alpha, beta]``.
+
+    Same transform-parameterized mean-NLL objective and batched L-BFGS as
+    ``models.garch.fit_argarch`` (dense case): the AR(1) mean removal is a
+    1-column halo, the GARCH seed is a psum'd masked variance of the
+    current residuals, and the variance recursion runs as the log-depth
+    affine scan of :func:`sp_garch_neg_loglik` with its first residual
+    excluded (``start=1``).
+    """
+    if tol is None:  # same dtype-dependent default as models.garch.fit_argarch
+        tol = 1e-7 if values.dtype == jnp.float64 else 1e-4
+    return _sp_argarch_fit_program(
         mesh, values.shape[1], max_iters, float(tol)
     )(values)
 

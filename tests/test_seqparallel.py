@@ -258,3 +258,26 @@ class TestTimeShardedFits:
             np.asarray(r_sh.params)[both], np.asarray(r_ref.params)[both],
             atol=1e-3,
         )
+
+    def test_sp_argarch_fit_matches_unsharded(self, mesh2d):
+        from spark_timeseries_tpu.models import garch
+
+        B, T = 8, 256
+        Y = jnp.stack([
+            garch.argarch_sample(
+                jnp.asarray([0.2, 0.5, 0.05, 0.1, 0.85]), jax.random.key(i), T)
+            for i in range(B)
+        ])
+        Yd = jax.device_put(Y, meshlib.series_sharding(mesh2d))
+        r_sh = sp.sp_argarch_fit(mesh2d, Yd)
+        r_ref = garch.fit_argarch(Y, backend="scan")
+        both = np.asarray(r_sh.converged & r_ref.converged)
+        assert both.mean() > 0.7
+        np.testing.assert_allclose(
+            np.asarray(r_sh.params)[both], np.asarray(r_ref.params)[both],
+            atol=2e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(r_sh.neg_log_likelihood)[both],
+            np.asarray(r_ref.neg_log_likelihood)[both], rtol=1e-5,
+        )
