@@ -470,9 +470,14 @@ def _css_ss_f_fwd(p, q, interpret, t, b, params, y3, zb3):
 
 def _css_ss_f_bwd(p, q, interpret, t, b, resid, gbar):
     y3, par3, zb3, e3 = resid
-    e = _unfold(e3, b)[:, :t]
-    g_e = 2.0 * e * gbar[:, None]
-    gparams, _, _ = _css_errors_bwd(p, q, interpret, (y3, par3, zb3, e3), g_e)
+    # the error cotangent stays IN the folded layout: gbar [B] folds to a
+    # [1, Bp/128, 128] plane that broadcasts over the time axis, so the
+    # gradient evaluation pays no unfold/refold panel passes (this runs
+    # once per optimizer iteration on the fit hot path)
+    gb3 = _fold(gbar[:, None].astype(e3.dtype))
+    g_e3 = 2.0 * e3 * gb3
+    gparams = _css_errors_bwd_f(p, q, interpret, (y3, par3, zb3, e3),
+                                g_e3, b, t)
     return gparams, jnp.zeros(y3.shape, y3.dtype), jnp.zeros(zb3.shape, zb3.dtype)
 
 
@@ -526,9 +531,17 @@ def _css_errors_bwd(p, q, interpret, res, g):
     y3, par3, zb3, e3 = res
     tp = y3.shape[0]
     b, t = g.shape
+    g3 = _fold(jnp.pad(g, ((0, 0), (0, tp - t))))
+    gparams = _css_errors_bwd_f(p, q, interpret, res, g3, b, t)
+    # observations and the mask boundary are constants of the fit objective
+    return gparams, jnp.zeros((b, t), g.dtype), jnp.zeros((b,), g.dtype)
+
+
+def _css_errors_bwd_f(p, q, interpret, res, g3, b, t):
+    """Adjoint core on FOLDED cotangents -> ``gparams [B, k]``."""
+    y3, par3, zb3, e3 = res
     k = 1 + p + q
     _, cs, nchunk = _time_layout(t)
-    g3 = _fold(jnp.pad(g, ((0, 0), (0, tp - t))))
     nblk = y3.shape[1] // _SUBL
     hp = nchunk > 1
     if hp:
@@ -545,7 +558,7 @@ def _css_errors_bwd(p, q, interpret, res, g):
         grid=(nblk, nchunk),
         in_specs=ins,
         out_specs=_bs(k, _fixed),
-        out_shape=jax.ShapeDtypeStruct(par3.shape, g.dtype),
+        out_shape=jax.ShapeDtypeStruct(par3.shape, g3.dtype),
         scratch_shapes=[
             pltpu.VMEM((cs, _SUBL, _LANES), jnp.float32),
             pltpu.VMEM((max(q, 1), _SUBL, _LANES), jnp.float32),
@@ -553,9 +566,7 @@ def _css_errors_bwd(p, q, interpret, res, g):
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
     )(*args)
-    gparams = _unfold(gpar3, b)
-    # observations and the mask boundary are constants of the fit objective
-    return gparams, jnp.zeros((b, t), g.dtype), jnp.zeros((b,), g.dtype)
+    return _unfold(gpar3, b)
 
 
 css_errors.defvjp(_css_errors_fwd, _css_errors_bwd)
