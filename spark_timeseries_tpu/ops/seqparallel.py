@@ -445,6 +445,14 @@ def sp_ewma_smooth_sharded(mesh: Mesh, values: jax.Array, alpha: jax.Array) -> j
 # Time-sharded model FITS (SURVEY.md §5.7 stretch: the reference cannot fit
 # a series longer than one executor's memory; here the fit OBJECTIVE itself
 # runs on the 2-D mesh, so the optimizer never materializes a whole series)
+#
+# Family boundary: EWMA, ARMA(1,d,1) CSS, GARCH, and ARGARCH all have
+# SCALAR affine carries, so their recursions parallelize as log-depth
+# associative scans with O(1) state per element.  Holt-Winters' carry is
+# (level, trend, seasonal ring) — dimension m + 2 — and composing affine
+# maps on R^(m+2) costs O(m^2) memory per scan element (~676 floats at
+# m = 24): time-sharding it would cost far more than it saves, so HW
+# long-series fits stay series-sharded by design.
 # ---------------------------------------------------------------------------
 
 
