@@ -125,7 +125,16 @@ def align_mode_on_host(yb) -> str:
     except TypeError:  # not weak-referenceable (e.g. plain numpy scalarlike)
         return mode
     if len(_align_mode_cache) >= 256:
-        _align_mode_cache.clear()
+        # drop entries whose array has been collected first; only if the
+        # cache is genuinely full of LIVE arrays fall back to FIFO eviction
+        # of the oldest insertions (dicts preserve insertion order) — a
+        # process cycling many panels must not lose every cached mode at
+        # once (ADVICE r4)
+        dead = [k for k, (r, _) in _align_mode_cache.items() if r() is None]
+        for k in dead:
+            del _align_mode_cache[k]
+        while len(_align_mode_cache) >= 256:
+            del _align_mode_cache[next(iter(_align_mode_cache))]
     _align_mode_cache[key] = (ref, mode)
     return mode
 

@@ -107,9 +107,12 @@ def supported(dtype, n_time: int) -> bool:
 
 def css_structural_ok(p: int, q: int) -> bool:
     """The CSS kernels' chunked layout: lag reads reach back at most one
-    chunk (the neighbor input block) and the cross-chunk trailing-error
-    stash holds ``q`` slots, so both orders must stay under ``_CHUNK_T``."""
-    return 0 <= p < _CHUNK_T and 0 <= q < _CHUNK_T
+    chunk (the neighbor input block), and the cross-chunk adjoint/error
+    stashes interleave their reads (positions ``>= cs - order``) with their
+    writes (positions ``< order``) inside one chunk, which is race-free only
+    while ``order <= chunk/2`` — so both orders must stay under
+    ``_CHUNK_T // 2``."""
+    return 0 <= p <= _CHUNK_T // 2 and 0 <= q <= _CHUNK_T // 2
 
 
 def hw_structural_ok(period: int) -> bool:
@@ -279,14 +282,20 @@ def _css_fwd_kernel(p, q, t_limit, cs, hp, mode, *refs):
         ce_ref[j] = e_ref[cs - q + j]
 
 
-def _css_bwd_kernel(p, q, t_limit, cs, nchunk, hp, *refs):
-    if hp:
-        (y_ref, yp_ref, e_ref, ep_ref, par_ref, zb_ref, g_ref,
-         gpar_ref, adj_ref, ca_ref) = refs
-    else:
-        (y_ref, e_ref, par_ref, zb_ref, g_ref,
-         gpar_ref, adj_ref, ca_ref) = refs
-        yp_ref = ep_ref = None
+def _css_bwd_kernel(p, q, t_limit, cs, nchunk, hp, want_gy, *refs):
+    refs = list(refs)
+    y_ref = refs.pop(0)
+    yp_ref = refs.pop(0) if hp else None
+    e_ref = refs.pop(0)
+    ep_ref = refs.pop(0) if hp else None
+    par_ref = refs.pop(0)
+    zb_ref = refs.pop(0)
+    g_ref = refs.pop(0)
+    gpar_ref = refs.pop(0)
+    gy_ref = refs.pop(0) if want_gy else None
+    adj_ref = refs.pop(0)
+    ca_ref = refs.pop(0)
+    cap_ref = refs.pop(0) if want_gy else None
     c = pl.program_id(1)
     base = (nchunk - 1 - c) * cs
     zb = zb_ref[0]
@@ -298,6 +307,9 @@ def _css_bwd_kernel(p, q, t_limit, cs, nchunk, hp, *refs):
             ca_ref[j] = _ZERO()
         for r in range(k):
             gpar_ref[r] = _ZERO()
+        if want_gy:
+            for i_ in range(max(p, 1)):
+                cap_ref[i_] = _ZERO()
 
     adj_ref[:] = g_ref[:]
 
@@ -314,6 +326,30 @@ def _css_bwd_kernel(p, q, t_limit, cs, nchunk, hp, *refs):
                 0.0,
             )
         a = jnp.where(live, aval, 0.0)
+        if want_gy:
+            # adj_ref[s] for s > tl has already been read (descending walk)
+            # and every theta adjustment targeting it landed before its own
+            # iteration, so the slot is dead — overwrite it with the FINAL
+            # adjoint a_s and read it back for the data cotangent
+            #   dL/dy_t = a_t - sum_i phi_i a_{t+i}
+            # (a_{t+i} in the next-later chunk comes from the cap carry)
+            adj_ref[tl] = a
+            gy = a
+            for i_ in range(1, p + 1):
+                far = (cap_ref[jnp.clip(tl + i_ - cs, 0, max(p - 1, 0))]
+                       if hp else 0.0)
+                av = jnp.where(
+                    tl + i_ < cs, adj_ref[jnp.clip(tl + i_, 0, cs - 1)], far
+                )
+                gy = gy - par_ref[i_] * av
+            gy_ref[tl] = gy
+            if hp and p > 0:
+                # stash a for the chunk below: writes hit tl < p, reads need
+                # tl >= cs - p; disjoint because cs >= 2p (css_structural_ok)
+                curc = cap_ref[jnp.clip(tl, 0, max(p - 1, 0))]
+                cap_ref[jnp.clip(tl, 0, max(p - 1, 0))] = jnp.where(
+                    tl < p, a, curc
+                )
         for j in range(1, q + 1):
             idx = jnp.maximum(tl - j, 0)
             contrib = jnp.where(tl - j >= 0, par_ref[p + j] * a, 0.0)
@@ -348,14 +384,17 @@ def css_errors(p: int, q: int, interpret: bool, params, yd, zb):
     without an intercept pass ``c = 0``); ``yd``: ``[B, T]`` differenced
     series with any invalid prefix already zeroed; ``zb``: ``[B]`` float —
     errors before this position are forced to zero (``start + p`` for the
-    conditional likelihood).  Gradients flow to ``params`` only.
+    conditional likelihood).  Differentiable in ``params`` AND ``yd`` (the
+    data cotangent ``dL/dy_t = a_t - sum_i phi_i a_{t+i}`` is an extra
+    backward-kernel output computed only when ``yd`` is perturbed, so the
+    params-only fit path pays nothing for it — ADVICE r4).
     """
     if not css_structural_ok(p, q):
         raise ValueError(
-            f"fused CSS kernel supports p, q < {_CHUNK_T} (got p={p}, q={q}); "
+            f"fused CSS kernel supports p, q <= {_CHUNK_T // 2} (got p={p}, q={q}); "
             "use backend='scan'"
         )
-    e, _ = _css_errors_fwd(p, q, interpret, params, yd, zb)
+    e, _ = _css_errors_primal(p, q, interpret, params, yd, zb)
     return e
 
 
@@ -412,10 +451,23 @@ def _css_fwd_call_f(p, q, interpret, mode, params, y3, zb3, t):
     return outs, (y3, par3, zb3)
 
 
-def _css_errors_fwd(p, q, interpret, params, yd, zb):
+def _css_errors_primal(p, q, interpret, params, yd, zb):
     b, t = yd.shape
     (e3,), (y3, par3, zb3) = _css_fwd_call(p, q, interpret, "e", params, yd, zb)
     return _unfold(e3, b)[:, :t], (y3, par3, zb3, e3)
+
+
+def _css_errors_fwd(p, q, interpret, params, yd, zb):
+    # symbolic_zeros: args are CustomVJPPrimal; .perturbed says whether the
+    # caller differentiates w.r.t. each input (see _ewma_s_fwd).  The data
+    # cotangent is an extra backward-kernel output computed only when yd is
+    # perturbed; the marker is structural (None vs ()) so the bwd branch is
+    # resolved at trace time.
+    b, t = yd.value.shape
+    e, res = _css_errors_primal(p, q, interpret, params.value, yd.value,
+                                zb.value)
+    marker = () if yd.perturbed else None
+    return e, res + (b, t, marker)
 
 
 @_scoped("pallas.css_last_errors")
@@ -431,7 +483,7 @@ def css_last_errors(p: int, q: int, interpret: bool, params, yd, zb):
     """
     if not css_structural_ok(p, q):
         raise ValueError(
-            f"fused CSS kernel supports p, q < {_CHUNK_T} (got p={p}, q={q}); "
+            f"fused CSS kernel supports p, q <= {_CHUNK_T // 2} (got p={p}, q={q}); "
             "use backend='scan'"
         )
     if q == 0:
@@ -447,7 +499,8 @@ def css_last_errors(p: int, q: int, interpret: bool, params, yd, zb):
 def _css_ss_f(p: int, q: int, interpret: bool, t: int, b: int,
               params, y3, zb3):
     """Per-series CSS sum of squared errors ``[B]`` from the FOLDED layout
-    (gradients flow to ``params`` only; ``t``/``b`` are the true unpadded
+    (differentiable in ``params`` and ``y3`` — the data cotangent is computed
+    only when the data is perturbed; ``t``/``b`` are the true unpadded
     lengths).
 
     Primal path uses the sum-only kernel (errors never leave VMEM — a
@@ -463,25 +516,37 @@ def _css_ss_f(p: int, q: int, interpret: bool, t: int, b: int,
 
 def _css_ss_f_fwd(p, q, interpret, t, b, params, y3, zb3):
     (e3, css3), (y3_, par3, zb3_) = _css_fwd_call_f(
-        p, q, interpret, "both", params, y3, zb3, t
+        p, q, interpret, "both", params.value, y3.value, zb3.value, t
     )
-    return _unfold(css3, b)[:, 0], (y3_, par3, zb3_, e3)
+    marker = () if y3.perturbed else None  # see _css_errors_fwd
+    return _unfold(css3, b)[:, 0], (y3_, par3, zb3_, e3, marker)
 
 
 def _css_ss_f_bwd(p, q, interpret, t, b, resid, gbar):
-    y3, par3, zb3, e3 = resid
+    y3, par3, zb3, e3, marker = resid
+    k = 1 + p + q
+    if isinstance(gbar, SymbolicZero):  # output provably unused
+        return (jnp.zeros((b, k), e3.dtype), jnp.zeros(y3.shape, y3.dtype),
+                jnp.zeros(zb3.shape, zb3.dtype))
     # the error cotangent stays IN the folded layout: gbar [B] folds to a
     # [1, Bp/128, 128] plane that broadcasts over the time axis, so the
     # gradient evaluation pays no unfold/refold panel passes (this runs
     # once per optimizer iteration on the fit hot path)
     gb3 = _fold(gbar[:, None].astype(e3.dtype))
     g_e3 = 2.0 * e3 * gb3
-    gparams = _css_errors_bwd_f(p, q, interpret, (y3, par3, zb3, e3),
-                                g_e3, b, t)
-    return gparams, jnp.zeros(y3.shape, y3.dtype), jnp.zeros(zb3.shape, zb3.dtype)
+    if marker is not None:
+        # data perturbed: the backward kernel additionally emits the folded
+        # data cotangent (an output the params-only fit path never pays for)
+        gparams, gy3 = _css_errors_bwd_f(p, q, interpret, (y3, par3, zb3, e3),
+                                         g_e3, b, t, want_gy=True)
+    else:
+        gparams = _css_errors_bwd_f(p, q, interpret, (y3, par3, zb3, e3),
+                                    g_e3, b, t)
+        gy3 = jnp.zeros(y3.shape, y3.dtype)
+    return gparams, gy3, jnp.zeros(zb3.shape, zb3.dtype)
 
 
-_css_ss_f.defvjp(_css_ss_f_fwd, _css_ss_f_bwd)
+_css_ss_f.defvjp(_css_ss_f_fwd, _css_ss_f_bwd, symbolic_zeros=True)
 
 
 def css_prefold(yd, order: Order, n_valid=None):
@@ -528,17 +593,30 @@ def css_neg_loglik_folded(params, y3, zb3, n: int, order: Order,
 
 
 def _css_errors_bwd(p, q, interpret, res, g):
-    y3, par3, zb3, e3 = res
+    y3, par3, zb3, e3, b, t, marker = res
+    k = 1 + p + q
+    if isinstance(g, SymbolicZero):  # output provably unused: all-zero grads
+        return (jnp.zeros((b, k), e3.dtype), jnp.zeros((b, t), e3.dtype),
+                jnp.zeros((b,), e3.dtype))
     tp = y3.shape[0]
-    b, t = g.shape
     g3 = _fold(jnp.pad(g, ((0, 0), (0, tp - t))))
-    gparams = _css_errors_bwd_f(p, q, interpret, res, g3, b, t)
-    # observations and the mask boundary are constants of the fit objective
-    return gparams, jnp.zeros((b, t), g.dtype), jnp.zeros((b,), g.dtype)
+    core_res = (y3, par3, zb3, e3)
+    if marker is not None:
+        gparams, gy3 = _css_errors_bwd_f(p, q, interpret, core_res, g3, b, t,
+                                         want_gy=True)
+        gy = _unfold(gy3, b)[:, :t]
+    else:
+        gparams = _css_errors_bwd_f(p, q, interpret, core_res, g3, b, t)
+        gy = jnp.zeros((b, t), g.dtype)
+    # the mask boundary zb is discrete: its cotangent stays zero
+    return gparams, gy, jnp.zeros((b,), g.dtype)
 
 
-def _css_errors_bwd_f(p, q, interpret, res, g3, b, t):
-    """Adjoint core on FOLDED cotangents -> ``gparams [B, k]``."""
+def _css_errors_bwd_f(p, q, interpret, res, g3, b, t, want_gy=False):
+    """Adjoint core on FOLDED cotangents -> ``gparams [B, k]`` or, with
+    ``want_gy``, ``(gparams, gy3)`` where ``gy3`` is the data cotangent in
+    the folded layout (an extra kernel output only callers that perturb the
+    data pay for — see ``_css_ss_f_fwd``)."""
     y3, par3, zb3, e3 = res
     k = 1 + p + q
     _, cs, nchunk = _time_layout(t)
@@ -553,23 +631,34 @@ def _css_errors_bwd_f(p, q, interpret, res, g3, b, t):
         ins = [_bs(cs, _rev(nchunk)), _bs(cs, _rev(nchunk)),
                _bs(k, _fixed), _bs(1, _fixed), _bs(cs, _rev(nchunk))]
         args = (y3, e3, par3, zb3, g3)
-    gpar3 = pl.pallas_call(
-        functools.partial(_css_bwd_kernel, p, q, t, cs, nchunk, hp),
+    out_specs = [_bs(k, _fixed)]
+    out_shape = [jax.ShapeDtypeStruct(par3.shape, g3.dtype)]
+    if want_gy:
+        out_specs.append(_bs(cs, _rev(nchunk)))
+        out_shape.append(jax.ShapeDtypeStruct(y3.shape, g3.dtype))
+    scratch = [
+        pltpu.VMEM((cs, _SUBL, _LANES), jnp.float32),
+        pltpu.VMEM((max(q, 1), _SUBL, _LANES), jnp.float32),
+    ]
+    if want_gy:
+        scratch.append(pltpu.VMEM((max(p, 1), _SUBL, _LANES), jnp.float32))
+    outs = pl.pallas_call(
+        functools.partial(_css_bwd_kernel, p, q, t, cs, nchunk, hp, want_gy),
         grid=(nblk, nchunk),
         in_specs=ins,
-        out_specs=_bs(k, _fixed),
-        out_shape=jax.ShapeDtypeStruct(par3.shape, g3.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((cs, _SUBL, _LANES), jnp.float32),
-            pltpu.VMEM((max(q, 1), _SUBL, _LANES), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         compiler_params=_VMEM_PARAMS,
         interpret=interpret,
     )(*args)
-    return _unfold(gpar3, b)
+    gparams = _unfold(outs[0], b)
+    if want_gy:
+        return gparams, outs[1]
+    return gparams
 
 
-css_errors.defvjp(_css_errors_fwd, _css_errors_bwd)
+css_errors.defvjp(_css_errors_fwd, _css_errors_bwd, symbolic_zeros=True)
 
 
 @_scoped("pallas.css_neg_loglik")

@@ -456,6 +456,26 @@ def sp_ewma_smooth_sharded(mesh: Mesh, values: jax.Array, alpha: jax.Array) -> j
 # ---------------------------------------------------------------------------
 
 
+def _too_short_program(k: int):
+    """NaN / not-converged ``FitResult`` with ``params [keys, k]`` for panels
+    statically too short to identify a model — the identifiability gates are
+    decided at program-build time (panel length is static), so the too-short
+    case never pays the distributed L-BFGS (ADVICE r4)."""
+    from ..models.base import FitResult
+
+    @jax.jit
+    def too_short(vals):
+        b = vals.shape[0]
+        return FitResult(
+            jnp.full((b, k), jnp.nan, vals.dtype),
+            jnp.full((b,), jnp.nan, vals.dtype),
+            jnp.zeros((b,), bool),
+            jnp.zeros((b,), jnp.int32),
+        )
+
+    return too_short
+
+
 @functools.lru_cache(maxsize=64)
 def _sp_ewma_fit_program(mesh: Mesh, n: int, max_iters: int, tol: float):
     """One compiled distributed-fit program per (mesh, length, budget) —
@@ -510,6 +530,12 @@ def _sp_garch_fit_program(mesh: Mesh, n: int, max_iters: int, tol: float):
     from ..models.base import FitResult
     from ..utils import optim
 
+    if n < 10:
+        # same identifiability gate as models.garch.fit (nv >= 10), decided
+        # at program-build time (n is static): short panels come back
+        # NaN / not-converged WITHOUT paying the distributed L-BFGS
+        return _too_short_program(3)
+
     spec2, spec1 = P(SERIES_AXIS, TIME_AXIS), P(SERIES_AXIS)
 
     def var_local(rb):
@@ -542,17 +568,7 @@ def _sp_garch_fit_program(mesh: Mesh, n: int, max_iters: int, tol: float):
         res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters,
                                            tol=tol)
         nat = jax.vmap(_garch._to_natural)(res.x)
-        if n >= 10:  # static length: the whole panel shares one verdict
-            return FitResult(nat, res.f * n, res.converged, res.iters)
-        # same identifiability gate as models.garch.fit (nv >= 10): short
-        # panels come back NaN / not-converged, not unidentified params
-        b = vals.shape[0]
-        return FitResult(
-            jnp.full_like(nat, jnp.nan),
-            jnp.full((b,), jnp.nan, vals.dtype),
-            jnp.zeros((b,), bool),
-            res.iters,
-        )
+        return FitResult(nat, res.f * n, res.converged, res.iters)
 
     return run
 
@@ -580,6 +596,12 @@ def _sp_argarch_fit_program(mesh: Mesh, n: int, max_iters: int, tol: float):
     from ..models import garch as _garch
     from ..models.base import FitResult
     from ..utils import optim
+
+    if n < 12:
+        # AR(1) + GARCH needs a few more rows than GARCH alone; decided at
+        # program-build time (n is static) so the too-short case never pays
+        # the distributed L-BFGS (ADVICE r4)
+        return _too_short_program(5)
 
     spec2, spec1 = P(SERIES_AXIS, TIME_AXIS), P(SERIES_AXIS)
 
@@ -639,15 +661,7 @@ def _sp_argarch_fit_program(mesh: Mesh, n: int, max_iters: int, tol: float):
         res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters,
                                            tol=tol)
         nat = jax.vmap(_garch._argarch_to_natural)(res.x)
-        if n >= 12:  # AR(1) + GARCH needs a few more rows than GARCH alone
-            return FitResult(nat, res.f * n_eff, res.converged, res.iters)
-        b = vals.shape[0]
-        return FitResult(
-            jnp.full_like(nat, jnp.nan),
-            jnp.full((b,), jnp.nan, vals.dtype),
-            jnp.zeros((b,), bool),
-            res.iters,
-        )
+        return FitResult(nat, res.f * n_eff, res.converged, res.iters)
 
     return run
 

@@ -34,7 +34,7 @@ class LBFGSResult(NamedTuple):
     f: jax.Array  # [] final objective
     converged: jax.Array  # [] bool: grad-norm tolerance reached
     iters: jax.Array  # [] iterations actually taken
-    grad_norm: jax.Array  # [] final gradient norm
+    grad_norm: jax.Array  # [] gradient norm at the returned x (best-seen iterate)
 
 
 class _State(NamedTuple):
@@ -51,9 +51,12 @@ class _State(NamedTuple):
     # best-seen iterate: the noise-floor-relaxed accept can adopt a step
     # that RAISES f by up to ftol*max(1,|f|) (and ftol-convergence then
     # freezes there), so the returned (x, f) is the best visited point,
-    # guaranteeing f(returned) <= f(x0) (ADVICE r3)
+    # guaranteeing f(returned) <= f(x0) (ADVICE r3).  bg is the gradient AT
+    # bx, so the reported grad_norm is a valid stationarity diagnostic for
+    # the returned point (ADVICE r4)
     bx: jax.Array
     bf: jax.Array
+    bg: jax.Array
 
 
 def _two_loop(g, s_hist, y_hist, rho_hist, k, m):
@@ -142,6 +145,7 @@ def minimize_lbfgs(
         tprev=jnp.ones((), dtype),
         bx=x0,
         bf=f0,
+        bg=g0,
     )
 
     def linesearch(x, f, g, direction, t0):
@@ -234,20 +238,20 @@ def minimize_lbfgs(
             tprev=jnp.where(accept, t, state.tprev),
             bx=jnp.where(better, x_out, state.bx),
             bf=jnp.where(better, f_out, state.bf),
+            bg=jnp.where(better, g_out, state.bg),
         )
 
     def cond(state: _State):
         return (state.k < max_iters) & ~state.converged & ~state.failed
 
     final = lax.while_loop(cond, step, init)
-    # (x, f) is the best-seen iterate; grad_norm remains the LAST iterate's
-    # (the two differ by at most the ftol noise floor in f)
+    # (x, f, grad_norm) all refer to the best-seen iterate
     return LBFGSResult(
         x=final.bx,
         f=final.bf,
         converged=final.converged & jnp.isfinite(final.bf),
         iters=final.k,
-        grad_norm=jnp.linalg.norm(final.g),
+        grad_norm=jnp.linalg.norm(final.bg),
     )
 
 
@@ -309,6 +313,7 @@ def minimize_lbfgs_batched(
         tprev=jnp.ones((bsz,), dtype),
         bx=x0,
         bf=f0,
+        bg=g0,
     )
     iters0 = jnp.zeros((bsz,), jnp.int32)
 
@@ -419,6 +424,7 @@ def minimize_lbfgs_batched(
             tprev=jnp.where(accept, t, state.tprev),
             bx=jnp.where(better[:, None], x_out, state.bx),
             bf=jnp.where(better, f_out, state.bf),
+            bg=jnp.where(better[:, None], g_out, state.bg),
         )
         iters = jnp.where(done, iters, state.k + 1)
         if ls_hist is not None:
@@ -431,14 +437,13 @@ def minimize_lbfgs_batched(
 
     ls0 = jnp.zeros((max_iters,), jnp.int32) if count_evals else None
     final, iters, ls_hist = lax.while_loop(cond, step, (init, iters0, ls0))
-    # (x, f) is the best-seen iterate per row; grad_norm remains the LAST
-    # iterate's (the two differ by at most the ftol noise floor in f)
+    # (x, f, grad_norm) all refer to the best-seen iterate per row
     result = LBFGSResult(
         x=final.bx,
         f=final.bf,
         converged=final.converged & jnp.isfinite(final.bf),
         iters=iters,
-        grad_norm=rownorm(final.g),
+        grad_norm=rownorm(final.bg),
     )
     return (result, ls_hist) if count_evals else result
 

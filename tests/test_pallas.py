@@ -96,6 +96,59 @@ def test_css_gradient_matches_autodiff_of_scan(order):
     )
 
 
+@pytest.mark.parametrize("order", [(1, 0, 1), (2, 0, 2), (0, 0, 1)])
+@pytest.mark.parametrize("t", [41, 2100])  # single-chunk and chunked grids
+def test_css_data_gradient_matches_autodiff_of_scan(order, t):
+    # ADVICE r4: jax.grad of the fused CSS objective w.r.t. the DATA used to
+    # silently return zeros; the adjoint kernel now emits the true data
+    # cotangent dL/dy_t = a_t - sum_i phi_i a_{t+i} when (and only when) the
+    # data is perturbed
+    p, _, q = order
+    b = 4
+    y = _arma_panel(b, t, seed=7)
+    k = 1 + p + q
+    rng = np.random.default_rng(8)
+    params = jnp.asarray(rng.normal(size=(b, k)).astype(np.float32) * 0.25)
+    nv = jnp.asarray([t, t - 3, t - 6, max(t - t // 3, 12)], jnp.int32)
+
+    def loss_scan(v):
+        return jnp.sum(
+            jax.vmap(lambda pr, row, n: arima.css_neg_loglik(
+                pr, row, order, True, n))(params, v, nv)
+        )
+
+    def loss_pal(v):
+        return jnp.sum(pk.css_neg_loglik(params, v, order, True, nv,
+                                         interpret=True))
+
+    gy_ref = jax.grad(loss_scan)(y)
+    gy_got = jax.grad(loss_pal)(y)
+    np.testing.assert_allclose(np.asarray(gy_got), np.asarray(gy_ref),
+                               rtol=1e-4, atol=1e-4)
+
+    # the raw error-panel op's data cotangent (weighted-sum pullback).  The
+    # kernel's contract is "prefix already zeroed", so the zeroing mask is
+    # applied INSIDE both loss functions — they are then the same function
+    # of the raw panel and their gradients must agree everywhere
+    w = jnp.asarray(rng.normal(size=(b, t)).astype(np.float32))
+    start = (t - nv).astype(jnp.float32)
+    zb = start + p
+
+    def err_scan(v):
+        e = jax.vmap(lambda pr, row, n: arima._css_errors(
+            pr, row, order, True, n_valid=n))(params, v, nv)
+        return jnp.sum(w * e)
+
+    def err_pal(v):
+        vz = jnp.where(jnp.arange(t)[None, :] >= start[:, None], v, 0.0)
+        return jnp.sum(w * pk.css_errors(p, q, True, params, vz, zb))
+
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(err_pal)(y)), np.asarray(jax.grad(err_scan)(y)),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
 def test_fit_backend_pallas_matches_scan():
     y = _arma_panel(8, 120, d_int=True, seed=5)
     r_scan = arima.fit(y, (1, 1, 1), backend="scan", max_iters=30)
