@@ -81,7 +81,7 @@ def main():
         jnp.asarray(rng.normal(size=(keys, t)).cumsum(axis=1)
                     .astype(np.float32)),
         meshlib.series_sharding(mesh))
-    fit = sp.sp_arima_fit(mesh, dense, d=1)
+    fit = sp.sp_arima_fit(mesh, dense, (1, 1, 1))
     print(f"time-sharded ARIMA(1,1,1): params[0]="
           f"{np.asarray(fit.params[0]).round(4)}  "
           f"converged={float(jnp.mean(fit.converged.astype(jnp.float32))):.2f}")

@@ -17,3 +17,16 @@ def gen_arma_panel(b, t, seed=0, phi=0.6, theta=0.3, integrate=True):
     for i in range(1, t):
         y[:, i] = phi * y[:, i - 1] + e[:, i] + theta * e[:, i - 1]
     return np.cumsum(y, axis=1) if integrate else y
+
+
+def gen_arma22_panel(b, t, seed=0, integrate=True):
+    """Stationary, invertible ARMA(2,2) innovations panel ``[b, t]``
+    (float32), optionally integrated once — identifiable data for the
+    general-order (2, d, 2) fit tests."""
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(b, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    for i in range(2, t):
+        y[:, i] = (0.5 * y[:, i - 1] + 0.2 * y[:, i - 2]
+                   + e[:, i] + 0.4 * e[:, i - 1] + 0.15 * e[:, i - 2])
+    return np.cumsum(y, axis=1) if integrate else y

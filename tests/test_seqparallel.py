@@ -206,7 +206,7 @@ class TestTimeShardedFits:
 
         y = gen_arma_panel(8, 256, seed=23).astype(np.float64)
         yd = jax.device_put(jnp.asarray(y), meshlib.series_sharding(mesh2d))
-        r_sh = sp.sp_arima_fit(mesh2d, yd, d=1)
+        r_sh = sp.sp_arima_fit(mesh2d, yd, (1, 1, 1))
         r_ref = arima.fit(jnp.asarray(y), (1, 1, 1), backend="scan")
         both = np.asarray(r_sh.converged & r_ref.converged)
         assert both.mean() > 0.7
@@ -219,6 +219,116 @@ class TestTimeShardedFits:
             np.asarray(r_sh.neg_log_likelihood)[both],
             np.asarray(r_ref.neg_log_likelihood)[both], rtol=1e-5,
         )
+
+    @pytest.mark.parametrize("order", [(2, 0, 2), (0, 0, 2), (2, 0, 0)])
+    def test_sp_css_nll_general_order_matches_unsharded(self, mesh2d, values,
+                                                        order):
+        # VERDICT r4: orders with q > 1 run the companion-matrix vector
+        # affine carry; p > 1 widens the AR halo
+        import functools
+
+        from jax import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from spark_timeseries_tpu.models import arima
+
+        p, _, q = order
+        rng = np.random.default_rng(27)
+        B = values.shape[0]
+        params = jnp.asarray(rng.normal(size=(B, 1 + p + q)) * 0.3)
+        v = np.asarray(values)
+        yd = v[:, 1:] - v[:, :-1]
+        ydg = jax.device_put(
+            jnp.asarray(np.concatenate([np.zeros((B, 1)), yd], axis=1)),
+            meshlib.series_sharding(mesh2d),
+        )
+        pd_ = jax.device_put(
+            params, NamedSharding(mesh2d, P(meshlib.SERIES_AXIS, None))
+        )
+        fn = jax.jit(shard_map(
+            functools.partial(sp.sp_css_neg_loglik, d_dead=1, p=p, q=q),
+            mesh=mesh2d,
+            in_specs=(P(meshlib.SERIES_AXIS, None),
+                      P(meshlib.SERIES_AXIS, meshlib.TIME_AXIS)),
+            out_specs=P(meshlib.SERIES_AXIS),
+        ))
+        got = np.asarray(fn(pd_, ydg))
+        ref = np.asarray(jax.vmap(
+            lambda pr, vv: arima.css_neg_loglik(pr, vv, (p, 0, q), True)
+        )(params, jnp.asarray(yd)))
+        np.testing.assert_allclose(got, ref, rtol=1e-9)
+
+    def test_sp_hannan_rissanen_matches_batched(self, mesh2d):
+        # the distributed init is the REAL two-stage HR: its psum'd normal
+        # equations must equal the unsharded masked-product construction
+        import functools
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from spark_timeseries_tpu.models import arima
+
+        from _synth import gen_arma22_panel
+
+        y = gen_arma22_panel(8, 256, seed=28).astype(np.float64)
+        yd = np.diff(y, axis=1)
+        grid = jnp.asarray(np.concatenate([np.zeros((8, 1)), yd], axis=1))
+        ydg = jax.device_put(grid, meshlib.series_sharding(mesh2d))
+        fn = jax.jit(shard_map(
+            functools.partial(sp.sp_hannan_rissanen, d_dead=1, p=2, q=2,
+                              n=256),
+            mesh=mesh2d,
+            in_specs=(P(meshlib.SERIES_AXIS, meshlib.TIME_AXIS),),
+            out_specs=P(meshlib.SERIES_AXIS, None),
+        ))
+        got = np.asarray(fn(ydg))
+        ref = np.asarray(arima.hannan_rissanen_batched(
+            jnp.asarray(yd), (2, 0, 2), True,
+            jnp.full((8,), yd.shape[1], jnp.int32),
+        ))
+        np.testing.assert_allclose(got, ref, rtol=1e-8, atol=1e-10)
+
+    def test_sp_arima_fit_general_order_matches_unsharded(self, mesh2d):
+        from spark_timeseries_tpu.models import arima
+
+        from _synth import gen_arma22_panel
+
+        y = gen_arma22_panel(8, 256, seed=29).astype(np.float64)
+        yd = jax.device_put(jnp.asarray(y), meshlib.series_sharding(mesh2d))
+        r_sh = sp.sp_arima_fit(mesh2d, yd, (2, 1, 2))
+        r_ref = arima.fit(jnp.asarray(y), (2, 1, 2), backend="scan")
+        both = np.asarray(r_sh.converged & r_ref.converged)
+        assert both.mean() > 0.6
+        # identical objective: achieved nll agrees even if paths differ
+        np.testing.assert_allclose(
+            np.asarray(r_sh.neg_log_likelihood)[both],
+            np.asarray(r_ref.neg_log_likelihood)[both], rtol=1e-5,
+        )
+
+    def test_sp_arima_fit_too_short_gate(self, mesh2d):
+        # same contract as models.arima.fit: a panel too short for the
+        # order comes back NaN / not-converged (no optimizer run)
+        rng = np.random.default_rng(31)
+        y = jax.device_put(
+            jnp.asarray(rng.normal(size=(8, 8))),
+            meshlib.series_sharding(mesh2d),
+        )
+        r = sp.sp_arima_fit(mesh2d, y, (1, 1, 1))
+        assert bool(jnp.all(jnp.isnan(r.params)))
+        assert not bool(jnp.any(r.converged))
+
+    def test_sp_arima_fit_rejects_lag_wider_than_shard(self):
+        # a halo exchange delivers at most one neighbor's columns: a lag
+        # reach wider than the shard-local length must fail loudly at
+        # program-build time, not silently misalign the regressors
+        mesh8 = meshlib.default_mesh(time_shards=8)
+        rng = np.random.default_rng(33)
+        y = jax.device_put(
+            jnp.asarray(rng.normal(size=(1, 32))),
+            meshlib.series_sharding(mesh8),
+        )
+        with pytest.raises(ValueError, match="lag reach"):
+            sp.sp_arima_fit(mesh8, y, (2, 1, 2))
 
     def test_sp_garch_nll_and_fit_match_unsharded(self, mesh2d):
         from jax import shard_map
