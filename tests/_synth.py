@@ -19,6 +19,16 @@ def gen_arma_panel(b, t, seed=0, phi=0.6, theta=0.3, integrate=True):
     return np.cumsum(y, axis=1) if integrate else y
 
 
+def gen_ewma_panel(b, t, seed=0):
+    """Level random walk + observation noise ``[b, t]`` (float32): the
+    optimal EWMA alpha is INTERIOR, so sharded and unsharded fits stop at
+    comparable points (a pure random walk pushes alpha to the boundary,
+    where the sigmoid tail is flat and stop points legitimately differ)."""
+    rng = np.random.default_rng(seed)
+    level = np.cumsum(0.2 * rng.normal(size=(b, t)), axis=1)
+    return (level + rng.normal(size=(b, t))).astype(np.float32)
+
+
 def gen_arma22_panel(b, t, seed=0, integrate=True):
     """Stationary, invertible ARMA(2,2) innovations panel ``[b, t]``
     (float32), optionally integrated once — identifiable data for the
