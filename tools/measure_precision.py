@@ -30,13 +30,31 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
 
 
+ARGARCH_TRUTH = np.array([0.5, 0.5, 0.05, 0.12, 0.8])  # c, phi, omega, a, b
+MODELS = ("arima", "garch", "hw", "hwm", "argarch")
+
+
 def _gen(batch, t):
     from bench import gen_arima_panel, gen_garch_returns, gen_seasonal_panel
+
+    # AR(1) over GARCH(1,1) innovations at the generating truth (the ARGARCH
+    # data-generating process, numpy so both precisions share one panel)
+    r = gen_garch_returns(batch, t, seed=3, omega=ARGARCH_TRUTH[2],
+                          alpha=ARGARCH_TRUTH[3], beta=ARGARCH_TRUTH[4])
+    c, phi = ARGARCH_TRUTH[:2]
+    y = np.empty_like(r)
+    y[:, 0] = c / (1.0 - phi) + r[:, 0]
+    for i in range(1, t):
+        y[:, i] = c + phi * y[:, i - 1] + r[:, i]
 
     return {
         "arima": gen_arima_panel(batch, t, seed=0).astype(np.float32),
         "garch": gen_garch_returns(batch, t, seed=1),
         "hw": gen_seasonal_panel(batch, min(t, 960), 24, seed=2),
+        # multiplicative HW needs a positive panel (level >> seasonal swing),
+        # same construction the bench parity gate uses
+        "hwm": gen_seasonal_panel(batch, min(t, 960), 24, seed=4) + 25.0,
+        "argarch": y.astype(np.float32),
     }
 
 
@@ -59,6 +77,11 @@ def _fit_all(data, backend_hint, x64):
     out["garch"] = (np.asarray(r.params), np.asarray(r.converged))
     r = hw.fit(jnp.asarray(data["hw"], dtype), 24, "additive", backend=backend)
     out["hw"] = (np.asarray(r.params), np.asarray(r.converged))
+    r = hw.fit(jnp.asarray(data["hwm"], dtype), 24, "multiplicative",
+               backend=backend)
+    out["hwm"] = (np.asarray(r.params), np.asarray(r.converged))
+    r = garch.fit_argarch(jnp.asarray(data["argarch"], dtype), backend=backend)
+    out["argarch"] = (np.asarray(r.params), np.asarray(r.converged))
     return out
 
 
@@ -105,7 +128,7 @@ def main():
             check=True, cwd=_ROOT,
         )
         z = np.load(opath)
-        f64 = {k: (z[f"{k}_p"], z[f"{k}_c"]) for k in ("arima", "garch", "hw")}
+        f64 = {k: (z[f"{k}_p"], z[f"{k}_c"]) for k in MODELS}
 
     import jax
 
@@ -116,11 +139,15 @@ def main():
         "arima": np.array([0.0, 0.6, 0.3]),
         "garch": np.array([0.05, 0.12, 0.8]),
         "hw": None,  # no single generating truth for (alpha, beta, gamma)
+        "hwm": None,
+        "argarch": ARGARCH_TRUTH,
     }
     names = {
         "arima": "ARIMA(1,1,1)",
         "garch": "GARCH(1,1)",
         "hw": "HoltWinters additive (vs f64 only)",
+        "hwm": "HoltWinters multiplicative (vs f64 only)",
+        "argarch": "AR(1)+GARCH(1,1)",
     }
     print(f"platform: {platform}; batch {args.batch} x {args.t}; "
           "f32 = production path (pallas on TPU), f64 = scan oracle under x64")
@@ -128,7 +155,7 @@ def main():
     print("| model | drift p50 | drift p95 | drift p99 | est-err p50 | "
           "est-err p95 | conv f32/f64 |")
     print("|---|---|---|---|---|---|---|")
-    for k in ("arima", "garch", "hw"):
+    for k in MODELS:
         p32, c32 = f32[k]
         p64, c64 = f64[k]
         both = c32 & c64
