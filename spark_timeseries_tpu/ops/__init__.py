@@ -1,4 +1,12 @@
 from . import univariate
 from .lagmat import lag_mat_trim_both, lag_mat_trim_both_2d
+from .layout import FoldedPanel, fold_panel, unfold_panel
 
-__all__ = ["univariate", "lag_mat_trim_both", "lag_mat_trim_both_2d"]
+__all__ = [
+    "univariate",
+    "lag_mat_trim_both",
+    "lag_mat_trim_both_2d",
+    "FoldedPanel",
+    "fold_panel",
+    "unfold_panel",
+]

@@ -473,25 +473,45 @@ def batch_autocorr(num_lags: int, backend: str = "auto") -> Callable:
     panels (``ops.pallas_kernels.batch_autocorr``; ~num_lags fewer HBM
     passes than the vmapped lowering) and falls back to ``vmap(autocorr)``
     everywhere else.  Both paths agree to float tolerance.
+
+    A resident :class:`~.layout.FoldedPanel` is accepted directly: the
+    kernel then streams the panel once, with no per-dispatch layout
+    conversion (``ops.layout`` — the TPU residency decision).
     """
     vmapped = batched(autocorr, num_lags)
-    if backend == "scan":
-        return vmapped
 
     def run(panel):
         from . import pallas_kernels as pk
+        from .layout import FoldedPanel, unfold_panel
 
+        if isinstance(panel, FoldedPanel):
+            if (
+                backend != "scan"
+                and 0 < num_lags < min(panel.t, pk._CHUNK_T)
+                and pk.supported(panel.dtype, panel.t)
+            ):
+                return pk.batch_autocorr_folded(panel, num_lags)
+            return vmapped(unfold_panel(panel))
         if (
-            getattr(panel, "ndim", 0) == 2
+            backend != "scan"
+            and getattr(panel, "ndim", 0) == 2
             and 0 < num_lags < min(panel.shape[1], pk._CHUNK_T)
             and pk.supported(panel.dtype, panel.shape[1])
         ):
             return pk.batch_autocorr(panel, num_lags)
         return vmapped(panel)
 
+    if backend == "scan":
+        return lambda panel: run(panel) if _is_folded(panel) else vmapped(panel)
     # the branch reads only static shape/dtype/platform, so it resolves at
     # trace time: callers get one compiled program either way
     return jax.jit(run)
+
+
+def _is_folded(panel) -> bool:
+    from .layout import FoldedPanel
+
+    return isinstance(panel, FoldedPanel)
 
 
 def batch_fill(method: str, backend: str = "auto") -> Callable:
@@ -510,23 +530,47 @@ def batch_fill(method: str, backend: str = "auto") -> Callable:
     return jax.jit(run)
 
 
-def batch_fill_linear_chain(panel, backend: str = "auto"):
+def batch_fill_linear_chain(panel, backend: str = "auto", outputs=None):
     """Fused fillLinear -> (filled, lag-1 difference, lag-1 shift) on a panel.
 
     The feature-prep chain of SURVEY.md Section 6 config 2 as ONE device
-    program: the Pallas path (TPU/f32) does two sequential array sweeps
-    instead of four log2(T)-step associative scans plus three elementwise
-    passes; elsewhere the same chain runs as the composed portable kernels.
+    program: the Pallas path (TPU/f32) runs a two-phase fused kernel whose
+    intermediates never leave VMEM; elsewhere the same chain runs as the
+    composed portable kernels.
+
+    ``outputs`` (default all three) selects which results to compute AND
+    return, in order — e.g. ``("diff", "lag")`` skips the filled-panel
+    store entirely on the Pallas path.  A resident
+    :class:`~.layout.FoldedPanel` input yields folded outputs with no
+    layout conversion anywhere in the chain.
     """
     from . import pallas_kernels as pk
+    from .layout import FoldedPanel, fold_panel, unfold_panel
+
+    sel = pk._CHAIN_OUTPUTS if outputs is None else tuple(outputs)
+    if not sel or any(o not in pk._CHAIN_OUTPUTS for o in sel):
+        raise ValueError(f"outputs must be a non-empty subset of "
+                         f"{pk._CHAIN_OUTPUTS}, got {outputs!r}")
+
+    if isinstance(panel, FoldedPanel):
+        if backend != "scan" and pk.supported(panel.dtype, panel.t):
+            return pk.fill_linear_chain_folded(panel, sel)
+        nat = batch_fill_linear_chain(unfold_panel(panel), backend, sel)
+        return tuple(fold_panel(o) for o in nat)
 
     if (
         backend != "scan"
         and getattr(panel, "ndim", 0) == 2
         and pk.supported(panel.dtype, panel.shape[1])
     ):
-        return pk.fill_linear_chain(panel)
+        if outputs is None:
+            return pk.fill_linear_chain(panel)
+        fps = pk.fill_linear_chain_folded(fold_panel(panel), sel)
+        return tuple(unfold_panel(o) for o in fps)
     f = jax.vmap(fill_linear)(panel)
-    d = jax.vmap(lambda v: differences_at_lag(v, 1))(f)
-    lagged = jax.vmap(lambda v: lag(v, 1))(f)
-    return f, d, lagged
+    by_name = {
+        "filled": lambda: f,
+        "diff": lambda: jax.vmap(lambda v: differences_at_lag(v, 1))(f),
+        "lag": lambda: jax.vmap(lambda v: lag(v, 1))(f),
+    }
+    return tuple(by_name[o]() for o in sel)

@@ -832,3 +832,113 @@ def test_fill_linear_fill_only_matches_portable():
     f = pk.fill_linear(y, interpret=True)
     ref = jax.vmap(uv.fill_linear)(y)
     np.testing.assert_allclose(np.asarray(f), np.asarray(ref), rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Resident folded layout (ops.layout)
+# ---------------------------------------------------------------------------
+
+
+def test_fold_unfold_roundtrip():
+    from spark_timeseries_tpu.ops.layout import fold_panel, unfold_panel
+
+    y = _gappy(5, 333, seed=21)
+    fp = fold_panel(y)
+    assert fp.shape == (5, 333)
+    back = unfold_panel(fp)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(y))
+
+
+def test_folded_panel_is_a_pytree():
+    from spark_timeseries_tpu.ops.layout import FoldedPanel, fold_panel
+
+    y = _gappy(4, 64, seed=22)
+    fp = fold_panel(y)
+
+    @jax.jit
+    def through(p):
+        return FoldedPanel(p.data * 2.0, p.b, p.t)
+
+    out = through(fp)
+    assert isinstance(out, FoldedPanel)
+    assert (out.b, out.t) == (fp.b, fp.t)
+    np.testing.assert_allclose(np.asarray(out.data), np.asarray(fp.data) * 2.0)
+
+
+@pytest.mark.parametrize("t", [90, 2 * pk._CHUNK_T + 57])
+def test_fill_chain_folded_matches_natural(t):
+    from spark_timeseries_tpu.ops.layout import fold_panel, unfold_panel
+
+    y = _gappy(5, t, seed=23)
+    f_ref, d_ref, l_ref = pk.fill_linear_chain(y, interpret=True)
+    fps = pk.fill_linear_chain_folded(fold_panel(y), interpret=True)
+    for fp, ref in zip(fps, (f_ref, d_ref, l_ref)):
+        np.testing.assert_allclose(
+            np.asarray(unfold_panel(fp)), np.asarray(ref), rtol=1e-6, atol=1e-6
+        )
+
+
+@pytest.mark.parametrize("outputs", [("diff", "lag"), ("lag",), ("lag", "filled")])
+def test_fill_chain_output_selection(outputs):
+    from spark_timeseries_tpu.ops.layout import fold_panel, unfold_panel
+
+    y = _gappy(5, 200, seed=24)
+    full = dict(zip(("filled", "diff", "lag"), pk.fill_linear_chain(y, interpret=True)))
+    fps = pk.fill_linear_chain_folded(fold_panel(y), outputs, interpret=True)
+    assert len(fps) == len(outputs)
+    for name, fp in zip(outputs, fps):
+        np.testing.assert_allclose(
+            np.asarray(unfold_panel(fp)), np.asarray(full[name]),
+            rtol=1e-6, atol=1e-6,
+        )
+
+
+def test_fill_chain_output_selection_rejects_unknown():
+    from spark_timeseries_tpu.ops.layout import fold_panel
+
+    y = _gappy(3, 50, seed=25)
+    with pytest.raises(ValueError, match="subset"):
+        pk.fill_linear_chain_folded(fold_panel(y), ("diff", "bogus"))
+    with pytest.raises(ValueError, match="subset"):
+        pk.fill_linear_chain_folded(fold_panel(y), ())
+
+
+@pytest.mark.parametrize("t", [200, pk._CHUNK_T + 100])
+def test_batch_autocorr_folded_matches_natural(t):
+    from spark_timeseries_tpu.ops.layout import fold_panel
+
+    y = _gappy(5, t, seed=26, edge_nans=False)
+    ref = pk.batch_autocorr(y, 7, interpret=True)
+    got = pk.batch_autocorr_folded(fold_panel(y), 7, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6, atol=1e-6)
+
+
+def test_univariate_dispatch_accepts_folded_off_tpu():
+    # off-TPU (this suite is CPU-pinned) the folded input falls back to the
+    # portable path via unfold, preserving results and — for the chain —
+    # returning folded outputs
+    from spark_timeseries_tpu.ops import univariate as uv
+    from spark_timeseries_tpu.ops.layout import FoldedPanel, fold_panel, unfold_panel
+
+    y = _gappy(4, 96, seed=27, edge_nans=False)
+    fp = fold_panel(y)
+    ref = uv.batch_autocorr(5, backend="scan")(y)
+    got = uv.batch_autocorr(5)(fp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    f_ref, d_ref, l_ref = uv.batch_fill_linear_chain(y, backend="scan")
+    outs = uv.batch_fill_linear_chain(fp, outputs=("diff", "filled"))
+    assert all(isinstance(o, FoldedPanel) for o in outs)
+    np.testing.assert_allclose(np.asarray(unfold_panel(outs[0])), np.asarray(d_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(unfold_panel(outs[1])), np.asarray(f_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_batch_fill_chain_outputs_natural_subset():
+    from spark_timeseries_tpu.ops import univariate as uv
+
+    y = _gappy(4, 80, seed=28)
+    f_ref, d_ref, l_ref = uv.batch_fill_linear_chain(y, backend="scan")
+    d, = uv.batch_fill_linear_chain(y, backend="scan", outputs=("diff",))
+    np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), rtol=1e-6, atol=1e-6)
