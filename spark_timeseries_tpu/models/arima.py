@@ -37,9 +37,15 @@ from ..utils import optim
 from ..utils.linalg import ols as _ols
 from ..utils.linalg import ridge_solve as _ridge_solve
 from .base import (FitResult, align_mode_on_host, align_right, debatch,
-                   ensure_batched, jit_program, maybe_align, resolve_backend)
+                   debatch_fit, ensure_batched, jit_program, maybe_align,
+                   require_pallas_for_count_evals, resolve_backend)
 
 Order = Tuple[int, int, int]
+
+# below this batch size the straggler-compaction stage of the batched
+# optimizer is not worth its gather (and the lane-aligned cap could not be
+# smaller than the batch anyway)
+_COMPACT_MIN_BATCH = 4096
 
 
 def _n_params(order: Order, include_intercept: bool) -> int:
@@ -251,6 +257,7 @@ def fit(
     max_iters: int = 60,
     tol: Optional[float] = None,
     backend: str = "auto",
+    count_evals: bool = False,
 ) -> FitResult:
     """Fit ARIMA(p,d,q) to one series ``[time]`` or a batch ``[batch, time]``.
 
@@ -263,9 +270,17 @@ def fit(
     (``vmap(lax.scan)``, runs everywhere), ``"pallas"`` (fused TPU kernel
     with hand-derived adjoint, ``ops.pallas_kernels``), or ``"auto"``
     (pallas whenever :func:`ops.pallas_kernels.supported` says so).
+
+    ``count_evals=True`` (pallas backend only) returns ``(FitResult, info)``
+    where ``info`` is the optimizer's pass-accounting dict
+    (``utils.optim.minimize_lbfgs_batched``) — the benchmark publishes it so
+    "how many objective passes does a fit spend" is a recorded number, not
+    an estimate.
     """
     if method not in ("css-lbfgs", "css-cgd", "css-bobyqa", "hannan-rissanen"):
         raise ValueError(f"unknown method {method!r}")
+    if count_evals and method == "hannan-rissanen":
+        raise ValueError("count_evals requires an optimizing method")
     p, d, q = order
     yb, single = ensure_batched(y)
     k = _n_params(order, include_intercept)
@@ -276,20 +291,23 @@ def fit(
 
     backend = resolve_backend(backend, yb.dtype, yb.shape[1] - d,
                               structural_ok=pk.css_structural_ok(p, q))
+    require_pallas_for_count_evals(count_evals, backend)
 
     run = _fit_program(
         order, include_intercept, method, backend, max_iters, float(tol),
-        init_params is not None, align_mode_on_host(yb),
+        init_params is not None, align_mode_on_host(yb), count_evals,
     )
     if init_params is None:
-        return debatch(run(yb), single)
-    return debatch(run(yb, jnp.asarray(init_params)), single)
+        out = run(yb)
+    else:
+        out = run(yb, jnp.asarray(init_params))
+    return debatch_fit(out, single, count_evals)
 
 
 @jit_program
 def _fit_program(order: Order, include_intercept: bool, method: str,
                  backend: str, max_iters: int, tol: float, has_init: bool,
-                 align_mode: str = "general"):
+                 align_mode: str = "general", count_evals: bool = False):
     p, d, q = order
     k = _n_params(order, include_intercept)
 
@@ -336,17 +354,47 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
         # rule is reachable at f32 instead of stalling on the accumulation
         # noise floor of a ~1k-term sum (the reported nll is unscaled)
         n_eff = jnp.maximum(nvd - p, 1).astype(yd.dtype)
+        info = None
         if backend in ("pallas", "pallas-interpret"):
             interp = backend == "pallas-interpret"
+            bsz, T = yd.shape
+
+            # straggler compaction (utils.optim): after most rows converge,
+            # lockstep passes still stream the whole panel; gather the tail
+            # into a 1/8-size problem instead.  The gather repacks folded
+            # COLUMNS (series ride the lanes); the kernels grid whole
+            # [8, 128] series blocks, so cap must be a multiple of 1024
+            cap = -(-max(1024, bsz // 8) // 1024) * 1024
+            straggler_fun = None
+            if bsz >= _COMPACT_MIN_BATCH:
+                tp = y3.shape[0]
+
+                def straggler_fun(idxc, _y3=y3, _zb3=zb3):
+                    y3s = _y3.reshape(tp, -1)[:, idxc].reshape(
+                        tp, cap // 128, 128)
+                    zb3s = _zb3.reshape(1, -1)[:, idxc].reshape(
+                        1, cap // 128, 128)
+                    nvs = nvd[idxc]
+                    nes = n_eff[idxc]
+                    return lambda P: _pk.css_neg_loglik_folded(
+                        P, y3s, zb3s, T, order, include_intercept, nvs,
+                        interpret=interp
+                    ) / nes
+
             res = optim.minimize_lbfgs_batched(
                 lambda P: _pk.css_neg_loglik_folded(
-                    P, y3, zb3, yd.shape[1], order, include_intercept, nvd,
+                    P, y3, zb3, T, order, include_intercept, nvd,
                     interpret=interp
                 ) / n_eff,
                 init,
                 max_iters=max_iters,
                 tol=tol,
+                straggler_fun=straggler_fun,
+                straggler_cap=cap,
+                count_evals=count_evals,
             )
+            if count_evals:
+                res, info = res
         else:
             res = optim.batched_minimize(
                 lambda pr, data: css_neg_loglik(
@@ -358,9 +406,10 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
                 tol=tol,
             )
         params = jnp.where(ok[:, None], res.x, jnp.nan)
-        return FitResult(
+        out = FitResult(
             params, jnp.where(ok, res.f * n_eff, jnp.nan), res.converged & ok, res.iters
         )
+        return (out, info) if count_evals else out
 
     return run
 

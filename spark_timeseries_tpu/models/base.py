@@ -88,6 +88,24 @@ def debatch(x, single: bool):
     return jax.tree.map(lambda a: a[0], x) if single else x
 
 
+def require_pallas_for_count_evals(count_evals: bool, backend: str) -> None:
+    """Shared ``count_evals`` contract: pass accounting instruments the
+    batched L-BFGS (``utils.optim``), which only the pallas fit paths use —
+    the scan paths go through ``batched_minimize`` (vmapped per-series
+    loops) where a per-iteration eval count has no batched meaning."""
+    if count_evals and backend not in ("pallas", "pallas-interpret"):
+        raise ValueError("count_evals requires the pallas backend "
+                         f"(resolved backend: {backend!r})")
+
+
+def debatch_fit(out, single: bool, count_evals: bool):
+    """Unpack a fit program's ``result | (result, info)`` return shape."""
+    if count_evals:
+        res, info = out
+        return debatch(res, single), info
+    return debatch(out, single)
+
+
 def align_mode_on_host(yb) -> str:
     """Static alignment mode for a fit program: how much work the per-row
     right-alignment actually needs on THIS panel.

@@ -24,6 +24,7 @@ from jax import lax
 
 from ..utils import optim
 from .base import (FitResult, align_mode_on_host, align_right, debatch,
+                   debatch_fit, require_pallas_for_count_evals,
                    ensure_batched, maybe_align,
                    jit_program, resolve_backend)
 
@@ -127,20 +128,24 @@ def neg_log_likelihood(params, r, n_valid=None):
 
 
 def fit(r, *, max_iters: int = 80, tol: Optional[float] = None,
-        backend: str = "auto") -> FitResult:
-    """Fit GARCH(1,1) per series -> natural params ``[batch?, 3]``."""
+        backend: str = "auto", count_evals: bool = False) -> FitResult:
+    """Fit GARCH(1,1) per series -> natural params ``[batch?, 3]``.
+
+    ``count_evals=True`` (pallas backend only) returns ``(FitResult, info)``
+    with the optimizer's pass-accounting dict (``utils.optim``)."""
     rb, single = ensure_batched(r)
     if tol is None:
         tol = 1e-7 if rb.dtype == jnp.float64 else 1e-4
     backend = resolve_backend(backend, rb.dtype, rb.shape[1])
-    return debatch(
-        _fit_program(max_iters, float(tol), backend, align_mode_on_host(rb))(rb),
-        single,
-    )
+    require_pallas_for_count_evals(count_evals, backend)
+    out = _fit_program(max_iters, float(tol), backend, align_mode_on_host(rb),
+                       count_evals)(rb)
+    return debatch_fit(out, single, count_evals)
 
 
 @jit_program
-def _fit_program(max_iters, tol, backend, align_mode="general"):
+def _fit_program(max_iters, tol, backend, align_mode="general",
+                 count_evals=False):
     def run(rb):
         ra, nv = maybe_align(rb, align_mode)
 
@@ -163,7 +168,11 @@ def _fit_program(max_iters, tol, backend, align_mode="general"):
                 nat = jax.vmap(_to_natural)(u)
                 return pk.garch_neg_loglik(nat, ra, nv, interpret=interp) / n_eff
 
-            res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
+            res = optim.minimize_lbfgs_batched(
+                fb, u0, max_iters=max_iters, tol=tol, count_evals=count_evals)
+            info = None
+            if count_evals:
+                res, info = res
         else:
             def objective(u, data):
                 rv, n, ne = data
@@ -173,12 +182,13 @@ def _fit_program(max_iters, tol, backend, align_mode="general"):
                 objective, u0, (ra, nv, n_eff), max_iters=max_iters, tol=tol
             )
         ok = nv >= 10  # GARCH needs a handful of observations to identify
-        return FitResult(
+        out = FitResult(
             jnp.where(ok[:, None], jax.vmap(_to_natural)(res.x), jnp.nan),
             jnp.where(ok, res.f * n_eff, jnp.nan),
             res.converged & ok,
             res.iters,
         )
+        return (out, info) if count_evals else out
 
     return run
 

@@ -22,6 +22,7 @@ from jax import lax
 
 from ..utils import optim
 from .base import (FitResult, align_mode_on_host, align_right, debatch,
+                   debatch_fit, require_pallas_for_count_evals,
                    ensure_batched, maybe_align,
                    jit_program, resolve_backend)
 
@@ -103,13 +104,16 @@ def fit(
     max_iters: int = 60,
     tol: Optional[float] = None,
     backend: str = "auto",
+    count_evals: bool = False,
 ) -> FitResult:
     """Fit (alpha, beta, gamma) per series -> params ``[batch?, 3]``.
 
     ``backend``: ``"scan"`` (portable), ``"pallas"`` (fused TPU kernel —
     additive and multiplicative, ragged panels via the right-aligned span),
     or ``"auto"`` (pallas whenever the platform/dtype/period allow).
-    """
+
+    ``count_evals=True`` (pallas backend only) returns ``(FitResult, info)``
+    with the optimizer's pass-accounting dict (``utils.optim``)."""
     if model_type not in ("additive", "multiplicative"):
         raise ValueError(f"model_type must be additive|multiplicative, got {model_type!r}")
     multiplicative = model_type == "multiplicative"
@@ -124,16 +128,15 @@ def fit(
 
     backend = resolve_backend(backend, yb.dtype, yb.shape[1],
                               structural_ok=pk.hw_structural_ok(period))
-    return debatch(
-        _fit_program(period, multiplicative, max_iters, float(tol), backend,
-                     align_mode_on_host(yb))(yb),
-        single,
-    )
+    require_pallas_for_count_evals(count_evals, backend)
+    out = _fit_program(period, multiplicative, max_iters, float(tol), backend,
+                       align_mode_on_host(yb), count_evals)(yb)
+    return debatch_fit(out, single, count_evals)
 
 
 @jit_program
 def _fit_program(period, multiplicative, max_iters, tol, backend,
-                 align_mode="general"):
+                 align_mode="general", count_evals=False):
     def run(yb):
         ya, nv = maybe_align(yb, align_mode)
 
@@ -161,7 +164,11 @@ def _fit_program(period, multiplicative, max_iters, tol, backend,
                     nat, ya, seeds, period, multiplicative, interpret=interp
                 ) / n_err
 
-            res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
+            res = optim.minimize_lbfgs_batched(
+                fb, u0, max_iters=max_iters, tol=tol, count_evals=count_evals)
+            info = None
+            if count_evals:
+                res, info = res
         else:
             def objective(u, data):
                 yv, n, ne = data
@@ -172,12 +179,13 @@ def _fit_program(period, multiplicative, max_iters, tol, backend,
                 objective, u0, (ya, nv, n_err), max_iters=max_iters, tol=tol
             )
         ok = nv >= 2 * period  # seed needs two full seasons of real data
-        return FitResult(
+        out = FitResult(
             jnp.where(ok[:, None], optim.sigmoid_to_interval(res.x, 0.0, 1.0), jnp.nan),
             jnp.where(ok, res.f * n_err, jnp.nan),  # report the SSE as before
             res.converged & ok,
             res.iters,
         )
+        return (out, info) if count_evals else out
 
     return run
 

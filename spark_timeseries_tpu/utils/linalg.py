@@ -11,6 +11,43 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def _chol_solve_unrolled(A, y):
+    """Batched SPD solve with statically unrolled Cholesky (k = A.shape[-1]).
+
+    ``jnp.linalg.solve`` lowers to a batched LU whose per-matrix control
+    flow is serial on TPU — ~190 ms of device time for 100k 4x4 systems,
+    which made the Hannan-Rissanen init the single largest cost of the
+    headline ARIMA fit.  For the tiny SPD systems every OLS here produces
+    (ridge-stabilized normal equations), an unrolled Cholesky is ~k^3/3
+    fused ELEMENTWISE ops over the batch — pure VPU streaming, no per-row
+    control flow.  ``sqrt`` is clamped so degenerate rows stay finite (they
+    produce the same garbage-in-garbage-out rows LU did)."""
+    k = A.shape[-1]
+    L = [[None] * k for _ in range(k)]
+    for i in range(k):
+        for j in range(i + 1):
+            s = A[..., i, j]
+            for p in range(j):
+                s = s - L[i][p] * L[j][p]
+            if i == j:
+                L[i][j] = jnp.sqrt(jnp.maximum(s, 1e-30))
+            else:
+                L[i][j] = s / L[j][j]
+    z = [None] * k
+    for i in range(k):
+        s = y[..., i]
+        for p in range(i):
+            s = s - L[i][p] * z[p]
+        z[i] = s / L[i][i]
+    x = [None] * k
+    for i in reversed(range(k)):
+        s = z[i]
+        for p in range(i + 1, k):
+            s = s - L[p][i] * x[p]
+        x[i] = s / L[i][i]
+    return jnp.stack(x, axis=-1)
+
+
 def ridge_solve(XtX, Xty, ridge: float = 1e-8):
     """Solve normal equations with THE scaled-ridge stabilization rule.
 
@@ -18,13 +55,17 @@ def ridge_solve(XtX, Xty, ridge: float = 1e-8):
     the tree (design-matrix, shifted-column, and pallas-moment paths) must
     funnel through here so the backends stay numerically identical.
     Supports leading batch dims: ``XtX [..., k, k]``, ``Xty [..., k]``.
+
+    Small systems (k <= 8 — every model-fit OLS in the tree) solve via the
+    batched unrolled Cholesky; larger ones fall back to ``linalg.solve``.
     """
     k = XtX.shape[-1]
     scale = jnp.maximum(jnp.trace(XtX, axis1=-2, axis2=-1) / k, 1.0)
     eye = jnp.eye(k, dtype=XtX.dtype)
-    return jnp.linalg.solve(
-        XtX + (ridge * scale)[..., None, None] * eye, Xty[..., None]
-    )[..., 0]
+    A = XtX + (ridge * scale)[..., None, None] * eye
+    if k <= 8:
+        return _chol_solve_unrolled(A, Xty)
+    return jnp.linalg.solve(A, Xty[..., None])[..., 0]
 
 
 def ols(X, y, ridge: float = 1e-8):

@@ -266,7 +266,9 @@ def minimize_lbfgs_batched(
     max_linesearch: int = 20,
     c1: float = 1e-4,
     count_evals: bool = False,
-) -> "LBFGSResult | tuple[LBFGSResult, jax.Array]":
+    straggler_fun: "Callable[[jax.Array], Callable] | None" = None,
+    straggler_cap: int | None = None,
+) -> "LBFGSResult | tuple[LBFGSResult, dict]":
     """Jointly minimize ``B`` independent problems with ONE batched objective.
 
     ``fun_batched(x[B, d]) -> f[B]`` evaluates every problem at once — the
@@ -278,23 +280,49 @@ def minimize_lbfgs_batched(
     lockstep (as they do under ``vmap`` of a ``while_loop``); finished rows
     freeze their state.
 
+    **Straggler compaction** (VERDICT r4 item 2): every lockstep pass costs
+    a full-batch objective evaluation even when most rows have converged —
+    the tail of the fit pays O(B) per iteration for O(B/8) live rows.  When
+    ``straggler_fun`` is given, the lockstep loop exits as soon as at most
+    ``straggler_cap`` rows remain unconverged; those rows (and their whole
+    optimizer state) are gathered into a ``[cap, d]`` problem whose
+    objective is ``straggler_fun(row_indices)``, the loop continues on the
+    small batch for the remaining iteration budget, and the results scatter
+    back.  In exact arithmetic per-row trajectories are identical to the
+    uncompacted run (the step-size carry, accept tests, and convergence
+    tests are all per-row, and batched objectives compute rows
+    independently) — but the compacted program IS a different compiled
+    program, so f32 fusion differences exist, and rows sitting on flat or
+    non-convex stretches can amplify them into different (equally valid)
+    optima.  Callers should hold compaction to the same distribution-level
+    parity bar as any backend change (see the bench parity gates), not to
+    bitwise equality.  ``straggler_cap`` defaults to ``max(128, B // 8)``.
+
     ``count_evals=True`` (diagnostics, e.g. ``tools/profile_headline.py``)
-    additionally returns ``(result, ls_evals_per_iter)`` where the second
-    array ``[max_iters] int32`` holds the number of full-batch linesearch
-    objective evaluations each outer iteration performed — the profiler
-    instruments the REAL optimizer instead of maintaining a fork of it.
+    additionally returns ``(result, info)`` with ``info["ls_evals"]``
+    (``[max_iters] int32`` — linesearch objective evaluations per outer
+    iteration), ``info["compact_at"]`` (iteration at which compaction
+    engaged, == iterations run when it never did), and ``info["cap"]`` —
+    the profiler instruments the REAL optimizer instead of a fork of it.
     """
     bsz, d = x0.shape
     m = history
     dtype = x0.dtype
     if ftol is None:
         ftol = 1e-9 if dtype == jnp.float64 else 1e-6
+    cap = straggler_cap if straggler_cap is not None else max(128, bsz // 8)
+    compact = straggler_fun is not None and cap < bsz
 
-    def vg(x):
-        f, pullback = jax.vjp(fun_batched, x)
-        (g,) = pullback(jnp.ones_like(f))
-        bad = ~jnp.isfinite(f) | ~jnp.all(jnp.isfinite(g), axis=-1)
-        return jnp.where(bad, jnp.inf, f), jnp.where(bad[:, None], 0.0, g)
+    def make_vg(fb):
+        def vg(x):
+            f, pullback = jax.vjp(fb, x)
+            (g,) = pullback(jnp.ones_like(f))
+            bad = ~jnp.isfinite(f) | ~jnp.all(jnp.isfinite(g), axis=-1)
+            return jnp.where(bad, jnp.inf, f), jnp.where(bad[:, None], 0.0, g)
+
+        return vg
+
+    vg = make_vg(fun_batched)
 
     rownorm = lambda v: jnp.linalg.norm(v, axis=-1)
     rowdot = lambda a, b: jnp.sum(a * b, axis=-1)
@@ -319,124 +347,191 @@ def minimize_lbfgs_batched(
 
     two_loop_b = jax.vmap(_two_loop, in_axes=(0, 0, 0, 0, None, None))
 
-    def linesearch(x, f, g, direction, done, t0):
-        # done rows are pre-satisfied: their (frozen) state can never pass the
-        # strict Armijo test, and one such row would otherwise drag the whole
-        # batch through max_linesearch extra objective evaluations.  Failed
-        # trials jump to the minimizer of the quadratic through (0, f),
-        # slope g·dir, and (t, f(t)) (clamped to [0.1t, 0.5t]): every trial
-        # is a FULL-batch objective pass gated by the worst row, and plain
-        # halving needs ~12 of them per iteration on badly scaled steps
-        gd = rowdot(g, direction)
-        # noise floor: near convergence the predicted decrease falls below
-        # the objective's f32 evaluation noise and the strict Armijo test
-        # rejects EVERY step size, dragging the whole batch through deep
-        # backtracks; the relaxed accept is resolved by the ftol rule
-        eps = ftol * jnp.maximum(1.0, jnp.abs(f))
+    def make_linesearch(fb):
+        def linesearch(x, f, g, direction, done, t0):
+            # done rows are pre-satisfied: their (frozen) state can never
+            # pass the strict Armijo test, and one such row would otherwise
+            # drag the whole batch through max_linesearch extra objective
+            # evaluations.  Failed trials jump to the minimizer of the
+            # quadratic through (0, f), slope g·dir, and (t, f(t)) (clamped
+            # to [0.1t, 0.5t]): every trial is a FULL-batch objective pass
+            # gated by the worst row, and plain halving needs ~12 of them
+            # per iteration on badly scaled steps
+            gd = rowdot(g, direction)
+            # noise floor: near convergence the predicted decrease falls
+            # below the objective's f32 evaluation noise and the strict
+            # Armijo test rejects EVERY step size, dragging the whole batch
+            # through deep backtracks; the relaxed accept is resolved by the
+            # ftol rule
+            eps = ftol * jnp.maximum(1.0, jnp.abs(f))
 
-        def body(carry):
-            t, ok, j = carry
-            fnew = fun_batched(x + t[:, None] * direction)
-            fnew = jnp.where(jnp.isfinite(fnew), fnew, jnp.inf)
-            ok_new = ok | (fnew <= f + c1 * t * gd + eps)
-            tq = -gd * t * t / (2.0 * (fnew - f - gd * t))
-            tq = jnp.where(jnp.isfinite(tq), tq, 0.0)
-            # the objective may evaluate in a wider dtype; the carry must not
-            tq = jnp.clip(tq, 0.1 * t, 0.5 * t).astype(t.dtype)
-            return jnp.where(ok_new, t, tq), ok_new, j + 1
+            def body(carry):
+                t, ok, j = carry
+                fnew = fb(x + t[:, None] * direction)
+                fnew = jnp.where(jnp.isfinite(fnew), fnew, jnp.inf)
+                ok_new = ok | (fnew <= f + c1 * t * gd + eps)
+                tq = -gd * t * t / (2.0 * (fnew - f - gd * t))
+                tq = jnp.where(jnp.isfinite(tq), tq, 0.0)
+                # the objective may evaluate in a wider dtype; the carry
+                # must not
+                tq = jnp.clip(tq, 0.1 * t, 0.5 * t).astype(t.dtype)
+                return jnp.where(ok_new, t, tq), ok_new, j + 1
 
-        def cond(carry):
-            _, ok, j = carry
-            return jnp.any(~ok) & (j < max_linesearch)
+            def cond(carry):
+                _, ok, j = carry
+                return jnp.any(~ok) & (j < max_linesearch)
 
-        t, ok, n_ls = lax.while_loop(cond, body, (t0, done, 0))
-        return t, ok, n_ls
+            t, ok, n_ls = lax.while_loop(cond, body, (t0, done, 0))
+            return t, ok, n_ls
 
-    def step(carry):
-        state, iters, ls_hist = carry
-        done = state.converged | state.failed
-        with jax.named_scope("optim.lbfgs_batched.two_loop"):
-            direction = -two_loop_b(
-                state.g, state.s_hist, state.y_hist, state.rho_hist, state.k, m
+        return linesearch
+
+    def make_step(fb):
+        vg_fb = make_vg(fb)
+        linesearch = make_linesearch(fb)
+
+        def step(carry):
+            state, iters, ls_hist = carry
+            done = state.converged | state.failed
+            with jax.named_scope("optim.lbfgs_batched.two_loop"):
+                direction = -two_loop_b(
+                    state.g, state.s_hist, state.y_hist, state.rho_hist,
+                    state.k, m
+                )
+            descent = rowdot(state.g, direction) < 0.0
+            direction = jnp.where(descent[:, None], direction, -state.g)
+
+            # rows with no curvature history step along raw steepest
+            # descent, whose scale is arbitrary: bound their first trial
+            # step length by 1.  With history, warm-start from the row's
+            # last accepted step — every extra trial is a FULL-batch
+            # objective pass, so a straggler row that keeps needing tiny
+            # steps must not re-pay the whole backtrack from t=1 every
+            # iteration
+            has_hist = jnp.any(state.rho_hist > 0.0, axis=-1)
+            t0 = jnp.where(
+                has_hist & descent,
+                jnp.minimum(1.0, 4.0 * state.tprev),
+                1.0 / jnp.maximum(1.0, rownorm(direction)),
+            ).astype(dtype)
+            with jax.named_scope("optim.lbfgs_batched.linesearch"):
+                t, ok, n_ls = linesearch(
+                    state.x, state.f, state.g, direction, done, t0)
+            x_new = state.x + t[:, None] * direction
+            with jax.named_scope("optim.lbfgs_batched.value_and_grad"):
+                f_new, g_new = vg_fb(x_new)
+
+            s = x_new - state.x
+            y = g_new - state.g
+            sy = rowdot(s, y)
+            slot = state.k % m
+            accept = (
+                ok
+                & (f_new <= state.f + ftol * jnp.maximum(1.0, jnp.abs(state.f)))
+                & ~done
             )
-        descent = rowdot(state.g, direction) < 0.0
-        direction = jnp.where(descent[:, None], direction, -state.g)
+            # gate history on accept (not just the linesearch ok), matching
+            # the per-series minimize_lbfgs: a step rejected at the
+            # re-evaluation must not poison the curvature history
+            good_pair = (sy > 1e-10) & accept
+            upd = lambda hist, v: hist.at[:, slot].set(
+                jnp.where(good_pair[:, None], v, hist[:, slot])
+            )
+            s_hist = upd(state.s_hist, s)
+            y_hist = upd(state.y_hist, y)
+            rho_hist = state.rho_hist.at[:, slot].set(
+                jnp.where(good_pair, 1.0 / jnp.maximum(sy, 1e-30),
+                          state.rho_hist[:, slot])
+            )
+            x_out = jnp.where(accept[:, None], x_new, state.x)
+            f_out = jnp.where(accept, f_new, state.f)
+            g_out = jnp.where(accept[:, None], g_new, state.g)
+            conv = state.converged | (
+                rownorm(g_out) < tol * jnp.maximum(1.0, rownorm(x_out))
+            )
+            conv = conv | (
+                accept
+                & (state.f - f_new <= ftol * jnp.maximum(1.0, jnp.abs(f_new)))
+            )
+            better = f_out < state.bf
+            new_state = _State(
+                k=state.k + 1,
+                x=x_out,
+                f=f_out,
+                g=g_out,
+                s_hist=s_hist,
+                y_hist=y_hist,
+                rho_hist=rho_hist,
+                converged=conv,
+                failed=state.failed | (~ok & ~conv & ~done),
+                tprev=jnp.where(accept, t, state.tprev),
+                bx=jnp.where(better[:, None], x_out, state.bx),
+                bf=jnp.where(better, f_out, state.bf),
+                bg=jnp.where(better[:, None], g_out, state.bg),
+            )
+            iters = jnp.where(done, iters, state.k + 1)
+            if ls_hist is not None:
+                ls_hist = ls_hist.at[state.k].set(n_ls)
+            return new_state, iters, ls_hist
 
-        # rows with no curvature history step along raw steepest descent,
-        # whose scale is arbitrary: bound their first trial step length by 1.
-        # With history, warm-start from the row's last accepted step — every
-        # extra trial is a FULL-batch objective pass, so a straggler row that
-        # keeps needing tiny steps must not re-pay the whole backtrack from
-        # t=1 every iteration
-        has_hist = jnp.any(state.rho_hist > 0.0, axis=-1)
-        t0 = jnp.where(
-            has_hist & descent,
-            jnp.minimum(1.0, 4.0 * state.tprev),
-            1.0 / jnp.maximum(1.0, rownorm(direction)),
-        ).astype(dtype)
-        with jax.named_scope("optim.lbfgs_batched.linesearch"):
-            t, ok, n_ls = linesearch(state.x, state.f, state.g, direction, done, t0)
-        x_new = state.x + t[:, None] * direction
-        with jax.named_scope("optim.lbfgs_batched.value_and_grad"):
-            f_new, g_new = vg(x_new)
+        return step
 
-        s = x_new - state.x
-        y = g_new - state.g
-        sy = rowdot(s, y)
-        slot = state.k % m
-        accept = (
-            ok
-            & (f_new <= state.f + ftol * jnp.maximum(1.0, jnp.abs(state.f)))
-            & ~done
-        )
-        # gate history on accept (not just the linesearch ok), matching the
-        # per-series minimize_lbfgs: a step rejected at the re-evaluation must
-        # not poison the curvature history
-        good_pair = (sy > 1e-10) & accept
-        upd = lambda hist, v: hist.at[:, slot].set(
-            jnp.where(good_pair[:, None], v, hist[:, slot])
-        )
-        s_hist = upd(state.s_hist, s)
-        y_hist = upd(state.y_hist, y)
-        rho_hist = state.rho_hist.at[:, slot].set(
-            jnp.where(good_pair, 1.0 / jnp.maximum(sy, 1e-30), state.rho_hist[:, slot])
-        )
-        x_out = jnp.where(accept[:, None], x_new, state.x)
-        f_out = jnp.where(accept, f_new, state.f)
-        g_out = jnp.where(accept[:, None], g_new, state.g)
-        conv = state.converged | (
-            rownorm(g_out) < tol * jnp.maximum(1.0, rownorm(x_out))
-        )
-        conv = conv | (
-            accept & (state.f - f_new <= ftol * jnp.maximum(1.0, jnp.abs(f_new)))
-        )
-        better = f_out < state.bf
-        new_state = _State(
-            k=state.k + 1,
-            x=x_out,
-            f=f_out,
-            g=g_out,
-            s_hist=s_hist,
-            y_hist=y_hist,
-            rho_hist=rho_hist,
-            converged=conv,
-            failed=state.failed | (~ok & ~conv & ~done),
-            tprev=jnp.where(accept, t, state.tprev),
-            bx=jnp.where(better[:, None], x_out, state.bx),
-            bf=jnp.where(better, f_out, state.bf),
-            bg=jnp.where(better[:, None], g_out, state.bg),
-        )
-        iters = jnp.where(done, iters, state.k + 1)
-        if ls_hist is not None:
-            ls_hist = ls_hist.at[state.k].set(n_ls)
-        return new_state, iters, ls_hist
-
-    def cond(carry):
-        state, _, _ = carry
-        return (state.k < max_iters) & jnp.any(~(state.converged | state.failed))
+    def undone_count(state):
+        return jnp.sum(~(state.converged | state.failed))
 
     ls0 = jnp.zeros((max_iters,), jnp.int32) if count_evals else None
-    final, iters, ls_hist = lax.while_loop(cond, step, (init, iters0, ls0))
+    step_full = make_step(fun_batched)
+
+    def cond_full(carry):
+        state, _, _ = carry
+        live = jnp.any(~(state.converged | state.failed))
+        if compact:
+            # keep lockstepping only while the stragglers outnumber the cap
+            live = live & (undone_count(state) > cap)
+        return (state.k < max_iters) & live
+
+    stage1, iters, ls_hist = lax.while_loop(
+        cond_full, step_full, (init, iters0, ls0))
+    final = stage1
+    compact_at = stage1.k
+
+    if compact:
+        # gather the (at most cap) unconverged rows and their whole state;
+        # out-of-range fill indices read row bsz-1 and are dropped on the
+        # scatter, so duplicates never corrupt live rows
+        undone1 = ~(stage1.converged | stage1.failed)
+        idx = jnp.nonzero(undone1, size=cap, fill_value=bsz)[0]
+        idxc = jnp.minimum(idx, bsz - 1)
+        take = lambda a: a[idxc]
+        sub = _State(
+            k=stage1.k,
+            x=take(stage1.x), f=take(stage1.f), g=take(stage1.g),
+            s_hist=take(stage1.s_hist), y_hist=take(stage1.y_hist),
+            rho_hist=take(stage1.rho_hist),
+            converged=take(stage1.converged), failed=take(stage1.failed),
+            tprev=take(stage1.tprev),
+            bx=take(stage1.bx), bf=take(stage1.bf), bg=take(stage1.bg),
+        )
+        step_sub = make_step(straggler_fun(idxc))
+
+        def cond_sub(carry):
+            state, _, _ = carry
+            return (state.k < max_iters) & jnp.any(
+                ~(state.converged | state.failed))
+
+        sub_f, sub_iters, ls_hist = lax.while_loop(
+            cond_sub, step_sub, (sub, take(iters), ls_hist))
+        put = lambda full, s: full.at[idx].set(s, mode="drop")
+        final = stage1._replace(
+            k=sub_f.k,
+            converged=put(stage1.converged, sub_f.converged),
+            failed=put(stage1.failed, sub_f.failed),
+            bx=put(stage1.bx, sub_f.bx),
+            bf=put(stage1.bf, sub_f.bf),
+            bg=put(stage1.bg, sub_f.bg),
+        )
+        iters = put(iters, sub_iters)
+
     # (x, f, grad_norm) all refer to the best-seen iterate per row
     result = LBFGSResult(
         x=final.bx,
@@ -445,7 +540,10 @@ def minimize_lbfgs_batched(
         iters=iters,
         grad_norm=rownorm(final.bg),
     )
-    return (result, ls_hist) if count_evals else result
+    if not count_evals:
+        return result
+    return result, {"ls_evals": ls_hist, "compact_at": compact_at,
+                    "cap": cap if compact else 0}
 
 
 def batched_minimize(

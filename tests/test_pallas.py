@@ -942,3 +942,39 @@ def test_batch_fill_chain_outputs_natural_subset():
     f_ref, d_ref, l_ref = uv.batch_fill_linear_chain(y, backend="scan")
     d, = uv.batch_fill_linear_chain(y, backend="scan", outputs=("diff",))
     np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), rtol=1e-6, atol=1e-6)
+
+
+def test_arima_fit_straggler_compaction_parity(monkeypatch):
+    # force the compaction stage on at a test-tractable batch size and check
+    # it preserves FIT QUALITY vs the uncompacted program.  The two are
+    # distinct compiled programs (extra loop clause + a second stage), so
+    # f32 fusion differences exist and rows on flat/non-convex stretches of
+    # the MA surface may legitimately take different paths — the contract is
+    # the bench parity gates' (converged fraction, achieved objective,
+    # typical params), not bitwise trajectories.
+    b, t = 2048, 64
+    y = jnp.asarray(_arma_panel(b, t, seed=77))
+    # ref MUST trace before the monkeypatch so it runs the uncompacted
+    # program; max_iters=14 is unique to this test so jit_program's cache
+    # cannot hand either fit a program traced under the other's threshold
+    ref = arima.fit(y, (1, 1, 1), backend="pallas-interpret", max_iters=14)
+    monkeypatch.setattr(arima, "_COMPACT_MIN_BATCH", 2048)
+    (got, info) = arima.fit(y, (1, 1, 1), backend="pallas-interpret",
+                            max_iters=14, count_evals=True)
+    assert int(info["cap"]) == 1024
+    assert int(info["compact_at"]) < 14  # compaction actually engaged
+    conv_ref = np.asarray(ref.converged)
+    conv_got = np.asarray(got.converged)
+    assert abs(conv_ref.mean() - conv_got.mean()) < 0.02
+    both = conv_ref & conv_got
+    # short series + a 14-iteration budget converge only ~55% of rows (the
+    # point is a test-tractable straggler tail); the quality gates below
+    # carry the parity claim, this floor just guards a meaningful sample
+    assert both.mean() > 0.45
+    nll_r = np.asarray(ref.neg_log_likelihood)[both]
+    nll_g = np.asarray(got.neg_log_likelihood)[both]
+    rel = np.abs(nll_r - nll_g) / np.maximum(np.abs(nll_r), 1e-6)
+    assert float(np.percentile(rel, 99)) < 1e-2
+    med = float(np.nanmedian(np.abs(
+        np.asarray(ref.params)[both] - np.asarray(got.params)[both])))
+    assert med < 1e-2

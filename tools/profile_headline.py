@@ -87,13 +87,13 @@ def main():
     out = run(init)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
-    res, ls_hist = run(init)
+    res, info = run(init)
     jax.block_until_ready(res.x)
     dt = time.perf_counter() - t0
     iters_np = np.asarray(res.iters)
     conv = np.asarray(res.converged)
     outer = int(iters_np.max())
-    ls = np.asarray(ls_hist)[:outer]
+    ls = np.asarray(info["ls_evals"])[:outer]
     n_ls = int(ls.sum())
     print(f"fit wall: {dt:.3f}s  ({b/dt:.0f} series/s raw, "
           f"{b*conv.mean()/dt:.0f} converged-only)")
@@ -102,6 +102,8 @@ def main():
     print(f"ls evals per outer iter: {ls.tolist()}")
     print(f"linesearch evals total: {n_ls}  (avg {n_ls/max(outer,1):.2f}/iter)")
     print(f"objective passes: {n_ls} fwd (linesearch) + {outer+1} vg")
+    print(f"compaction: engaged at iter {int(info['compact_at'])} "
+          f"(cap {int(info['cap'])})")
     qs = [50, 75, 90, 95, 99, 100]
     print("per-row iters quantiles:",
           {q: int(np.percentile(iters_np, q)) for q in qs})
