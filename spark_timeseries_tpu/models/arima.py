@@ -42,10 +42,9 @@ from .base import (FitResult, align_mode_on_host, align_right, debatch,
 
 Order = Tuple[int, int, int]
 
-# below this batch size the straggler-compaction stage of the batched
-# optimizer is not worth its gather (and the lane-aligned cap could not be
-# smaller than the batch anyway)
-_COMPACT_MIN_BATCH = 4096
+# module-level so tests can monkeypatch the gate per model; the value and
+# the cap sizing live with the compaction feature (utils.optim)
+_COMPACT_MIN_BATCH = optim.COMPACT_MIN_BATCH
 
 
 def _n_params(order: Order, include_intercept: bool) -> int:
@@ -362,9 +361,8 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
             # straggler compaction (utils.optim): after most rows converge,
             # lockstep passes still stream the whole panel; gather the tail
             # into a 1/8-size problem instead.  The gather repacks folded
-            # COLUMNS (series ride the lanes); the kernels grid whole
-            # [8, 128] series blocks, so cap must be a multiple of 1024
-            cap = -(-max(1024, bsz // 8) // 1024) * 1024
+            # COLUMNS (series ride the lanes), grid-aligned by the cap
+            cap = optim.compaction_cap(bsz)
             straggler_fun = None
             if bsz >= _COMPACT_MIN_BATCH:
                 tp = y3.shape[0]

@@ -126,6 +126,10 @@ def neg_log_likelihood(params, r, n_valid=None):
 
 # -- fitting ----------------------------------------------------------------
 
+# module-level so tests can monkeypatch the gate per model (sizing lives
+# with the compaction feature: utils.optim)
+_COMPACT_MIN_BATCH = optim.COMPACT_MIN_BATCH
+
 
 def fit(r, *, max_iters: int = 80, tol: Optional[float] = None,
         backend: str = "auto", count_evals: bool = False) -> FitResult:
@@ -168,8 +172,27 @@ def _fit_program(max_iters, tol, backend, align_mode="general",
                 nat = jax.vmap(_to_natural)(u)
                 return pk.garch_neg_loglik(nat, ra, nv, interpret=interp) / n_eff
 
+            # straggler compaction (utils.optim): the objective closes over
+            # the NATURAL-layout panel (the kernel folds internally), so the
+            # subset gather is a plain row gather
+            bsz = ra.shape[0]
+            cap = optim.compaction_cap(bsz)
+            straggler_fun = None
+            if bsz >= _COMPACT_MIN_BATCH:
+
+                def straggler_fun(idxc):
+                    ras, nvs, nes = ra[idxc], nv[idxc], n_eff[idxc]
+
+                    def fb_s(u):
+                        nat = jax.vmap(_to_natural)(u)
+                        return pk.garch_neg_loglik(
+                            nat, ras, nvs, interpret=interp) / nes
+
+                    return fb_s
+
             res = optim.minimize_lbfgs_batched(
-                fb, u0, max_iters=max_iters, tol=tol, count_evals=count_evals)
+                fb, u0, max_iters=max_iters, tol=tol, count_evals=count_evals,
+                straggler_fun=straggler_fun, straggler_cap=cap)
             info = None
             if count_evals:
                 res, info = res

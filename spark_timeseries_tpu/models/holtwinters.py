@@ -96,6 +96,11 @@ def sse(params, y, period: int, multiplicative: bool, n_valid=None):
     return jnp.sum(err * err)
 
 
+# module-level so tests can monkeypatch the gate per model (sizing lives
+# with the compaction feature: utils.optim)
+_COMPACT_MIN_BATCH = optim.COMPACT_MIN_BATCH
+
+
 def fit(
     y,
     period: int,
@@ -164,8 +169,30 @@ def _fit_program(period, multiplicative, max_iters, tol, backend,
                     nat, ya, seeds, period, multiplicative, interpret=interp
                 ) / n_err
 
+            # straggler compaction (utils.optim): the objective closes over
+            # the NATURAL-layout panel + per-row seed state, so the subset
+            # gather is a plain row gather of each
+            bsz = ya.shape[0]
+            cap = optim.compaction_cap(bsz)
+            straggler_fun = None
+            if bsz >= _COMPACT_MIN_BATCH:
+
+                def straggler_fun(idxc):
+                    yas = ya[idxc]
+                    seeds_s = tuple(s[idxc] for s in seeds)
+                    nes = n_err[idxc]
+
+                    def fb_s(u):
+                        nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
+                        return pk.hw_sse_seeded(
+                            nat, yas, seeds_s, period, multiplicative,
+                            interpret=interp) / nes
+
+                    return fb_s
+
             res = optim.minimize_lbfgs_batched(
-                fb, u0, max_iters=max_iters, tol=tol, count_evals=count_evals)
+                fb, u0, max_iters=max_iters, tol=tol, count_evals=count_evals,
+                straggler_fun=straggler_fun, straggler_cap=cap)
             info = None
             if count_evals:
                 res, info = res

@@ -300,6 +300,16 @@ class TimeSeriesPanel:
             )
         return self._like(out, index=idx)
 
+    def to_folded(self):
+        """Values in the resident TPU kernel layout (``ops.layout``):
+        ``FoldedPanel`` — fold once at the panel boundary, then every
+        transform dispatch on it streams at kernel rate with no per-dispatch
+        layout transpose.  Pass it to ``ops.univariate.batch_autocorr`` /
+        ``batch_fill_linear_chain``; ``ops.unfold_panel`` converts back."""
+        from .ops.layout import fold_panel
+
+        return fold_panel(self.series_values())
+
     def fill(self, method: str, value=None) -> "TimeSeriesPanel":
         # single-host linear fill takes the fused Pallas sweep when the
         # platform supports it (the dispatcher falls back to the vmapped

@@ -29,6 +29,18 @@ import jax.numpy as jnp
 from jax import lax
 
 
+# Straggler-compaction sizing shared by every fit driver: below this batch
+# size the compaction stage is not worth its gather, and the cap must cover
+# whole [8, 128] kernel series blocks (ops.pallas_kernels._SBLK) so folded-
+# column gathers stay grid-aligned.
+COMPACT_MIN_BATCH = 4096
+
+
+def compaction_cap(bsz: int) -> int:
+    """Straggler cap for a batch of ``bsz`` rows: ~bsz/8, 1024-aligned."""
+    return -(-max(1024, bsz // 8) // 1024) * 1024
+
+
 class LBFGSResult(NamedTuple):
     x: jax.Array  # [d] solution
     f: jax.Array  # [] final objective

@@ -978,3 +978,49 @@ def test_arima_fit_straggler_compaction_parity(monkeypatch):
     med = float(np.nanmedian(np.abs(
         np.asarray(ref.params)[both] - np.asarray(got.params)[both])))
     assert med < 1e-2
+
+
+def _dist_parity(ref, got, conv_floor=0.45):
+    conv_ref = np.asarray(ref.converged)
+    conv_got = np.asarray(got.converged)
+    assert abs(conv_ref.mean() - conv_got.mean()) < 0.02
+    both = conv_ref & conv_got
+    assert both.mean() > conv_floor
+    nll_r = np.asarray(ref.neg_log_likelihood)[both]
+    nll_g = np.asarray(got.neg_log_likelihood)[both]
+    rel = np.abs(nll_r - nll_g) / np.maximum(np.abs(nll_r), 1e-6)
+    assert float(np.percentile(rel, 99)) < 1e-2
+    med = float(np.nanmedian(np.abs(
+        np.asarray(ref.params)[both] - np.asarray(got.params)[both])))
+    assert med < 1e-2
+
+
+def test_garch_fit_straggler_compaction_parity(monkeypatch):
+    from spark_timeseries_tpu.models import garch
+
+    rng = np.random.default_rng(31)
+    r = jnp.asarray((rng.normal(size=(2048, 96)) * 0.1).astype(np.float32))
+    ref = garch.fit(r, backend="pallas-interpret", max_iters=13)
+    monkeypatch.setattr(garch, "_COMPACT_MIN_BATCH", 2048)
+    got, info = garch.fit(r, backend="pallas-interpret", max_iters=13,
+                          count_evals=True)
+    assert int(info["cap"]) == 1024
+    assert int(info["compact_at"]) < 13
+    _dist_parity(ref, got)
+
+
+def test_hw_fit_straggler_compaction_parity(monkeypatch):
+    from spark_timeseries_tpu.models import holtwinters as hw
+
+    rng = np.random.default_rng(32)
+    tt = np.arange(96, dtype=np.float32)
+    w = (10 + 0.02 * tt[None, :] + 2 * np.sin(2 * np.pi * tt[None, :] / 24)
+         + 0.3 * rng.normal(size=(2048, 96))).astype(np.float32)
+    w = jnp.asarray(w)
+    ref = hw.fit(w, 24, "additive", backend="pallas-interpret", max_iters=13)
+    monkeypatch.setattr(hw, "_COMPACT_MIN_BATCH", 2048)
+    got, info = hw.fit(w, 24, "additive", backend="pallas-interpret",
+                       max_iters=13, count_evals=True)
+    assert int(info["cap"]) == 1024
+    assert int(info["compact_at"]) < 13
+    _dist_parity(ref, got)

@@ -353,3 +353,15 @@ class TestRound2Fixes:
         assert panellib._cached_batched(lambda v, c=2.0: v * c) is not (
             panellib._cached_batched(lambda v, c=3.0: v * c)
         )
+
+
+def test_to_folded_roundtrip(small_panel):
+    from spark_timeseries_tpu.ops.layout import FoldedPanel, unfold_panel
+
+    fp = small_panel.to_folded()
+    assert isinstance(fp, FoldedPanel)
+    assert fp.shape == (3, 6)
+    back = np.asarray(unfold_panel(fp))
+    ref = np.asarray(small_panel.series_values())
+    np.testing.assert_array_equal(np.isnan(back), np.isnan(ref))
+    np.testing.assert_array_equal(np.nan_to_num(back), np.nan_to_num(ref))

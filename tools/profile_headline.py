@@ -102,8 +102,11 @@ def main():
     print(f"ls evals per outer iter: {ls.tolist()}")
     print(f"linesearch evals total: {n_ls}  (avg {n_ls/max(outer,1):.2f}/iter)")
     print(f"objective passes: {n_ls} fwd (linesearch) + {outer+1} vg")
-    print(f"compaction: engaged at iter {int(info['compact_at'])} "
-          f"(cap {int(info['cap'])})")
+    if int(info["cap"]):
+        print(f"compaction: engaged at iter {int(info['compact_at'])} "
+              f"(cap {int(info['cap'])})")
+    else:
+        print("compaction: not enabled in this tool (no straggler_fun)")
     qs = [50, 75, 90, 95, 99, 100]
     print("per-row iters quantiles:",
           {q: int(np.percentile(iters_np, q)) for q in qs})
