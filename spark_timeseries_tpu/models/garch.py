@@ -362,7 +362,28 @@ def _fit_argarch_program(max_iters, tol, backend):
                 r = jnp.where(t_idx[None, :] <= start[:, None], 0.0, r)
                 return pk.garch_neg_loglik(nat[:, 2:], r, nv - 1, interpret=interp) / n_eff
 
-            res = optim.minimize_lbfgs_batched(fb, u0, max_iters=max_iters, tol=tol)
+            # straggler compaction: row gathers, as in fit()
+            bsz = ya.shape[0]
+            cap = optim.compaction_cap(bsz)
+            straggler_fun = None
+            if bsz >= _COMPACT_MIN_BATCH:
+
+                def straggler_fun(idxc):
+                    yas, prevs = ya[idxc], prev[idxc]
+                    starts, nvs, nes = start[idxc], nv[idxc], n_eff[idxc]
+
+                    def fb_s(u):
+                        nat = jax.vmap(_argarch_to_natural)(u)
+                        r = yas - nat[:, 0:1] - nat[:, 1:2] * prevs
+                        r = jnp.where(t_idx[None, :] <= starts[:, None], 0.0, r)
+                        return pk.garch_neg_loglik(
+                            nat[:, 2:], r, nvs - 1, interpret=interp) / nes
+
+                    return fb_s
+
+            res = optim.minimize_lbfgs_batched(
+                fb, u0, max_iters=max_iters, tol=tol,
+                straggler_fun=straggler_fun, straggler_cap=cap)
         else:
             def obj_scaled(u, data):
                 yv, n, ne = data
