@@ -1,0 +1,46 @@
+"""Headline ARIMA fit timing with straggler compaction + pass accounting."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from bench import gen_arima_panel
+from spark_timeseries_tpu.models import arima
+
+b, t = 100_352, 1000
+order = (1, 1, 1)
+panels = [gen_arima_panel(b, t, seed=s) for s in range(3)]
+dev = [jnp.asarray(p) for p in panels]
+for d in dev:
+    jax.block_until_ready(d)
+print("staged", flush=True)
+
+r = arima.fit(dev[0], order)  # warm/compile
+jax.block_until_ready(r.params)
+print("compiled", flush=True)
+
+times = []
+for v in dev * 2:
+    t0 = time.perf_counter()
+    r = arima.fit(v, order)
+    conv = float(jnp.mean(r.converged))
+    float(jnp.sum(jnp.nan_to_num(r.params)))
+    times.append(time.perf_counter() - t0)
+print(f"fit latencies: {[round(x,3) for x in times]}", flush=True)
+best, p50 = min(times), float(np.median(times))
+print(f"best {best:.3f}s p50 {p50:.3f}s conv {conv:.4f} "
+      f"-> {b*conv/best:.0f} series/s best, {b*conv/p50:.0f} p50", flush=True)
+
+res, info = arima.fit(dev[0], order, count_evals=True)
+jax.block_until_ready(res.params)
+iters = np.asarray(res.iters)
+k_end = int(iters.max())
+ca = int(info["compact_at"])
+ls = np.asarray(info["ls_evals"])
+print(f"compact_at {ca} cap {int(info['cap'])} iters_end {k_end}")
+print(f"ls evals stage1 {int(ls[:ca].sum())} stage2 {int(ls[ca:k_end].sum())}")
+print(f"per-row iters quantiles:",
+      {q: int(np.percentile(iters, q)) for q in (50, 75, 90, 95, 99, 100)})

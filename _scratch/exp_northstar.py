@@ -1,0 +1,9 @@
+import sys
+import json
+
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+from bench import _northstar_1m
+
+print(json.dumps(_northstar_1m(jnp, (1, 1, 1)), indent=1))

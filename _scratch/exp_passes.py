@@ -1,0 +1,101 @@
+"""Where do the headline fit's milliseconds go?  Component timing with all
+data passed as jit ARGUMENTS (closures embed the panel as an HLO constant,
+which the tunnel's compile endpoint rejects at 413)."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from bench import gen_arima_panel
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.models.base import maybe_align
+from spark_timeseries_tpu.ops import pallas_kernels as pk
+from spark_timeseries_tpu.utils import optim
+
+b, t = 100_352, 1000
+order = (1, 1, 1)
+y = jnp.asarray(gen_arima_panel(b, t, seed=0))
+jax.block_until_ready(y)
+print("staged", flush=True)
+
+
+def _sync(out):
+    # the axon tunnel's block_until_ready is a no-op; only a host transfer
+    # actually waits for the device
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jnp.sum(jnp.nan_to_num(jnp.ravel(leaf)[:8]).astype(jnp.float32)))
+
+
+def timeit(name, fn, *args, reps=6):
+    out = fn(*args)
+    _sync(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _sync(out)
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:28s} best {min(ts)*1e3:8.1f} ms  p50 {np.median(ts)*1e3:8.1f} ms",
+          flush=True)
+    return out
+
+
+@jax.jit
+def prep(yb):
+    ya, nv0 = maybe_align(yb, "dense")
+    yd = jax.vmap(lambda v: arima._difference(v, 1))(ya)
+    nvd = nv0 - 1
+    y3, zb3 = pk.css_prefold(yd, order, nvd)
+    init = pk.hr_init(yd, order, True, nvd, y3=y3)
+    return y3, zb3, nvd, init
+
+
+y3, zb3, nvd, init = timeit("prep+prefold+hr_init", prep, y)
+n_eff = jnp.maximum(nvd - 1, 1).astype(jnp.float32)
+
+
+def obj(P, y3, zb3, nvd, ne):
+    return pk.css_neg_loglik_folded(P, y3, zb3, t, order, True, nvd) / ne
+
+
+@jax.jit
+def fwd1(P, y3, zb3, nvd, ne):
+    return jnp.sum(obj(P, y3, zb3, nvd, ne))
+
+
+@jax.jit
+def vg1(P, y3, zb3, nvd, ne):
+    f, pb = jax.vjp(lambda P_: obj(P_, y3, zb3, nvd, ne), P)
+    return pb(jnp.ones_like(f))[0]
+
+
+timeit("value pass (1 dispatch)", fwd1, init, y3, zb3, nvd, n_eff)
+timeit("value+grad (1 dispatch)", vg1, init, y3, zb3, nvd, n_eff)
+
+
+@jax.jit
+def opt(init, y3, zb3, nvd, ne):
+    return optim.minimize_lbfgs_batched(
+        lambda P: obj(P, y3, zb3, nvd, ne), init, max_iters=60, tol=1e-4)
+
+
+timeit("optimizer (no compaction)", opt, init, y3, zb3, nvd, n_eff)
+
+
+@jax.jit
+def full(yb):
+    return arima.fit(yb, order)
+
+
+timeit("arima.fit end-to-end", full, y)
+
+# null program: dispatch round-trip floor
+@jax.jit
+def null(yb):
+    return jnp.float32(0.0) + yb[0, 0]
+
+
+timeit("null dispatch", null, y)

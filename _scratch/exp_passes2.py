@@ -1,0 +1,110 @@
+"""Drill into the headline fit's prep + compaction costs."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from bench import gen_arima_panel
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.models.base import maybe_align
+from spark_timeseries_tpu.ops import pallas_kernels as pk
+from spark_timeseries_tpu.utils import optim
+
+b, t = 100_352, 1000
+order = (1, 1, 1)
+y = jnp.asarray(gen_arima_panel(b, t, seed=0))
+jax.block_until_ready(y)
+print("staged", flush=True)
+
+
+def _sync(out):
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jnp.sum(jnp.nan_to_num(jnp.ravel(leaf)[:8]).astype(jnp.float32)))
+
+
+def timeit(name, fn, *args, reps=6):
+    out = fn(*args)
+    _sync(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _sync(out)
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:34s} best {min(ts)*1e3:8.1f} ms  p50 {np.median(ts)*1e3:8.1f} ms",
+          flush=True)
+    return out
+
+
+@jax.jit
+def stage_a(yb):
+    ya, nv0 = maybe_align(yb, "dense")
+    yd = jax.vmap(lambda v: arima._difference(v, 1))(ya)
+    return yd, nv0 - 1
+
+
+yd, nvd = timeit("align+diff", stage_a, y)
+
+
+@jax.jit
+def stage_b(yd, nvd):
+    return pk.css_prefold(yd, order, nvd)
+
+
+y3, zb3 = timeit("css_prefold", stage_b, yd, nvd)
+
+
+@jax.jit
+def stage_c(yd, nvd, y3):
+    return pk.hr_init(yd, order, True, nvd, y3=y3)
+
+
+init = timeit("hr_init (given y3)", stage_c, yd, nvd, y3)
+n_eff = jnp.maximum(nvd - 1, 1).astype(jnp.float32)
+
+
+def obj(P, y3, zb3, nvd, ne):
+    return pk.css_neg_loglik_folded(P, y3, zb3, t, order, True, nvd) / ne
+
+
+@jax.jit
+def opt_plain(init, y3, zb3, nvd, ne):
+    return optim.minimize_lbfgs_batched(
+        lambda P: obj(P, y3, zb3, nvd, ne), init, max_iters=60, tol=1e-4)
+
+
+timeit("optimizer no-compact", opt_plain, init, y3, zb3, nvd, n_eff)
+
+cap = -(-max(1024, b // 8) // 1024) * 1024
+tp = y3.shape[0]
+
+
+@jax.jit
+def opt_compact(init, y3, zb3, nvd, ne):
+    def straggler_fun(idxc):
+        y3s = y3.reshape(tp, -1)[:, idxc].reshape(tp, cap // 128, 128)
+        zb3s = zb3.reshape(1, -1)[:, idxc].reshape(1, cap // 128, 128)
+        nvs = nvd[idxc]
+        nes = ne[idxc]
+        return lambda P: pk.css_neg_loglik_folded(
+            P, y3s, zb3s, t, order, True, nvs) / nes
+
+    return optim.minimize_lbfgs_batched(
+        lambda P: obj(P, y3, zb3, nvd, ne), init, max_iters=60, tol=1e-4,
+        straggler_fun=straggler_fun, straggler_cap=cap)
+
+
+timeit("optimizer compact", opt_compact, init, y3, zb3, nvd, n_eff)
+
+
+@jax.jit
+def gather_only(y3, nvd):
+    idxc = jnp.arange(cap) * 7 % b
+    y3s = y3.reshape(tp, -1)[:, idxc].reshape(tp, cap // 128, 128)
+    return y3s
+
+
+timeit("folded column gather alone", gather_only, y3, nvd)

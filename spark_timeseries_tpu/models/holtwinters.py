@@ -160,8 +160,11 @@ def _fit_program(period, multiplicative, max_iters, tol, backend,
 
             # seeds are data-only: compute ONCE, not per objective call
             # (vmapped seed slices are batched gathers — recomputed inside
-            # the loop they dominate an objective evaluation at panel scale)
-            seeds = pk.hw_seeds(ya, period, multiplicative, nv)
+            # the loop they dominate an objective evaluation at panel scale;
+            # the dense mode takes the gather-free static-slice path)
+            seeds = pk.hw_seeds(
+                ya, period, multiplicative,
+                None if align_mode == "dense" else nv)
 
             def fb(u):
                 nat = optim.sigmoid_to_interval(u, 0.0, 1.0)

@@ -1024,3 +1024,20 @@ def test_hw_fit_straggler_compaction_parity(monkeypatch):
     assert int(info["cap"]) == 1024
     assert int(info["compact_at"]) < 13
     _dist_parity(ref, got)
+
+
+@pytest.mark.parametrize("mult", [False, True])
+def test_hw_seeds_dense_path_matches_general(mult):
+    # n_valid=None takes the gather-free static-slice path; it must produce
+    # the exact seeds of the general path with a zero start vector
+    rng = np.random.default_rng(41)
+    tt = np.arange(120, dtype=np.float32)
+    y = (10 + 0.05 * tt[None, :] + 2 * np.sin(2 * np.pi * tt[None, :] / 24)
+         + 0.2 * rng.normal(size=(7, 120))).astype(np.float32)
+    y = jnp.asarray(y)
+    nv = jnp.full((7,), 120, jnp.int32)
+    dense = pk.hw_seeds(y, 24, mult, None)
+    general = pk.hw_seeds(y, 24, mult, nv)
+    for d, g in zip(dense, general):
+        np.testing.assert_allclose(np.asarray(d), np.asarray(g),
+                                   rtol=1e-6, atol=1e-6)
