@@ -13,10 +13,21 @@ export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_ci_cache
 # -rs surfaces every skip with its reason: the 2-process jax.distributed
 # smoke test skips on a chronically slow host, and that must be VISIBLE in
 # CI output, not silently folded into the pass count (VERDICT r3 weak #4)
-python -m pytest tests/ -q -rs "$@" | tee /tmp/ci_pytest_out.txt
+# test_reliability.py is excluded here and run below under escalated
+# warnings — once per CI invocation, not twice
+python -m pytest tests/ -q -rs --ignore=tests/test_reliability.py "$@" \
+  | tee /tmp/ci_pytest_out.txt
 if grep -qE "skipped" /tmp/ci_pytest_out.txt; then
   echo "ci.sh: NOTE — skipped tests present (reasons above)." >&2
 fi
+
+# fault-injection sweep (ISSUE 1): the reliability module re-runs with
+# RuntimeWarnings escalated to errors, so an unhandled-NaN warning escaping
+# a fit path (invalid-value reductions, divide-by-zero in an objective)
+# fails CI instead of scrolling by.  Scoped to the reliability tests: the
+# wider suite intentionally feeds models NaN panels whose warnings are the
+# point under test.
+python -m pytest tests/test_reliability.py -q -rs -W error::RuntimeWarning "$@"
 
 # the driver's multi-chip artifact, same environment
 python - <<'EOF'

@@ -37,8 +37,9 @@ from ..utils import optim
 from ..utils.linalg import ols as _ols
 from ..utils.linalg import ridge_solve as _ridge_solve
 from .base import (FitResult, align_mode_on_host, align_right, debatch,
-                   debatch_fit, ensure_batched, jit_program, maybe_align,
-                   require_pallas_for_count_evals, resolve_backend)
+                   debatch_fit, derive_status, ensure_batched, jit_program,
+                   maybe_align, require_pallas_for_count_evals,
+                   resolve_backend)
 
 Order = Tuple[int, int, int]
 
@@ -257,6 +258,7 @@ def fit(
     tol: Optional[float] = None,
     backend: str = "auto",
     count_evals: bool = False,
+    compact: bool = True,
 ) -> FitResult:
     """Fit ARIMA(p,d,q) to one series ``[time]`` or a batch ``[batch, time]``.
 
@@ -275,6 +277,17 @@ def fit(
     (``utils.optim.minimize_lbfgs_batched``) — the benchmark publishes it so
     "how many objective passes does a fit spend" is a recorded number, not
     an estimate.
+
+    ``compact=False`` disables straggler compaction (``utils.optim``) for
+    run-to-run reproducibility: compaction engages automatically on the
+    pallas backend at batches >= ``utils.optim.COMPACT_MIN_BATCH`` (4096;
+    tests may monkeypatch the module-level ``_COMPACT_MIN_BATCH`` gate)
+    and — while parity-gated at the distribution level — is a different
+    compiled program, so individual rows on flat/non-convex stretches can
+    reach different (equally valid) optima than an uncompacted run.
+
+    ``FitResult.status`` reports per-row ``reliability.FitStatus`` codes
+    (OK / DIVERGED / EXCLUDED for a plain fit).
     """
     if method not in ("css-lbfgs", "css-cgd", "css-bobyqa", "hannan-rissanen"):
         raise ValueError(f"unknown method {method!r}")
@@ -295,6 +308,7 @@ def fit(
     run = _fit_program(
         order, include_intercept, method, backend, max_iters, float(tol),
         init_params is not None, align_mode_on_host(yb), count_evals,
+        compact,
     )
     if init_params is None:
         out = run(yb)
@@ -306,7 +320,8 @@ def fit(
 @jit_program
 def _fit_program(order: Order, include_intercept: bool, method: str,
                  backend: str, max_iters: int, tol: float, has_init: bool,
-                 align_mode: str = "general", count_evals: bool = False):
+                 align_mode: str = "general", count_evals: bool = False,
+                 compact: bool = True):
     p, d, q = order
     k = _n_params(order, include_intercept)
 
@@ -347,7 +362,8 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
             )(init, yd, nvd)
             z = jnp.zeros((yd.shape[0],), jnp.int32)
             params = jnp.where(ok[:, None], init, jnp.nan)
-            return FitResult(params, jnp.where(ok, nll, jnp.nan), ok, z)
+            return FitResult(params, jnp.where(ok, nll, jnp.nan), ok, z,
+                             derive_status(ok, ok, params))
         # optimize the MEAN log-likelihood (nll / effective obs): same
         # argmin, but gradients are O(1) so the relative grad-norm stopping
         # rule is reachable at f32 instead of stalling on the accumulation
@@ -364,7 +380,7 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
             # COLUMNS (series ride the lanes), grid-aligned by the cap
             cap = optim.compaction_cap(bsz)
             straggler_fun = None
-            if bsz >= _COMPACT_MIN_BATCH:
+            if compact and bsz >= _COMPACT_MIN_BATCH:
                 tp = y3.shape[0]
 
                 def straggler_fun(idxc, _y3=y3, _zb3=zb3):
@@ -405,7 +421,9 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
             )
         params = jnp.where(ok[:, None], res.x, jnp.nan)
         out = FitResult(
-            params, jnp.where(ok, res.f * n_eff, jnp.nan), res.converged & ok, res.iters
+            params, jnp.where(ok, res.f * n_eff, jnp.nan),
+            res.converged & ok, res.iters,
+            derive_status(ok, res.converged, params),
         )
         return (out, info) if count_evals else out
 

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 from ..ops.lagmat import lag_mat_trim_both
 from . import arima as _arima
 from ..utils.linalg import ols as _ols
-from .base import FitResult, align_right, debatch, ensure_batched, jit_program
+from .base import (FitResult, align_right, debatch, derive_status,
+                   ensure_batched, jit_program)
 
 
 def fit(y, max_lag: int = 1, no_intercept: bool = False) -> FitResult:
@@ -55,11 +56,13 @@ def _fit_program(max_lag, no_intercept):
         params, nll = jax.vmap(one)(ya, nv)
         ok = nv >= max_lag + (1 if no_intercept else 2) + 1
         b = yb.shape[0]
+        params = jnp.where(ok[:, None], params, jnp.nan)
         return FitResult(
-            jnp.where(ok[:, None], params, jnp.nan),
+            params,
             jnp.where(ok, nll, jnp.nan),
             ok,
             jnp.zeros((b,), jnp.int32),
+            derive_status(ok, ok, params),
         )
 
     return run

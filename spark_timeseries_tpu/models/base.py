@@ -66,12 +66,40 @@ def resolve_backend(backend: str, dtype, n_time: int,
 
 
 class FitResult(NamedTuple):
-    """Batched fit output: parameters + convergence diagnostics."""
+    """Batched fit output: parameters + convergence diagnostics.
+
+    ``status`` carries per-row ``reliability.FitStatus`` codes (int8): a
+    plain fit reports ``OK`` (converged, finite params), ``DIVERGED``
+    (optimizer failed or produced non-finite output), or ``EXCLUDED``
+    (the model rejected the row structurally — too short / all NaN).  The
+    resilient runner (``reliability.resilient_fit``) refines these with
+    the ``SANITIZED`` / ``RETRIED`` / ``FALLBACK`` transitions.
+    """
 
     params: jax.Array  # [batch?, k]
     neg_log_likelihood: jax.Array  # [batch?] final objective (model-defined)
     converged: jax.Array  # [batch?] bool
     iters: jax.Array  # [batch?] optimizer iterations used
+    status: jax.Array = None  # [batch?] int8 FitStatus codes
+
+
+def derive_status(ok, converged, params) -> jax.Array:
+    """Per-row FitStatus for a plain (non-resilient) fit program.
+
+    ``ok`` is the model's structural gate (enough valid observations to
+    identify the parameters): gated-out rows are ``EXCLUDED``; rows that
+    converged to finite params are ``OK``; everything else ``DIVERGED``.
+    Computed inside the jitted fit program — int8 codes cost nothing next
+    to the params they ride with.
+    """
+    from ..reliability.status import FitStatus
+
+    good = ok & converged & jnp.all(jnp.isfinite(params), axis=-1)
+    return jnp.where(
+        ~ok,
+        jnp.int8(FitStatus.EXCLUDED),
+        jnp.where(good, jnp.int8(FitStatus.OK), jnp.int8(FitStatus.DIVERGED)),
+    )
 
 
 def ensure_batched(y) -> tuple[jax.Array, bool]:
@@ -133,11 +161,21 @@ def align_mode_on_host(yb) -> str:
     hit = _align_mode_cache.get(key)
     if hit is not None and hit[0]() is yb:
         return hit[1]
-    nan_any, nan_last = _nan_probe(yb)
-    if not bool(nan_any):
-        mode = "dense"
+    try:
+        nan_any, nan_last = _nan_probe(yb)
+    except RuntimeError:
+        # some backends cannot run even this tiny probe on the panel (e.g.
+        # jax 0.4 CPU refuses multiprocess computations on process-spanning
+        # sharded arrays): degrade to the always-correct general path
+        # rather than failing the fit.  The degraded mode still enters the
+        # cache below — repeat fits on the same panel must not re-pay a
+        # probe that is known to fail on this array
+        mode = "general"
     else:
-        mode = "no-trailing" if not bool(nan_last) else "general"
+        if not bool(nan_any):
+            mode = "dense"
+        else:
+            mode = "no-trailing" if not bool(nan_last) else "general"
     try:
         ref = weakref.ref(yb)
     except TypeError:  # not weak-referenceable (e.g. plain numpy scalarlike)

@@ -20,7 +20,7 @@ from jax import lax
 
 from ..utils import optim
 from .base import (FitResult, align_mode_on_host, align_right, debatch,
-                   ensure_batched, maybe_align,
+                   derive_status, ensure_batched, maybe_align,
                    jit_program, resolve_backend)
 
 
@@ -121,11 +121,13 @@ def _fit_program(max_iters, tol, backend, align_mode="general"):
             )
         alpha = optim.sigmoid_to_interval(res.x, 0.0, 1.0)
         ok = nv >= 3
+        params = jnp.where(ok[:, None], alpha, jnp.nan)
         return FitResult(
-            jnp.where(ok[:, None], alpha, jnp.nan),
+            params,
             jnp.where(ok, res.f * n_eff, jnp.nan),
             res.converged & ok,
             res.iters,
+            derive_status(ok, res.converged, params),
         )
 
     return run

@@ -24,7 +24,8 @@ from jax import lax
 
 from ..utils import optim
 from .base import (FitResult, align_mode_on_host, align_right, debatch,
-                   debatch_fit, require_pallas_for_count_evals,
+                   debatch_fit, derive_status,
+                   require_pallas_for_count_evals,
                    ensure_batched, maybe_align,
                    jit_program, resolve_backend)
 
@@ -132,24 +133,31 @@ _COMPACT_MIN_BATCH = optim.COMPACT_MIN_BATCH
 
 
 def fit(r, *, max_iters: int = 80, tol: Optional[float] = None,
-        backend: str = "auto", count_evals: bool = False) -> FitResult:
+        backend: str = "auto", count_evals: bool = False,
+        compact: bool = True) -> FitResult:
     """Fit GARCH(1,1) per series -> natural params ``[batch?, 3]``.
 
     ``count_evals=True`` (pallas backend only) returns ``(FitResult, info)``
-    with the optimizer's pass-accounting dict (``utils.optim``)."""
+    with the optimizer's pass-accounting dict (``utils.optim``).
+
+    ``compact=False`` disables straggler compaction for run-to-run
+    reproducibility (it engages on the pallas backend at batches >=
+    ``utils.optim.COMPACT_MIN_BATCH`` = 4096 and is a different compiled
+    program — bitwise outputs can differ from the uncompacted run).
+    ``FitResult.status`` carries per-row ``reliability.FitStatus`` codes."""
     rb, single = ensure_batched(r)
     if tol is None:
         tol = 1e-7 if rb.dtype == jnp.float64 else 1e-4
     backend = resolve_backend(backend, rb.dtype, rb.shape[1])
     require_pallas_for_count_evals(count_evals, backend)
     out = _fit_program(max_iters, float(tol), backend, align_mode_on_host(rb),
-                       count_evals)(rb)
+                       count_evals, compact)(rb)
     return debatch_fit(out, single, count_evals)
 
 
 @jit_program
 def _fit_program(max_iters, tol, backend, align_mode="general",
-                 count_evals=False):
+                 count_evals=False, compact=True):
     def run(rb):
         ra, nv = maybe_align(rb, align_mode)
 
@@ -178,7 +186,7 @@ def _fit_program(max_iters, tol, backend, align_mode="general",
             bsz = ra.shape[0]
             cap = optim.compaction_cap(bsz)
             straggler_fun = None
-            if bsz >= _COMPACT_MIN_BATCH:
+            if compact and bsz >= _COMPACT_MIN_BATCH:
 
                 def straggler_fun(idxc):
                     ras, nvs, nes = ra[idxc], nv[idxc], n_eff[idxc]
@@ -205,11 +213,13 @@ def _fit_program(max_iters, tol, backend, align_mode="general",
                 objective, u0, (ra, nv, n_eff), max_iters=max_iters, tol=tol
             )
         ok = nv >= 10  # GARCH needs a handful of observations to identify
+        params = jnp.where(ok[:, None], jax.vmap(_to_natural)(res.x), jnp.nan)
         out = FitResult(
-            jnp.where(ok[:, None], jax.vmap(_to_natural)(res.x), jnp.nan),
+            params,
             jnp.where(ok, res.f * n_eff, jnp.nan),
             res.converged & ok,
             res.iters,
+            derive_status(ok, res.converged, params),
         )
         return (out, info) if count_evals else out
 
@@ -304,18 +314,23 @@ def argarch_neg_log_likelihood(params, y, n_valid=None):
 
 
 def fit_argarch(y, *, max_iters: int = 100, tol: Optional[float] = None,
-                backend: str = "auto") -> FitResult:
+                backend: str = "auto", compact: bool = True) -> FitResult:
     """Fit AR(1)+GARCH(1,1) -> natural params ``[batch?, 5]``
-    (reference ``ARGARCH.fitModel``)."""
+    (reference ``ARGARCH.fitModel``).
+
+    ``compact=False`` disables straggler compaction (see :func:`fit`);
+    ``FitResult.status`` carries per-row ``reliability.FitStatus`` codes."""
     yb, single = ensure_batched(y)
     if tol is None:
         tol = 1e-7 if yb.dtype == jnp.float64 else 1e-4
     backend = resolve_backend(backend, yb.dtype, yb.shape[1])
-    return debatch(_fit_argarch_program(max_iters, float(tol), backend)(yb), single)
+    return debatch(
+        _fit_argarch_program(max_iters, float(tol), backend, compact)(yb),
+        single)
 
 
 @jit_program
-def _fit_argarch_program(max_iters, tol, backend):
+def _fit_argarch_program(max_iters, tol, backend, compact=True):
     def run(yb):
         ya, nv = jax.vmap(align_right)(yb)
 
@@ -366,7 +381,7 @@ def _fit_argarch_program(max_iters, tol, backend):
             bsz = ya.shape[0]
             cap = optim.compaction_cap(bsz)
             straggler_fun = None
-            if bsz >= _COMPACT_MIN_BATCH:
+            if compact and bsz >= _COMPACT_MIN_BATCH:
 
                 def straggler_fun(idxc):
                     yas, prevs = ya[idxc], prev[idxc]
@@ -393,11 +408,14 @@ def _fit_argarch_program(max_iters, tol, backend):
                 obj_scaled, u0, (ya, nv, n_eff), max_iters=max_iters, tol=tol
             )
         ok = nv >= 12
+        params = jnp.where(
+            ok[:, None], jax.vmap(_argarch_to_natural)(res.x), jnp.nan)
         return FitResult(
-            jnp.where(ok[:, None], jax.vmap(_argarch_to_natural)(res.x), jnp.nan),
+            params,
             jnp.where(ok, res.f * n_eff, jnp.nan),
             res.converged & ok,
             res.iters,
+            derive_status(ok, res.converged, params),
         )
 
     return run

@@ -22,7 +22,8 @@ from jax import lax
 
 from ..utils import optim
 from .base import (FitResult, align_mode_on_host, align_right, debatch,
-                   debatch_fit, require_pallas_for_count_evals,
+                   debatch_fit, derive_status,
+                   require_pallas_for_count_evals,
                    ensure_batched, maybe_align,
                    jit_program, resolve_backend)
 
@@ -110,6 +111,7 @@ def fit(
     tol: Optional[float] = None,
     backend: str = "auto",
     count_evals: bool = False,
+    compact: bool = True,
 ) -> FitResult:
     """Fit (alpha, beta, gamma) per series -> params ``[batch?, 3]``.
 
@@ -118,7 +120,13 @@ def fit(
     or ``"auto"`` (pallas whenever the platform/dtype/period allow).
 
     ``count_evals=True`` (pallas backend only) returns ``(FitResult, info)``
-    with the optimizer's pass-accounting dict (``utils.optim``)."""
+    with the optimizer's pass-accounting dict (``utils.optim``).
+
+    ``compact=False`` disables straggler compaction for run-to-run
+    reproducibility (it engages on the pallas backend at batches >=
+    ``utils.optim.COMPACT_MIN_BATCH`` = 4096 and is a different compiled
+    program — bitwise outputs can differ from the uncompacted run).
+    ``FitResult.status`` carries per-row ``reliability.FitStatus`` codes."""
     if model_type not in ("additive", "multiplicative"):
         raise ValueError(f"model_type must be additive|multiplicative, got {model_type!r}")
     multiplicative = model_type == "multiplicative"
@@ -135,13 +143,13 @@ def fit(
                               structural_ok=pk.hw_structural_ok(period))
     require_pallas_for_count_evals(count_evals, backend)
     out = _fit_program(period, multiplicative, max_iters, float(tol), backend,
-                       align_mode_on_host(yb), count_evals)(yb)
+                       align_mode_on_host(yb), count_evals, compact)(yb)
     return debatch_fit(out, single, count_evals)
 
 
 @jit_program
 def _fit_program(period, multiplicative, max_iters, tol, backend,
-                 align_mode="general", count_evals=False):
+                 align_mode="general", count_evals=False, compact=True):
     def run(yb):
         ya, nv = maybe_align(yb, align_mode)
 
@@ -178,7 +186,7 @@ def _fit_program(period, multiplicative, max_iters, tol, backend,
             bsz = ya.shape[0]
             cap = optim.compaction_cap(bsz)
             straggler_fun = None
-            if bsz >= _COMPACT_MIN_BATCH:
+            if compact and bsz >= _COMPACT_MIN_BATCH:
 
                 def straggler_fun(idxc):
                     yas = ya[idxc]
@@ -209,11 +217,14 @@ def _fit_program(period, multiplicative, max_iters, tol, backend,
                 objective, u0, (ya, nv, n_err), max_iters=max_iters, tol=tol
             )
         ok = nv >= 2 * period  # seed needs two full seasons of real data
+        params = jnp.where(
+            ok[:, None], optim.sigmoid_to_interval(res.x, 0.0, 1.0), jnp.nan)
         out = FitResult(
-            jnp.where(ok[:, None], optim.sigmoid_to_interval(res.x, 0.0, 1.0), jnp.nan),
+            params,
             jnp.where(ok, res.f * n_err, jnp.nan),  # report the SSE as before
             res.converged & ok,
             res.iters,
+            derive_status(ok, res.converged, params),
         )
         return (out, info) if count_evals else out
 

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils.linalg import ols as _ols
-from .base import FitResult, debatch, jit_program
+from .base import FitResult, debatch, derive_status, jit_program
 
 
 def _design(X):
@@ -72,7 +72,10 @@ def _co_program(max_iter):
 
         params, nll = jax.vmap(one)(yb, Xb)
         b = yb.shape[0]
-        return FitResult(params, nll, jnp.ones((b,), bool), jnp.full((b,), max_iter, jnp.int32))
+        ones = jnp.ones((b,), bool)
+        return FitResult(params, nll, ones,
+                         jnp.full((b,), max_iter, jnp.int32),
+                         derive_status(ones, ones, params))
 
     return run
 

@@ -15,10 +15,8 @@ So the fold is a *residency* decision, the TPU analogue of picking NCHW vs
 NHWC once at ingest: :func:`fold_panel` converts a panel ONCE, the
 :class:`FoldedPanel` stays on device in kernel layout, and every subsequent
 transform/fit reads it at streaming rate.  The reference has no equivalent
-decision to make — JVM rows are object arrays — but the role matches the
-layout choice its Breeze matrices make once per ``TimeSeriesRDD`` partition
-(upstream ``TimeSeriesRDD.scala`` collects series into column-major
-``DenseMatrix`` blocks) [UNVERIFIED: empty reference mount].
+decision to make — JVM rows are object arrays — so this layer is purely a
+TPU-rebuild concern.
 
 ``FoldedPanel`` is a registered pytree: it passes through ``jit`` /
 ``vmap``-free program boundaries with ``b``/``t`` as static aux data, so

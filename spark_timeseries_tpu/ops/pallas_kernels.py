@@ -73,7 +73,12 @@ _SBLK = _SUBL * _LANES  # series per grid step (1024)
 _CHUNK_T = 1024  # time steps resident in VMEM per grid step
 # Scoped-VMEM override shared by every kernel here: a handful of
 # [_CHUNK_T, 8, 128] blocks plus double buffering exceeds the default budget.
-_VMEM_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+# (``CompilerParams`` was named ``TPUCompilerParams`` before jax 0.6 — take
+# whichever this build provides so CPU-only environments can still import
+# the module and reach the interpret/scan paths.)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+_VMEM_PARAMS = _CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
 
 _ZERO = lambda: jnp.zeros((_SUBL, _LANES), jnp.float32)  # noqa: E731
 

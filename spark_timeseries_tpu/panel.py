@@ -343,6 +343,42 @@ class TimeSeriesPanel:
         out = _cached_batched(uv.pacf, num_lags)(self.values)
         return out[: self.n_series]
 
+    def fit(self, model, *, chunk_rows: Optional[int] = None,
+            resilient: bool = True, policy: str = "impute", **fit_kwargs):
+        """Fit a model family over every series via the resilient chunk driver.
+
+        ``model`` is a model-module name (``"arima"``, ``"garch"``,
+        ``"ewma"``, ``"holtwinters"``, ``"autoregression"``) or any
+        callable ``fit(values, **kwargs) -> FitResult``.  Execution goes
+        through ``reliability.fit_chunked``: the panel is fitted in row
+        chunks of at most ``chunk_rows`` (default: one chunk) with bounded
+        RESOURCE_EXHAUSTED backoff, and — unless ``resilient=False`` —
+        each chunk runs the sanitize -> fit -> retry -> fallback ladder
+        (``reliability.resilient_fit``) so one poisoned series cannot take
+        down the batch.
+
+        Returns a ``reliability.ResilientFitResult`` whose rows align with
+        ``self.keys``; ``.status`` carries per-series ``FitStatus`` codes
+        and ``.meta`` the chunk/ladder accounting.  This is the north-star
+        serving entry point: the batch analog of the reference mapping
+        ``fitModel`` over an RDD under Spark task retry.
+        """
+        if callable(model):
+            fit_fn = model
+        else:
+            from . import models as _models
+
+            mod = getattr(_models, model, None)
+            if mod is None or not hasattr(mod, "fit"):
+                raise ValueError(f"unknown model {model!r}")
+            fit_fn = mod.fit
+        from .reliability import fit_chunked
+
+        return fit_chunked(
+            fit_fn, self.series_values(), chunk_rows=chunk_rows,
+            resilient=resilient, policy=policy, **fit_kwargs,
+        )
+
     def lags(self, max_lag: int, include_original: bool = True,
              lagged_key: Callable[[object, int], object] = None) -> "TimeSeriesPanel":
         """Panel of lagged copies of every series — the upstream

@@ -1,0 +1,45 @@
+"""Resilient fit execution (L4.5): the batch analog of Spark task retry.
+
+The reference inherited robustness from its substrate — a NaN-poisoned or
+OOM-killed executor task was re-run elsewhere by Spark.  The TPU rebuild's
+substrate is one monolithic vmapped program, so this package rebuilds the
+same guarantees at row granularity:
+
+- :mod:`.status` — the per-row :class:`FitStatus` vocabulary every public
+  ``fit`` now reports.
+- :mod:`.sanitize` — input repair/rejection (NaN/Inf/constant/all-NaN)
+  with an impute / exclude / raise policy.
+- :mod:`.runner` — :func:`resilient_fit`: sanitize, fit, then a retry ->
+  fallback ladder over the failed subset (perturbed inits, portable
+  backend) before any row is marked ``DIVERGED``.
+- :mod:`.chunked` — :func:`fit_chunked`: chunked execution with bounded
+  ``RESOURCE_EXHAUSTED`` backoff and degradation recorded in metadata.
+- :mod:`.faultinject` — deterministic data and behavioral faults so every
+  ladder rung runs in tier-1 CPU tests.
+"""
+
+from . import chunked, faultinject, runner, sanitize, status
+from .chunked import OOMBackoffExceeded, fit_chunked, is_resource_exhausted
+from .runner import (ResilientFitResult, RetryRung, default_ladder,
+                     resilient_fit)
+from .sanitize import SanitizeReport, sanitize
+from .status import FitStatus, merge_status, status_counts
+
+__all__ = [
+    "FitStatus",
+    "OOMBackoffExceeded",
+    "ResilientFitResult",
+    "RetryRung",
+    "SanitizeReport",
+    "chunked",
+    "default_ladder",
+    "faultinject",
+    "fit_chunked",
+    "is_resource_exhausted",
+    "merge_status",
+    "resilient_fit",
+    "runner",
+    "sanitize",
+    "status",
+    "status_counts",
+]

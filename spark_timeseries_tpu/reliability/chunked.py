@@ -1,0 +1,158 @@
+"""Chunked fit execution with HBM OOM backoff.
+
+The north-star workload (ROADMAP: 1M series x 1k obs) cannot always fit one
+monolithic batch in HBM — and the right chunk size depends on the model,
+the dtype, and what else is resident on the chip.  Rather than making the
+caller guess, :func:`fit_chunked` walks the panel in row chunks and treats
+``RESOURCE_EXHAUSTED`` as a recoverable signal: the chunk size is halved
+(bounded retries) and the degradation is recorded in the result metadata,
+the batch analog of Spark re-running a too-big task after an executor OOM.
+
+Only allocation failures trigger backoff; every other error propagates
+unchanged (halving a chunk cannot fix a shape bug, and silently retrying
+would bury it).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .runner import ResilientFitResult, resilient_fit
+from .status import STATUS_DTYPE, FitStatus, status_counts
+
+__all__ = ["OOMBackoffExceeded", "is_resource_exhausted", "fit_chunked"]
+
+# substrings the XLA runtime uses for allocation failure; the simulated OOM
+# of reliability.faultinject raises with the same marker so tier-1 CPU tests
+# drive this path without a real HBM exhaustion
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+class OOMBackoffExceeded(RuntimeError):
+    """Raised when the minimum chunk size still exhausts device memory."""
+
+
+def is_resource_exhausted(e: BaseException) -> bool:
+    """True for XLA RESOURCE_EXHAUSTED-style allocation failures.
+
+    ``jaxlib``'s ``XlaRuntimeError`` subclasses ``RuntimeError``, so the
+    check is message-based on RuntimeError/MemoryError rather than pinned
+    to a jaxlib exception type that moves between releases.
+    """
+    if isinstance(e, MemoryError):
+        return True
+    if not isinstance(e, RuntimeError):
+        return False
+    msg = str(e)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def fit_chunked(
+    fit_fn: Callable,
+    y,
+    *,
+    chunk_rows: Optional[int] = None,
+    min_chunk_rows: int = 256,
+    max_backoffs: int = 8,
+    resilient: bool = True,
+    policy: str = "impute",
+    ladder=None,
+    **fit_kwargs,
+) -> ResilientFitResult:
+    """Fit ``y [B, T]`` in row chunks of at most ``chunk_rows``.
+
+    Each chunk runs through :func:`~.runner.resilient_fit` (sanitize +
+    retry ladder) unless ``resilient=False``, in which case ``fit_fn`` is
+    called directly and per-row status comes from the model's own status
+    output.  On a ``RESOURCE_EXHAUSTED`` failure the chunk size halves
+    (never below ``min_chunk_rows``) and the chunk is retried, at most
+    ``max_backoffs`` times across the whole run; exhausting the budget (or
+    OOMing at the floor) raises :class:`OOMBackoffExceeded`.
+
+    ``meta`` records ``chunk_rows_initial`` / ``chunk_rows_final``, every
+    backoff event, and ``degraded=True`` whenever a backoff happened — so
+    a production driver can see that a run survived by shrinking, not
+    just that it finished.
+    """
+    yb = jnp.asarray(y)
+    if yb.ndim != 2:
+        raise ValueError(f"fit_chunked expects [batch, time], got {yb.shape}")
+    b = yb.shape[0]
+    chunk = int(chunk_rows) if chunk_rows else b
+    chunk = max(1, min(chunk, b))
+    chunk0 = chunk
+
+    pieces = []
+    oom_events = []
+    lo = 0
+    while lo < b:
+        hi = min(lo + chunk, b)
+        # whole-panel chunk: hand the caller's array through untouched (a
+        # slice would be a fresh device buffer — an extra HBM copy, and a
+        # miss in the per-array-identity align-mode cache callers pre-warm)
+        vals = yb if (lo == 0 and hi == b) else yb[lo:hi]
+        try:
+            if resilient:
+                piece = resilient_fit(
+                    fit_fn, vals, policy=policy, ladder=ladder,
+                    **fit_kwargs,
+                )
+            else:
+                piece = fit_fn(vals, **fit_kwargs)
+        except Exception as e:  # noqa: BLE001 - filtered just below
+            if not is_resource_exhausted(e):
+                raise
+            oom_events.append({
+                "at_row": lo, "chunk_rows": chunk,
+                "error": f"{type(e).__name__}: {e}"[:200],
+            })
+            if chunk <= min_chunk_rows or len(oom_events) > max_backoffs:
+                raise OOMBackoffExceeded(
+                    f"chunk of {chunk} rows still RESOURCE_EXHAUSTED after "
+                    f"{len(oom_events)} backoffs (floor {min_chunk_rows})"
+                ) from e
+            chunk = max(min_chunk_rows, chunk // 2)
+            continue
+        pieces.append(piece)
+        lo = hi
+
+    params = np.concatenate([np.asarray(p.params) for p in pieces])
+    nll = np.concatenate([np.asarray(p.neg_log_likelihood) for p in pieces])
+    conv = np.concatenate([np.asarray(p.converged) for p in pieces])
+    iters = np.concatenate([np.asarray(p.iters) for p in pieces])
+    status = np.concatenate([_piece_status(p) for p in pieces])
+
+    meta = {
+        "chunk_rows_initial": chunk0,
+        "chunk_rows_final": chunk,
+        "chunks_run": len(pieces),
+        "oom_backoffs": len(oom_events),
+        "oom_events": oom_events,
+        "degraded": bool(oom_events),
+        "status_counts": status_counts(status),
+    }
+    # ladder/sanitize accounting aggregated across chunks (resilient mode)
+    rung_totals: dict = {}
+    for p in pieces:
+        for r in (getattr(p, "meta", None) or {}).get("ladder", ()):
+            agg = rung_totals.setdefault(
+                r["rung"], {"attempted": 0, "rescued": 0})
+            agg["attempted"] += r["attempted"]
+            agg["rescued"] += r["rescued"]
+    if rung_totals:
+        meta["ladder_totals"] = rung_totals
+    return ResilientFitResult(params, nll, conv, iters, status, meta)
+
+
+def _piece_status(p) -> np.ndarray:
+    """Status of one chunk result; synthesized when the fit has none."""
+    status = getattr(p, "status", None)
+    conv = np.asarray(p.converged)
+    if status is None:
+        finite = np.isfinite(np.asarray(p.params)).all(axis=-1)
+        return np.where(conv & finite, FitStatus.OK,
+                        FitStatus.DIVERGED).astype(STATUS_DTYPE)
+    return np.asarray(status).astype(STATUS_DTYPE)

@@ -1,0 +1,211 @@
+"""Deterministic fault injection for the reliability layer.
+
+Every rung of the resilience ladder must be exercisable in tier-1 CPU
+tests — a recovery path that only runs when a real TPU OOMs is a recovery
+path that has never run.  This module provides two kinds of fault:
+
+**Data faults** (pure ``numpy -> numpy`` panel corruptions): NaN holes
+inside the valid span, inf spikes, constant rows, all-NaN rows, and
+explosive near-collinear rows whose f32 normal equations go indefinite
+(the non-SPD Hannan-Rissanen case of ADVICE round 5).  All are driven by
+an explicit seed.
+
+**Behavioral faults** (fit-function wrappers): :func:`failing_fit` forces
+designated rows to report non-convergence for a fixed number of fit calls
+— rows are recognized by a value fingerprint, so the same row keeps
+failing as the ladder gathers it into retry sub-batches — and
+:func:`oom_fit` raises a ``RESOURCE_EXHAUSTED``-marked error whenever the
+batch exceeds a row threshold, driving the chunk driver's backoff without
+a real allocation failure.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import numpy as np
+
+from .status import STATUS_DTYPE, FitStatus
+
+__all__ = [
+    "SimulatedResourceExhausted",
+    "inject_nan_rows",
+    "inject_inf_rows",
+    "make_constant_rows",
+    "make_all_nan_rows",
+    "make_explosive_rows",
+    "nonspd_gram",
+    "failing_fit",
+    "oom_fit",
+]
+
+
+class SimulatedResourceExhausted(RuntimeError):
+    """Stands in for jaxlib's XlaRuntimeError on allocation failure.
+
+    Carries the same ``RESOURCE_EXHAUSTED`` marker the real error message
+    does, so ``reliability.chunked.is_resource_exhausted`` treats both
+    identically.
+    """
+
+    def __init__(self, nbytes: int):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            f"{nbytes} bytes. (simulated by reliability.faultinject)"
+        )
+
+
+def _as_host(y) -> np.ndarray:
+    return np.array(y, dtype=np.asarray(y).dtype, copy=True)
+
+
+def inject_nan_rows(y, rows, frac: float = 0.2, seed: int = 0) -> np.ndarray:
+    """Punch NaN holes INSIDE the valid span of the given rows.
+
+    Edge positions are kept so the holes are interior — the fault the
+    sanitizer must repair, not legitimate raggedness.
+    """
+    out = _as_host(y)
+    rng = np.random.default_rng(seed)
+    t = out.shape[1]
+    n_holes = max(1, int(frac * (t - 2)))
+    for r in np.atleast_1d(rows):
+        holes = rng.choice(np.arange(1, t - 1), size=n_holes, replace=False)
+        out[r, holes] = np.nan
+    return out
+
+
+def inject_inf_rows(y, rows, n: int = 3, seed: int = 0) -> np.ndarray:
+    """Replace ``n`` interior positions of each given row with +/-inf."""
+    out = _as_host(y)
+    rng = np.random.default_rng(seed)
+    t = out.shape[1]
+    for r in np.atleast_1d(rows):
+        pos = rng.choice(np.arange(1, t - 1), size=n, replace=False)
+        out[r, pos] = np.where(rng.random(n) < 0.5, np.inf, -np.inf)
+    return out
+
+
+def make_constant_rows(y, rows, value: float = 1.0) -> np.ndarray:
+    """Overwrite the given rows with a constant (zero innovation variance)."""
+    out = _as_host(y)
+    out[np.atleast_1d(rows)] = value
+    return out
+
+
+def make_all_nan_rows(y, rows) -> np.ndarray:
+    """Overwrite the given rows with NaN everywhere (nothing to fit)."""
+    out = _as_host(y)
+    out[np.atleast_1d(rows)] = np.nan
+    return out
+
+
+def make_explosive_rows(y, rows, growth: float = 1.35, seed: int = 0) -> np.ndarray:
+    """Overwrite rows with an explosive near-collinear AR process.
+
+    ``y_t ~= growth * y_{t-1}`` spans ~130 orders of magnitude over a 1k
+    panel: at f32 the Hannan-Rissanen lag Gram matrix accumulates to an
+    (effectively) indefinite / overflowed system — the non-SPD
+    normal-equations fault of ADVICE round 5 — and CSS optimization on the
+    row is hopeless within any budget, exercising the DIVERGED terminal.
+    """
+    out = _as_host(y)
+    rng = np.random.default_rng(seed)
+    t = out.shape[1]
+    for r in np.atleast_1d(rows):
+        noise = 1.0 + 0.01 * rng.standard_normal(t)
+        out[r] = (growth ** np.arange(t)) * noise
+    return out
+
+
+def nonspd_gram(k: int = 4, dtype=np.float32) -> np.ndarray:
+    """A deterministic symmetric matrix with one (slightly) negative
+    eigenvalue — what f32 accumulation can make of a rank-deficient
+    ``X^T X``.  For unit tests of ``utils.linalg.ridge_solve``'s
+    non-positive-pivot fallback."""
+    rng = np.random.default_rng(7)
+    q, _ = np.linalg.qr(rng.standard_normal((k, k)))
+    eig = np.ones(k)
+    eig[-1] = -1e-3
+    return (q @ np.diag(eig) @ q.T).astype(dtype)
+
+
+def _fingerprints(y, rows) -> np.ndarray:
+    """Identify rows by their last value (float64-exact).
+
+    The resilient runner re-fits failed rows on the SAME (sanitized) data,
+    so a row's tail value is stable across ladder rungs and sub-batch
+    gathers; designated rows should be NaN-free so the sanitizer passes
+    them through bit-identically.
+    """
+    tails = np.asarray(y)[np.atleast_1d(rows), -1].astype(np.float64)
+    if np.unique(tails).size != tails.size or np.isnan(tails).any():
+        raise ValueError(
+            "failing_fit fingerprints must be unique, finite tail values; "
+            "pick clean rows (or perturb their last sample)"
+        )
+    return tails
+
+
+def failing_fit(fit_fn: Callable, y, rows, n_failures: int = 1) -> Callable:
+    """Wrap ``fit_fn`` so the given rows of ``y`` report non-convergence.
+
+    Each designated row fails (``converged=False``, NaN params/nll,
+    ``DIVERGED`` model status) for its first ``n_failures`` fit calls that
+    include it, then behaves normally — so ``n_failures=1`` drives the
+    ``RETRIED`` transition, ``n_failures=2`` drives ``FALLBACK`` (with the
+    default two-rung ladder), and a large value drives ``DIVERGED``.
+    Budgets decrement once per CALL per row (pad rows duplicating a failed
+    row do not burn extra budget).
+    """
+    budgets = {fp: n_failures for fp in _fingerprints(y, rows)}
+
+    # functools.wraps: signature introspection (the runner's per-rung
+    # kwarg filtering) must see the REAL fit's signature, not (yb, **kw)
+    @functools.wraps(fit_fn)
+    def wrapped(yb, **kwargs):
+        res = fit_fn(yb, **kwargs)
+        tails = np.asarray(yb)[:, -1].astype(np.float64)
+        mask = np.zeros(tails.shape[0], bool)
+        for fp in list(budgets):
+            if budgets[fp] <= 0:
+                continue
+            hit = tails == fp
+            if hit.any():
+                mask |= hit
+                budgets[fp] -= 1
+        if not mask.any():
+            return res
+        import jax.numpy as jnp
+
+        m = jnp.asarray(mask)
+        params = jnp.where(m[:, None], jnp.nan, res.params)
+        nll = jnp.where(m, jnp.nan, res.neg_log_likelihood)
+        conv = res.converged & ~m
+        status = res.status
+        if status is not None:
+            status = jnp.where(
+                m, np.int8(FitStatus.DIVERGED), status
+            ).astype(STATUS_DTYPE)
+        return res._replace(
+            params=params, neg_log_likelihood=nll, converged=conv,
+            status=status,
+        )
+
+    return wrapped
+
+
+def oom_fit(fit_fn: Callable, max_rows: int) -> Callable:
+    """Wrap ``fit_fn`` to raise a simulated RESOURCE_EXHAUSTED whenever the
+    batch has more than ``max_rows`` rows — the chunk driver must back off
+    to at most ``max_rows`` before the fit is allowed to run."""
+
+    @functools.wraps(fit_fn)
+    def wrapped(yb, **kwargs):
+        shape = np.asarray(yb.shape)
+        if int(shape[0]) > max_rows:
+            raise SimulatedResourceExhausted(int(shape.prod()) * 4)
+        return fit_fn(yb, **kwargs)
+
+    return wrapped

@@ -1,0 +1,256 @@
+"""Resilient fit execution: sanitize -> fit -> retry ladder -> fallback.
+
+The batch analog of Spark task retry (PAPER.md: per-series numerics ran
+inside executor tasks, and a failed task was simply re-run elsewhere).
+Here a "task" is a ROW of a monolithic vmapped fit, so recovery is a
+gather/re-fit/scatter ladder:
+
+1. **Sanitize** the input panel (``reliability.sanitize``): repair or
+   exclude rows no fit can survive (inf, interior NaN, constant, all-NaN).
+2. **Primary fit** via the model's public ``fit`` — one compiled program
+   over the whole batch, exactly as before.
+3. **Retry rung**: rows that came back non-converged or non-finite are
+   gathered into a small padded batch (the host-side analog of the
+   straggler compaction in ``utils.optim`` — ``optim.retry_cap`` bounds
+   the distinct compiled shapes) and re-fit with a larger iteration budget
+   and, where the model supports ``init_params``, a deterministically
+   perturbed init.
+4. **Fallback rung**: rows still failing are re-fit on the conservative
+   path — portable ``scan`` backend (no Pallas), no straggler compaction,
+   largest budget.  ``utils.linalg.ridge_solve`` independently falls back
+   from the unpivoted Cholesky to ``jnp.linalg.solve`` for non-SPD rows.
+5. Rows that survive nothing are marked ``DIVERGED`` (NaN params, flagged)
+   instead of silently propagating NaNs into downstream aggregates.
+
+Per-row outcomes are reported as :class:`~.status.FitStatus` codes;
+``meta`` records what every rung attempted and recovered.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Callable, NamedTuple, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import optim
+from .sanitize import sanitize as _sanitize
+from .status import STATUS_DTYPE, FitStatus, status_counts
+
+__all__ = ["RetryRung", "ResilientFitResult", "default_ladder", "resilient_fit"]
+
+
+class RetryRung(NamedTuple):
+    """One rung of the retry ladder."""
+
+    name: str  # label recorded in meta
+    status: int  # FitStatus granted to rows this rung rescues
+    kwargs: dict  # fit-kwarg overrides (filtered to the fit's signature)
+    perturb: float = 0.0  # init perturbation scale (models with init_params)
+
+
+class ResilientFitResult(NamedTuple):
+    """Batched fit output with per-row status and run metadata.
+
+    Field layout extends ``models.base.FitResult``; arrays are host-side
+    (the ladder assembles rows across several device programs).
+    """
+
+    params: np.ndarray  # [batch, k]
+    neg_log_likelihood: np.ndarray  # [batch]
+    converged: np.ndarray  # [batch] bool
+    iters: np.ndarray  # [batch]
+    status: np.ndarray  # [batch] int8 FitStatus codes
+    meta: dict
+
+
+def default_ladder(fit_fn: Callable, base_iters: Optional[int] = None) -> tuple:
+    """The standard two-rung ladder, filtered to what ``fit_fn`` accepts.
+
+    Rung 1 (``RETRIED``) re-fits with a LARGER iteration budget (at least
+    double the primary fit's ``base_iters`` when known) and a small
+    perturbed init; rung 2 (``FALLBACK``) escalates to the portable scan
+    backend with compaction disabled and a larger budget still.  Models
+    without a ``backend``/``max_iters`` knob simply get whichever
+    overrides their signature supports.
+    """
+    base = int(base_iters) if base_iters else 60
+    return (
+        RetryRung("retry", int(FitStatus.RETRIED),
+                  {"max_iters": max(120, 2 * base)}, perturb=0.05),
+        RetryRung("fallback", int(FitStatus.FALLBACK),
+                  {"max_iters": max(240, 4 * base), "backend": "scan",
+                   "compact": False},
+                  perturb=0.2),
+    )
+
+
+def _accepted_kwargs(fit_fn: Callable, kwargs: dict) -> dict:
+    """Drop overrides the fit's signature does not accept."""
+    try:
+        params = inspect.signature(fit_fn).parameters
+    except (TypeError, ValueError):  # builtins / C callables: pass through
+        return dict(kwargs)
+    if any(p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()):
+        return dict(kwargs)
+    return {k: v for k, v in kwargs.items() if k in params}
+
+
+def _failed_mask(res) -> np.ndarray:
+    """Rows whose fit cannot be trusted: non-converged or non-finite."""
+    params = np.asarray(res.params)
+    nll = np.asarray(res.neg_log_likelihood)
+    conv = np.asarray(res.converged)
+    finite = np.isfinite(params).all(axis=-1) & np.isfinite(nll)
+    return ~(conv & finite)
+
+
+def _structurally_excluded(res) -> np.ndarray:
+    """Rows the model itself refused (too short / empty): retry cannot help."""
+    if res.status is None:
+        return np.zeros(np.asarray(res.converged).shape, bool)
+    return np.asarray(res.status) == FitStatus.EXCLUDED
+
+
+def resilient_fit(
+    fit_fn: Callable,
+    y,
+    *,
+    policy: str = "impute",
+    ladder: Optional[Sequence[RetryRung]] = None,
+    sanitize: bool = True,
+    max_retry_rows: Optional[int] = None,
+    seed: int = 0,
+    **fit_kwargs,
+) -> ResilientFitResult:
+    """Run ``fit_fn(y, **fit_kwargs)`` with sanitization and the retry ladder.
+
+    ``fit_fn`` is any public model fit (``models.arima.fit`` partials
+    included) returning a ``FitResult``.  ``policy`` is the sanitizer's
+    non-finite policy (``"impute"`` / ``"exclude"`` / ``"raise"``);
+    ``sanitize=False`` skips the pass entirely (rows the models reject
+    still come back ``EXCLUDED`` via their own status output).  ``ladder``
+    overrides :func:`default_ladder`; an empty ladder means failed rows go
+    straight to ``DIVERGED``.  ``seed`` drives the deterministic init
+    perturbation of retry rungs.
+
+    COST NOTE: every non-converged row enters the ladder, and the default
+    fallback rung re-fits on the portable ``scan`` backend — much slower
+    per row than the fused path.  A panel where a sizable fraction of rows
+    legitimately fails to converge within budget can therefore spend far
+    longer in the ladder than in the primary fit.  For latency-critical
+    serving, bound the ladder with ``max_retry_rows`` (rows beyond the cap
+    skip the ladder and are flagged ``DIVERGED`` directly, ladder rungs
+    recorded in ``meta`` either way), pass a custom ``ladder`` without the
+    scan rung, or ``ladder=()`` to disable retries entirely.
+
+    Healthy rows are fitted bit-identically to a direct ``fit_fn`` call on
+    the SANITIZED panel: the ladder only ever re-fits the failed subset,
+    scattering recovered rows back without touching their neighbors.  (A
+    direct call on the raw panel can differ at f32 fusion level when
+    sanitization changes the panel's NaN pattern — the alignment mode, and
+    with it the compiled program, is chosen per panel.)
+    """
+    yb = jnp.asarray(y)
+    single = yb.ndim == 1
+    if single:
+        yb = yb[None, :]
+    b = yb.shape[0]
+
+    if sanitize:
+        rep = _sanitize(yb, policy=policy)
+        y_clean, status, san_meta = rep.values, rep.status.copy(), rep.meta
+    else:
+        y_clean = yb
+        status = np.zeros(b, STATUS_DTYPE)
+        san_meta = {"policy": "off"}
+
+    res = fit_fn(y_clean, **fit_kwargs)
+    params = np.array(res.params)
+    nll = np.array(res.neg_log_likelihood)
+    conv = np.array(res.converged)
+    iters = np.array(res.iters)
+    excluded = (status == FitStatus.EXCLUDED) | _structurally_excluded(res)
+    status = np.maximum(
+        status, np.where(excluded, FitStatus.EXCLUDED, 0)
+    ).astype(STATUS_DTYPE)
+
+    failed = _failed_mask(res) & ~excluded
+    # ladder size cap: rows past the cap skip the ladder entirely (they
+    # stay in ``failed`` and are flagged DIVERGED below), bounding the
+    # worst-case ladder cost on mass-non-convergence panels
+    retryable = failed.copy()
+    over_cap = 0
+    if max_retry_rows is not None and int(retryable.sum()) > max_retry_rows:
+        skipped = np.nonzero(retryable)[0][max_retry_rows:]
+        retryable[skipped] = False
+        over_cap = skipped.size
+    rungs = (default_ladder(fit_fn, fit_kwargs.get("max_iters"))
+             if ladder is None else tuple(ladder))
+    rung_meta = []
+    rng = np.random.default_rng(seed)
+    supports_init = "init_params" in _accepted_kwargs(
+        fit_fn, {"init_params": None}
+    )
+
+    for depth, rung in enumerate(rungs):
+        idx = np.nonzero(retryable)[0]
+        if idx.size == 0:
+            break
+        # gather the failed subset into an aligned bucket (same contract as
+        # the optimizer's straggler compaction: out-of-range pad rows are
+        # copies of a real row whose results are dropped on the scatter)
+        cap = optim.retry_cap(idx.size)
+        pad_idx = np.concatenate([idx, np.full(cap - idx.size, idx[0])])
+        y_sub = y_clean[jnp.asarray(pad_idx)]
+        kw = {**fit_kwargs, **rung.kwargs}
+        if supports_init and rung.perturb:
+            # deterministic perturbed init: best-seen params of the failed
+            # rows, jittered relative to their own magnitude
+            base = np.nan_to_num(params[pad_idx], nan=0.0,
+                                 posinf=0.0, neginf=0.0)
+            jitter = rung.perturb * (1.0 + np.abs(base)) * rng.standard_normal(
+                base.shape
+            )
+            kw["init_params"] = jnp.asarray(
+                (base + jitter).astype(np.asarray(y_clean).dtype)
+            )
+        kw = _accepted_kwargs(fit_fn, kw)
+        sub = fit_fn(y_sub, **kw)
+        sub_failed = _failed_mask(sub)[: idx.size]
+        rescued = idx[~sub_failed]
+        if rescued.size:
+            keep = np.nonzero(~sub_failed)[0]
+            params[rescued] = np.asarray(sub.params)[keep]
+            nll[rescued] = np.asarray(sub.neg_log_likelihood)[keep]
+            conv[rescued] = np.asarray(sub.converged)[keep]
+            iters[rescued] = np.asarray(sub.iters)[keep]
+            status[rescued] = np.maximum(status[rescued], rung.status)
+            failed[rescued] = False
+            retryable[rescued] = False
+        rung_meta.append({
+            "rung": rung.name, "depth": depth,
+            "attempted": int(idx.size), "rescued": int(rescued.size),
+            "kwargs": {k: v for k, v in rung.kwargs.items()},
+        })
+
+    # survivors of every rung: flag DIVERGED and refuse to hand back
+    # non-finite params as if they were estimates
+    if failed.any():
+        params[failed] = np.nan
+        nll[failed] = np.nan
+        conv[failed] = False
+        status[failed] = np.maximum(status[failed], FitStatus.DIVERGED)
+
+    meta = {
+        "sanitize": san_meta,
+        "ladder": rung_meta,
+        "retry_rows_over_cap": over_cap,
+        "status_counts": status_counts(status),
+    }
+    if single:
+        return ResilientFitResult(
+            params[0], nll[0], conv[0], iters[0], status[0], meta
+        )
+    return ResilientFitResult(params, nll, conv, iters, status, meta)

@@ -1,0 +1,61 @@
+"""Per-row fit status vocabulary shared by every fit path.
+
+The reference's robustness story is Spark task retry: a failed executor task
+re-runs elsewhere and the driver log says what happened to each partition.
+The TPU rebuild fits the whole panel in one vmapped program, so "what
+happened" must be a per-ROW record instead: every public ``fit`` returns a
+``status`` array of :class:`FitStatus` codes alongside the parameters, and
+the resilient runner (``reliability.runner``) refines those codes as rows
+move through the sanitize -> fit -> retry -> fallback ladder.
+
+Codes are ordered by severity so ladder stages can be merged with an
+elementwise ``maximum`` — a row keeps the most severe thing that happened
+to it:
+
+====  ==========  ====================================================
+code  name        meaning
+====  ==========  ====================================================
+0     OK          fit converged on the primary path, params finite
+1     SANITIZED   input was repaired (NaN/Inf imputed) before fitting
+2     RETRIED     primary fit failed; a retry rung (perturbed init /
+                  larger budget) succeeded
+3     FALLBACK    retries failed; the conservative fallback rung
+                  (portable backend, no compaction) succeeded
+4     DIVERGED    every rung failed; params are NaN, row is flagged
+                  instead of poisoning the batch
+5     EXCLUDED    input rejected before/without fitting (all-NaN,
+                  constant, too short, or policy="exclude" hit)
+====  ==========  ====================================================
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class FitStatus(enum.IntEnum):
+    """Severity-ordered per-row fit outcome (see module docstring)."""
+
+    OK = 0
+    SANITIZED = 1
+    RETRIED = 2
+    FALLBACK = 3
+    DIVERGED = 4
+    EXCLUDED = 5
+
+
+# dtype every status array uses (device and host side)
+STATUS_DTYPE = np.int8
+
+
+def status_counts(status) -> dict:
+    """``{status_name: row_count}`` for a status array (host-side)."""
+    s = np.asarray(status)
+    return {m.name: int((s == m.value).sum()) for m in FitStatus}
+
+
+def merge_status(a, b):
+    """Elementwise most-severe-wins merge of two status arrays."""
+    return np.maximum(np.asarray(a), np.asarray(b)).astype(STATUS_DTYPE)

@@ -9,6 +9,7 @@ MXU (tiny ``[k, k]`` Gram matrices, huge batch).
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 
 def _chol_solve_unrolled(A, y):
@@ -20,9 +21,26 @@ def _chol_solve_unrolled(A, y):
     headline ARIMA fit.  For the tiny SPD systems every OLS here produces
     (ridge-stabilized normal equations), an unrolled Cholesky is ~k^3/3
     fused ELEMENTWISE ops over the batch — pure VPU streaming, no per-row
-    control flow.  ``sqrt`` is clamped so degenerate rows stay finite (they
-    produce the same garbage-in-garbage-out rows LU did)."""
+    control flow.
+
+    PRECONDITION: ``A`` must be symmetric positive-definite at working
+    precision (this decomposition is UNPIVOTED — there is no row exchange
+    to recover from a non-positive pivot).  Rows that violate it are
+    reported in the returned ``bad`` mask; their solutions are computed
+    with pivots clamped to a floor SCALED TO THE MATRIX
+    (``eps * trace/k``, ADVICE r5 — an absolute 1e-30 floor turned
+    slightly-indefinite f32 input into ~1e+15 divisions and exploding
+    solutions), so they stay bounded relative to the input but are NOT
+    trustworthy — callers should replace them (see :func:`ridge_solve`).
+
+    Returns ``(x, bad)``: the solutions and a ``[...]`` bool mask of rows
+    whose factorization hit a non-positive (or non-finite) pivot.
+    """
     k = A.shape[-1]
+    eps = jnp.asarray(jnp.finfo(A.dtype).eps, A.dtype)
+    scale = jnp.trace(A, axis1=-2, axis2=-1) / k
+    floor = eps * jnp.maximum(scale, jnp.asarray(jnp.finfo(A.dtype).tiny, A.dtype))
+    bad = jnp.zeros(A.shape[:-2], bool)
     L = [[None] * k for _ in range(k)]
     for i in range(k):
         for j in range(i + 1):
@@ -30,7 +48,8 @@ def _chol_solve_unrolled(A, y):
             for p in range(j):
                 s = s - L[i][p] * L[j][p]
             if i == j:
-                L[i][j] = jnp.sqrt(jnp.maximum(s, 1e-30))
+                bad = bad | ~(s > 0.0)  # non-positive OR NaN pivot
+                L[i][j] = jnp.sqrt(jnp.maximum(s, floor))
             else:
                 L[i][j] = s / L[j][j]
     z = [None] * k
@@ -45,7 +64,7 @@ def _chol_solve_unrolled(A, y):
         for p in range(i + 1, k):
             s = s - L[p][i] * x[p]
         x[i] = s / L[i][i]
-    return jnp.stack(x, axis=-1)
+    return jnp.stack(x, axis=-1), bad
 
 
 def ridge_solve(XtX, Xty, ridge: float = 1e-8):
@@ -58,14 +77,37 @@ def ridge_solve(XtX, Xty, ridge: float = 1e-8):
 
     Small systems (k <= 8 — every model-fit OLS in the tree) solve via the
     batched unrolled Cholesky; larger ones fall back to ``linalg.solve``.
+
+    The Cholesky path assumes SPD input; rows whose factorization hits a
+    non-positive pivot (f32 accumulation can leave a near-rank-deficient
+    Gram matrix slightly indefinite even after the ridge) are re-solved
+    with the pivoted ``jnp.linalg.solve`` LU instead of returning an
+    exploding clamped-pivot solution (ADVICE r5).  The fallback runs under
+    a ``lax.cond``: batches with no bad row — the overwhelmingly common
+    case — never pay the LU.  (Under ``vmap`` the cond lowers to a select
+    and both paths execute; only the cheap vmapped-per-series OLS callers
+    take that hit, never the hot batched fit paths.)
     """
     k = XtX.shape[-1]
     scale = jnp.maximum(jnp.trace(XtX, axis1=-2, axis2=-1) / k, 1.0)
     eye = jnp.eye(k, dtype=XtX.dtype)
     A = XtX + (ridge * scale)[..., None, None] * eye
-    if k <= 8:
-        return _chol_solve_unrolled(A, Xty)
-    return jnp.linalg.solve(A, Xty[..., None])[..., 0]
+    if k > 8:
+        return jnp.linalg.solve(A, Xty[..., None])[..., 0]
+    x, bad = _chol_solve_unrolled(A, Xty)
+    if bad.ndim == 0:  # unbatched solve: one row, one decision
+        return lax.cond(
+            bad,
+            lambda: jnp.linalg.solve(A, Xty[..., None])[..., 0],
+            lambda: x,
+        )
+    return lax.cond(
+        jnp.any(bad),
+        lambda: jnp.where(
+            bad[..., None], jnp.linalg.solve(A, Xty[..., None])[..., 0], x
+        ),
+        lambda: x,
+    )
 
 
 def ols(X, y, ridge: float = 1e-8):

@@ -41,6 +41,23 @@ def compaction_cap(bsz: int) -> int:
     return -(-max(1024, bsz // 8) // 1024) * 1024
 
 
+def retry_cap(n: int, align: int = 8) -> int:
+    """Bucket size for a host-side failed-subset gather: the next power of
+    two at or above ``n`` (minimum ``align``).
+
+    The resilient runner (``reliability.runner``) pads retry sub-batches to
+    this cap for the same reason :func:`compaction_cap` aligns the
+    straggler gather: the padded shape, not the exact failure count,
+    determines the compiled program, so bucketing bounds the number of
+    distinct shapes (and recompiles) the ladder can create.
+    """
+    n = max(int(n), 1)
+    cap = max(align, 1)
+    while cap < n:
+        cap *= 2
+    return cap
+
+
 class LBFGSResult(NamedTuple):
     x: jax.Array  # [d] solution
     f: jax.Array  # [] final objective
@@ -510,7 +527,15 @@ def minimize_lbfgs_batched(
     if compact:
         # gather the (at most cap) unconverged rows and their whole state;
         # out-of-range fill indices read row bsz-1 and are dropped on the
-        # scatter, so duplicates never corrupt live rows
+        # scatter, so duplicates never corrupt live rows.
+        #
+        # TRUNCATION CONTRACT (ADVICE r5): when stage 1 exits at max_iters
+        # with MORE than cap rows undone, this size=cap gather silently
+        # drops the excess — benign only because stage 2 shares the same
+        # exhausted iteration budget (cond_sub tests state.k < max_iters),
+        # so the sub-loop runs zero steps and the dropped rows' state is
+        # unchanged by the scatter.  Any change that gives stage 2 its OWN
+        # budget must first make this gather lossless.
         undone1 = ~(stage1.converged | stage1.failed)
         idx = jnp.nonzero(undone1, size=cap, fill_value=bsz)[0]
         idxc = jnp.minimum(idx, bsz - 1)

@@ -96,6 +96,7 @@ def test_css_gradient_matches_autodiff_of_scan(order):
     )
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep: tier-2 (`-m slow`), see pyproject markers
 @pytest.mark.parametrize("order", [(1, 0, 1), (2, 0, 2), (0, 0, 1)])
 @pytest.mark.parametrize("t", [41, 2100])  # single-chunk and chunked grids
 def test_css_data_gradient_matches_autodiff_of_scan(order, t):
@@ -613,6 +614,7 @@ def test_css_last_errors_matches_full(t):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep: tier-2 (`-m slow`), see pyproject markers
 def test_chunked_css_matches_scan_long_series():
     assert pk._CHUNK_T >= 512  # chunk-boundary sizes below assume >= 512
     order = (2, 0, 2)
@@ -757,6 +759,7 @@ def test_fill_linear_chain_matches_portable(t):
     np.testing.assert_allclose(np.asarray(lg), np.asarray(l_ref), rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep: tier-2 (`-m slow`), see pyproject markers
 def test_fill_linear_chain_chunked_long_series():
     from spark_timeseries_tpu.ops import univariate as uv
 
@@ -808,6 +811,7 @@ def test_hr_init_matches_batched(order, intercept):
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep: tier-2 (`-m slow`), see pyproject markers
 def test_hr_init_chunked_long_series():
     from spark_timeseries_tpu.models.arima import hannan_rissanen_batched
 
@@ -823,6 +827,7 @@ def test_hr_init_chunked_long_series():
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep: tier-2 (`-m slow`), see pyproject markers
 def test_fill_linear_fill_only_matches_portable():
     # the singleton-output variant (no difference/lag stores) — regression
     # for the pallas_call sequence-return handling
@@ -913,6 +918,7 @@ def test_batch_autocorr_folded_matches_natural(t):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep: tier-2 (`-m slow`), see pyproject markers
 def test_univariate_dispatch_accepts_folded_off_tpu():
     # off-TPU (this suite is CPU-pinned) the folded input falls back to the
     # portable path via unfold, preserving results and — for the chain —
@@ -935,6 +941,7 @@ def test_univariate_dispatch_accepts_folded_off_tpu():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep: tier-2 (`-m slow`), see pyproject markers
 def test_batch_fill_chain_outputs_natural_subset():
     from spark_timeseries_tpu.ops import univariate as uv
 
@@ -944,6 +951,7 @@ def test_batch_fill_chain_outputs_natural_subset():
     np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep: tier-2 (`-m slow`), see pyproject markers
 def test_arima_fit_straggler_compaction_parity(monkeypatch):
     # force the compaction stage on at a test-tractable batch size and check
     # it preserves FIT QUALITY vs the uncompacted program.  The two are
@@ -995,6 +1003,7 @@ def _dist_parity(ref, got, conv_floor=0.45):
     assert med < 1e-2
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep: tier-2 (`-m slow`), see pyproject markers
 def test_garch_fit_straggler_compaction_parity(monkeypatch):
     from spark_timeseries_tpu.models import garch
 
@@ -1009,6 +1018,7 @@ def test_garch_fit_straggler_compaction_parity(monkeypatch):
     _dist_parity(ref, got)
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep: tier-2 (`-m slow`), see pyproject markers
 def test_hw_fit_straggler_compaction_parity(monkeypatch):
     from spark_timeseries_tpu.models import holtwinters as hw
 

@@ -91,6 +91,15 @@ def test_two_process_distributed_fit(tmp_path):
             f"deadlock); partial worker output: {partial}"
         )
     for p, log in zip(procs, logs):
+        # jax 0.4's CPU backend has no cross-process collectives at all
+        # (added later via gloo): on such builds this test is impossible,
+        # not failing — skip VISIBLY (ci.sh surfaces every skip reason)
+        if "Multiprocess computations aren't implemented" in log:
+            pytest.skip(
+                "this jax build's CPU backend does not implement "
+                "multiprocess computations; 2-process smoke test not "
+                "runnable (needs jax with gloo CPU collectives)"
+            )
         assert p.returncode == 0, f"worker failed:\n{log}"
     assert out.exists(), f"worker 0 wrote no result:\n{logs[0]}"
 

@@ -1,0 +1,397 @@
+"""Fault-injection tests for the reliability layer (tier-1, CPU).
+
+Every rung of the resilience ladder is driven deterministically via
+``reliability.faultinject``: data faults (NaN holes, inf spikes, constant /
+all-NaN / explosive rows) exercise the sanitizer, behavioral faults
+(forced non-convergence, simulated RESOURCE_EXHAUSTED) exercise the retry
+ladder and the chunk driver's OOM backoff.  ``ci.sh`` re-runs this module
+with ``-W error::RuntimeWarning`` so an unhandled-NaN warning escaping a
+fit path fails CI.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.models import arima, autoregression, ewma, garch
+from spark_timeseries_tpu.models import holtwinters as hw
+from spark_timeseries_tpu.reliability import FitStatus
+from spark_timeseries_tpu.reliability import faultinject as fi
+from spark_timeseries_tpu.utils import linalg, optim
+from spark_timeseries_tpu import panel as panel_mod
+from spark_timeseries_tpu import index as dtix
+
+
+def _ar_panel(b=16, t=240, seed=0, phi=0.6):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(b, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, t):
+        y[:, i] = phi * y[:, i - 1] + e[:, i]
+    return y
+
+
+def _garch_panel(b=12, t=300, seed=1):
+    rng = np.random.default_rng(seed)
+    z = rng.normal(size=(b, t)).astype(np.float32)
+    r = np.zeros_like(z)
+    h = np.full((b,), 0.5, np.float32)
+    rprev = np.zeros((b,), np.float32)
+    for i in range(t):
+        h = 0.05 + 0.1 * rprev**2 + 0.8 * h
+        r[:, i] = np.sqrt(h) * z[:, i]
+        rprev = r[:, i]
+    return r
+
+
+def _seasonal_panel(b=8, t=96, m=12, seed=2):
+    rng = np.random.default_rng(seed)
+    tt = np.arange(t, dtype=np.float32)
+    seas = 2.0 * np.sin(2 * np.pi * tt[None, :] / m)
+    return (10.0 + 0.02 * tt[None, :] + seas
+            + rng.normal(scale=0.3, size=(b, t))).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# sanitizer
+# ---------------------------------------------------------------------------
+
+
+class TestSanitize:
+    def test_clean_rows_bit_identical(self):
+        y = _ar_panel()
+        rep = rel.sanitize(y)
+        assert (rep.status == FitStatus.OK).all()
+        np.testing.assert_array_equal(np.asarray(rep.values), y)
+
+    def test_interior_nan_imputed_and_flagged(self):
+        y = fi.inject_nan_rows(_ar_panel(), [3], seed=5)
+        rep = rel.sanitize(y, policy="impute")
+        assert rep.status[3] == FitStatus.SANITIZED
+        assert np.isfinite(np.asarray(rep.values)[3]).all()
+        # untouched rows stay bit-identical
+        np.testing.assert_array_equal(np.asarray(rep.values)[0], y[0])
+
+    def test_inf_imputed_and_flagged(self):
+        y = fi.inject_inf_rows(_ar_panel(), [2], seed=6)
+        rep = rel.sanitize(y, policy="impute")
+        assert rep.status[2] == FitStatus.SANITIZED
+        assert np.isfinite(np.asarray(rep.values)[2]).all()
+
+    def test_exclude_policy(self):
+        y = fi.inject_nan_rows(_ar_panel(), [4], seed=7)
+        rep = rel.sanitize(y, policy="exclude")
+        assert rep.status[4] == FitStatus.EXCLUDED
+        assert np.isnan(np.asarray(rep.values)[4]).all()
+
+    def test_constant_and_all_nan_excluded(self):
+        y = fi.make_constant_rows(_ar_panel(), [1], value=3.0)
+        y = fi.make_all_nan_rows(y, [5])
+        rep = rel.sanitize(y)
+        assert rep.status[1] == FitStatus.EXCLUDED
+        assert rep.status[5] == FitStatus.EXCLUDED
+
+    def test_raise_policy(self):
+        y = fi.inject_inf_rows(_ar_panel(), [0], seed=8)
+        with pytest.raises(ValueError, match="sanitiz"):
+            rel.sanitize(y, policy="raise")
+
+    def test_ragged_rows_pass_through(self):
+        # leading/trailing NaNs are raggedness, not faults
+        y = _ar_panel()
+        y[2, :40] = np.nan
+        y[3, -25:] = np.nan
+        rep = rel.sanitize(y)
+        assert (rep.status == FitStatus.OK).all()
+        np.testing.assert_array_equal(np.asarray(rep.values), y)
+
+
+# ---------------------------------------------------------------------------
+# model-level status output
+# ---------------------------------------------------------------------------
+
+
+class TestModelStatus:
+    def test_arima_status_ok(self):
+        r = arima.fit(jnp.asarray(_ar_panel()), (1, 0, 0), max_iters=30)
+        s = np.asarray(r.status)
+        conv = np.asarray(r.converged)
+        assert (s[conv] == FitStatus.OK).all()
+        assert ((s == FitStatus.OK) == conv).all()
+
+    def test_too_short_rows_excluded(self):
+        y = _ar_panel(b=4)
+        y[1, :-5] = np.nan  # 5 valid points: structurally unfittable
+        r = arima.fit(jnp.asarray(y), (1, 0, 1), max_iters=20)
+        assert np.asarray(r.status)[1] == FitStatus.EXCLUDED
+
+    @pytest.mark.parametrize("fit_fn, args", [
+        (lambda y: ewma.fit(y, max_iters=20), ()),
+        (lambda y: autoregression.fit(y, max_lag=2), ()),
+        (lambda y: garch.fit(y, max_iters=30), ()),
+    ])
+    def test_all_models_emit_status(self, fit_fn, args):
+        y = jnp.asarray(_garch_panel())
+        r = fit_fn(y)
+        assert r.status is not None
+        assert np.asarray(r.status).shape == (y.shape[0],)
+
+    def test_holtwinters_emits_status(self):
+        r = hw.fit(jnp.asarray(_seasonal_panel()), 12, max_iters=25)
+        assert r.status is not None
+
+    def test_single_series_status_scalar(self):
+        r = ewma.fit(jnp.asarray(_ar_panel(b=1)[0]), max_iters=20)
+        assert np.asarray(r.status).shape == ()
+
+
+# ---------------------------------------------------------------------------
+# retry ladder
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def ar_panel():
+    return _ar_panel()
+
+
+class TestRetryLadder:
+    @pytest.mark.parametrize("n_failures, expected", [
+        (1, FitStatus.RETRIED),
+        (2, FitStatus.FALLBACK),
+        (99, FitStatus.DIVERGED),
+    ])
+    def test_every_rung(self, ar_panel, n_failures, expected):
+        ff = fi.failing_fit(arima.fit, ar_panel, [7], n_failures=n_failures)
+        res = rel.resilient_fit(ff, ar_panel, order=(1, 0, 0), max_iters=30)
+        assert FitStatus(res.status[7]) == expected
+        others = np.arange(len(ar_panel)) != 7
+        assert (res.status[others] == FitStatus.OK).all()
+        assert np.isfinite(res.params[others]).all()
+        if expected != FitStatus.DIVERGED:
+            assert np.isfinite(res.params[7]).all()
+            assert res.converged[7]
+        else:
+            assert np.isnan(res.params[7]).all()
+            assert not res.converged[7]
+
+    def test_ladder_meta_accounting(self, ar_panel):
+        ff = fi.failing_fit(arima.fit, ar_panel, [3, 9], n_failures=1)
+        res = rel.resilient_fit(ff, ar_panel, order=(1, 0, 0), max_iters=30)
+        (rung,) = [r for r in res.meta["ladder"] if r["rescued"]]
+        assert rung["rung"] == "retry"
+        assert rung["attempted"] == 2 and rung["rescued"] == 2
+        assert res.meta["status_counts"]["RETRIED"] == 2
+
+    def test_acceptance_mixed_fault_batch(self, ar_panel):
+        """ISSUE acceptance: injected NaN rows + a non-SPD-init row + a
+        forced-non-convergence row -> finite params and correct status for
+        every healthy row, no NaN propagation."""
+        y = fi.inject_nan_rows(ar_panel, [2], seed=11)
+        y = fi.make_explosive_rows(y, [4], seed=12)  # non-SPD f32 normal eqs
+        ff = fi.failing_fit(arima.fit, y, [6], n_failures=1)
+        res = rel.resilient_fit(ff, y, order=(1, 0, 1), max_iters=30)
+        assert FitStatus(res.status[2]) == FitStatus.SANITIZED
+        assert FitStatus(res.status[6]) == FitStatus.RETRIED
+        # the explosive row either recovers through a rung or is flagged
+        # DIVERGED — never a silent NaN with an OK status
+        s4 = FitStatus(res.status[4])
+        assert s4 in (FitStatus.RETRIED, FitStatus.FALLBACK,
+                      FitStatus.DIVERGED)
+        if s4 == FitStatus.DIVERGED:
+            assert np.isnan(res.params[4]).all()
+        healthy = [i for i in range(len(y)) if i not in (2, 4, 6)]
+        assert (res.status[healthy] == FitStatus.OK).all()
+        assert np.isfinite(res.params[healthy]).all()
+        # healthy rows fit EXACTLY as a plain fit over the sanitized panel
+        # would: same data, same program — the ladder never touches them.
+        # (A plain fit on the RAW panel compiles a different alignment mode
+        # and may differ at f32 fusion level, so that is not the bar.)
+        plain = arima.fit(rel.sanitize(y).values, (1, 0, 1), max_iters=30)
+        np.testing.assert_array_equal(
+            res.params[healthy], np.asarray(plain.params)[healthy])
+
+    def test_ragged_panel_through_ladder(self):
+        y = _ar_panel(b=12)
+        y[1, :60] = np.nan  # ragged head
+        y[5, -30:] = np.nan  # ragged tail
+        y = fi.inject_nan_rows(y, [8], seed=13)
+        ff = fi.failing_fit(arima.fit, y, [3], n_failures=1)
+        res = rel.resilient_fit(ff, y, order=(1, 0, 0), max_iters=30)
+        assert FitStatus(res.status[8]) == FitStatus.SANITIZED
+        assert FitStatus(res.status[3]) == FitStatus.RETRIED
+        # ragged rows are NOT sanitized away and still fit
+        assert res.status[1] in (FitStatus.OK, FitStatus.RETRIED,
+                                 FitStatus.FALLBACK)
+        assert np.isfinite(res.params[1]).all()
+
+    def test_empty_ladder_goes_straight_to_diverged(self, ar_panel):
+        ff = fi.failing_fit(arima.fit, ar_panel, [0], n_failures=1)
+        res = rel.resilient_fit(ff, ar_panel, ladder=(), order=(1, 0, 0),
+                                max_iters=30)
+        assert FitStatus(res.status[0]) == FitStatus.DIVERGED
+
+    def test_no_failures_skips_ladder(self, ar_panel):
+        res = rel.resilient_fit(arima.fit, ar_panel, order=(1, 0, 0),
+                                max_iters=30)
+        assert res.meta["ladder"] == []
+        assert (res.status == FitStatus.OK).all()
+
+    def test_excluded_rows_not_retried(self, ar_panel):
+        y = fi.make_all_nan_rows(ar_panel, [2])
+        res = rel.resilient_fit(arima.fit, y, order=(1, 0, 0), max_iters=30)
+        assert FitStatus(res.status[2]) == FitStatus.EXCLUDED
+        assert res.meta["ladder"] == []  # nothing retryable
+
+    def test_max_retry_rows_caps_ladder(self, ar_panel):
+        ff = fi.failing_fit(arima.fit, ar_panel, [3, 9, 12], n_failures=1)
+        res = rel.resilient_fit(ff, ar_panel, order=(1, 0, 0), max_iters=30,
+                                max_retry_rows=2)
+        assert res.meta["retry_rows_over_cap"] == 1
+        # the first two failed rows go through the ladder, the third is
+        # flagged DIVERGED without burning fit calls
+        assert FitStatus(res.status[3]) == FitStatus.RETRIED
+        assert FitStatus(res.status[9]) == FitStatus.RETRIED
+        assert FitStatus(res.status[12]) == FitStatus.DIVERGED
+        assert np.isnan(res.params[12]).all()
+
+    def test_resilient_single_series(self):
+        y = _ar_panel(b=1)[0]
+        res = rel.resilient_fit(arima.fit, y, order=(1, 0, 0), max_iters=30)
+        assert res.params.ndim == 1
+        assert FitStatus(int(res.status)) == FitStatus.OK
+
+    def test_other_model_families(self):
+        r = _garch_panel()
+        ff = fi.failing_fit(garch.fit, r, [1], n_failures=1)
+        res = rel.resilient_fit(ff, r, max_iters=30)
+        assert FitStatus(res.status[1]) == FitStatus.RETRIED
+        w = _seasonal_panel()
+        res2 = rel.resilient_fit(hw.fit, w, period=12, max_iters=25)
+        assert res2.status.shape == (len(w),)
+
+
+# ---------------------------------------------------------------------------
+# OOM chunk backoff
+# ---------------------------------------------------------------------------
+
+
+class TestOOMBackoff:
+    def test_backoff_completes_and_records_degradation(self, ar_panel):
+        of = fi.oom_fit(arima.fit, max_rows=4)
+        res = rel.fit_chunked(of, ar_panel, chunk_rows=16, min_chunk_rows=2,
+                              resilient=False, order=(1, 0, 0), max_iters=30)
+        assert res.meta["degraded"] is True
+        assert res.meta["oom_backoffs"] == 2  # 16 -> 8 -> 4
+        assert res.meta["chunk_rows_final"] == 4
+        assert res.params.shape[0] == len(ar_panel)
+        assert (res.status == FitStatus.OK).all()
+        # chunked result matches the unchunked fit row-for-row
+        plain = arima.fit(jnp.asarray(ar_panel), (1, 0, 0), max_iters=30)
+        conv = np.asarray(plain.converged)
+        np.testing.assert_allclose(
+            res.params[conv], np.asarray(plain.params)[conv], rtol=2e-3,
+            atol=2e-3)
+
+    def test_floor_exhaustion_raises(self, ar_panel):
+        of = fi.oom_fit(arima.fit, max_rows=1)
+        with pytest.raises(rel.OOMBackoffExceeded):
+            rel.fit_chunked(of, ar_panel, chunk_rows=16, min_chunk_rows=4,
+                            resilient=False, order=(1, 0, 0), max_iters=30)
+
+    def test_non_oom_errors_propagate(self, ar_panel):
+        def broken(yb, **kw):
+            raise ValueError("shape bug")
+
+        with pytest.raises(ValueError, match="shape bug"):
+            rel.fit_chunked(broken, ar_panel, chunk_rows=4)
+
+    def test_resilient_chunks_aggregate_ladder_meta(self, ar_panel):
+        ff = fi.failing_fit(arima.fit, ar_panel, [1, 9], n_failures=1)
+        res = rel.fit_chunked(ff, ar_panel, chunk_rows=8, order=(1, 0, 0),
+                              max_iters=30)
+        assert res.meta["ladder_totals"]["retry"]["rescued"] == 2
+        assert res.meta["status_counts"]["RETRIED"] == 2
+
+
+# ---------------------------------------------------------------------------
+# panel chunk driver + linalg fallback + misc
+# ---------------------------------------------------------------------------
+
+
+class TestPanelFit:
+    def test_panel_fit_by_name(self):
+        y = _ar_panel(b=6, t=120)
+        idx = dtix.uniform("2024-01-01", periods=120,
+                           frequency=dtix.DayFrequency(1))
+        p = panel_mod.TimeSeriesPanel(idx, [f"s{i}" for i in range(6)], y)
+        res = p.fit("arima", order=(1, 0, 0), max_iters=25)
+        assert res.params.shape[0] == 6
+        assert (res.status <= FitStatus.EXCLUDED).all()
+
+    def test_panel_fit_unknown_model(self):
+        y = _ar_panel(b=2, t=60)
+        idx = dtix.uniform("2024-01-01", periods=60,
+                           frequency=dtix.DayFrequency(1))
+        p = panel_mod.TimeSeriesPanel(idx, ["a", "b"], y)
+        with pytest.raises(ValueError, match="unknown model"):
+            p.fit("nope")
+
+
+class TestLinalgFallback:
+    def test_nonspd_falls_back_to_lu(self):
+        A = fi.nonspd_gram(4)
+        b = np.ones(4, np.float32)
+        x = np.asarray(linalg.ridge_solve(jnp.asarray(A), jnp.asarray(b)))
+        scale = max(np.trace(A) / 4, 1.0)
+        ref = np.linalg.solve(A + 1e-8 * scale * np.eye(4, dtype=A.dtype), b)
+        assert np.isfinite(x).all()
+        np.testing.assert_allclose(x, ref, rtol=1e-3)
+
+    def test_spd_path_unchanged(self):
+        rng = np.random.default_rng(3)
+        X = rng.standard_normal((64, 4)).astype(np.float32)
+        A = (X.T @ X).astype(np.float32)
+        b = rng.standard_normal(4).astype(np.float32)
+        x = np.asarray(linalg.ridge_solve(jnp.asarray(A), jnp.asarray(b)))
+        scale = max(np.trace(A) / 4, 1.0)
+        ref = np.linalg.solve(A + 1e-8 * scale * np.eye(4), b.astype(np.float64))
+        np.testing.assert_allclose(x, ref, rtol=2e-3)
+
+    def test_batched_mixed_spd_nonspd(self):
+        rng = np.random.default_rng(4)
+        X = rng.standard_normal((64, 3)).astype(np.float32)
+        good = X.T @ X
+        batch = np.stack([good, fi.nonspd_gram(3), good])
+        rhs = np.tile(np.ones(3, np.float32), (3, 1))
+        x = np.asarray(linalg.ridge_solve(jnp.asarray(batch), jnp.asarray(rhs)))
+        assert np.isfinite(x).all()
+        # good rows unaffected by their bad neighbor
+        np.testing.assert_allclose(x[0], x[2], rtol=1e-6)
+
+
+class TestKnobs:
+    def test_retry_cap_buckets(self):
+        assert optim.retry_cap(1) == 8
+        assert optim.retry_cap(8) == 8
+        assert optim.retry_cap(9) == 16
+        assert optim.retry_cap(1000) == 1024
+
+    def test_compact_escape_hatch_accepted(self):
+        # compact=False must be a no-op below COMPACT_MIN_BATCH and a valid
+        # knob everywhere (the reproducibility escape hatch of ADVICE r5)
+        y = jnp.asarray(_ar_panel(b=4))
+        r1 = arima.fit(y, (1, 0, 0), max_iters=20, compact=True)
+        r2 = arima.fit(y, (1, 0, 0), max_iters=20, compact=False)
+        np.testing.assert_array_equal(np.asarray(r1.params),
+                                      np.asarray(r2.params))
+
+    def test_status_counts_and_merge(self):
+        s = np.array([0, 1, 5, 2], np.int8)
+        c = rel.status_counts(s)
+        assert c["OK"] == 1 and c["EXCLUDED"] == 1
+        m = rel.merge_status(s, np.array([3, 0, 0, 0], np.int8))
+        assert m.tolist() == [3, 1, 5, 2]

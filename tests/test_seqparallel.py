@@ -129,7 +129,7 @@ class TestTimeShardedFits:
     the affine-carry decomposition of the EWMA/CSS recursions)."""
 
     def test_sp_ewma_sse_matches_unsharded(self, mesh2d, values):
-        from jax import shard_map
+        from spark_timeseries_tpu.ops.seqparallel import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from spark_timeseries_tpu.models import ewma
@@ -170,7 +170,7 @@ class TestTimeShardedFits:
     def test_sp_css_nll_matches_unsharded(self, mesh2d, values):
         import functools
 
-        from jax import shard_map
+        from spark_timeseries_tpu.ops.seqparallel import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from spark_timeseries_tpu.models import arima
@@ -227,7 +227,7 @@ class TestTimeShardedFits:
         # affine carry; p > 1 widens the AR halo
         import functools
 
-        from jax import shard_map
+        from spark_timeseries_tpu.ops.seqparallel import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from spark_timeseries_tpu.models import arima
@@ -263,7 +263,7 @@ class TestTimeShardedFits:
         # equations must equal the unsharded masked-product construction
         import functools
 
-        from jax import shard_map
+        from spark_timeseries_tpu.ops.seqparallel import shard_map
         from jax.sharding import PartitionSpec as P
 
         from spark_timeseries_tpu.models import arima
@@ -331,7 +331,7 @@ class TestTimeShardedFits:
             sp.sp_arima_fit(mesh8, y, (2, 1, 2))
 
     def test_sp_garch_nll_and_fit_match_unsharded(self, mesh2d):
-        from jax import shard_map
+        from spark_timeseries_tpu.ops.seqparallel import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from spark_timeseries_tpu.models import garch
