@@ -2,7 +2,12 @@
 
 Emits ONE JSON line per benchmark, each with the driver schema
 ``{"metric", "value", "unit", "vs_baseline"}`` plus extra diagnostic fields.
-The HEADLINE line (config 3, the north-star ARIMA fit) is printed LAST.
+The HEADLINE line (config 3, the north-star ARIMA fit) prints after the
+other configs, followed only by a compact ``bench_summary`` digest of every
+config — the driver artifact keeps just the output TAIL (~2000 chars, which
+by round 5 no single full config line fit inside), so the digest is what
+guarantees the artifact captures every config's numbers
+(``tools/gen_readme_perf.py`` parses it first-class).
 
 Configs (``BASELINE.json.configs``):
   1. autocorr via the mapSeries equivalent, 1k keys x 1k obs
@@ -1183,36 +1188,66 @@ def _northstar_1m(jnp, order):
         align_mode_on_host(v)
         chunks.append(v)
 
-    # every chunk fit goes through the reliability chunk driver: an HBM
-    # RESOURCE_EXHAUSTED halves the row count (bounded) instead of killing
-    # the sustained run, and the degradation is recorded in the artifact.
+    # every chunk fit goes through the JOURNALED reliability chunk driver
+    # (ISSUE 2): an HBM RESOURCE_EXHAUSTED halves the row count (bounded)
+    # instead of killing the sustained run, and every finished chunk is
+    # committed to a write-ahead journal — a SIGKILL/preemption at chunk 7
+    # loses nothing, and re-running bench.py with the same STSTPU_NORTHSTAR
+    # _CKPT resumes from the committed shards (resume metadata lands in the
+    # artifact either way).  Each device-generated chunk is its own panel,
+    # so each gets a per-chunk journal namespace under one job directory.
     # resilient=False keeps the measured work identical to a plain fit
     # (per-row status still comes from the fit program itself); sanitize /
     # retry-ladder behavior is exercised by the tier-1 fault-injection
-    # tests, not timed here.
+    # tests, not timed here.  The journal commit (host copy + npz shard)
+    # rides INSIDE the timed wall: the sustained rate now measures the
+    # durable serving path, commit cost included.
+    import tempfile
+
     from spark_timeseries_tpu import reliability as _rel
 
+    ckpt_root = os.environ.get("STSTPU_NORTHSTAR_CKPT") or tempfile.mkdtemp(
+        prefix="northstar_journal_")
+
+    # VERDICT r5 item 6: the missing half of the headline measurement —
+    # sampled around the sustained run; the chunk driver records the same
+    # reading per chunk in the journal manifest (one shared helper)
+    from spark_timeseries_tpu.reliability.chunked import (
+        _device_peak_hbm as _peak_hbm,
+    )
+
+    peak = _peak_hbm()  # before the run: warmup/compile already resident
     total_conv, wall = 0.0, 0.0
+    fitted_conv = 0.0  # converged rows actually FITTED this run: a resumed
+    # chunk rehydrates from its shard in ~0 wall, and counting its rows in
+    # the throughput would publish an absurd rate (review finding) — the
+    # sustained figure divides fitted work by fitted wall only
     status_totals = {}
     oom_backoffs, chunk_rows_final = 0, chunk_b
-    for v in chunks:
+    chunks_committed, chunks_resumed, run_ids = 0, 0, []
+    for i, v in enumerate(chunks):
         t0 = time.perf_counter()
         r = _rel.fit_chunked(arima.fit, v, chunk_rows=chunk_b,
-                             resilient=False, order=order)
+                             resilient=False, order=order,
+                             checkpoint_dir=os.path.join(
+                                 ckpt_root, f"chunk_{i:02d}"))
         n_conv = float(np.sum(r.converged))
-        wall += time.perf_counter() - t0
+        j = r.meta.get("journal", {})
+        resumed = bool(j.get("chunks_resumed", 0))
+        if not resumed:
+            wall += time.perf_counter() - t0
+            fitted_conv += n_conv
         total_conv += n_conv
         for k, c in r.meta["status_counts"].items():
             status_totals[k] = status_totals.get(k, 0) + c
         oom_backoffs += r.meta["oom_backoffs"]
         chunk_rows_final = min(chunk_rows_final, r.meta["chunk_rows_final"])
+        chunks_committed += j.get("chunks_committed", 0)
+        chunks_resumed += j.get("chunks_resumed", 0)
+        run_ids.append(j.get("run_id"))
+        peak = max(peak or 0, _peak_hbm() or 0) or None
         del r
     del chunks
-    try:
-        stats = jax.devices()[0].memory_stats() or {}
-        peak = int(stats.get("peak_bytes_in_use", 0)) or None
-    except Exception:
-        peak = None
     total = chunk_b * n_chunks
     return {
         "series_total": total,
@@ -1220,7 +1255,10 @@ def _northstar_1m(jnp, order):
         "chunks": n_chunks,
         "wall_s": round(wall, 3),
         "converged_frac": round(total_conv / total, 4),
-        "sustained_converged_series_per_sec": round(total_conv / wall, 1),
+        # fitted work over fitted wall; None when every chunk was resumed
+        # from a prior run's journal (nothing was measured)
+        "sustained_converged_series_per_sec":
+            round(fitted_conv / wall, 1) if wall > 0 else None,
         "peak_hbm_bytes": peak,
         # reliability layer accounting (ISSUE 1): per-row FitStatus totals
         # and whether any chunk survived only by OOM backoff
@@ -1228,8 +1266,18 @@ def _northstar_1m(jnp, order):
         "oom_backoffs": oom_backoffs,
         "chunk_rows_final": chunk_rows_final,
         "degraded_by_oom_backoff": bool(oom_backoffs),
+        # job durability accounting (ISSUE 2): the run is journaled; a
+        # resumed re-run reports chunks_resumed > 0 and skips their fits
+        "journal": {
+            "dir": ckpt_root,
+            "chunks_committed": chunks_committed,
+            "chunks_resumed": chunks_resumed,
+            "run_ids": run_ids,
+        },
         "data": "generated on device from the exact ARIMA(1,1,1) process "
-                "(phi 0.6, theta 0.3, d=1), fresh key per chunk",
+                "(phi 0.6, theta 0.3, d=1), fresh key per chunk; fits "
+                "journaled (write-ahead chunk shards, commit inside the "
+                "timed wall)",
     }
 
 
@@ -1319,10 +1367,78 @@ def bench_arima_headline(jnp, quick, on_tpu, n_chips, platform, parity=None):
     }
 
 
+def _summary_line(emitted):
+    """One compact JSON line holding every config's key numbers.
+
+    VERDICT r5 item 7: the driver artifact keeps only the last ~2000 output
+    characters, and by round 5 a single full config line outgrew that —
+    the artifact captured no parseable metric at all and the README table
+    fell back to PROVISIONAL local rows.  Printing this digest LAST puts
+    every config (and the north-star/parity essentials) inside any
+    truncation window; ``tools/gen_readme_perf.py`` parses it first-class.
+    """
+    import re
+
+    configs = {}
+    headline = {}
+    parity_ok = None
+    for obj in emitted:
+        m = obj.get("metric", "")
+        if m.startswith("pallas/scan"):
+            parity_ok = obj.get("ok")
+            continue
+        match = re.match(r"(config\d+b?)\b", m)
+        if not match:
+            continue
+        key = match.group(1)
+        entry = {
+            "metric": m,
+            "value": obj.get("value"),
+            "unit": str(obj.get("unit", ""))[:44],
+            "vs_baseline": obj.get("vs_baseline"),
+            "speedup_vs_cpu_allcore": obj.get("speedup_vs_cpu_allcore"),
+        }
+        if obj.get("converged_frac") is not None:
+            entry["converged_frac"] = obj["converged_frac"]
+        if key == "config3":
+            headline = obj
+            for f in ("vs_target_unscaled", "fit_plus_forecast_series_per_sec",
+                      "p50_fit_latency_s"):
+                if obj.get(f) is not None:
+                    entry[f] = obj[f]
+            ns = obj.get("northstar_1m")
+            if ns:
+                entry["northstar_1m"] = {k: ns.get(k) for k in (
+                    "series_total", "wall_s", "converged_frac",
+                    "sustained_converged_series_per_sec", "peak_hbm_bytes")}
+                j = ns.get("journal") or {}
+                entry["northstar_1m"]["chunks_resumed"] = j.get(
+                    "chunks_resumed")
+        configs[key] = entry
+    line = {
+        "metric": "bench_summary: all configs, tail-truncation-proof "
+                  "(parsed by tools/gen_readme_perf.py)",
+        "value": headline.get("value"),
+        "unit": headline.get("unit"),
+        "vs_baseline": headline.get("vs_baseline"),
+        "parity_ok": parity_ok,
+        "configs": configs,
+    }
+    # fit inside the truncation window: shorten metric strings (the scale
+    # regexes need ~110 chars), then drop them entirely as a last resort
+    for trim in (110, 80, 0):
+        if len(json.dumps(line)) <= 1950:
+            break
+        for e in configs.values():
+            e["metric"] = e["metric"][:trim]
+    return line
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="1,2,4,5,3",
-                    help="comma-separated subset of 1..5 (3 always prints last)")
+                    help="comma-separated subset of 1..5 (3 always prints "
+                         "last, followed only by the compact summary line)")
     ap.add_argument("--quick", action="store_true", help="small sizes (CI smoke)")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the headline config")
@@ -1336,6 +1452,12 @@ def main():
     on_tpu = platform in ("tpu", "axon")
     n_chips = len(jax.devices())
 
+    emitted = []
+
+    def track(obj):
+        emitted.append(obj)
+        _emit(obj)
+
     _progress(f"platform={platform} chips={n_chips}; parity gate...")
     # fail-SOFT: a gate trip must not erase the whole benchmark record —
     # emit the failure loudly and keep measuring (the judge sees both)
@@ -1344,29 +1466,29 @@ def main():
         # ok=True ONLY when the gate actually ran and passed; an off-TPU run
         # (checked=False) must not read as a pass downstream
         parity = {"ok": bool(parity.get("checked")), **parity}
-        _emit({"metric": "pallas/scan on-device parity gate", "value": 1.0,
+        track({"metric": "pallas/scan on-device parity gate", "value": 1.0,
                "unit": "ok", "vs_baseline": 1.0, **parity})
     except Exception as e:  # gate trip OR compile/runtime failure:
         # either way the record must say so and the measurements continue
         parity = {"ok": False, "checked": True,
                   "error": f"{type(e).__name__}: {e}"[:500]}
-        _emit({"metric": "pallas/scan on-device parity gate", "value": 0.0,
+        track({"metric": "pallas/scan on-device parity gate", "value": 0.0,
                "unit": "FAILED", "vs_baseline": 0.0, **parity})
 
     if "1" in wanted:
         _progress("config 1...")
-        _emit(bench_autocorr(jnp, args.quick))
+        track(bench_autocorr(jnp, args.quick))
         _progress("config 1b...")
-        _emit(bench_autocorr_at_scale(jnp, args.quick, on_tpu))
+        track(bench_autocorr_at_scale(jnp, args.quick, on_tpu))
     if "2" in wanted:
         _progress("config 2...")
-        _emit(bench_fill_chain(jnp, args.quick, on_tpu))
+        track(bench_fill_chain(jnp, args.quick, on_tpu))
     if "4" in wanted:
         _progress("config 4...")
-        _emit(bench_garch(jnp, args.quick, on_tpu))
+        track(bench_garch(jnp, args.quick, on_tpu))
     if "5" in wanted:
         _progress("config 5...")
-        _emit(bench_holtwinters(jnp, args.quick, on_tpu))
+        track(bench_holtwinters(jnp, args.quick, on_tpu))
     if "3" in wanted:
         _progress("config 3 (headline)...")
         if args.profile:
@@ -1376,7 +1498,10 @@ def main():
         else:
             line = bench_arima_headline(jnp, args.quick, on_tpu, n_chips,
                                         platform, parity)
-        _emit(line)
+        track(line)
+    # LAST line: the compact all-configs digest — whatever tail the driver
+    # keeps, every config's numbers survive
+    _emit(_summary_line(emitted))
 
 
 if __name__ == "__main__":

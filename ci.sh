@@ -29,6 +29,13 @@ fi
 # point under test.
 python -m pytest tests/test_reliability.py -q -rs -W error::RuntimeWarning "$@"
 
+# kill-and-resume smoke (ISSUE 2): a journaled 4-chunk CPU fit is SIGKILLed
+# after committing chunk 2, resumed from the write-ahead journal, and the
+# resumed result must be BITWISE-identical to an uninterrupted run with the
+# manifest accounting for all 4 chunks — real process death, not an
+# exception (tests/_journal_worker.py orchestrates three worker processes)
+python tests/_journal_worker.py --smoke
+
 # the driver's multi-chip artifact, same environment
 python - <<'EOF'
 import __graft_entry__ as g

@@ -250,6 +250,45 @@ def time_series_rdd_from_pandas_dataframe(dt_index: DateTimeIndex, df
 # ---------------------------------------------------------------------------
 
 
+def _require_checkpoint_dir(durable_kwargs: dict) -> None:
+    """The durability knobs only act through the journaled chunk driver;
+    accepting them on the plain path would silently drop an SLO the caller
+    believes is armed (and swallow typos)."""
+    if durable_kwargs:
+        raise TypeError(
+            f"{sorted(durable_kwargs)} require checkpoint_dir= (they "
+            "configure the journaled chunk driver; without a journal the "
+            "plain fit path would silently ignore them)")
+
+
+def _durable_fit(fit_fn, ts, checkpoint_dir, *, chunk_rows=None,
+                 chunk_budget_s=None, job_budget_s=None, resume="auto"):
+    """Route a compat fit through the journaled chunk driver.
+
+    The upstream Python API ran fits inside Spark tasks, whose lineage
+    made a long batch job survive executor loss; ``checkpoint_dir=`` on a
+    ``fit_model`` call is the panel-era equivalent — every finished chunk
+    is committed to a write-ahead journal (``reliability.journal``) and a
+    restarted call with the same data/config skips committed chunks
+    (results bitwise-identical to an uninterrupted run).  ``fit_fn`` is a
+    keyword-bound partial of the model-module fit so the journal's config
+    hash covers the hyperparameters.  Returns the ``[batch?, k]`` params
+    with single-series inputs debatched, like the plain path.
+    """
+    from .. import reliability as rel
+
+    a = jnp.asarray(ts)
+    single = a.ndim == 1
+    yb = jnp.atleast_2d(a)
+    res = rel.fit_chunked(
+        fit_fn, yb, chunk_rows=chunk_rows, resilient=False,
+        checkpoint_dir=checkpoint_dir, resume=resume,
+        chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
+    )
+    params = jnp.asarray(res.params)
+    return params[0] if single else params
+
+
 class _ModelBase:
     def __init__(self, params):
         self.params = jnp.asarray(params)
@@ -369,7 +408,23 @@ class ARIMAModel(_ModelBase):
 class ARIMA:
     @staticmethod
     def fit_model(p: int, d: int, q: int, ts, include_intercept: bool = True,
-                  method: str = "css-cgd", user_init_params=None) -> ARIMAModel:
+                  method: str = "css-cgd", user_init_params=None,
+                  checkpoint_dir: Optional[str] = None,
+                  **durable_kwargs) -> ARIMAModel:
+        """``checkpoint_dir=`` journals the fit for crash/preemption resume
+        (``reliability.fit_chunked``); ``chunk_rows`` / ``chunk_budget_s``
+        / ``job_budget_s`` / ``resume`` ride along to the chunk driver."""
+        if checkpoint_dir is not None:
+            import functools
+
+            params = _durable_fit(
+                functools.partial(_arima.fit, order=(p, d, q),
+                                  include_intercept=include_intercept,
+                                  method=method,
+                                  init_params=user_init_params),
+                ts, checkpoint_dir, **durable_kwargs)
+            return ARIMAModel(p, d, q, params, include_intercept)
+        _require_checkpoint_dir(durable_kwargs)
         res = _arima.fit(jnp.asarray(ts), (p, d, q), include_intercept,
                          method=method, init_params=user_init_params)
         return ARIMAModel(p, d, q, res.params, include_intercept)
@@ -431,7 +486,12 @@ class EWMAModel(_ModelBase):
 
 class EWMA:
     @staticmethod
-    def fit_model(ts) -> EWMAModel:
+    def fit_model(ts, checkpoint_dir: Optional[str] = None,
+                  **durable_kwargs) -> EWMAModel:
+        if checkpoint_dir is not None:
+            return EWMAModel(_durable_fit(_ewma.fit, ts, checkpoint_dir,
+                                          **durable_kwargs))
+        _require_checkpoint_dir(durable_kwargs)
         return EWMAModel(_ewma.fit(jnp.asarray(ts)).params)
 
 
@@ -466,7 +526,12 @@ class GARCHModel(_ModelBase):
 
 class GARCH:
     @staticmethod
-    def fit_model(ts) -> GARCHModel:
+    def fit_model(ts, checkpoint_dir: Optional[str] = None,
+                  **durable_kwargs) -> GARCHModel:
+        if checkpoint_dir is not None:
+            return GARCHModel(_durable_fit(_garch.fit, ts, checkpoint_dir,
+                                           **durable_kwargs))
+        _require_checkpoint_dir(durable_kwargs)
         return GARCHModel(_garch.fit(jnp.asarray(ts)).params)
 
 
@@ -508,11 +573,22 @@ class HoltWintersModel(_ModelBase):
 class HoltWinters:
     @staticmethod
     def fit_model(ts, period: int, model_type: str = "additive",
-                  method: str = "BOBYQA") -> HoltWintersModel:
+                  method: str = "BOBYQA",
+                  checkpoint_dir: Optional[str] = None,
+                  **durable_kwargs) -> HoltWintersModel:
         # upstream's only optimizer is BOBYQA; here the bounded problem is
         # solved by sigmoid-transformed L-BFGS, so both names map to it
         if method not in ("BOBYQA", "L-BFGS"):
             raise ValueError(f"unknown method {method!r} (supported: BOBYQA, L-BFGS)")
+        if checkpoint_dir is not None:
+            import functools
+
+            params = _durable_fit(
+                functools.partial(_hw.fit, period=period,
+                                  model_type=model_type),
+                ts, checkpoint_dir, **durable_kwargs)
+            return HoltWintersModel(params, period, model_type)
+        _require_checkpoint_dir(durable_kwargs)
         res = _hw.fit(jnp.asarray(ts), period, model_type=model_type)
         return HoltWintersModel(res.params, period, model_type)
 

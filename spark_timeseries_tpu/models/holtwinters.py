@@ -101,6 +101,19 @@ def sse(params, y, period: int, multiplicative: bool, n_valid=None):
 # with the compaction feature: utils.optim)
 _COMPACT_MIN_BATCH = optim.COMPACT_MIN_BATCH
 
+# seeded multi-start inits (natural (alpha, beta, gamma) space), probed in
+# order: the long-standing default first, then two deterministic probes at
+# opposite corners of the smoothing cube.  The multiplicative SSE surface is
+# non-convex with a fat local-optimum tail (PRECISION.md round 5: p99 drift
+# 0.74, f64 oracle non-converged on 9.8% of rows); re-running the optimizer
+# from 2-3 spread inits and keeping each row's best final objective
+# collapses that tail for ~(n_starts - 1) extra fit passes.
+_MULTISTART_NATS = (
+    (0.3, 0.1, 0.1),
+    (0.7, 0.25, 0.4),
+    (0.12, 0.05, 0.6),
+)
+
 
 def fit(
     y,
@@ -112,6 +125,7 @@ def fit(
     backend: str = "auto",
     count_evals: bool = False,
     compact: bool = True,
+    n_starts: Optional[int] = None,
 ) -> FitResult:
     """Fit (alpha, beta, gamma) per series -> params ``[batch?, 3]``.
 
@@ -120,16 +134,33 @@ def fit(
     or ``"auto"`` (pallas whenever the platform/dtype/period allow).
 
     ``count_evals=True`` (pallas backend only) returns ``(FitResult, info)``
-    with the optimizer's pass-accounting dict (``utils.optim``).
+    with the optimizer's pass-accounting dict (``utils.optim``; multi-start
+    fits report the FIRST start's passes plus an ``n_starts`` multiplier).
 
     ``compact=False`` disables straggler compaction for run-to-run
     reproducibility (it engages on the pallas backend at batches >=
     ``utils.optim.COMPACT_MIN_BATCH`` = 4096 and is a different compiled
     program — bitwise outputs can differ from the uncompacted run).
+
+    ``n_starts`` (default: 3 for multiplicative, 1 for additive; at most
+    ``len(_MULTISTART_NATS)`` = 3 — extend that table for more) runs the
+    optimizer from that many deterministic seeded inits
+    (``_MULTISTART_NATS``) and keeps each row's best final objective —
+    preferring converged starts — so rows stranded in a bad local optimum
+    of the non-convex (especially multiplicative) SSE surface are rescued
+    by a better basin instead of shipping a 0.7-drift parameter tail.
     ``FitResult.status`` carries per-row ``reliability.FitStatus`` codes."""
     if model_type not in ("additive", "multiplicative"):
         raise ValueError(f"model_type must be additive|multiplicative, got {model_type!r}")
     multiplicative = model_type == "multiplicative"
+    if n_starts is None:
+        n_starts = 3 if multiplicative else 1
+    if not 1 <= int(n_starts) <= len(_MULTISTART_NATS):
+        raise ValueError(
+            f"n_starts must be in [1, {len(_MULTISTART_NATS)}] (one per "
+            "seeded init in holtwinters._MULTISTART_NATS — extend that "
+            f"table to probe more basins), got {n_starts}")
+    n_starts = int(n_starts)
     yb, single = ensure_batched(y)
     if yb.shape[1] < 2 * period:
         raise ValueError(
@@ -143,20 +174,18 @@ def fit(
                               structural_ok=pk.hw_structural_ok(period))
     require_pallas_for_count_evals(count_evals, backend)
     out = _fit_program(period, multiplicative, max_iters, float(tol), backend,
-                       align_mode_on_host(yb), count_evals, compact)(yb)
+                       align_mode_on_host(yb), count_evals, compact,
+                       n_starts)(yb)
     return debatch_fit(out, single, count_evals)
 
 
 @jit_program
 def _fit_program(period, multiplicative, max_iters, tol, backend,
-                 align_mode="general", count_evals=False, compact=True):
+                 align_mode="general", count_evals=False, compact=True,
+                 n_starts=1):
     def run(yb):
         ya, nv = maybe_align(yb, align_mode)
 
-        nat0 = jnp.asarray([0.3, 0.1, 0.1], yb.dtype)
-        u0 = jnp.broadcast_to(
-            optim.interval_to_sigmoid(nat0, 0.0, 1.0), (yb.shape[0], 3)
-        )
         # optimize the MEAN one-step squared error: same argmin as the SSE,
         # but the gradient scale is O(1), so the relative grad-norm stopping
         # rule fires when the fit is actually done instead of never
@@ -166,10 +195,10 @@ def _fit_program(period, multiplicative, max_iters, tol, backend,
 
             interp = backend == "pallas-interpret"
 
-            # seeds are data-only: compute ONCE, not per objective call
-            # (vmapped seed slices are batched gathers — recomputed inside
-            # the loop they dominate an objective evaluation at panel scale;
-            # the dense mode takes the gather-free static-slice path)
+            # seeds are data-only: compute ONCE, not per objective call or
+            # per start (vmapped seed slices are batched gathers — recomputed
+            # inside the loop they dominate an objective evaluation at panel
+            # scale; the dense mode takes the gather-free static-slice path)
             seeds = pk.hw_seeds(
                 ya, period, multiplicative,
                 None if align_mode == "dense" else nv)
@@ -201,21 +230,79 @@ def _fit_program(period, multiplicative, max_iters, tol, backend,
 
                     return fb_s
 
-            res = optim.minimize_lbfgs_batched(
-                fb, u0, max_iters=max_iters, tol=tol, count_evals=count_evals,
-                straggler_fun=straggler_fun, straggler_cap=cap)
-            info = None
-            if count_evals:
-                res, info = res
+            def one_start(nat0, want_info):
+                u0 = jnp.broadcast_to(
+                    optim.interval_to_sigmoid(
+                        jnp.asarray(nat0, yb.dtype), 0.0, 1.0),
+                    (yb.shape[0], 3))
+                r = optim.minimize_lbfgs_batched(
+                    fb, u0, max_iters=max_iters, tol=tol,
+                    count_evals=want_info,
+                    straggler_fun=straggler_fun, straggler_cap=cap)
+                return r if want_info else (r, None)
         else:
             def objective(u, data):
                 yv, n, ne = data
                 nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
                 return sse(nat, yv, period, multiplicative, n) / ne
 
-            res = optim.batched_minimize(
-                objective, u0, (ya, nv, n_err), max_iters=max_iters, tol=tol
-            )
+            def one_start(nat0, want_info):
+                u0 = jnp.broadcast_to(
+                    optim.interval_to_sigmoid(
+                        jnp.asarray(nat0, yb.dtype), 0.0, 1.0),
+                    (yb.shape[0], 3))
+                r = optim.batched_minimize(
+                    objective, u0, (ya, nv, n_err), max_iters=max_iters,
+                    tol=tol)
+                return r, None
+
+        # seeded multi-start: run the optimizer from each init and keep,
+        # per row, the best basin.  Selection is two-stage and designed to
+        # be DETERMINISTIC ACROSS PRECISIONS (PRECISION.md: the
+        # multiplicative surface has near-tied local optima, and picking
+        # by raw SSE order lets f32 and f64 flip coins on which basin
+        # float noise ranks first, shipping a fat cross-precision
+        # parameter-drift tail):
+        #   1. candidates = converged starts (all starts when none
+        #      converged) within 0.1% relative of the row's best final
+        #      objective — statistically indistinguishable fits;
+        #   2. among candidates, prefer the SMOOTHEST model (smallest
+        #      alpha+beta+gamma; basins sit far apart in parameter space,
+        #      so this comparison is float-noise-robust), ties to the
+        #      earliest start.
+        # Pass accounting (count_evals) reports the first start's passes;
+        # n_starts rides in the info dict as a multiplier.
+        res, info = one_start(_MULTISTART_NATS[0], count_evals)
+        if info is not None:
+            info = {**info, "n_starts": n_starts}
+        if n_starts > 1:
+            starts = [res] + [one_start(_MULTISTART_NATS[s], False)[0]
+                              for s in range(1, n_starts)]
+            xs = jnp.stack([r.x for r in starts])  # [S, B, 3]
+            fs = jnp.stack([jnp.nan_to_num(r.f, nan=jnp.inf, posinf=jnp.inf)
+                            for r in starts])
+            convs = jnp.stack([r.converged for r in starts])
+            any_conv = convs.any(axis=0)
+            eligible = jnp.where(any_conv[None, :], convs, True)
+            f_elig = jnp.where(eligible, fs, jnp.inf)
+            best_f = jnp.min(f_elig, axis=0)
+            near = eligible & (f_elig <= best_f[None, :] * (1 + 1e-3) + 1e-12)
+            smooth = jnp.sum(
+                optim.sigmoid_to_interval(xs, 0.0, 1.0), axis=-1)
+            sel = jnp.argmin(jnp.where(near, smooth, jnp.inf), axis=0)
+            take = lambda field: jnp.take_along_axis(  # noqa: E731
+                jnp.stack([getattr(r, field) for r in starts]),
+                sel[None, :], axis=0)[0]
+            merged = {
+                "x": jnp.take_along_axis(
+                    xs, sel[None, :, None], axis=0)[0],
+                "f": take("f"),
+                "converged": take("converged"),
+                "iters": take("iters"),
+            }
+            if hasattr(res, "grad_norm"):
+                merged["grad_norm"] = take("grad_norm")
+            res = res._replace(**merged)
         ok = nv >= 2 * period  # seed needs two full seasons of real data
         params = jnp.where(
             ok[:, None], optim.sigmoid_to_interval(res.x, 0.0, 1.0), jnp.nan)

@@ -344,7 +344,10 @@ class TimeSeriesPanel:
         return out[: self.n_series]
 
     def fit(self, model, *, chunk_rows: Optional[int] = None,
-            resilient: bool = True, policy: str = "impute", **fit_kwargs):
+            resilient: bool = True, policy: str = "impute",
+            checkpoint_dir: Optional[str] = None, resume: str = "auto",
+            chunk_budget_s: Optional[float] = None,
+            job_budget_s: Optional[float] = None, **fit_kwargs):
         """Fit a model family over every series via the resilient chunk driver.
 
         ``model`` is a model-module name (``"arima"``, ``"garch"``,
@@ -357,11 +360,22 @@ class TimeSeriesPanel:
         (``reliability.resilient_fit``) so one poisoned series cannot take
         down the batch.
 
+        ``checkpoint_dir=`` makes the job DURABLE: every finished chunk is
+        committed to a write-ahead journal (``reliability.journal``) and a
+        restarted call with the same panel/config skips committed chunks,
+        producing results bitwise-identical to an uninterrupted run (a
+        stale or torn journal is rejected loudly — see
+        ``reliability.fit_chunked``).  ``chunk_budget_s`` / ``job_budget_s``
+        bound the fit's wall clock: overrunning chunks come back with rows
+        flagged ``FitStatus.TIMEOUT`` instead of hanging the job, and are
+        retried on the next journaled resume.
+
         Returns a ``reliability.ResilientFitResult`` whose rows align with
         ``self.keys``; ``.status`` carries per-series ``FitStatus`` codes
-        and ``.meta`` the chunk/ladder accounting.  This is the north-star
-        serving entry point: the batch analog of the reference mapping
-        ``fitModel`` over an RDD under Spark task retry.
+        and ``.meta`` the chunk/ladder/journal accounting.  This is the
+        north-star serving entry point: the batch analog of the reference
+        mapping ``fitModel`` over an RDD under Spark task retry — with the
+        journal standing in for RDD lineage.
         """
         if callable(model):
             fit_fn = model
@@ -376,7 +390,10 @@ class TimeSeriesPanel:
 
         return fit_chunked(
             fit_fn, self.series_values(), chunk_rows=chunk_rows,
-            resilient=resilient, policy=policy, **fit_kwargs,
+            resilient=resilient, policy=policy,
+            checkpoint_dir=checkpoint_dir, resume=resume,
+            chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
+            **fit_kwargs,
         )
 
     def lags(self, max_lag: int, include_original: bool = True,

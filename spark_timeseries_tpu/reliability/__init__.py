@@ -14,32 +14,54 @@ same guarantees at row granularity:
   backend) before any row is marked ``DIVERGED``.
 - :mod:`.chunked` — :func:`fit_chunked`: chunked execution with bounded
   ``RESOURCE_EXHAUSTED`` backoff and degradation recorded in metadata.
-- :mod:`.faultinject` — deterministic data and behavioral faults so every
-  ladder rung runs in tier-1 CPU tests.
+- :mod:`.journal` — :class:`ChunkJournal`: write-ahead per-chunk npz
+  shards + an atomic JSON manifest, so a journaled multi-chunk fit
+  (``fit_chunked(..., checkpoint_dir=...)``) survives process death and
+  resumes bitwise-identical, skipping committed chunks.
+- :mod:`.watchdog` — wall-clock deadlines for fit dispatch: overrunning
+  chunks are flagged ``TIMEOUT`` and the job degrades gracefully instead
+  of hanging past its SLO.
+- :mod:`.faultinject` — deterministic data, behavioral, and process
+  faults (forced non-convergence, simulated OOM, SIGKILL-after-commit,
+  torn manifests) so every recovery path runs in tier-1 CPU tests.
 """
 
-from . import chunked, faultinject, runner, sanitize, status
+from . import chunked, faultinject, journal, runner, sanitize, status, watchdog
 from .chunked import OOMBackoffExceeded, fit_chunked, is_resource_exhausted
+from .journal import (ChunkJournal, JournalError, StaleJournalError,
+                      TornManifestError, config_hash, panel_fingerprint)
 from .runner import (ResilientFitResult, RetryRung, default_ladder,
                      resilient_fit)
 from .sanitize import SanitizeReport, sanitize
 from .status import FitStatus, merge_status, status_counts
+from .watchdog import Deadline, DeadlineExceeded, call_with_deadline
 
 __all__ = [
+    "ChunkJournal",
+    "Deadline",
+    "DeadlineExceeded",
     "FitStatus",
+    "JournalError",
     "OOMBackoffExceeded",
     "ResilientFitResult",
     "RetryRung",
     "SanitizeReport",
+    "StaleJournalError",
+    "TornManifestError",
+    "call_with_deadline",
     "chunked",
+    "config_hash",
     "default_ladder",
     "faultinject",
     "fit_chunked",
     "is_resource_exhausted",
+    "journal",
     "merge_status",
+    "panel_fingerprint",
     "resilient_fit",
     "runner",
     "sanitize",
     "status",
     "status_counts",
+    "watchdog",
 ]

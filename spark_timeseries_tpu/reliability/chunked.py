@@ -1,4 +1,4 @@
-"""Chunked fit execution with HBM OOM backoff.
+"""Chunked fit execution: OOM backoff, chunk journal, deadline watchdog.
 
 The north-star workload (ROADMAP: 1M series x 1k obs) cannot always fit one
 monolithic batch in HBM — and the right chunk size depends on the model,
@@ -11,6 +11,21 @@ the batch analog of Spark re-running a too-big task after an executor OOM.
 Only allocation failures trigger backoff; every other error propagates
 unchanged (halving a chunk cannot fix a shape bug, and silently retrying
 would bury it).
+
+Above the backoff sit the two *job-level* durability layers Spark provided
+for free and a single Python process does not:
+
+- ``checkpoint_dir=`` attaches a write-ahead **chunk journal**
+  (:mod:`.journal`): every finished chunk is committed as an npz shard
+  plus an atomically updated manifest, and a restarted run SKIPS committed
+  chunks, producing results bitwise-identical to an uninterrupted run.
+- ``chunk_budget_s=`` / ``job_budget_s=`` arm the **deadline watchdog**
+  (:mod:`.watchdog`): a chunk that overruns its wall-clock budget is
+  marked ``FitStatus.TIMEOUT`` (rows NaN, journal entry ``TIMEOUT``) and
+  the walk continues; once the job budget is spent, remaining chunks are
+  marked TIMEOUT without dispatch.  The job always terminates with exact
+  per-row status counts instead of hanging past its SLO, and a later
+  resume retries only the TIMEOUT/pending chunks.
 """
 
 from __future__ import annotations
@@ -20,6 +35,8 @@ from typing import Callable, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from . import journal as journal_mod
+from . import watchdog as watchdog_mod
 from .runner import ResilientFitResult, resilient_fit
 from .status import STATUS_DTYPE, FitStatus, status_counts
 
@@ -50,6 +67,41 @@ def is_resource_exhausted(e: BaseException) -> bool:
     return any(m in msg for m in _OOM_MARKERS)
 
 
+def _device_peak_hbm() -> Optional[int]:
+    """Peak device-memory bytes, when the backend reports them (TPU does;
+    CPU's ``memory_stats()`` is ``None``)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:  # noqa: BLE001 - diagnostics only, never fail the fit
+        return None
+    peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+    return int(peak) if peak else None
+
+
+class _TimeoutChunk:
+    """Placeholder for a chunk whose fit never finished; materialized into
+    NaN-param / ``TIMEOUT``-status rows once the parameter width is known
+    (from any finished chunk) at assembly time."""
+
+    __slots__ = ("lo", "hi")
+
+    def __init__(self, lo: int, hi: int):
+        self.lo, self.hi = lo, hi
+
+
+def _commit_arrays(piece) -> dict:
+    """Host-side arrays of one finished chunk, in the journal shard schema."""
+    return {
+        "params": np.asarray(piece.params),
+        "nll": np.asarray(piece.neg_log_likelihood),
+        "converged": np.asarray(piece.converged),
+        "iters": np.asarray(piece.iters),
+        "status": _piece_status(piece),
+    }
+
+
 def fit_chunked(
     fit_fn: Callable,
     y,
@@ -60,6 +112,13 @@ def fit_chunked(
     resilient: bool = True,
     policy: str = "impute",
     ladder=None,
+    checkpoint_dir: Optional[str] = None,
+    resume: str = "auto",
+    chunk_budget_s: Optional[float] = None,
+    job_budget_s: Optional[float] = None,
+    process_index: Optional[int] = None,
+    journal_extra: Optional[dict] = None,
+    _journal_commit_hook=None,
     **fit_kwargs,
 ) -> ResilientFitResult:
     """Fit ``y [B, T]`` in row chunks of at most ``chunk_rows``.
@@ -72,10 +131,37 @@ def fit_chunked(
     ``max_backoffs`` times across the whole run; exhausting the budget (or
     OOMing at the floor) raises :class:`OOMBackoffExceeded`.
 
+    **Durability** (``checkpoint_dir=``): finished chunks are committed to
+    a write-ahead journal (:class:`~.journal.ChunkJournal`) — npz shard
+    first, then an atomic manifest update recording the row range, per-row
+    ``FitStatus`` counts, wall time, peak device memory, and the run's
+    config hash / panel fingerprint.  A restarted call with the same panel
+    and config (``resume="auto"``, the default) loads committed chunks
+    from their shards and recomputes only what is missing, so the final
+    result is bitwise-identical to an uninterrupted run; a journal written
+    under a different panel or config is rejected
+    (:class:`~.journal.StaleJournalError`), as is a torn manifest
+    (:class:`~.journal.TornManifestError`) — under EVERY resume mode: a
+    journal directory belongs to one (panel, config) job for its lifetime,
+    and a different job must claim a fresh directory (or the operator
+    removes the old one explicitly).  ``resume="never"`` reruns the same
+    job from scratch, ignoring its committed chunks; ``"require"`` demands
+    a resumable manifest.  Under
+    ``jax.distributed`` every process journals into its own namespace and
+    only process 0 commits the job-level ``manifest.json``
+    (``process_index`` defaults to ``jax.process_index()``).
+
+    **Deadlines**: ``chunk_budget_s`` bounds each chunk's fit dispatch
+    (overrun -> rows flagged ``TIMEOUT``, walk continues — the compiled
+    computation is abandoned, not cancelled); ``job_budget_s`` bounds the
+    whole walk (once spent, remaining chunks are marked TIMEOUT without
+    dispatch).  Partial results always carry exact status counts, and
+    TIMEOUT chunks are retried on a journaled resume.
+
     ``meta`` records ``chunk_rows_initial`` / ``chunk_rows_final``, every
-    backoff event, and ``degraded=True`` whenever a backoff happened — so
-    a production driver can see that a run survived by shrinking, not
-    just that it finished.
+    backoff and timeout event, ``degraded=True`` whenever a backoff or
+    timeout happened, and — when journaled — the journal accounting
+    (``meta["journal"]``: run id, chunks committed/resumed/timeout).
     """
     yb = jnp.asarray(y)
     if yb.ndim != 2:
@@ -85,26 +171,130 @@ def fit_chunked(
     chunk = max(1, min(chunk, b))
     chunk0 = chunk
 
+    journal = None
+    if checkpoint_dir is not None:
+        if process_index is None:
+            try:
+                import jax
+
+                process_index = jax.process_index()
+            except Exception:  # noqa: BLE001 - no backend yet: single process
+                process_index = 0
+        cfg = journal_mod.config_hash(
+            fit_fn, fit_kwargs,
+            extra={"chunk_rows": chunk0, "min_chunk_rows": min_chunk_rows,
+                   "resilient": resilient, "policy": policy,
+                   "ladder": "default" if ladder is None else repr(ladder)})
+        journal = journal_mod.ChunkJournal(
+            checkpoint_dir,
+            config_hash=cfg,
+            panel_fingerprint=journal_mod.panel_fingerprint(yb),
+            n_rows=b,
+            chunk_rows=chunk0,
+            resume=resume,
+            process_index=process_index,
+            extra=journal_extra,
+            commit_hook=_journal_commit_hook,
+        )
+    deadline = watchdog_mod.Deadline(job_budget_s)
+
+    import time as _time
+
     pieces = []
     oom_events = []
+    timeout_events = []
+    # boundaries of committed-but-unloadable (torn-shard) chunks: the
+    # recompute must cover the EXACT recorded [lo, hi) — deriving hi from
+    # the current chunk size could overlap a later committed chunk and
+    # break the bitwise-identical-boundaries contract
+    lost_boundaries: dict = {}
     lo = 0
     while lo < b:
-        hi = min(lo + chunk, b)
+        if journal is not None:
+            entry = journal.committed(lo)
+            if entry is not None:
+                piece = journal.load_chunk(entry)
+                if piece is not None:
+                    pieces.append(piece)
+                    lo = entry["hi"]
+                    # replay the backoff state in effect when the chunk
+                    # committed, so the resumed walk visits the SAME
+                    # boundaries the uninterrupted run would have
+                    chunk = int(entry.get("chunk_rows_after", chunk))
+                    continue
+                lost_boundaries[lo] = (
+                    int(entry["hi"]),
+                    int(entry.get("chunk_rows_after", chunk)))
+        forced = lost_boundaries.get(lo)
+        hi = forced[0] if forced else min(lo + chunk, b)
+        if journal is not None and not forced:
+            # keep the walk on the committed grid: after an OOM backoff
+            # whose halving does not divide the original chunk size, a
+            # free-running hi would sail past the next committed chunk's
+            # lo, orphaning it (never matched again) and double-counting
+            # its rows in the manifest — clamp to the boundary instead
+            nxt = journal.next_committed_lo(lo)
+            if nxt is not None and nxt < hi:
+                hi = nxt
+        if deadline.exceeded():
+            if forced:
+                chunk = forced[1]
+                lost_boundaries.pop(lo, None)
+            timeout_events.append({
+                "at_row": lo, "chunk_rows": hi - lo, "dispatched": False,
+                "budget_s": deadline.budget_s, "scope": "job"})
+            pieces.append(_TimeoutChunk(lo, hi))
+            if journal is not None:
+                journal.mark_timeout(lo, hi, scope="job",
+                                     budget_s=deadline.budget_s,
+                                     chunk_rows_after=chunk)
+            lo = hi
+            continue
         # whole-panel chunk: hand the caller's array through untouched (a
         # slice would be a fresh device buffer — an extra HBM copy, and a
         # miss in the per-array-identity align-mode cache callers pre-warm)
         vals = yb if (lo == 0 and hi == b) else yb[lo:hi]
-        try:
+
+        def run_chunk(vals=vals):
             if resilient:
-                piece = resilient_fit(
-                    fit_fn, vals, policy=policy, ladder=ladder,
-                    **fit_kwargs,
-                )
-            else:
-                piece = fit_fn(vals, **fit_kwargs)
+                return resilient_fit(
+                    fit_fn, vals, policy=policy, ladder=ladder, **fit_kwargs)
+            return fit_fn(vals, **fit_kwargs)
+
+        t0 = _time.perf_counter()
+        try:
+            piece = watchdog_mod.call_with_deadline(
+                run_chunk, chunk_budget_s, label=f"chunk rows [{lo}, {hi})")
+        except watchdog_mod.DeadlineExceeded:
+            if forced:
+                chunk = forced[1]
+                lost_boundaries.pop(lo, None)
+            timeout_events.append({
+                "at_row": lo, "chunk_rows": hi - lo, "dispatched": True,
+                "budget_s": chunk_budget_s, "scope": "chunk"})
+            pieces.append(_TimeoutChunk(lo, hi))
+            if journal is not None:
+                journal.mark_timeout(lo, hi, scope="chunk",
+                                     budget_s=chunk_budget_s,
+                                     chunk_rows_after=chunk)
+            lo = hi
+            continue
         except Exception as e:  # noqa: BLE001 - filtered just below
             if not is_resource_exhausted(e):
                 raise
+            if forced:
+                # a torn-shard recompute is pinned to the committed
+                # [lo, hi): halving `chunk` would not shrink the dispatch
+                # (hi stays forced), so retrying is futile — fail with the
+                # actionable cause instead of burning the backoff budget
+                raise OOMBackoffExceeded(
+                    f"recompute of torn-shard chunk [{lo}, {hi}) hit "
+                    "RESOURCE_EXHAUSTED; its boundaries are fixed by the "
+                    "journal, so backoff cannot help. Free device memory, "
+                    "or restart the job under a fresh checkpoint_dir (or "
+                    "remove this journal explicitly) to let the walk "
+                    "re-chunk."
+                ) from e
             oom_events.append({
                 "at_row": lo, "chunk_rows": chunk,
                 "error": f"{type(e).__name__}: {e}"[:200],
@@ -116,14 +306,45 @@ def fit_chunked(
                 ) from e
             chunk = max(min_chunk_rows, chunk // 2)
             continue
+        if forced:  # torn-shard recompute done: restore the recorded walk
+            chunk = forced[1]
+            lost_boundaries.pop(lo, None)
+        if journal is not None:
+            arrays = _commit_arrays(piece)
+            journal.commit_chunk(
+                lo, hi, arrays,
+                wall_s=round(_time.perf_counter() - t0, 4),
+                peak_hbm_bytes=_device_peak_hbm(),
+                chunk_rows_after=chunk,
+                status_counts=status_counts(arrays["status"]),
+            )
         pieces.append(piece)
         lo = hi
 
-    params = np.concatenate([np.asarray(p.params) for p in pieces])
-    nll = np.concatenate([np.asarray(p.neg_log_likelihood) for p in pieces])
-    conv = np.concatenate([np.asarray(p.converged) for p in pieces])
-    iters = np.concatenate([np.asarray(p.iters) for p in pieces])
-    status = np.concatenate([_piece_status(p) for p in pieces])
+    # parameter width for synthesized TIMEOUT rows comes from any finished
+    # chunk; an all-TIMEOUT job degenerates to a single NaN column
+    k = next((int(np.asarray(p.params).shape[-1]) for p in pieces
+              if not isinstance(p, _TimeoutChunk)), 1)
+    dtype = np.dtype(str(yb.dtype))
+
+    def _mat(p):
+        if isinstance(p, _TimeoutChunk):
+            n = p.hi - p.lo
+            return (np.full((n, k), np.nan, dtype),
+                    np.full(n, np.nan, dtype),
+                    np.zeros(n, bool),
+                    np.zeros(n, np.int32),
+                    np.full(n, FitStatus.TIMEOUT, STATUS_DTYPE))
+        return (np.asarray(p.params), np.asarray(p.neg_log_likelihood),
+                np.asarray(p.converged), np.asarray(p.iters),
+                _piece_status(p))
+
+    mats = [_mat(p) for p in pieces]
+    params = np.concatenate([m[0] for m in mats])
+    nll = np.concatenate([m[1] for m in mats])
+    conv = np.concatenate([m[2] for m in mats])
+    iters = np.concatenate([m[3] for m in mats])
+    status = np.concatenate([m[4] for m in mats])
 
     meta = {
         "chunk_rows_initial": chunk0,
@@ -131,9 +352,13 @@ def fit_chunked(
         "chunks_run": len(pieces),
         "oom_backoffs": len(oom_events),
         "oom_events": oom_events,
-        "degraded": bool(oom_events),
+        "timeouts": len(timeout_events),
+        "timeout_events": timeout_events,
+        "degraded": bool(oom_events or timeout_events),
         "status_counts": status_counts(status),
     }
+    if journal is not None:
+        meta["journal"] = journal.accounting()
     # ladder/sanitize accounting aggregated across chunks (resilient mode)
     rung_totals: dict = {}
     for p in pieces:

@@ -17,11 +17,23 @@ failing as the ladder gathers it into retry sub-batches — and
 :func:`oom_fit` raises a ``RESOURCE_EXHAUSTED``-marked error whenever the
 batch exceeds a row threshold, driving the chunk driver's backoff without
 a real allocation failure.
+
+**Process/durability faults** (ISSUE 2 — the chunk journal and watchdog
+must be exercisable in tier-1 CPU tests): :func:`hanging_fit` stalls
+designated fit calls past any watchdog budget; :func:`kill_after_commits`
+and :func:`crash_after_commits` are journal commit hooks that SIGKILL the
+process / raise mid-run after N durable chunk commits (between or mid
+commit, selectable), simulating preemption exactly where it hurts; and
+:func:`tear_file` truncates a manifest or shard to a prefix, simulating a
+torn write on a non-atomic filesystem.
 """
 
 from __future__ import annotations
 
 import functools
+import os
+import signal
+import time
 from typing import Callable
 
 import numpy as np
@@ -29,6 +41,7 @@ import numpy as np
 from .status import STATUS_DTYPE, FitStatus
 
 __all__ = [
+    "SimulatedCrash",
     "SimulatedResourceExhausted",
     "inject_nan_rows",
     "inject_inf_rows",
@@ -38,6 +51,10 @@ __all__ = [
     "nonspd_gram",
     "failing_fit",
     "oom_fit",
+    "hanging_fit",
+    "kill_after_commits",
+    "crash_after_commits",
+    "tear_file",
 ]
 
 
@@ -209,3 +226,88 @@ def oom_fit(fit_fn: Callable, max_rows: int) -> Callable:
         return fit_fn(yb, **kwargs)
 
     return wrapped
+
+
+# ---------------------------------------------------------------------------
+# process / durability faults (chunk journal + deadline watchdog)
+# ---------------------------------------------------------------------------
+
+
+class SimulatedCrash(BaseException):
+    """In-process stand-in for a SIGKILL: derives from ``BaseException`` so
+    no ``except Exception`` recovery path can accidentally swallow it — the
+    journaled driver must survive by durability, not by catching it."""
+
+
+def hanging_fit(fit_fn: Callable, hang_calls, sleep_s: float = 30.0) -> Callable:
+    """Wrap ``fit_fn`` so the given (0-based) call indices stall ``sleep_s``
+    before fitting — a stand-in for a hung compile or pathological optimizer
+    tail.  With a ``chunk_budget_s`` below ``sleep_s`` the watchdog abandons
+    the call and marks the chunk TIMEOUT; the abandoned worker thread wakes
+    later, runs the real fit, and its result is discarded.  One fit call per
+    chunk (``resilient=False``) makes the call index the chunk index."""
+    hang = set(int(i) for i in np.atleast_1d(hang_calls))
+    state = {"calls": 0}
+
+    @functools.wraps(fit_fn)
+    def wrapped(yb, **kwargs):
+        i = state["calls"]
+        state["calls"] += 1
+        if i in hang:
+            time.sleep(sleep_s)
+        return fit_fn(yb, **kwargs)
+
+    return wrapped
+
+
+def kill_after_commits(n: int, *, mid_commit: bool = False) -> Callable:
+    """Journal commit hook that SIGKILLs THIS process after ``n`` chunks
+    have been made durable — no atexit, no cleanup, exactly like a
+    preemption.  ``mid_commit=True`` kills after the nth shard is written
+    but BEFORE the manifest names it (the orphan-shard window the
+    write-ahead ordering must make recoverable); otherwise the kill lands
+    after the manifest update (between chunks).  Pass as
+    ``fit_chunked(..., _journal_commit_hook=...)`` in a subprocess.
+    """
+    event = "shard_written" if mid_commit else "committed"
+    seen = {"n": 0}
+
+    def hook(ev: str, lo: int) -> None:
+        if ev != event:
+            return
+        seen["n"] += 1
+        if seen["n"] >= n:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    return hook
+
+
+def crash_after_commits(n: int, *, mid_commit: bool = False) -> Callable:
+    """Like :func:`kill_after_commits` but raises :class:`SimulatedCrash`
+    instead of dying — the in-process variant for tests that want to crash
+    and resume inside one interpreter (same journal state on disk, no
+    subprocess round trip)."""
+    event = "shard_written" if mid_commit else "committed"
+    seen = {"n": 0}
+
+    def hook(ev: str, lo: int) -> None:
+        if ev != event:
+            return
+        seen["n"] += 1
+        if seen["n"] >= n:
+            raise SimulatedCrash(
+                f"simulated process death after {n} {event} events")
+
+    return hook
+
+
+def tear_file(path: str, keep_frac: float = 0.5) -> None:
+    """Truncate ``path`` to a prefix, simulating a torn write (a crash on a
+    filesystem without atomic replace, or a partially flushed page).  Torn
+    manifests must be REJECTED on resume (``TornManifestError``), torn
+    shards silently downgraded to a recompute."""
+    size = os.path.getsize(path)
+    keep = max(1, int(size * keep_frac))
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+

@@ -1,0 +1,446 @@
+"""Write-ahead chunk journal: whole-job durability for panel fits.
+
+Upstream spark-timeseries inherited *job-level* durability from Spark
+itself: RDD lineage meant a lost executor or a preempted node only
+recomputed its partitions, and a restarted driver replayed the DAG from the
+last materialized stage.  The TPU rebuild runs a multi-chunk panel fit in
+one Python process, so a SIGKILL, TPU preemption, or hung compile at chunk
+7 of 8 would lose every finished chunk.  This module is the replacement
+lineage: a directory holding
+
+- one **npz result shard per committed chunk** (params / nll / converged /
+  iters / status for its row range), written tmp-then-``os.replace`` so a
+  shard either exists whole or not at all; and
+- an atomically updated **JSON manifest** recording the run id, git commit,
+  panel fingerprint, fit-config hash, and — per chunk — the row range,
+  status (``committed`` / ``TIMEOUT``), ``FitStatus`` counts, wall time,
+  and peak device memory.
+
+Write-ahead ordering: the shard is durable *before* the manifest names it,
+so a crash between the two leaves an orphan shard that is simply
+recomputed — the manifest never references bytes that might not exist.
+
+**Resume contract** (``reliability.fit_chunked(..., checkpoint_dir=...)``):
+on restart with the same panel and fit config, committed chunks load from
+their shards and only pending/TIMEOUT chunks recompute, producing results
+bitwise-identical to an uninterrupted run (same chunk boundaries -> same
+compiled programs over the same rows; a chunk's committed bytes ARE the
+bytes the uninterrupted run produced).  A manifest whose config hash or
+panel fingerprint does not match is STALE — resuming under it would splice
+rows fitted under a different model/config into the result — and is
+rejected loudly (:class:`StaleJournalError`); an unparseable manifest is a
+torn write from a mid-commit crash of a non-atomic filesystem and is also
+rejected (:class:`TornManifestError`) rather than silently started over.
+
+**Multi-host ownership**: every process journals into its own namespace
+(``proc_00001/...``) with a process-local manifest, but only process 0
+commits ``manifest.json`` — the job-level manifest tooling and post-mortems
+read (``tools/inspect_journal.py``) — mirroring the Spark driver being the
+single writer of job state while executors own their shuffle files.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import os
+import subprocess
+import tempfile
+import time
+import uuid
+import zipfile
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = [
+    "ChunkJournal",
+    "JournalError",
+    "LoadedChunk",
+    "StaleJournalError",
+    "TornManifestError",
+    "config_hash",
+    "panel_fingerprint",
+]
+
+JOURNAL_VERSION = 1
+MANIFEST = "manifest.json"
+RESUME_MODES = ("auto", "require", "never")
+
+
+class JournalError(RuntimeError):
+    """Base class for journal failures."""
+
+
+class TornManifestError(JournalError):
+    """The manifest exists but does not parse — a torn/partial write."""
+
+
+class StaleJournalError(JournalError):
+    """The manifest belongs to a different panel or fit configuration."""
+
+
+def _array_digest(v) -> str:
+    """Shape + dtype + content digest of an array-valued fit kwarg.
+
+    Contents MUST count: two ``init_params`` arrays of equal shape are
+    different fit configurations, and accepting a journal across them
+    would splice rows fitted under the other init.  Large arrays hash a
+    deterministic strided subsample (same trust argument as
+    :func:`panel_fingerprint`)."""
+    a = np.asarray(v)
+    if a.size > 1 << 20:
+        step = -(-a.size // (1 << 20))
+        a = np.ascontiguousarray(a.reshape(-1)[::step])
+    digest = hashlib.sha256(np.ascontiguousarray(a).tobytes()).hexdigest()[:12]
+    return f"array{tuple(np.shape(v))}:{np.asarray(v).dtype}:{digest}"
+
+
+def config_hash(fit_fn: Callable, fit_kwargs: dict,
+                extra: Optional[dict] = None) -> str:
+    """Stable hash of everything that decides what a chunk's bytes mean.
+
+    Covers the fit function's identity (``functools.partial`` layers are
+    unwrapped and their bound arguments included), every fit kwarg (arrays
+    by shape, dtype, AND a content digest — a different ``init_params`` is
+    a different config), and driver-level knobs passed via ``extra``
+    (chunk size, resilient mode, ...).  Two runs with equal hashes over
+    the same panel produce interchangeable shards; a mismatch on resume
+    means the journal is stale and must not be spliced into the new run.
+    """
+    layers = []
+    f = fit_fn
+    while isinstance(f, functools.partial):
+        layers.append([
+            repr(tuple(_enc(a) for a in f.args)),
+            repr(sorted((k, _enc(v)) for k, v in (f.keywords or {}).items())),
+        ])
+        f = f.func
+    name = (getattr(f, "__module__", "?") + "."
+            + getattr(f, "__qualname__", repr(f)))
+    kv = sorted((k, _enc(v)) for k, v in fit_kwargs.items())
+    ex = sorted((k, _enc(v)) for k, v in (extra or {}).items())
+    blob = json.dumps([name, layers, kv, ex], default=repr)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _enc(v):
+    """Hashable text encoding of one fit-kwarg value (see config_hash)."""
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        return _array_digest(v)
+    return repr(v)
+
+
+def panel_fingerprint(y, max_side: int = 256) -> str:
+    """Cheap content fingerprint of a ``[B, T]`` panel.
+
+    Hashes the shape, dtype, and a deterministic strided subsample of at
+    most ``max_side**2`` raw values (bit patterns, so NaN placement
+    counts).  The subsample keeps the device->host transfer a few hundred
+    KB even for the million-series panel; a journal is rejected as stale
+    when the fingerprint differs, so collisions only risk *accepting* a
+    journal for a panel that agrees on every sampled byte — the same
+    trust level a size+mtime check gives, at content strength.
+    """
+    b, t = int(y.shape[0]), int(y.shape[1])
+    sr, sc = max(1, -(-b // max_side)), max(1, -(-t // max_side))
+    sample = np.ascontiguousarray(np.asarray(y[::sr, ::sc]))
+    h = hashlib.sha256()
+    h.update(f"{b}x{t}:{sample.dtype}".encode())
+    h.update(sample.tobytes())
+    return h.hexdigest()[:16]
+
+
+def _git_commit(root: Optional[str] = None) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "-C", root or os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+             "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        )
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp -> fsync -> ``os.replace``: the file is whole or absent."""
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-", suffix=os.path.basename(path))
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class LoadedChunk:
+    """A committed chunk rehydrated from its shard (duck-types the result
+    pieces ``fit_chunked`` assembles: ``params`` / ``neg_log_likelihood`` /
+    ``converged`` / ``iters`` / ``status`` / ``meta``)."""
+
+    __slots__ = ("params", "neg_log_likelihood", "converged", "iters",
+                 "status", "meta")
+
+    def __init__(self, z, entry: dict):
+        self.params = z["params"]
+        self.neg_log_likelihood = z["nll"]
+        self.converged = z["converged"]
+        self.iters = z["iters"]
+        self.status = z["status"]
+        self.meta = {"resumed_from_journal": True, "lo": entry["lo"],
+                     "hi": entry["hi"]}
+
+
+class ChunkJournal:
+    """Directory-backed chunk journal (see module docstring).
+
+    ``resume``: ``"auto"`` adopts a compatible existing manifest (and
+    starts fresh when none exists), ``"require"`` demands one,
+    ``"never"`` ignores any prior state and starts a fresh run (existing
+    entries are dropped from the new manifest; shard files are
+    overwritten as their chunks recommit).  Stale and torn manifests
+    raise under every mode — deleting a journal is the operator's
+    explicit act, never a side effect.
+
+    ``process_index`` selects the namespace: process 0 owns the job-level
+    ``manifest.json`` at the directory root; every other process works
+    under ``proc_{i:05d}/`` with a manifest named for it, so concurrent
+    multi-host writers never race on one file.
+
+    ``commit_hook(event, lo)`` is a test/fault-injection surface called
+    with ``"shard_written"`` (shard durable, manifest not yet updated) and
+    ``"committed"`` (manifest updated) — ``reliability.faultinject`` uses
+    it to kill the process at either point.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        config_hash: str,
+        panel_fingerprint: str,
+        n_rows: int,
+        chunk_rows: int,
+        resume: str = "auto",
+        process_index: int = 0,
+        extra: Optional[dict] = None,
+        commit_hook: Optional[Callable[[str, int], None]] = None,
+    ):
+        if resume not in RESUME_MODES:
+            raise ValueError(f"resume must be one of {RESUME_MODES}, got {resume!r}")
+        self.process_index = int(process_index)
+        root = os.path.abspath(directory)
+        self.dir = root if self.process_index == 0 else os.path.join(
+            root, f"proc_{self.process_index:05d}")
+        os.makedirs(self.dir, exist_ok=True)
+        self.manifest_path = os.path.join(
+            self.dir,
+            MANIFEST if self.process_index == 0
+            else f"manifest.proc_{self.process_index:05d}.json")
+        self.config_hash = config_hash
+        self.panel_fingerprint = panel_fingerprint
+        self.n_rows = int(n_rows)
+        self.run_id = uuid.uuid4().hex[:12]
+        self._commit_hook = commit_hook
+        self.resumed_entries = 0
+
+        prior = self._load_manifest() if resume != "never" else None
+        if resume == "never":
+            # a torn/stale manifest still must not be silently destroyed:
+            # surface it even though we will not resume from it
+            self._load_manifest()
+        if resume == "require" and prior is None:
+            raise JournalError(
+                f"resume='require' but no manifest at {self.manifest_path}")
+        if prior is not None:
+            self._manifest = prior
+            head = _git_commit()
+            if head and prior.get("git_commit") and head != prior["git_commit"]:
+                # same config hash across a code upgrade can still mean
+                # different numerics (a changed model default); surface it —
+                # the operator decides whether mixed-code chunks are fine
+                import warnings
+
+                warnings.warn(
+                    f"resuming journal {self.manifest_path} written at git "
+                    f"commit {prior['git_commit'][:12]} from {head[:12]}: "
+                    "committed chunks were fitted by the older code",
+                    stacklevel=3,
+                )
+            self._manifest.setdefault("resumes", []).append(
+                {"run_id": self.run_id, "at": time.time(),
+                 "git_commit": head})
+        else:
+            self._manifest = {
+                "journal_version": JOURNAL_VERSION,
+                "run_id": self.run_id,
+                "created_at": time.time(),
+                "git_commit": _git_commit(),
+                "config_hash": config_hash,
+                "panel_fingerprint": panel_fingerprint,
+                "n_rows": self.n_rows,
+                "chunk_rows": int(chunk_rows),
+                "process_index": self.process_index,
+                "extra": dict(extra or {}),
+                "resumes": [],
+                "chunks": [],
+            }
+            self._write_manifest()
+        self._by_lo = {e["lo"]: e for e in self._manifest["chunks"]}
+
+    # -- manifest I/O -------------------------------------------------------
+
+    def _load_manifest(self) -> Optional[dict]:
+        if not os.path.exists(self.manifest_path):
+            return None
+        try:
+            with open(self.manifest_path, "rb") as f:
+                m = json.loads(f.read().decode())
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise TornManifestError(
+                f"{self.manifest_path} does not parse ({e}); a mid-commit "
+                "crash tore the write. Inspect/remove the journal directory "
+                "explicitly — it will not be silently overwritten."
+            ) from e
+        mismatches = []
+        if m.get("config_hash") != self.config_hash:
+            mismatches.append(
+                f"config_hash {m.get('config_hash')} != {self.config_hash}")
+        if m.get("panel_fingerprint") != self.panel_fingerprint:
+            mismatches.append(
+                f"panel_fingerprint {m.get('panel_fingerprint')} != "
+                f"{self.panel_fingerprint}")
+        if int(m.get("n_rows", -1)) != self.n_rows:
+            mismatches.append(f"n_rows {m.get('n_rows')} != {self.n_rows}")
+        if mismatches:
+            raise StaleJournalError(
+                f"{self.manifest_path} was written by a different run "
+                f"({'; '.join(mismatches)}). Resuming would splice rows "
+                "fitted under a different panel/config into this result; "
+                "point checkpoint_dir at a fresh directory or remove the "
+                "stale journal explicitly."
+            )
+        return m
+
+    def _write_manifest(self) -> None:
+        self._manifest["updated_at"] = time.time()
+        _atomic_write_bytes(
+            self.manifest_path,
+            (json.dumps(self._manifest, indent=1, sort_keys=True) + "\n").encode())
+
+    # -- chunk lifecycle ----------------------------------------------------
+
+    def _shard_name(self, lo: int, hi: int) -> str:
+        return f"chunk_{lo:09d}_{hi:09d}.npz"
+
+    def committed(self, lo: int) -> Optional[dict]:
+        """The committed manifest entry starting at row ``lo``, if any."""
+        e = self._by_lo.get(int(lo))
+        return e if e is not None and e["status"] == "committed" else None
+
+    def next_committed_lo(self, lo: int) -> Optional[int]:
+        """Smallest committed-chunk start strictly beyond ``lo`` — the
+        boundary a recomputing walk must not run past."""
+        starts = [e["lo"] for e in self._manifest["chunks"]
+                  if e["status"] == "committed" and e["lo"] > int(lo)]
+        return min(starts) if starts else None
+
+    def load_chunk(self, entry: dict) -> Optional[LoadedChunk]:
+        """Rehydrate a committed chunk; ``None`` (recompute) when the shard
+        is missing or unreadable — a shard torn by a crash downgrades to a
+        recompute, never to corrupt rows."""
+        path = os.path.join(self.dir, entry["shard"])
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                piece = LoadedChunk({k: z[k] for k in
+                                     ("params", "nll", "converged", "iters",
+                                      "status")}, entry)
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+            entry["status"] = "shard-lost"
+            self._write_manifest()
+            self._by_lo.pop(entry["lo"], None)
+            return None
+        if piece.params.shape[0] != entry["hi"] - entry["lo"]:
+            entry["status"] = "shard-lost"
+            self._write_manifest()
+            self._by_lo.pop(entry["lo"], None)
+            return None
+        self.resumed_entries += 1  # resumed = actually rehydrated, not
+        return piece               # merely listed (a torn shard recomputes)
+
+    def _record(self, entry: dict) -> None:
+        self._manifest["chunks"] = [
+            e for e in self._manifest["chunks"] if e["lo"] != entry["lo"]]
+        self._manifest["chunks"].append(entry)
+        self._manifest["chunks"].sort(key=lambda e: e["lo"])
+        self._by_lo[entry["lo"]] = entry
+        self._write_manifest()
+        if self._commit_hook is not None:
+            # "committed" fires only for durable result chunks: a TIMEOUT
+            # mark is bookkeeping, and kill_after_commits counting it would
+            # shift the crash window the harness means to exercise
+            event = ("committed" if entry["status"] == "committed"
+                     else "timeout_recorded")
+            self._commit_hook(event, entry["lo"])
+
+    def commit_chunk(self, lo: int, hi: int, arrays: dict, **info) -> dict:
+        """Write the shard durably, THEN name it in the manifest."""
+        lo, hi = int(lo), int(hi)
+        shard = self._shard_name(lo, hi)
+        path = os.path.join(self.dir, shard)
+        fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".tmp-", suffix=".npz")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        if self._commit_hook is not None:
+            self._commit_hook("shard_written", lo)
+        entry = {"lo": lo, "hi": hi, "status": "committed", "shard": shard,
+                 "run_id": self.run_id, "committed_at": time.time(), **info}
+        self._record(entry)
+        return entry
+
+    def mark_timeout(self, lo: int, hi: int, **info) -> dict:
+        """Record a chunk that overran its budget (no shard: a resume
+        retries it — ``committed()`` skips non-committed entries)."""
+        entry = {"lo": int(lo), "hi": int(hi), "status": "TIMEOUT",
+                 "run_id": self.run_id, "committed_at": time.time(), **info}
+        self._record(entry)
+        return entry
+
+    # -- summary ------------------------------------------------------------
+
+    def accounting(self) -> dict:
+        """Job-level journal metadata for result ``meta`` / bench artifacts."""
+        chunks = self._manifest["chunks"]
+        return {
+            "dir": self.dir,
+            "manifest": os.path.basename(self.manifest_path),
+            "run_id": self.run_id,
+            "config_hash": self.config_hash,
+            "process_index": self.process_index,
+            "chunks_committed": sum(1 for e in chunks
+                                    if e["status"] == "committed"),
+            "chunks_timeout": sum(1 for e in chunks
+                                  if e["status"] == "TIMEOUT"),
+            "chunks_resumed": self.resumed_entries,
+            "resumes": len(self._manifest.get("resumes", [])),
+        }

@@ -25,6 +25,9 @@ code  name        meaning
                   instead of poisoning the batch
 5     EXCLUDED    input rejected before/without fitting (all-NaN,
                   constant, too short, or policy="exclude" hit)
+6     TIMEOUT     the chunk holding the row overran its wall-clock
+                  budget (reliability.watchdog); the fit never
+                  finished, params are NaN
 ====  ==========  ====================================================
 """
 
@@ -44,6 +47,7 @@ class FitStatus(enum.IntEnum):
     FALLBACK = 3
     DIVERGED = 4
     EXCLUDED = 5
+    TIMEOUT = 6
 
 
 # dtype every status array uses (device and host side)

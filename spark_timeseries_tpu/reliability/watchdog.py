@@ -1,0 +1,107 @@
+"""Deadline watchdog: wall-clock budgets for compiled fit dispatch.
+
+Spark bounded a runaway job twice over — ``spark.task.maxFailures`` killed a
+task that would not finish, and the driver's scheduler could abandon a stage
+that blew its allotment.  The TPU rebuild dispatches one compiled program
+per chunk, and a hung compile or a pathological optimizer tail has nothing
+above it to pull the plug: the job simply never returns.  This module
+rebuilds the bound at the two granularities the chunk driver works in:
+
+- **per-chunk budget** (:func:`call_with_deadline`): the chunk's fit runs in
+  a worker thread; if it has not produced a result within ``budget_s`` the
+  driver gets :class:`DeadlineExceeded` and moves on, marking the chunk's
+  rows ``FitStatus.TIMEOUT`` (and the chunk ``TIMEOUT`` in the journal when
+  one is attached).  The overrunning computation is ABANDONED, not
+  cancelled — XLA dispatch is not interruptible from Python — so its thread
+  may finish in the background; its results are discarded either way.
+- **per-job budget** (:class:`Deadline`): a monotonic wall-clock allotment
+  for the whole chunk walk.  Once spent, remaining chunks are marked
+  ``TIMEOUT`` *without dispatch*, so a journaled job always terminates with
+  an accurate per-chunk account instead of hanging past its SLO.
+
+Both degrade gracefully by design: a timed-out chunk never aborts the job;
+finished chunks keep their results and the partial output reports exact
+per-row status counts.  A later resume (``checkpoint_dir=``) retries only
+the TIMEOUT/pending chunks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["Deadline", "DeadlineExceeded", "call_with_deadline"]
+
+
+class DeadlineExceeded(RuntimeError):
+    """A fit dispatch (or the whole job) overran its wall-clock budget."""
+
+    def __init__(self, label: str, budget_s: float):
+        super().__init__(
+            f"{label or 'fit dispatch'} exceeded its {budget_s:g}s wall-clock "
+            "budget (reliability.watchdog)"
+        )
+        self.label = label
+        self.budget_s = budget_s
+
+
+class Deadline:
+    """A monotonic wall-clock allotment for a whole job.
+
+    ``budget_s=None`` means unbounded (every query answers "plenty left").
+    The clock starts at construction — build it when the job starts.
+    """
+
+    def __init__(self, budget_s: Optional[float] = None):
+        self.budget_s = None if budget_s is None else float(budget_s)
+        self._t0 = time.monotonic()
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self._t0
+
+    def remaining(self) -> Optional[float]:
+        """Seconds left, or None when unbounded.  Can be negative."""
+        if self.budget_s is None:
+            return None
+        return self.budget_s - self.elapsed()
+
+    def exceeded(self) -> bool:
+        rem = self.remaining()
+        return rem is not None and rem <= 0.0
+
+
+def call_with_deadline(fn: Callable, budget_s: Optional[float] = None,
+                       *, label: str = ""):
+    """Run ``fn()`` with at most ``budget_s`` seconds of wall clock.
+
+    ``budget_s=None`` calls ``fn`` inline (zero overhead).  Otherwise ``fn``
+    runs in a daemon worker thread and this call blocks up to ``budget_s``:
+    a result (or the exception ``fn`` raised — re-raised here unchanged, so
+    OOM backoff still sees RESOURCE_EXHAUSTED through the watchdog) within
+    the budget is returned normally; overrunning raises
+    :class:`DeadlineExceeded` and ABANDONS the worker — the computation is
+    not cancelled (XLA dispatch cannot be interrupted from Python), its
+    eventual result is discarded, and the thread dies with the process.
+    """
+    if budget_s is None:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def worker():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised in the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name=f"watchdog:{label or 'fit'}")
+    t.start()
+    if not done.wait(timeout=float(budget_s)):
+        raise DeadlineExceeded(label, float(budget_s))
+    if "error" in box:
+        raise box["error"]
+    return box["result"]

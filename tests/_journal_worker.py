@@ -1,0 +1,141 @@
+"""Subprocess worker for the kill-and-resume durability tests (ISSUE 2).
+
+Runs a journaled 4-chunk CPU fit of a deterministic AR(1) panel, optionally
+SIGKILLing itself after N durable chunk commits — a real process death, not
+an exception — so both ``tests/test_journal.py`` and the ``ci.sh`` smoke
+can exercise crash/resume across genuine process boundaries.  Every run
+(killed, resumed, and the uninterrupted reference) executes in a separate
+worker process with identical jax configuration, so result comparisons are
+bitwise-meaningful.
+
+Modes:
+    --run --dir D [--kill-after N] [--mid-commit] [--out F]
+        one journaled fit; with --kill-after the process dies mid-run
+        (exit by SIGKILL), else the assembled result is saved to F.
+    --smoke
+        full orchestration (used by ci.sh): run a child with
+        --kill-after 2, verify it died, resume, compare bitwise against an
+        uninterrupted run in a fresh directory, check the manifest
+        accounting, and print PASS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+CHUNK_ROWS = 8
+N_ROWS = 32
+
+
+def make_panel() -> np.ndarray:
+    rng = np.random.default_rng(7)
+    e = rng.normal(size=(N_ROWS, 120)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, y.shape[1]):
+        y[:, i] = 0.6 * y[:, i - 1] + e[:, i]
+    return y
+
+
+def run_fit(directory: str, kill_after: int | None, mid_commit: bool,
+            out: str | None) -> None:
+    from spark_timeseries_tpu import reliability as rel
+    from spark_timeseries_tpu.models import arima
+    from spark_timeseries_tpu.reliability import faultinject as fi
+
+    hook = None
+    if kill_after is not None:
+        hook = fi.kill_after_commits(kill_after, mid_commit=mid_commit)
+    res = rel.fit_chunked(
+        arima.fit, make_panel(), chunk_rows=CHUNK_ROWS, resilient=False,
+        checkpoint_dir=directory, order=(1, 0, 0), max_iters=25,
+        _journal_commit_hook=hook,
+    )
+    if kill_after is not None:  # the SIGKILL should have landed mid-run
+        sys.exit(f"kill_after={kill_after} but the fit finished — the hook "
+                 "never fired")
+    if out:
+        np.savez(out, params=res.params, nll=res.neg_log_likelihood,
+                 converged=res.converged, iters=res.iters, status=res.status,
+                 journal=json.dumps(res.meta.get("journal", {})))
+
+
+def _child(args: list) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), *args],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=600,
+    )
+
+
+def smoke() -> None:
+    with tempfile.TemporaryDirectory() as td:
+        jdir = os.path.join(td, "journal")
+        # 1. child killed by SIGKILL after committing chunk 2 of 4
+        r = _child(["--run", "--dir", jdir, "--kill-after", "2"])
+        if r.returncode != -9:
+            sys.exit(f"expected SIGKILL (-9), got rc={r.returncode}\n"
+                     f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}")
+        manifest = json.load(open(os.path.join(jdir, "manifest.json")))
+        done = [(c["lo"], c["hi"]) for c in manifest["chunks"]
+                if c["status"] == "committed"]
+        if done != [(0, 8), (8, 16)]:
+            sys.exit(f"expected chunks (0,8),(8,16) committed, got {done}")
+        # 2. resume completes the job from the journal
+        resumed_out = os.path.join(td, "resumed.npz")
+        r = _child(["--run", "--dir", jdir, "--out", resumed_out])
+        if r.returncode != 0:
+            sys.exit(f"resume failed rc={r.returncode}\nstderr:\n{r.stderr}")
+        # 3. uninterrupted reference in a fresh directory
+        full_out = os.path.join(td, "full.npz")
+        r = _child(["--run", "--dir", os.path.join(td, "fresh"), "--out",
+                    full_out])
+        if r.returncode != 0:
+            sys.exit(f"reference run failed rc={r.returncode}\n{r.stderr}")
+        a, b = np.load(resumed_out), np.load(full_out)
+        for k in ("params", "nll", "converged", "iters", "status"):
+            if not np.array_equal(a[k], b[k], equal_nan=True):
+                sys.exit(f"resumed result differs from uninterrupted run on "
+                         f"{k!r} — resume is NOT bitwise-identical")
+        j = json.loads(str(a["journal"]))
+        if j.get("chunks_resumed") != 2 or j.get("chunks_committed") != 4:
+            sys.exit(f"resume accounting wrong: {j}")
+        manifest = json.load(open(os.path.join(jdir, "manifest.json")))
+        n_done = sum(1 for c in manifest["chunks"]
+                     if c["status"] == "committed")
+        if n_done != 4:
+            sys.exit(f"manifest should show 4 committed chunks, got {n_done}")
+        print("journal kill-and-resume smoke: PASS "
+              "(SIGKILL after chunk 2, resumed bitwise-identical, "
+              f"manifest accounts for all 4 chunks, resumes={len(manifest['resumes'])})")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run", action="store_true")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--dir")
+    ap.add_argument("--kill-after", type=int, default=None)
+    ap.add_argument("--mid-commit", action="store_true")
+    ap.add_argument("--out")
+    args = ap.parse_args()
+    if args.smoke:
+        return smoke()
+    if not args.run or not args.dir:
+        ap.error("need --run --dir D or --smoke")
+    run_fit(args.dir, args.kill_after, args.mid_commit, args.out)
+
+
+if __name__ == "__main__":
+    main()

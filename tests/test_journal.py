@@ -1,0 +1,473 @@
+"""Durability tests: chunk journal, crash/preemption resume, deadline
+watchdog (ISSUE 2, tier-1 CPU).
+
+The acceptance bar is the Spark-lineage guarantee rebuilt: a journaled
+multi-chunk panel fit killed mid-run and resumed produces results
+BITWISE-IDENTICAL to an uninterrupted run, with the manifest accounting for
+every chunk (committed / resumed / TIMEOUT).  Process death is exercised
+two ways — an in-process ``SimulatedCrash`` raised by a journal commit hook
+(cheap, same interpreter) and a real ``SIGKILL`` of a subprocess worker
+(``tests/_journal_worker.py``, also the ci.sh smoke) — plus the rejection
+cases resume must fail loudly on: torn manifests and stale journals
+(config-hash / panel-fingerprint mismatch).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu import index as dtix
+from spark_timeseries_tpu import panel as panel_mod
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.compat import sparkts
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.models import holtwinters as hw
+from spark_timeseries_tpu.reliability import FitStatus
+from spark_timeseries_tpu.reliability import faultinject as fi
+from spark_timeseries_tpu.reliability import journal as journal_mod
+from spark_timeseries_tpu.reliability import watchdog as watchdog_mod
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ar_panel(b=32, t=120, seed=7, phi=0.6):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(b, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, t):
+        y[:, i] = phi * y[:, i - 1] + e[:, i]
+    return y
+
+
+def _fit(y, d, **kw):
+    return rel.fit_chunked(arima.fit, y, chunk_rows=8, resilient=False,
+                           checkpoint_dir=d, order=(1, 0, 0), max_iters=25,
+                           **kw)
+
+
+def _assert_bitwise(a, b):
+    for f in ("params", "neg_log_likelihood", "converged", "iters", "status"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, f)),
+                                      np.asarray(getattr(b, f)),
+                                      err_msg=f"field {f!r} differs")
+
+
+# ---------------------------------------------------------------------------
+# in-process crash + resume
+# ---------------------------------------------------------------------------
+
+
+class TestCrashResume:
+    def test_resume_is_bitwise_identical(self, tmp_path):
+        y = _ar_panel()
+        full = _fit(y, None)  # uninterrupted, unjournaled reference
+        d = str(tmp_path / "j")
+        with pytest.raises(fi.SimulatedCrash):
+            _fit(y, d, _journal_commit_hook=fi.crash_after_commits(2))
+        # the journal holds exactly the chunks committed before the crash
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        done = [(c["lo"], c["hi"]) for c in m["chunks"]
+                if c["status"] == "committed"]
+        assert done == [(0, 8), (8, 16)]
+        res = _fit(y, d)
+        _assert_bitwise(res, full)
+        j = res.meta["journal"]
+        assert j["chunks_resumed"] == 2
+        assert j["chunks_committed"] == 4
+        assert j["chunks_timeout"] == 0
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        assert sum(1 for c in m["chunks"] if c["status"] == "committed") == 4
+        assert len(m["resumes"]) == 1
+
+    def test_mid_commit_crash_leaves_recoverable_orphan(self, tmp_path):
+        """Killed after the shard hits disk but BEFORE the manifest names
+        it: the write-ahead ordering means the orphan shard is simply
+        recomputed — never referenced, never corrupting."""
+        y = _ar_panel()
+        d = str(tmp_path / "j")
+        with pytest.raises(fi.SimulatedCrash):
+            _fit(y, d, _journal_commit_hook=fi.crash_after_commits(
+                3, mid_commit=True))
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        assert sum(1 for c in m["chunks"] if c["status"] == "committed") == 2
+        # the orphan shard exists on disk but the manifest does not name it
+        assert os.path.exists(os.path.join(d, "chunk_000000016_000000024.npz"))
+        res = _fit(y, d)
+        _assert_bitwise(res, _fit(y, None))
+        assert res.meta["journal"]["chunks_resumed"] == 2
+
+    def test_full_rerun_loads_every_chunk(self, tmp_path):
+        y = _ar_panel()
+        d = str(tmp_path / "j")
+        first = _fit(y, d)
+        again = _fit(y, d)
+        _assert_bitwise(first, again)
+        assert again.meta["journal"]["chunks_resumed"] == 4
+
+    def test_torn_shard_downgrades_to_recompute(self, tmp_path):
+        y = _ar_panel()
+        d = str(tmp_path / "j")
+        _fit(y, d)
+        fi.tear_file(os.path.join(d, "chunk_000000008_000000016.npz"), 0.3)
+        res = _fit(y, d)  # torn shard recomputed, result still exact
+        _assert_bitwise(res, _fit(y, None))
+        assert res.meta["journal"]["chunks_resumed"] == 3
+
+    def test_torn_shard_recompute_keeps_recorded_boundaries(self, tmp_path):
+        """Backoff halves the chunk size mid-run, so later shards have a
+        different width than the torn one: the recompute must cover the
+        torn entry's EXACT [lo, hi) (not lo + current chunk size), or it
+        would overlap the next committed chunk and corrupt the walk."""
+        y = _ar_panel()
+        d = str(tmp_path / "j")
+        of = fi.oom_fit(arima.fit, max_rows=8)  # 16 -> 8 backoff at row 0
+        ref = rel.fit_chunked(of, y, chunk_rows=16, min_chunk_rows=4,
+                              resilient=False, order=(1, 0, 0), max_iters=25)
+        full = rel.fit_chunked(fi.oom_fit(arima.fit, max_rows=8), y,
+                               chunk_rows=16, min_chunk_rows=4,
+                               resilient=False, checkpoint_dir=d,
+                               order=(1, 0, 0), max_iters=25)
+        _assert_bitwise(full, ref)
+        # tear the FIRST 8-row shard; the resume sees chunk_rows=16 at
+        # lo=0 but must recompute exactly [0, 8).  (Resume with the same
+        # wrapped fit so the config hash matches; the OOM wrapper only
+        # fires above 8 rows, and the forced recompute is exactly 8.)
+        fi.tear_file(os.path.join(d, "chunk_000000000_000000008.npz"), 0.3)
+        res = rel.fit_chunked(fi.oom_fit(arima.fit, max_rows=8), y,
+                              chunk_rows=16, min_chunk_rows=4,
+                              resilient=False, checkpoint_dir=d,
+                              order=(1, 0, 0), max_iters=25)
+        _assert_bitwise(res, ref)
+        assert res.meta["journal"]["chunks_resumed"] == 3
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        spans = sorted((c["lo"], c["hi"]) for c in m["chunks"]
+                       if c["status"] == "committed")
+        assert spans == [(0, 8), (8, 16), (16, 24), (24, 32)]
+
+    def test_backoff_on_resume_stays_on_committed_grid(self, tmp_path):
+        """An OOM backoff during a journaled resume whose halving does not
+        divide the original chunk size must clamp to the next committed
+        chunk's boundary — a free-running walk would sail past it, orphan
+        the committed entry, and double-count its rows."""
+        y = _ar_panel()
+        d = str(tmp_path / "j")
+        # run 1: chunk [0, 8) hangs -> TIMEOUT; [8, 32) commits in 8s
+        hf = fi.hanging_fit(arima.fit, [0], sleep_s=10.0)
+        rel.fit_chunked(hf, y, chunk_rows=8, min_chunk_rows=3,
+                        resilient=False, checkpoint_dir=d,
+                        chunk_budget_s=0.5, order=(1, 0, 0), max_iters=25)
+        # resume: recomputing [0, 8) OOMs down to 3-row chunks (8->4->3,
+        # which does not divide 8) — the walk must still meet lo=8 exactly
+        of = fi.oom_fit(arima.fit, max_rows=3)
+        res = rel.fit_chunked(of, y, chunk_rows=8, min_chunk_rows=3,
+                              resilient=False, checkpoint_dir=d,
+                              order=(1, 0, 0), max_iters=25)
+        assert res.meta["journal"]["chunks_resumed"] == 3
+        assert res.meta["status_counts"]["TIMEOUT"] == 0
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        spans = sorted((c["lo"], c["hi"]) for c in m["chunks"]
+                       if c["status"] == "committed")
+        # exact partition of [0, 32): no overlap, no gap, no orphans
+        assert spans[0][0] == 0 and spans[-1][1] == 32
+        assert all(a[1] == b[0] for a, b in zip(spans, spans[1:]))
+        assert (8, 16) in spans and (16, 24) in spans and (24, 32) in spans
+
+
+# ---------------------------------------------------------------------------
+# rejection cases: resume must fail loudly, never splice
+# ---------------------------------------------------------------------------
+
+
+class TestJournalRejection:
+    def test_torn_manifest_rejected(self, tmp_path):
+        y = _ar_panel()
+        d = str(tmp_path / "j")
+        _fit(y, d)
+        fi.tear_file(os.path.join(d, "manifest.json"), 0.4)
+        with pytest.raises(rel.TornManifestError):
+            _fit(y, d)
+
+    def test_config_mismatch_rejected(self, tmp_path):
+        y = _ar_panel()
+        d = str(tmp_path / "j")
+        _fit(y, d)
+        with pytest.raises(rel.StaleJournalError, match="config_hash"):
+            rel.fit_chunked(arima.fit, y, chunk_rows=8, resilient=False,
+                            checkpoint_dir=d, order=(1, 0, 1), max_iters=25)
+
+    def test_panel_mismatch_rejected(self, tmp_path):
+        d = str(tmp_path / "j")
+        _fit(_ar_panel(seed=7), d)
+        with pytest.raises(rel.StaleJournalError, match="panel_fingerprint"):
+            _fit(_ar_panel(seed=8), d)
+
+    def test_resume_require_demands_manifest(self, tmp_path):
+        with pytest.raises(rel.JournalError, match="require"):
+            _fit(_ar_panel(), str(tmp_path / "empty"), resume="require")
+
+    def test_resume_never_starts_over(self, tmp_path):
+        y = _ar_panel()
+        d = str(tmp_path / "j")
+        _fit(y, d)
+        res = _fit(y, d, resume="never")
+        assert res.meta["journal"]["chunks_resumed"] == 0
+        _assert_bitwise(res, _fit(y, None))
+
+    def test_resume_modes_validated(self, tmp_path):
+        with pytest.raises(ValueError, match="resume"):
+            _fit(_ar_panel(), str(tmp_path / "j"), resume="sometimes")
+
+
+# ---------------------------------------------------------------------------
+# deadline watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestWatchdog:
+    def test_call_with_deadline_passthrough(self):
+        assert watchdog_mod.call_with_deadline(lambda: 41 + 1) == 42
+        assert watchdog_mod.call_with_deadline(lambda: 42, 5.0) == 42
+        with pytest.raises(ValueError, match="boom"):
+            watchdog_mod.call_with_deadline(
+                lambda: (_ for _ in ()).throw(ValueError("boom")), 5.0)
+
+    def test_call_with_deadline_times_out(self):
+        import time as _t
+
+        with pytest.raises(watchdog_mod.DeadlineExceeded):
+            watchdog_mod.call_with_deadline(lambda: _t.sleep(5.0), 0.1)
+
+    def test_deadline_object(self):
+        d = watchdog_mod.Deadline(None)
+        assert d.remaining() is None and not d.exceeded()
+        d = watchdog_mod.Deadline(0.0)
+        assert d.exceeded()
+
+    def test_hung_chunk_marked_timeout_and_job_continues(self, tmp_path):
+        y = _ar_panel()
+        d = str(tmp_path / "j")
+        hf = fi.hanging_fit(arima.fit, [1], sleep_s=10.0)
+        res = rel.fit_chunked(hf, y, chunk_rows=8, resilient=False,
+                              checkpoint_dir=d, chunk_budget_s=0.5,
+                              order=(1, 0, 0), max_iters=25)
+        counts = res.meta["status_counts"]
+        assert counts["TIMEOUT"] == 8
+        assert counts["OK"] + counts["DIVERGED"] == 24
+        assert np.isnan(res.params[8:16]).all()
+        assert (np.asarray(res.status[8:16]) == FitStatus.TIMEOUT).all()
+        assert res.meta["degraded"] is True
+        assert res.meta["timeouts"] == 1
+        assert res.meta["journal"]["chunks_timeout"] == 1
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        stat = {(c["lo"], c["hi"]): c["status"] for c in m["chunks"]}
+        assert stat[(8, 16)] == "TIMEOUT"
+        assert sum(1 for s in stat.values() if s == "committed") == 3
+
+    def test_timeout_chunk_retried_on_resume(self, tmp_path):
+        y = _ar_panel()
+        d = str(tmp_path / "j")
+        hf = fi.hanging_fit(arima.fit, [1], sleep_s=10.0)
+        rel.fit_chunked(hf, y, chunk_rows=8, resilient=False,
+                        checkpoint_dir=d, chunk_budget_s=0.5,
+                        order=(1, 0, 0), max_iters=25)
+        res = _fit(y, d)  # no hang this time: TIMEOUT chunk recomputes
+        _assert_bitwise(res, _fit(y, None))
+        assert res.meta["journal"]["chunks_timeout"] == 0
+        assert res.meta["status_counts"]["TIMEOUT"] == 0
+
+    def test_job_budget_marks_remaining_without_dispatch(self):
+        y = _ar_panel()
+        calls = {"n": 0}
+
+        def counting_fit(yb, **kw):
+            calls["n"] += 1
+            return arima.fit(yb, **kw)
+
+        res = rel.fit_chunked(counting_fit, y, chunk_rows=8, resilient=False,
+                              job_budget_s=0.0, order=(1, 0, 0), max_iters=25)
+        assert calls["n"] == 0
+        assert res.meta["status_counts"]["TIMEOUT"] == 32
+        assert all(e["scope"] == "job" and not e["dispatched"]
+                   for e in res.meta["timeout_events"])
+
+
+# ---------------------------------------------------------------------------
+# real process death: SIGKILL subprocess (the acceptance-criteria path)
+# ---------------------------------------------------------------------------
+
+
+class TestKillResumeSubprocess:
+    def test_sigkill_then_resume_bitwise(self, tmp_path):
+        worker = os.path.join(_ROOT, "tests", "_journal_worker.py")
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+        def child(*args):
+            return subprocess.run([sys.executable, worker, *args],
+                                  cwd=_ROOT, env=env, capture_output=True,
+                                  text=True, timeout=600)
+
+        jdir = str(tmp_path / "journal")
+        r = child("--run", "--dir", jdir, "--kill-after", "2")
+        assert r.returncode == -9, f"expected SIGKILL: {r.stderr}"
+        resumed = str(tmp_path / "resumed.npz")
+        r = child("--run", "--dir", jdir, "--out", resumed)
+        assert r.returncode == 0, r.stderr
+        full = str(tmp_path / "full.npz")
+        r = child("--run", "--dir", str(tmp_path / "fresh"), "--out", full)
+        assert r.returncode == 0, r.stderr
+        a, b = np.load(resumed), np.load(full)
+        for k in ("params", "nll", "converged", "iters", "status"):
+            np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+        j = json.loads(str(a["journal"]))
+        assert j["chunks_resumed"] == 2 and j["chunks_committed"] == 4
+        m = json.load(open(os.path.join(jdir, "manifest.json")))
+        assert sum(1 for c in m["chunks"]
+                   if c["status"] == "committed") == 4
+
+
+# ---------------------------------------------------------------------------
+# API surfaces: panel, compat, multi-host namespaces, tooling
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def test_panel_fit_checkpoint_dir(self, tmp_path):
+        y = _ar_panel(b=12, t=120)
+        idx = dtix.uniform("2024-01-01", periods=120,
+                           frequency=dtix.DayFrequency(1))
+        p = panel_mod.TimeSeriesPanel(idx, [f"s{i}" for i in range(12)], y)
+        d = str(tmp_path / "j")
+        r1 = p.fit("arima", order=(1, 0, 0), max_iters=25, chunk_rows=4,
+                   resilient=False, checkpoint_dir=d)
+        r2 = p.fit("arima", order=(1, 0, 0), max_iters=25, chunk_rows=4,
+                   resilient=False, checkpoint_dir=d)
+        _assert_bitwise(r1, r2)
+        assert r2.meta["journal"]["chunks_resumed"] == 3
+
+    def test_compat_fit_model_checkpoint_dir(self, tmp_path):
+        y = _ar_panel(b=8, t=120)
+        plain = sparkts.ARIMA.fit_model(1, 0, 0, jnp.asarray(y))
+        d = str(tmp_path / "j")
+        durable = sparkts.ARIMA.fit_model(1, 0, 0, jnp.asarray(y),
+                                          checkpoint_dir=d, chunk_rows=4)
+        np.testing.assert_array_equal(np.asarray(durable.params),
+                                      np.asarray(plain.params))
+        # second call resumes from the journal and agrees bitwise
+        resumed = sparkts.ARIMA.fit_model(1, 0, 0, jnp.asarray(y),
+                                          checkpoint_dir=d, chunk_rows=4)
+        np.testing.assert_array_equal(np.asarray(resumed.params),
+                                      np.asarray(durable.params))
+        assert os.path.exists(os.path.join(d, "manifest.json"))
+
+    def test_nonzero_process_owns_namespace_not_manifest(self, tmp_path):
+        y = _ar_panel(b=16)
+        d = str(tmp_path / "j")
+        res = rel.fit_chunked(arima.fit, y, chunk_rows=8, resilient=False,
+                              checkpoint_dir=d, process_index=1,
+                              order=(1, 0, 0), max_iters=25)
+        # only process 0 commits the job-level manifest.json
+        assert not os.path.exists(os.path.join(d, "manifest.json"))
+        ns = os.path.join(d, "proc_00001")
+        assert os.path.exists(os.path.join(ns, "manifest.proc_00001.json"))
+        assert res.meta["journal"]["process_index"] == 1
+        # the process resumes from its own namespace
+        res2 = rel.fit_chunked(arima.fit, y, chunk_rows=8, resilient=False,
+                               checkpoint_dir=d, process_index=1,
+                               order=(1, 0, 0), max_iters=25)
+        _assert_bitwise(res, res2)
+        assert res2.meta["journal"]["chunks_resumed"] == 2
+
+    def test_inspect_journal_tool(self, tmp_path):
+        y = _ar_panel()
+        d = str(tmp_path / "j")
+        hf = fi.hanging_fit(arima.fit, [1], sleep_s=10.0)
+        rel.fit_chunked(hf, y, chunk_rows=8, resilient=False,
+                        checkpoint_dir=d, chunk_budget_s=0.5,
+                        order=(1, 0, 0), max_iters=25)
+        out = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools", "inspect_journal.py"),
+             d, "--json"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        s = json.loads(out.stdout)
+        assert s["chunks_committed"] == 3
+        assert s["chunks_timeout"] == 1
+        assert s["rows_timeout"] == 8
+        assert s["status_totals"]["OK"] + s["status_totals"]["DIVERGED"] == 24
+        # torn manifest: exit 2, same condition resume rejects
+        fi.tear_file(os.path.join(d, "manifest.json"), 0.4)
+        out = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools", "inspect_journal.py"),
+             d],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 2
+        assert "TORN" in out.stderr
+
+    def test_fingerprint_and_config_hash_stability(self):
+        y = _ar_panel()
+        assert (journal_mod.panel_fingerprint(y)
+                == journal_mod.panel_fingerprint(y.copy()))
+        y2 = y.copy()
+        y2[3, 0] += 1.0
+        assert (journal_mod.panel_fingerprint(y)
+                != journal_mod.panel_fingerprint(y2))
+        h1 = journal_mod.config_hash(arima.fit, {"order": (1, 0, 0)})
+        h2 = journal_mod.config_hash(arima.fit, {"order": (1, 0, 0)})
+        h3 = journal_mod.config_hash(arima.fit, {"order": (2, 0, 0)})
+        assert h1 == h2 != h3
+
+
+# ---------------------------------------------------------------------------
+# Holt-Winters seeded multi-start (VERDICT r5 item 5 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _seasonal_panel(b=24, t=96, m=12, seed=3):
+    rng = np.random.default_rng(seed)
+    tt = np.arange(t, dtype=np.float32)
+    phase = rng.uniform(0, 2 * np.pi, (b, 1)).astype(np.float32)
+    seas = 2.0 * np.sin(2 * np.pi * tt[None, :] / m + phase)
+    return (25.0 + 0.02 * tt[None, :] + seas
+            + rng.normal(scale=0.3, size=(b, t))).astype(np.float32)
+
+
+class TestHWMultiStart:
+    def test_multiplicative_defaults_to_multi_start_and_never_worse(self):
+        y = jnp.asarray(_seasonal_panel())
+        multi = hw.fit(y, 12, "multiplicative", max_iters=25)  # n_starts=3
+        single = hw.fit(y, 12, "multiplicative", max_iters=25, n_starts=1)
+        f_multi = np.nan_to_num(np.asarray(multi.neg_log_likelihood),
+                                nan=np.inf)
+        f_single = np.nan_to_num(np.asarray(single.neg_log_likelihood),
+                                 nan=np.inf)
+        conv_m = np.asarray(multi.converged)
+        conv_s = np.asarray(single.converged)
+        # per row: never lose convergence, and among rows both converge the
+        # kept objective is never MATERIALLY worse — the selection prefers
+        # the smoothest basin within a 0.1% relative band of the best (the
+        # cross-precision determinism rule, holtwinters._fit_program), so
+        # the bound is the band, not exact dominance
+        assert (conv_m | ~conv_s).all()
+        both = conv_m & conv_s
+        assert (f_multi[both] <= f_single[both] * (1 + 1.2e-3) + 1e-6).all()
+
+    def test_additive_default_single_start_unchanged(self):
+        y = jnp.asarray(_seasonal_panel())
+        r1 = hw.fit(y, 12, "additive", max_iters=25)
+        r2 = hw.fit(y, 12, "additive", max_iters=25, n_starts=1)
+        np.testing.assert_array_equal(np.asarray(r1.params),
+                                      np.asarray(r2.params))
+
+    def test_multi_start_deterministic(self):
+        y = jnp.asarray(_seasonal_panel())
+        r1 = hw.fit(y, 12, "multiplicative", max_iters=25, n_starts=3)
+        r2 = hw.fit(y, 12, "multiplicative", max_iters=25, n_starts=3)
+        np.testing.assert_array_equal(np.asarray(r1.params),
+                                      np.asarray(r2.params))

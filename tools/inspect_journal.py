@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Pretty-print a chunk-journal manifest for post-mortems.
+
+A journaled panel fit (``reliability.fit_chunked(..., checkpoint_dir=...)``)
+leaves behind npz result shards plus an atomically updated
+``manifest.json``.  When a job dies — SIGKILL, TPU preemption, deadline
+blowout — this tool answers the on-call questions from the manifest alone:
+which chunks committed, which TIMED OUT, what is still pending, what the
+per-row FitStatus totals look like, and how much HBM the run peaked at.
+
+    python tools/inspect_journal.py CHECKPOINT_DIR [--json]
+
+Accepts the journal directory (reads ``manifest.json``; pass a
+``manifest.proc_*.json`` path directly for a non-zero process's namespace)
+and exits 2 on a torn (unparseable) manifest — the same condition a resume
+rejects — printing what little can be salvaged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _fmt_bytes(n) -> str:
+    if not n:
+        return "—"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024:
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024.0
+    return f"{n:.1f} PiB"
+
+
+def _fmt_when(ts) -> str:
+    if not ts:
+        return "—"
+    return time.strftime("%Y-%m-%d %H:%M:%SZ", time.gmtime(ts))
+
+
+def load_manifest(path: str) -> dict:
+    if os.path.isdir(path):
+        path = os.path.join(path, "manifest.json")
+    if not os.path.exists(path):
+        sys.exit(f"no manifest at {path}")
+    try:
+        with open(path, "rb") as f:
+            return json.loads(f.read().decode())
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        print(f"TORN MANIFEST: {path} does not parse ({e}).", file=sys.stderr)
+        print("A mid-commit crash tore the write; a resume under this "
+              "journal is rejected (TornManifestError). The npz shards on "
+              "disk are still intact — recover by removing/renaming the "
+              "manifest only if you accept recomputing every chunk.",
+              file=sys.stderr)
+        sys.exit(2)
+
+
+def summarize(m: dict) -> dict:
+    chunks = sorted(m.get("chunks", []), key=lambda e: e["lo"])
+    n_rows = int(m.get("n_rows", 0))
+    committed = [e for e in chunks if e["status"] == "committed"]
+    timeout = [e for e in chunks if e["status"] == "TIMEOUT"]
+    covered = sum(e["hi"] - e["lo"] for e in committed)
+    status_totals: dict = {}
+    for e in committed:
+        for k, v in (e.get("status_counts") or {}).items():
+            status_totals[k] = status_totals.get(k, 0) + v
+    peaks = [e.get("peak_hbm_bytes") for e in chunks if e.get("peak_hbm_bytes")]
+    return {
+        "run_id": m.get("run_id"),
+        "created_at": m.get("created_at"),
+        "git_commit": m.get("git_commit"),
+        "config_hash": m.get("config_hash"),
+        "panel_fingerprint": m.get("panel_fingerprint"),
+        "n_rows": n_rows,
+        "resumes": len(m.get("resumes", [])),
+        "chunks_committed": len(committed),
+        "chunks_timeout": len(timeout),
+        "rows_committed": covered,
+        "rows_pending": max(0, n_rows - covered
+                            - sum(e["hi"] - e["lo"] for e in timeout)),
+        "rows_timeout": sum(e["hi"] - e["lo"] for e in timeout),
+        "status_totals": status_totals,
+        "peak_hbm_bytes": max(peaks) if peaks else None,
+        "chunks": chunks,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="journal directory or manifest path")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary instead of the table")
+    args = ap.parse_args()
+    m = load_manifest(args.path)
+    s = summarize(m)
+    if args.json:
+        print(json.dumps(s, indent=1, sort_keys=True))
+        return
+
+    print(f"journal {args.path}")
+    print(f"  run {s['run_id']}  created {_fmt_when(s['created_at'])}  "
+          f"commit {(s['git_commit'] or '?')[:12]}  resumes {s['resumes']}")
+    print(f"  config {s['config_hash']}  panel {s['panel_fingerprint']}  "
+          f"rows {s['n_rows']}")
+    print(f"  chunks: {s['chunks_committed']} committed, "
+          f"{s['chunks_timeout']} TIMEOUT; rows: {s['rows_committed']} done, "
+          f"{s['rows_timeout']} timed out, {s['rows_pending']} pending")
+    if s["status_totals"]:
+        totals = ", ".join(f"{k}={v}" for k, v in s["status_totals"].items()
+                           if v)
+        print(f"  fit status totals: {totals or 'none recorded'}")
+    print(f"  peak HBM (max over chunks): {_fmt_bytes(s['peak_hbm_bytes'])}")
+    if s["chunks"]:
+        print(f"  {'rows':>21}  {'status':<9} {'wall_s':>8} {'peak_hbm':>10}"
+              f"  {'run':<12} counts")
+        for e in s["chunks"]:
+            counts = e.get("status_counts") or {}
+            counts_s = ",".join(f"{k}:{v}" for k, v in counts.items() if v)
+            wall = e.get("wall_s")
+            print(f"  [{e['lo']:>9}, {e['hi']:>9})  {e['status']:<9} "
+                  f"{wall if wall is not None else '—':>8} "
+                  f"{_fmt_bytes(e.get('peak_hbm_bytes')):>10}  "
+                  f"{(e.get('run_id') or '?'):<12} {counts_s}")
+    else:
+        print("  (no chunks recorded yet)")
+
+
+if __name__ == "__main__":
+    main()
