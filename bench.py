@@ -1211,12 +1211,13 @@ def _northstar_1m(jnp, order):
 
     # VERDICT r5 item 6: the missing half of the headline measurement —
     # sampled around the sustained run; the chunk driver records the same
-    # reading per chunk in the journal manifest (one shared helper)
-    from spark_timeseries_tpu.reliability.chunked import (
-        _device_peak_hbm as _peak_hbm,
-    )
+    # reading per chunk in the journal manifest (one shared probe).  On
+    # backends without memory_stats() the probe degrades to host peak RSS
+    # instead of null (ISSUE 3 satellite) — peak_mem_source says which.
+    from spark_timeseries_tpu.obs.memory import peak_memory as _peak_mem
 
-    peak = _peak_hbm()  # before the run: warmup/compile already resident
+    _pm = _peak_mem()  # before the run: warmup/compile already resident
+    peak, peak_src = _pm.bytes, _pm.source
     total_conv, wall = 0.0, 0.0
     fitted_conv = 0.0  # converged rows actually FITTED this run: a resumed
     # chunk rehydrates from its shard in ~0 wall, and counting its rows in
@@ -1245,7 +1246,9 @@ def _northstar_1m(jnp, order):
         chunks_committed += j.get("chunks_committed", 0)
         chunks_resumed += j.get("chunks_resumed", 0)
         run_ids.append(j.get("run_id"))
-        peak = max(peak or 0, _peak_hbm() or 0) or None
+        _pm = _peak_mem()
+        if _pm.bytes and _pm.bytes > (peak or 0):
+            peak, peak_src = _pm.bytes, _pm.source
         del r
     del chunks
     total = chunk_b * n_chunks
@@ -1260,6 +1263,9 @@ def _northstar_1m(jnp, order):
         "sustained_converged_series_per_sec":
             round(fitted_conv / wall, 1) if wall > 0 else None,
         "peak_hbm_bytes": peak,
+        # which probe produced the reading: "device" = real HBM stats,
+        # "host_rss" = process peak RSS fallback (CPU runs — never null)
+        "peak_mem_source": peak_src,
         # reliability layer accounting (ISSUE 1): per-row FitStatus totals
         # and whether any chunk survived only by OOM backoff
         "fit_status_counts": status_totals,
@@ -1410,7 +1416,8 @@ def _summary_line(emitted):
             if ns:
                 entry["northstar_1m"] = {k: ns.get(k) for k in (
                     "series_total", "wall_s", "converged_frac",
-                    "sustained_converged_series_per_sec", "peak_hbm_bytes")}
+                    "sustained_converged_series_per_sec", "peak_hbm_bytes",
+                    "peak_mem_source")}
                 j = ns.get("journal") or {}
                 entry["northstar_1m"]["chunks_resumed"] = j.get(
                     "chunks_resumed")

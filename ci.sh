@@ -36,6 +36,36 @@ python -m pytest tests/test_reliability.py -q -rs -W error::RuntimeWarning "$@"
 # exception (tests/_journal_worker.py orchestrates three worker processes)
 python tests/_journal_worker.py --smoke
 
+# telemetry smoke (ISSUE 3): a small journaled chunked fit runs with the
+# obs plane enabled; the JSONL event log AND the manifest's embedded
+# telemetry block (per-chunk compile/execute spans, ladder counters,
+# non-null peak memory) must validate under the schema checker
+OBS_SMOKE_DIR=$(python - <<'EOF'
+import os, tempfile
+import numpy as np
+from spark_timeseries_tpu import obs
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.models import arima
+
+root = tempfile.mkdtemp(prefix="obs_smoke_")
+obs.enable(os.path.join(root, "events.jsonl"))
+rng = np.random.default_rng(0)
+y = np.cumsum(rng.normal(size=(32, 96)).astype(np.float32), axis=1)
+res = rel.fit_chunked(arima.fit, y, chunk_rows=4, order=(1, 0, 0),
+                      max_iters=15,
+                      checkpoint_dir=os.path.join(root, "journal"))
+assert "telemetry" in res.meta, "telemetry summary missing from meta"
+obs.disable()
+print(root)
+EOF
+)
+python tools/obs_report.py --check "$OBS_SMOKE_DIR/events.jsonl" \
+  --manifest "$OBS_SMOKE_DIR/journal"
+python tools/inspect_journal.py "$OBS_SMOKE_DIR/journal" \
+  | grep -q "telemetry (obs run" \
+  || { echo "ci.sh: inspect_journal did not print the telemetry summary" >&2; exit 1; }
+rm -rf "$OBS_SMOKE_DIR"
+
 # the driver's multi-chip artifact, same environment
 python - <<'EOF'
 import __graft_entry__ as g

@@ -41,6 +41,7 @@ from .panel import (
 from . import parallel
 from .parallel import default_mesh
 from . import models
+from . import obs
 from . import reliability
 from . import stats
 from . import compat

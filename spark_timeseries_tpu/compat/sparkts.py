@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import index as dtix
+from .. import obs
 from .. import panel as panellib
 from ..index import DateTimeIndex
 from ..models import arima as _arima
@@ -414,20 +415,21 @@ class ARIMA:
         """``checkpoint_dir=`` journals the fit for crash/preemption resume
         (``reliability.fit_chunked``); ``chunk_rows`` / ``chunk_budget_s``
         / ``job_budget_s`` / ``resume`` ride along to the chunk driver."""
-        if checkpoint_dir is not None:
-            import functools
+        with obs.span("compat.fit_model", model="ARIMA"):
+            if checkpoint_dir is not None:
+                import functools
 
-            params = _durable_fit(
-                functools.partial(_arima.fit, order=(p, d, q),
-                                  include_intercept=include_intercept,
-                                  method=method,
-                                  init_params=user_init_params),
-                ts, checkpoint_dir, **durable_kwargs)
-            return ARIMAModel(p, d, q, params, include_intercept)
-        _require_checkpoint_dir(durable_kwargs)
-        res = _arima.fit(jnp.asarray(ts), (p, d, q), include_intercept,
-                         method=method, init_params=user_init_params)
-        return ARIMAModel(p, d, q, res.params, include_intercept)
+                params = _durable_fit(
+                    functools.partial(_arima.fit, order=(p, d, q),
+                                      include_intercept=include_intercept,
+                                      method=method,
+                                      init_params=user_init_params),
+                    ts, checkpoint_dir, **durable_kwargs)
+                return ARIMAModel(p, d, q, params, include_intercept)
+            _require_checkpoint_dir(durable_kwargs)
+            res = _arima.fit(jnp.asarray(ts), (p, d, q), include_intercept,
+                             method=method, init_params=user_init_params)
+            return ARIMAModel(p, d, q, res.params, include_intercept)
 
 
 class ARModel(_ModelBase):
@@ -465,8 +467,9 @@ class ARModel(_ModelBase):
 class Autoregression:
     @staticmethod
     def fit_model(ts, max_lag: int = 1, no_intercept: bool = False) -> ARModel:
-        res = _ar.fit(jnp.asarray(ts), max_lag, no_intercept)
-        return ARModel(res.params, max_lag)
+        with obs.span("compat.fit_model", model="Autoregression"):
+            res = _ar.fit(jnp.asarray(ts), max_lag, no_intercept)
+            return ARModel(res.params, max_lag)
 
 
 class EWMAModel(_ModelBase):
@@ -488,11 +491,12 @@ class EWMA:
     @staticmethod
     def fit_model(ts, checkpoint_dir: Optional[str] = None,
                   **durable_kwargs) -> EWMAModel:
-        if checkpoint_dir is not None:
-            return EWMAModel(_durable_fit(_ewma.fit, ts, checkpoint_dir,
-                                          **durable_kwargs))
-        _require_checkpoint_dir(durable_kwargs)
-        return EWMAModel(_ewma.fit(jnp.asarray(ts)).params)
+        with obs.span("compat.fit_model", model="EWMA"):
+            if checkpoint_dir is not None:
+                return EWMAModel(_durable_fit(_ewma.fit, ts, checkpoint_dir,
+                                              **durable_kwargs))
+            _require_checkpoint_dir(durable_kwargs)
+            return EWMAModel(_ewma.fit(jnp.asarray(ts)).params)
 
 
 class GARCHModel(_ModelBase):
@@ -528,11 +532,12 @@ class GARCH:
     @staticmethod
     def fit_model(ts, checkpoint_dir: Optional[str] = None,
                   **durable_kwargs) -> GARCHModel:
-        if checkpoint_dir is not None:
-            return GARCHModel(_durable_fit(_garch.fit, ts, checkpoint_dir,
-                                           **durable_kwargs))
-        _require_checkpoint_dir(durable_kwargs)
-        return GARCHModel(_garch.fit(jnp.asarray(ts)).params)
+        with obs.span("compat.fit_model", model="GARCH"):
+            if checkpoint_dir is not None:
+                return GARCHModel(_durable_fit(_garch.fit, ts, checkpoint_dir,
+                                               **durable_kwargs))
+            _require_checkpoint_dir(durable_kwargs)
+            return GARCHModel(_garch.fit(jnp.asarray(ts)).params)
 
 
 class ARGARCHModel(_ModelBase):
@@ -543,7 +548,8 @@ class ARGARCHModel(_ModelBase):
 class ARGARCH:
     @staticmethod
     def fit_model(ts) -> ARGARCHModel:
-        return ARGARCHModel(_garch.fit_argarch(jnp.asarray(ts)).params)
+        with obs.span("compat.fit_model", model="ARGARCH"):
+            return ARGARCHModel(_garch.fit_argarch(jnp.asarray(ts)).params)
 
 
 class HoltWintersModel(_ModelBase):
@@ -580,17 +586,18 @@ class HoltWinters:
         # solved by sigmoid-transformed L-BFGS, so both names map to it
         if method not in ("BOBYQA", "L-BFGS"):
             raise ValueError(f"unknown method {method!r} (supported: BOBYQA, L-BFGS)")
-        if checkpoint_dir is not None:
-            import functools
+        with obs.span("compat.fit_model", model="HoltWinters"):
+            if checkpoint_dir is not None:
+                import functools
 
-            params = _durable_fit(
-                functools.partial(_hw.fit, period=period,
-                                  model_type=model_type),
-                ts, checkpoint_dir, **durable_kwargs)
-            return HoltWintersModel(params, period, model_type)
-        _require_checkpoint_dir(durable_kwargs)
-        res = _hw.fit(jnp.asarray(ts), period, model_type=model_type)
-        return HoltWintersModel(res.params, period, model_type)
+                params = _durable_fit(
+                    functools.partial(_hw.fit, period=period,
+                                      model_type=model_type),
+                    ts, checkpoint_dir, **durable_kwargs)
+                return HoltWintersModel(params, period, model_type)
+            _require_checkpoint_dir(durable_kwargs)
+            res = _hw.fit(jnp.asarray(ts), period, model_type=model_type)
+            return HoltWintersModel(res.params, period, model_type)
 
 
 class RegressionARIMAModel(_ModelBase):
@@ -602,8 +609,10 @@ class RegressionARIMA:
     @staticmethod
     def fit_model(y, X, method: str = "cochrane-orcutt",
                   **kwargs) -> RegressionARIMAModel:
-        res = _regarima.fit(jnp.asarray(y), jnp.asarray(X), method, **kwargs)
-        return RegressionARIMAModel(res.params)
+        with obs.span("compat.fit_model", model="RegressionARIMA"):
+            res = _regarima.fit(jnp.asarray(y), jnp.asarray(X), method,
+                                **kwargs)
+            return RegressionARIMAModel(res.params)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,87 @@
+"""Runtime telemetry plane (ISSUE 3): spans, metrics, flight recorder.
+
+The reference got its observability for free from Spark — per-task
+metrics, the event log, and a web UI made every job's stragglers, retries,
+and memory pressure visible.  The TPU rebuild re-created Spark's
+*resilience* (retry ladder, chunk journal, watchdog) but a million-series
+fit was still a black box between "started" and the final status counts.
+This package is the missing plane, zero-dependency and **off by default**
+— when disabled every call returns a shared no-op object, adds no events,
+and leaves fit results bitwise-identical to the uninstrumented code:
+
+- :mod:`.core` — nested wall/process-time **spans**
+  (``obs.span("chunk", lo=...)``), first-dispatch tagging that separates
+  trace+compile time from steady-state execute time, run summaries, and
+  failure dumps; ``profile=True`` mirrors spans into ``jax.profiler``
+  annotations.
+- :mod:`.metrics` — the **registry** of counters / gauges / histograms
+  the instrumented paths feed: ladder-rung counts per ``FitStatus``,
+  sanitizer actions, OOM backoff halvings, watchdog timeouts, journal
+  commit latency, ``map_series`` compiled-kernel cache hits/misses,
+  peak-memory gauges.
+- :mod:`.recorder` — the bounded ring-buffer **flight recorder**: every
+  span/event lands in a ring (and, when enabled with a path, a flushed
+  JSONL stream ``tools/obs_report.py`` renders), and any fit failure
+  dumps the tail for post-mortems.
+- :mod:`.memory` — peak-memory probe: device ``memory_stats()`` with a
+  host peak-RSS fallback, so the reading is never null on CPU.
+
+Usage::
+
+    from spark_timeseries_tpu import obs
+    obs.enable("run.jsonl")           # or STSTPU_OBS=1 in the environment
+    res = panel.fit("arima", order=(1, 1, 1), chunk_rows=131_072,
+                    checkpoint_dir="/ckpt/job42")
+    res.meta["telemetry"]             # per-chunk spans, counters, peak mem
+    obs.disable()                     # final metrics snapshot -> JSONL
+
+Instrumented surfaces: ``reliability.fit_chunked`` / ``resilient_fit`` /
+``sanitize`` / ``journal`` / ``watchdog``, ``TimeSeriesPanel.fit`` /
+``map_series``, the compat ``fit_model`` wrappers, and
+``utils.optim``'s straggler-compaction stage.
+"""
+
+from . import core, memory, metrics, recorder
+from .core import (NULL_SPAN, Span, counter, disable, dump_failure,
+                   dump_on_failure, emit_metrics, enable, enable_from_env,
+                   enabled, event, first_dispatch, gauge, histogram,
+                   last_crash_dump, snapshot, span, summary)
+from .memory import PeakMemory, peak_memory
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .recorder import SCHEMA_VERSION, FlightRecorder
+
+__all__ = [
+    "Counter",
+    "FlightRecorder",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NULL_SPAN",
+    "PeakMemory",
+    "SCHEMA_VERSION",
+    "Span",
+    "core",
+    "counter",
+    "disable",
+    "dump_failure",
+    "dump_on_failure",
+    "emit_metrics",
+    "enable",
+    "enable_from_env",
+    "enabled",
+    "event",
+    "first_dispatch",
+    "gauge",
+    "histogram",
+    "last_crash_dump",
+    "memory",
+    "metrics",
+    "peak_memory",
+    "recorder",
+    "snapshot",
+    "span",
+    "summary",
+]
+
+# bench / CI opt-in without code changes (no-op unless STSTPU_OBS=1)
+enable_from_env()

@@ -1,0 +1,66 @@
+"""Peak-memory probe: device ``memory_stats()`` with a host-RSS fallback.
+
+``BENCH_r05.json`` shipped ``northstar_1m.peak_hbm_bytes: null`` whenever
+the run landed on a backend whose devices do not implement
+``memory_stats()`` (CPU, some GPU builds) — the reading silently vanished
+exactly where operators develop and CI runs.  This probe never returns
+null on a working interpreter: it prefers the device allocator's
+``peak_bytes_in_use`` (TPU HBM — the number capacity planning wants) and
+falls back to the process's peak resident set via ``resource.getrusage``
+(the closest host-side analog), always reporting WHICH source produced
+the number so a dashboard cannot mistake host RSS for HBM.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import NamedTuple, Optional
+
+__all__ = ["PeakMemory", "peak_memory"]
+
+
+class PeakMemory(NamedTuple):
+    """A peak-memory reading and the probe that produced it."""
+
+    bytes: Optional[int]  # None only when every probe failed
+    source: str  # "device" | "host_rss" | "unavailable"
+
+
+def _device_peak() -> Optional[int]:
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:  # noqa: BLE001 - diagnostics only, never fail the fit
+        return None
+    peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+    return int(peak) if peak else None
+
+
+def _host_peak_rss() -> Optional[int]:
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    except Exception:  # noqa: BLE001 - e.g. no resource module (Windows)
+        return None
+    if not peak:
+        return None
+    # ru_maxrss is KiB on Linux, bytes on macOS
+    return int(peak) if sys.platform == "darwin" else int(peak) * 1024
+
+
+def peak_memory() -> PeakMemory:
+    """Best available peak-memory reading (see module docstring).
+
+    On backends with a real device allocator the reading is peak HBM; on
+    CPU it degrades to host peak RSS rather than ``None`` — the source
+    field says which, and consumers must label accordingly.
+    """
+    b = _device_peak()
+    if b is not None:
+        return PeakMemory(b, "device")
+    b = _host_peak_rss()
+    if b is not None:
+        return PeakMemory(b, "host_rss")
+    return PeakMemory(None, "unavailable")

@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from . import index as dtix
+from . import obs
 from .index import DateTimeIndex, DateTimeLike
 from .ops import univariate as uv
 from .parallel import mesh as meshlib
@@ -148,7 +149,11 @@ def _cached_batched(fn: Callable, *args) -> Callable:
     if key is not None:
         hit = _BATCH_CACHE.get(key)
         if hit is not None:
+            obs.counter("panel.map_series.cache_hits").inc()
             return hit
+        obs.counter("panel.map_series.cache_misses").inc()
+    else:
+        obs.counter("panel.map_series.uncached").inc()
     def _scoped_fn(v):
         with jax.named_scope("panel.map_series"):
             return fn(v, *args)
@@ -290,8 +295,11 @@ class TimeSeriesPanel:
         referenced-global values (not object identity), so passing a fresh
         but textually identical lambda each call reuses one compiled program;
         kernels whose closures capture unhashable state compile uncached.
+        Cache hits/misses feed the telemetry registry
+        (``panel.map_series.cache_*``) when ``obs`` is enabled.
         """
-        out = _cached_batched(fn)(self.values)
+        with obs.span("panel.map_series", n_series=self.n_series):
+            out = _cached_batched(fn)(self.values)
         idx = new_index if new_index is not None else self.index
         if out.ndim != 2 or out.shape[1] != idx.size:
             raise ValueError(
@@ -388,13 +396,16 @@ class TimeSeriesPanel:
             fit_fn = mod.fit
         from .reliability import fit_chunked
 
-        return fit_chunked(
-            fit_fn, self.series_values(), chunk_rows=chunk_rows,
-            resilient=resilient, policy=policy,
-            checkpoint_dir=checkpoint_dir, resume=resume,
-            chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
-            **fit_kwargs,
-        )
+        model_name = (model if isinstance(model, str)
+                      else getattr(model, "__qualname__", repr(model)))
+        with obs.span("panel.fit", model=model_name, n_series=self.n_series):
+            return fit_chunked(
+                fit_fn, self.series_values(), chunk_rows=chunk_rows,
+                resilient=resilient, policy=policy,
+                checkpoint_dir=checkpoint_dir, resume=resume,
+                chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
+                **fit_kwargs,
+            )
 
     def lags(self, max_lag: int, include_original: bool = True,
              lagged_key: Callable[[object, int], object] = None) -> "TimeSeriesPanel":

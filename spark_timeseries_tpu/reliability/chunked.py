@@ -35,6 +35,8 @@ from typing import Callable, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
+from ..obs import memory as memory_probe
 from . import journal as journal_mod
 from . import watchdog as watchdog_mod
 from .runner import ResilientFitResult, resilient_fit
@@ -67,17 +69,17 @@ def is_resource_exhausted(e: BaseException) -> bool:
     return any(m in msg for m in _OOM_MARKERS)
 
 
-def _device_peak_hbm() -> Optional[int]:
-    """Peak device-memory bytes, when the backend reports them (TPU does;
-    CPU's ``memory_stats()`` is ``None``)."""
-    try:
-        import jax
-
-        stats = jax.local_devices()[0].memory_stats() or {}
-    except Exception:  # noqa: BLE001 - diagnostics only, never fail the fit
-        return None
-    peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
-    return int(peak) if peak else None
+def _span_times(sp) -> dict:
+    """Wall/process times of a closed chunk span, or ``{}`` when the plane
+    was disabled mid-run (the span degraded to the shared no-op whose
+    times are None — telemetry may lose a row's timings but must never
+    crash the fit it observes)."""
+    if sp.wall_s is None:
+        return {}
+    out = {"wall_s": round(sp.wall_s, 6)}
+    if sp.process_s is not None:
+        out["process_s"] = round(sp.process_s, 6)
+    return out
 
 
 class _TimeoutChunk:
@@ -102,6 +104,7 @@ def _commit_arrays(piece) -> dict:
     }
 
 
+@obs.dump_on_failure("fit_chunked")
 def fit_chunked(
     fit_fn: Callable,
     y,
@@ -162,6 +165,16 @@ def fit_chunked(
     backoff and timeout event, ``degraded=True`` whenever a backoff or
     timeout happened, and — when journaled — the journal accounting
     (``meta["journal"]``: run id, chunks committed/resumed/timeout).
+
+    **Telemetry** (``obs.enable()``): each chunk dispatch runs under an
+    ``obs.span("chunk")`` whose first dispatch per (fit, shape, dtype) is
+    tagged ``compile+execute`` (JAX pays trace+compile there) and the rest
+    ``execute``; backoffs, timeouts, and per-row status totals feed the
+    metrics registry, and the per-run summary — per-chunk span times,
+    counters, peak memory (never null: host-RSS fallback) — lands in
+    ``meta["telemetry"]`` and, when journaled, the manifest's
+    ``telemetry`` block.  Disabled (the default), none of this runs and
+    the result is bitwise-identical to the uninstrumented driver.
     """
     yb = jnp.asarray(y)
     if yb.ndim != 2:
@@ -200,6 +213,33 @@ def fit_chunked(
 
     import time as _time
 
+    # per-chunk telemetry rows for meta["telemetry"] / the manifest block;
+    # None (not empty) when disabled so the disabled path allocates nothing
+    # and meta stays byte-identical to the uninstrumented driver
+    tele = obs.enabled()
+    tele_chunks = [] if tele else None
+    # counter baseline at fit start: the registry is run-wide (one
+    # obs.enable() can span many fits), but THIS fit's summary must report
+    # its own activity — counters are emitted as deltas from here, so fit
+    # B's manifest does not inherit fit A's DIVERGED rows or OOM backoffs.
+    # Known limit: a watchdog-ABANDONED worker (timed-out chunk) may still
+    # be incrementing counters after its fit returns; those late increments
+    # land in whichever delta window is open (XLA dispatch cannot be
+    # cancelled, so this is inherent to abandonment, and data-quality only)
+    counters0 = (obs.snapshot() or {}).get("counters") if tele else None
+    # compile-affecting identity of this fit config, computed ONCE: the
+    # first dispatch per (config, chunk-rows) pays JAX trace+compile, and a
+    # later job with the same shape but different static config (order,
+    # max_iters, backend, ladder) compiles anew — reuse the journal's
+    # config_hash (fit identity + every kwarg + driver knobs) so the
+    # compile-identity ingredients live in ONE place
+    fit_key = journal_mod.config_hash(
+        fit_fn, fit_kwargs,
+        extra={"resilient": resilient, "policy": policy,
+               "ladder": "default" if ladder is None else repr(ladder),
+               "time": int(yb.shape[1]), "dtype": str(yb.dtype)},
+    ) if tele else None
+
     pieces = []
     oom_events = []
     timeout_events = []
@@ -216,6 +256,9 @@ def fit_chunked(
                 piece = journal.load_chunk(entry)
                 if piece is not None:
                     pieces.append(piece)
+                    if tele:
+                        tele_chunks.append({"lo": lo, "hi": int(entry["hi"]),
+                                            "phase": "resumed"})
                     lo = entry["hi"]
                     # replay the backoff state in effect when the chunk
                     # committed, so the resumed walk visits the SAME
@@ -243,6 +286,12 @@ def fit_chunked(
             timeout_events.append({
                 "at_row": lo, "chunk_rows": hi - lo, "dispatched": False,
                 "budget_s": deadline.budget_s, "scope": "job"})
+            obs.counter("chunked.timeouts.job").inc()
+            obs.event("chunk.timeout", lo=lo, hi=hi, scope="job",
+                      dispatched=False)
+            if tele:
+                tele_chunks.append({"lo": lo, "hi": hi, "phase": "timeout",
+                                    "scope": "job"})
             pieces.append(_TimeoutChunk(lo, hi))
             if journal is not None:
                 journal.mark_timeout(lo, hi, scope="job",
@@ -261,10 +310,22 @@ def fit_chunked(
                     fit_fn, vals, policy=policy, ladder=ladder, **fit_kwargs)
             return fit_fn(vals, **fit_kwargs)
 
+        phase = None
+        if tele:
+            # first dispatch of this (fit config, chunk rows) pays JAX
+            # trace+compile; later dispatches of the same shape execute a
+            # cached program — the split BENCH scraped ad hoc, now recorded
+            # per chunk (a backoff-halved chunk is a NEW shape = new compile)
+            phase = ("compile+execute"
+                     if obs.first_dispatch((fit_key, hi - lo))
+                     else "execute")
+        sp = obs.span("chunk", lo=lo, hi=hi, phase=phase)
         t0 = _time.perf_counter()
         try:
-            piece = watchdog_mod.call_with_deadline(
-                run_chunk, chunk_budget_s, label=f"chunk rows [{lo}, {hi})")
+            with sp:
+                piece = watchdog_mod.call_with_deadline(
+                    run_chunk, chunk_budget_s,
+                    label=f"chunk rows [{lo}, {hi})")
         except watchdog_mod.DeadlineExceeded:
             if forced:
                 chunk = forced[1]
@@ -272,6 +333,12 @@ def fit_chunked(
             timeout_events.append({
                 "at_row": lo, "chunk_rows": hi - lo, "dispatched": True,
                 "budget_s": chunk_budget_s, "scope": "chunk"})
+            obs.counter("chunked.timeouts.chunk").inc()
+            obs.event("chunk.timeout", lo=lo, hi=hi, scope="chunk",
+                      dispatched=True, budget_s=chunk_budget_s)
+            if tele:
+                tele_chunks.append({"lo": lo, "hi": hi, "phase": "timeout",
+                                    "scope": "chunk", **_span_times(sp)})
             pieces.append(_TimeoutChunk(lo, hi))
             if journal is not None:
                 journal.mark_timeout(lo, hi, scope="chunk",
@@ -299,6 +366,8 @@ def fit_chunked(
                 "at_row": lo, "chunk_rows": chunk,
                 "error": f"{type(e).__name__}: {e}"[:200],
             })
+            obs.counter("chunked.oom_backoffs").inc()
+            obs.event("chunk.oom_backoff", at_row=lo, chunk_rows=chunk)
             if chunk <= min_chunk_rows or len(oom_events) > max_backoffs:
                 raise OOMBackoffExceeded(
                     f"chunk of {chunk} rows still RESOURCE_EXHAUSTED after "
@@ -309,12 +378,17 @@ def fit_chunked(
         if forced:  # torn-shard recompute done: restore the recorded walk
             chunk = forced[1]
             lost_boundaries.pop(lo, None)
+        if tele:
+            tele_chunks.append({"lo": lo, "hi": hi, "phase": phase,
+                                **_span_times(sp)})
         if journal is not None:
             arrays = _commit_arrays(piece)
+            pm = memory_probe.peak_memory()
             journal.commit_chunk(
                 lo, hi, arrays,
                 wall_s=round(_time.perf_counter() - t0, 4),
-                peak_hbm_bytes=_device_peak_hbm(),
+                peak_hbm_bytes=pm.bytes,
+                peak_hbm_source=pm.source,
                 chunk_rows_after=chunk,
                 status_counts=status_counts(arrays["status"]),
             )
@@ -369,6 +443,18 @@ def fit_chunked(
             agg["rescued"] += r["rescued"]
     if rung_totals:
         meta["ladder_totals"] = rung_totals
+    if tele:
+        for name, v in meta["status_counts"].items():
+            if v:
+                obs.counter(f"fit_status.{name}").add(v)
+        # summary() is None if the plane was disabled mid-run: drop the
+        # block entirely rather than crash or journal a null
+        telemetry = obs.summary(counters_since=counters0, chunks=tele_chunks)
+        if telemetry is not None:
+            meta["telemetry"] = telemetry
+            if journal is not None:
+                journal.record_telemetry(telemetry)
+            obs.emit_metrics()
     return ResilientFitResult(params, nll, conv, iters, status, meta)
 
 

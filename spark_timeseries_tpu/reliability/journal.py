@@ -54,6 +54,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from .. import obs
+
 __all__ = [
     "ChunkJournal",
     "JournalError",
@@ -376,7 +378,8 @@ class ChunkJournal:
             self._by_lo.pop(entry["lo"], None)
             return None
         self.resumed_entries += 1  # resumed = actually rehydrated, not
-        return piece               # merely listed (a torn shard recomputes)
+        obs.counter("journal.chunks_resumed").inc()  # (torn shards recompute)
+        return piece
 
     def _record(self, entry: dict) -> None:
         self._manifest["chunks"] = [
@@ -395,6 +398,7 @@ class ChunkJournal:
 
     def commit_chunk(self, lo: int, hi: int, arrays: dict, **info) -> dict:
         """Write the shard durably, THEN name it in the manifest."""
+        t0 = time.perf_counter()
         lo, hi = int(lo), int(hi)
         shard = self._shard_name(lo, hi)
         path = os.path.join(self.dir, shard)
@@ -416,6 +420,10 @@ class ChunkJournal:
         entry = {"lo": lo, "hi": hi, "status": "committed", "shard": shard,
                  "run_id": self.run_id, "committed_at": time.time(), **info}
         self._record(entry)
+        commit_s = time.perf_counter() - t0
+        obs.histogram("journal.commit_s").observe(commit_s)
+        obs.event("journal.commit", lo=lo, hi=hi,
+                  commit_s=round(commit_s, 6))
         return entry
 
     def mark_timeout(self, lo: int, hi: int, **info) -> dict:
@@ -424,7 +432,17 @@ class ChunkJournal:
         entry = {"lo": int(lo), "hi": int(hi), "status": "TIMEOUT",
                  "run_id": self.run_id, "committed_at": time.time(), **info}
         self._record(entry)
+        obs.event("journal.timeout", lo=int(lo), hi=int(hi))
         return entry
+
+    def record_telemetry(self, telemetry: dict) -> None:
+        """Embed the run's telemetry summary in the manifest (atomically
+        rewritten), so post-mortems read compile/execute span times,
+        counters, and peak memory from the journal alone
+        (``tools/inspect_journal.py`` prints it, ``tools/obs_report.py
+        --manifest`` validates it)."""
+        self._manifest["telemetry"] = telemetry
+        self._write_manifest()
 
     # -- summary ------------------------------------------------------------
 

@@ -34,6 +34,7 @@ from typing import Callable, NamedTuple, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..utils import optim
 from .sanitize import sanitize as _sanitize
 from .status import STATUS_DTYPE, FitStatus, status_counts
@@ -113,6 +114,16 @@ def _structurally_excluded(res) -> np.ndarray:
     return np.asarray(res.status) == FitStatus.EXCLUDED
 
 
+def _recoverable_oom(e: BaseException) -> bool:
+    """RESOURCE_EXHAUSTED is recoverable one layer up (``fit_chunked``
+    backoff) — no crash dump for it here; the lazy import avoids the
+    runner<->chunked cycle (chunked imports this module)."""
+    from .chunked import is_resource_exhausted
+
+    return is_resource_exhausted(e)
+
+
+@obs.dump_on_failure("resilient_fit", unless=_recoverable_oom)
 def resilient_fit(
     fit_fn: Callable,
     y,
@@ -166,7 +177,8 @@ def resilient_fit(
         status = np.zeros(b, STATUS_DTYPE)
         san_meta = {"policy": "off"}
 
-    res = fit_fn(y_clean, **fit_kwargs)
+    with obs.span("fit.primary", rows=b):
+        res = fit_fn(y_clean, **fit_kwargs)
     params = np.array(res.params)
     nll = np.array(res.neg_log_likelihood)
     conv = np.array(res.converged)
@@ -188,6 +200,12 @@ def resilient_fit(
         over_cap = skipped.size
     rungs = (default_ladder(fit_fn, fit_kwargs.get("max_iters"))
              if ladder is None else tuple(ladder))
+    # register every rung's counters up front (zero-valued when no row ever
+    # enters the ladder) so the run summary always reports the full
+    # ladder-rung vocabulary, not just the rungs that happened to fire
+    for rung in rungs:
+        obs.counter(f"ladder.{rung.name}.attempted")
+        obs.counter(f"ladder.{rung.name}.rescued")
     rung_meta = []
     rng = np.random.default_rng(seed)
     supports_init = "init_params" in _accepted_kwargs(
@@ -217,7 +235,8 @@ def resilient_fit(
                 (base + jitter).astype(np.asarray(y_clean).dtype)
             )
         kw = _accepted_kwargs(fit_fn, kw)
-        sub = fit_fn(y_sub, **kw)
+        with obs.span(f"fit.rung.{rung.name}", rows=int(idx.size), cap=cap):
+            sub = fit_fn(y_sub, **kw)
         sub_failed = _failed_mask(sub)[: idx.size]
         rescued = idx[~sub_failed]
         if rescued.size:
@@ -234,6 +253,8 @@ def resilient_fit(
             "attempted": int(idx.size), "rescued": int(rescued.size),
             "kwargs": {k: v for k, v in rung.kwargs.items()},
         })
+        obs.counter(f"ladder.{rung.name}.attempted").add(int(idx.size))
+        obs.counter(f"ladder.{rung.name}.rescued").add(int(rescued.size))
 
     # survivors of every rung: flag DIVERGED and refuse to hand back
     # non-finite params as if they were estimates

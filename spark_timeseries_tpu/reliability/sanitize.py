@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..ops import univariate as uv
 from .status import STATUS_DTYPE, FitStatus
 
@@ -92,6 +93,11 @@ def sanitize(y, policy: str = "impute") -> SanitizeReport:
     yb = jnp.asarray(y)
     if yb.ndim != 2:
         raise ValueError(f"sanitize expects [batch, time], got {yb.shape}")
+    with obs.span("sanitize", rows=int(yb.shape[0]), policy=policy):
+        return _sanitize_timed(yb, policy)
+
+
+def _sanitize_timed(yb, policy: str) -> SanitizeReport:
     y1, had_inf, interior_nan, constant, all_nan = _probe(yb)
     had_inf = np.asarray(had_inf)
     interior_nan = np.asarray(interior_nan)
@@ -135,4 +141,12 @@ def sanitize(y, policy: str = "impute") -> SanitizeReport:
         "rows_excluded": int((status == FitStatus.EXCLUDED).sum()),
         **{f"rows_{k}": int(v.sum()) for k, v in flags.items()},
     }
+    # telemetry: sanitizer actions as monotonic counters (no-ops when off)
+    obs.counter("sanitize.rows_checked").add(int(yb.shape[0]))
+    obs.counter("sanitize.rows_sanitized").add(meta["rows_sanitized"])
+    obs.counter("sanitize.rows_excluded").add(meta["rows_excluded"])
+    for k, v in flags.items():
+        n = int(v.sum())
+        if n:
+            obs.counter(f"sanitize.rows_{k}").add(n)
     return SanitizeReport(out, status, flags, meta)

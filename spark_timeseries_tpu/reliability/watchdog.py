@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from .. import obs
+
 __all__ = ["Deadline", "DeadlineExceeded", "call_with_deadline"]
 
 
@@ -101,6 +103,8 @@ def call_with_deadline(fn: Callable, budget_s: Optional[float] = None,
                          name=f"watchdog:{label or 'fit'}")
     t.start()
     if not done.wait(timeout=float(budget_s)):
+        obs.counter("watchdog.deadline_exceeded").inc()
+        obs.event("watchdog.timeout", label=label, budget_s=float(budget_s))
         raise DeadlineExceeded(label, float(budget_s))
     if "error" in box:
         raise box["error"]

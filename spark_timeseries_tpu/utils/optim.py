@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import obs
+
 
 # Straggler-compaction sizing shared by every fit driver: below this batch
 # size the compaction stage is not worth its gather, and the cap must cover
@@ -532,10 +534,20 @@ def minimize_lbfgs_batched(
         # TRUNCATION CONTRACT (ADVICE r5): when stage 1 exits at max_iters
         # with MORE than cap rows undone, this size=cap gather silently
         # drops the excess — benign only because stage 2 shares the same
-        # exhausted iteration budget (cond_sub tests state.k < max_iters),
-        # so the sub-loop runs zero steps and the dropped rows' state is
-        # unchanged by the scatter.  Any change that gives stage 2 its OWN
-        # budget must first make this gather lossless.
+        # exhausted iteration budget (cond_sub tests state.k <
+        # stage2_max_iters == max_iters), so the sub-loop runs zero steps
+        # and the dropped rows' state is unchanged by the scatter.  Any
+        # change that gives stage 2 its OWN budget must first make this
+        # gather lossless — the assert below is the tripwire.
+        stage2_max_iters = max_iters
+        assert stage2_max_iters == max_iters, (
+            "stage-2 straggler budget must equal max_iters while the "
+            "size=cap gather can truncate at max_iters (ADVICE r5: make "
+            "the gather lossless before giving stage 2 its own budget)")
+        # this Python block runs once per TRACE of the enclosing fit
+        # program (lru-cached jit per static config), so the counter counts
+        # stage-2 COMPILE trips, not steady-state dispatches
+        obs.counter("optim.stage2_compact_traces").inc()
         undone1 = ~(stage1.converged | stage1.failed)
         idx = jnp.nonzero(undone1, size=cap, fill_value=bsz)[0]
         idxc = jnp.minimum(idx, bsz - 1)
@@ -553,7 +565,7 @@ def minimize_lbfgs_batched(
 
         def cond_sub(carry):
             state, _, _ = carry
-            return (state.k < max_iters) & jnp.any(
+            return (state.k < stage2_max_iters) & jnp.any(
                 ~(state.converged | state.failed))
 
         sub_f, sub_iters, ls_hist = lax.while_loop(

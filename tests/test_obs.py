@@ -1,0 +1,402 @@
+"""Telemetry plane tests (ISSUE 3, tier-1 CPU).
+
+Two contracts dominate: (1) **invariance** — telemetry observes, never
+participates: a fit with the plane enabled is bitwise-identical to the same
+fit disabled, including across a journaled kill-and-resume; (2) the
+**disabled path is structurally free** — every entry point returns one
+shared no-op object, no events accumulate, and result metadata gains no
+keys, so pre-PR behavior is preserved byte for byte.  On top of those, the
+acceptance scenario: a journaled 8-chunk fit with telemetry on produces a
+schema-valid JSONL event log, a manifest ``telemetry`` block with
+per-chunk compile/execute span times and ladder-rung counters, and a
+non-null peak-memory reading on CPU (host-RSS fallback).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu import obs
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.reliability import faultinject as fi
+from spark_timeseries_tpu.utils import optim
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _plane_off():
+    """Every test starts and ends with the plane disabled (enable() builds
+    a fresh registry, so state cannot bleed between tests either way)."""
+    obs.disable()
+    yield
+    obs.disable()
+
+
+def _ar_panel(b=32, t=96, seed=7, phi=0.6):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(b, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, t):
+        y[:, i] = phi * y[:, i - 1] + e[:, i]
+    return y
+
+
+def _fit(y, d=None, **kw):
+    return rel.fit_chunked(arima.fit, y, chunk_rows=4, checkpoint_dir=d,
+                           order=(1, 0, 0), max_iters=15, **kw)
+
+
+def _assert_bitwise(a, b):
+    for f in ("params", "neg_log_likelihood", "converged", "iters", "status"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, f)),
+                                      np.asarray(getattr(b, f)),
+                                      err_msg=f"field {f!r} differs")
+
+
+# ---------------------------------------------------------------------------
+# disabled path: structurally a no-op
+# ---------------------------------------------------------------------------
+
+
+class TestDisabled:
+    def test_disabled_entry_points_are_shared_noops(self):
+        assert not obs.enabled()
+        assert obs.span("a") is obs.span("b") is obs.NULL_SPAN
+        assert obs.counter("a") is obs.gauge("b") is obs.histogram("c")
+        assert obs.snapshot() is None
+        assert obs.summary() is None
+        obs.event("e", x=1)  # swallowed, no recorder exists
+        obs.emit_metrics()
+        assert not obs.first_dispatch(("k",))
+
+    def test_disabled_fit_adds_no_meta_and_no_manifest_block(self, tmp_path):
+        d = str(tmp_path / "j")
+        res = _fit(_ar_panel(), d)
+        assert "telemetry" not in res.meta
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        assert "telemetry" not in m
+        assert m["chunks"][0]["peak_hbm_bytes"]  # fallback fills it anyway
+
+    def test_disable_is_idempotent(self):
+        obs.disable()
+        obs.disable()
+
+
+# ---------------------------------------------------------------------------
+# invariance: telemetry observes, never participates
+# ---------------------------------------------------------------------------
+
+
+class TestInvariance:
+    def test_enabled_fit_bitwise_equals_disabled_fit(self, tmp_path):
+        y = _ar_panel()
+        ref = _fit(y)  # plane off
+        obs.enable(str(tmp_path / "ev.jsonl"))
+        got = _fit(y)
+        _assert_bitwise(got, ref)
+        assert "telemetry" in got.meta
+
+    def test_kill_and_resume_with_telemetry_is_bitwise(self, tmp_path):
+        """The satellite bar: a journaled crash/resume run with telemetry
+        ENABLED matches an uninterrupted (uninstrumented) run bitwise."""
+        y = _ar_panel()
+        full = _fit(y)  # plane off, unjournaled reference
+        d = str(tmp_path / "j")
+        obs.enable(str(tmp_path / "ev.jsonl"))
+        with pytest.raises(fi.SimulatedCrash):
+            _fit(y, d, _journal_commit_hook=fi.crash_after_commits(2))
+        res = _fit(y, d)
+        _assert_bitwise(res, full)
+        assert res.meta["journal"]["chunks_resumed"] == 2
+        t = res.meta["telemetry"]
+        phases = [c["phase"] for c in t["chunks"]]
+        assert phases.count("resumed") == 2
+        assert phases.count("execute") + phases.count("compile+execute") == 6
+
+    def test_per_fit_counter_deltas_across_one_enable(self, tmp_path):
+        """One obs.enable() spanning two fits: fit B's summary must report
+        B's own counts, not inherit fit A's failures (per-fit deltas)."""
+        y = _ar_panel()
+        obs.enable()
+        ff = fi.failing_fit(arima.fit, y, rows=[2], n_failures=9)
+        ra = rel.fit_chunked(ff, y, chunk_rows=16, order=(1, 0, 0),
+                             max_iters=15)
+        assert ra.meta["telemetry"]["counters"]["fit_status.DIVERGED"] == 1
+        d = str(tmp_path / "j")
+        rb = _fit(y, d)
+        assert rb.meta["telemetry"]["counters"]["fit_status.DIVERGED"] == 0
+        assert rb.meta["telemetry"]["counters"]["fit_status.OK"] == 32
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        assert m["telemetry"]["counters"]["fit_status.DIVERGED"] == 0
+
+    def test_mid_run_disable_never_crashes_the_fit(self):
+        """disable() landing while a chunked fit is mid-walk (another fit
+        in the process tearing down its telemetry) must not take the fit
+        down; the partial telemetry block is dropped, never null."""
+        import threading
+        import time as _t
+
+        y = _ar_panel()
+        obs.enable()
+        th = threading.Thread(
+            target=lambda: (_t.sleep(0.05), obs.disable()))
+        slow = fi.hanging_fit(arima.fit, [0, 1], sleep_s=0.2)
+        th.start()
+        res = rel.fit_chunked(slow, y, chunk_rows=8, resilient=False,
+                              order=(1, 0, 0), max_iters=15)
+        th.join()
+        assert res.params.shape[0] == 32
+        t = res.meta.get("telemetry")
+        assert t is None or isinstance(t, dict)  # present or dropped, no null
+
+    def test_profile_mode_does_not_change_results(self, tmp_path):
+        y = _ar_panel(b=8)
+        ref = _fit(y)
+        obs.enable(str(tmp_path / "ev.jsonl"), profile=True)
+        got = _fit(y)
+        _assert_bitwise(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: journaled 8-chunk fit, full surface validated
+# ---------------------------------------------------------------------------
+
+
+class TestAcceptance:
+    def test_journaled_8_chunk_fit_full_telemetry_surface(self, tmp_path):
+        y = _ar_panel()  # 32 rows / chunk_rows=4 -> 8 chunks
+        jsonl = str(tmp_path / "ev.jsonl")
+        ck = str(tmp_path / "journal")
+        obs.enable(jsonl)
+        res = _fit(y, ck)
+        t = res.meta["telemetry"]
+
+        # per-chunk compile/execute span times
+        assert len(t["chunks"]) == 8
+        assert t["chunks"][0]["phase"] == "compile+execute"
+        assert all(c["phase"] == "execute" for c in t["chunks"][1:])
+        assert all(c["wall_s"] >= 0 and c["process_s"] >= 0
+                   for c in t["chunks"])
+
+        # ladder-rung counters present (zero: nothing failed), sanitizer
+        # actions, journal commit latency, per-status totals
+        for k in ("ladder.retry.attempted", "ladder.retry.rescued",
+                  "ladder.fallback.attempted", "ladder.fallback.rescued"):
+            assert k in t["counters"]
+        assert t["counters"]["sanitize.rows_checked"] == 32
+        assert t["counters"]["fit_status.OK"] == 32
+        assert t["histograms"]["journal.commit_s"]["count"] == 8
+
+        # non-null peak memory on CPU (host-RSS fallback), source recorded
+        assert t["peak_memory"]["bytes"] > 0
+        assert t["peak_memory"]["source"] in ("device", "host_rss")
+
+        # manifest embeds the same block; per-chunk entries carry source
+        m = json.load(open(os.path.join(ck, "manifest.json")))
+        assert m["telemetry"]["run_id"] == t["run_id"]
+        assert all(e["peak_hbm_bytes"] and e["peak_hbm_source"]
+                   for e in m["chunks"])
+
+        obs.disable()  # flush the closing metrics line
+
+        # the JSONL stream validates under the CI schema gate
+        out = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools", "obs_report.py"),
+             jsonl, "--check", "--manifest", ck],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        # and renders without error
+        out = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools", "obs_report.py"),
+             jsonl],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "chunk" in out.stdout and "counters:" in out.stdout
+
+    def test_inspect_journal_prints_telemetry(self, tmp_path):
+        y = _ar_panel(b=8)
+        ck = str(tmp_path / "journal")
+        obs.enable()
+        rel.fit_chunked(arima.fit, y, chunk_rows=4, checkpoint_dir=ck,
+                        order=(1, 0, 0), max_iters=15)
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(_ROOT, "tools", "inspect_journal.py"), ck],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "telemetry (obs run" in out.stdout
+        assert "compile+execute" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# subsystem units: spans, metrics, recorder, memory, failure dumps
+# ---------------------------------------------------------------------------
+
+
+class TestSpansAndMetrics:
+    def test_nested_spans_record_depth_and_order(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        obs.enable(p)
+        with obs.span("outer"):
+            with obs.span("inner", k=1):
+                pass
+        obs.disable()
+        lines = [json.loads(l) for l in open(p)]
+        spans = [l for l in lines if l["kind"] == "span"]
+        assert [s["name"] for s in spans] == ["inner", "outer"]
+        assert spans[0]["depth"] == 1 and spans[1]["depth"] == 0
+        assert spans[0]["attrs"] == {"k": 1}
+
+    def test_metrics_registry_semantics(self):
+        obs.enable()
+        obs.counter("c").inc()
+        obs.counter("c").add(4)
+        obs.gauge("g").set(7)
+        obs.gauge("peak").max(3)
+        obs.gauge("peak").max(1)  # keeps the max
+        for v in (0.5, 1.5, 1.0):
+            obs.histogram("h").observe(v)
+        s = obs.snapshot()
+        assert s["counters"]["c"] == 5
+        assert s["gauges"]["g"] == 7 and s["gauges"]["peak"] == 3
+        h = s["histograms"]["h"]
+        assert h["count"] == 3 and h["min"] == 0.5 and h["max"] == 1.5
+        assert h["mean"] == pytest.approx(1.0)
+
+    def test_flight_recorder_ring_is_bounded(self, tmp_path):
+        obs.enable(ring_size=4)
+        for i in range(10):
+            obs.event("e", i=i)
+        tail = obs.core._STATE.recorder.tail()
+        assert len(tail) == 4
+        assert tail[-1]["attrs"]["i"] == 9
+
+    def test_enable_returns_fresh_run(self):
+        r1 = obs.enable()
+        obs.counter("x").inc()
+        r2 = obs.enable()  # finalizes the first run
+        assert r1 != r2
+        assert obs.snapshot()["counters"] == {}
+
+    def test_peak_memory_never_null_on_cpu(self):
+        pm = obs.peak_memory()
+        assert pm.bytes and pm.bytes > 0
+        assert pm.source in ("device", "host_rss")
+
+    def test_first_dispatch_once_per_key(self):
+        obs.enable()
+        assert obs.first_dispatch(("k", 1))
+        assert not obs.first_dispatch(("k", 1))
+        assert obs.first_dispatch(("k", 2))
+
+
+class TestFailureDump:
+    def test_fit_failure_dumps_recorder_tail(self, tmp_path):
+        y = _ar_panel(b=8)
+        obs.enable(str(tmp_path / "ev.jsonl"))
+        # OOM at the floor: backoff cannot help -> OOMBackoffExceeded
+        of = fi.oom_fit(arima.fit, max_rows=2)
+        with pytest.raises(rel.OOMBackoffExceeded):
+            rel.fit_chunked(of, y, chunk_rows=8, min_chunk_rows=4,
+                            resilient=False, order=(1, 0, 0), max_iters=15)
+        path = obs.last_crash_dump()
+        assert path and os.path.exists(path)
+        evs = [json.loads(l) for l in open(path)]
+        names = [e.get("name") for e in evs if e["kind"] == "event"]
+        assert "fit.failure" in names and "chunk.oom_backoff" in names
+        assert evs[-1]["kind"] == "metrics"
+        assert evs[-1]["counters"]["chunked.oom_backoffs"] >= 1
+
+    def test_disabled_failure_dumps_nothing(self):
+        obs.enable()  # fresh run clears any previous crash record...
+        obs.disable()  # ...and the plane is OFF for the failing fit
+        y = _ar_panel(b=8)
+        of = fi.oom_fit(arima.fit, max_rows=2)
+        with pytest.raises(rel.OOMBackoffExceeded):
+            rel.fit_chunked(of, y, chunk_rows=8, min_chunk_rows=4,
+                            resilient=False, order=(1, 0, 0), max_iters=15)
+        assert obs.last_crash_dump() is None
+
+
+# ---------------------------------------------------------------------------
+# instrumented neighbors: ladder counters, map_series cache, optim stage 2
+# ---------------------------------------------------------------------------
+
+
+class TestInstrumentation:
+    def test_ladder_counters_count_attempts_and_rescues(self):
+        y = _ar_panel(b=8)
+        ff = fi.failing_fit(arima.fit, y, rows=[2], n_failures=1)
+        obs.enable()
+        rel.resilient_fit(ff, y, order=(1, 0, 0), max_iters=15)
+        s = obs.snapshot()
+        assert s["counters"]["ladder.retry.attempted"] == 1
+        assert s["counters"]["ladder.retry.rescued"] == 1
+        assert s["counters"]["ladder.fallback.attempted"] == 0
+
+    def test_watchdog_timeout_counted(self):
+        import time as _t
+
+        from spark_timeseries_tpu.reliability import watchdog as wd
+
+        obs.enable()
+        with pytest.raises(wd.DeadlineExceeded):
+            wd.call_with_deadline(lambda: _t.sleep(5.0), 0.1)
+        assert obs.snapshot()["counters"]["watchdog.deadline_exceeded"] == 1
+
+    def test_map_series_cache_hit_miss_counters(self):
+        from spark_timeseries_tpu import index as dtix
+        from spark_timeseries_tpu import panel as panel_mod
+
+        idx = dtix.uniform("2024-01-01", periods=16,
+                           frequency=dtix.DayFrequency(1))
+        p = panel_mod.TimeSeriesPanel(
+            idx, [f"s{i}" for i in range(4)],
+            np.arange(64, dtype=np.float32).reshape(4, 16))
+        obs.enable()
+        p.map_series(lambda v: v * 2.0)
+        p.map_series(lambda v: v * 2.0)  # textually identical -> cache hit
+        s = obs.snapshot()
+        assert s["counters"]["panel.map_series.cache_hits"] >= 1
+        assert s["counters"].get("panel.map_series.cache_misses", 0) >= 1
+
+    def test_optim_stage2_compact_trace_counter(self):
+        rng = np.random.default_rng(0)
+        scales = jnp.asarray(
+            rng.uniform(0.05, 50.0, size=(64, 3)).astype(np.float32))
+        target = jnp.asarray(rng.normal(size=(64, 3)).astype(np.float32))
+
+        def fb(x):
+            r = (x - target) * scales
+            return jnp.sum(r**2, axis=-1)
+
+        def straggler_fun(idx):
+            sc, tg = scales[idx], target[idx]
+            return lambda x: jnp.sum(((x - tg) * sc) ** 2, axis=-1)
+
+        obs.enable()
+        optim.minimize_lbfgs_batched(
+            fb, jnp.zeros((64, 3), jnp.float32), max_iters=60,
+            straggler_fun=straggler_fun, straggler_cap=16)
+        assert obs.snapshot()["counters"]["optim.stage2_compact_traces"] >= 1
+
+    def test_compat_fit_model_span_recorded(self, tmp_path):
+        from spark_timeseries_tpu.compat import sparkts
+
+        p = str(tmp_path / "ev.jsonl")
+        obs.enable(p)
+        sparkts.EWMA.fit_model(jnp.asarray(_ar_panel(b=2, t=64)))
+        obs.disable()
+        spans = [json.loads(l) for l in open(p)
+                 if json.loads(l).get("kind") == "span"]
+        assert any(s["name"] == "compat.fit_model"
+                   and s["attrs"]["model"] == "EWMA" for s in spans)

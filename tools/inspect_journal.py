@@ -70,6 +70,10 @@ def summarize(m: dict) -> dict:
         for k, v in (e.get("status_counts") or {}).items():
             status_totals[k] = status_totals.get(k, 0) + v
     peaks = [e.get("peak_hbm_bytes") for e in chunks if e.get("peak_hbm_bytes")]
+    # which probe produced the readings: "device" is real HBM; "host_rss"
+    # is the process peak-RSS fallback (must not be presented as HBM)
+    peak_sources = sorted({e.get("peak_hbm_source") or "device"
+                           for e in chunks if e.get("peak_hbm_bytes")})
     return {
         "run_id": m.get("run_id"),
         "created_at": m.get("created_at"),
@@ -86,7 +90,9 @@ def summarize(m: dict) -> dict:
         "rows_timeout": sum(e["hi"] - e["lo"] for e in timeout),
         "status_totals": status_totals,
         "peak_hbm_bytes": max(peaks) if peaks else None,
+        "peak_mem_sources": peak_sources,
         "chunks": chunks,
+        "telemetry": m.get("telemetry"),
     }
 
 
@@ -114,9 +120,14 @@ def main():
         totals = ", ".join(f"{k}={v}" for k, v in s["status_totals"].items()
                            if v)
         print(f"  fit status totals: {totals or 'none recorded'}")
-    print(f"  peak HBM (max over chunks): {_fmt_bytes(s['peak_hbm_bytes'])}")
+    src = ",".join(s.get("peak_mem_sources") or [])
+    print(f"  peak memory (max over chunks): "
+          f"{_fmt_bytes(s['peak_hbm_bytes'])}"
+          + (f" [{src}]" if src else "")  # no readings -> no source claim
+          + ("  (host_rss = process peak RSS fallback, NOT device HBM)"
+             if "host_rss" in src else ""))
     if s["chunks"]:
-        print(f"  {'rows':>21}  {'status':<9} {'wall_s':>8} {'peak_hbm':>10}"
+        print(f"  {'rows':>21}  {'status':<9} {'wall_s':>8} {'peak_mem':>10}"
               f"  {'run':<12} counts")
         for e in s["chunks"]:
             counts = e.get("status_counts") or {}
@@ -128,6 +139,28 @@ def main():
                   f"{(e.get('run_id') or '?'):<12} {counts_s}")
     else:
         print("  (no chunks recorded yet)")
+    t = s.get("telemetry")
+    if t:
+        pm = t.get("peak_memory") or {}
+        print(f"  telemetry (obs run {t.get('run_id')}): "
+              f"peak mem {_fmt_bytes(pm.get('bytes'))} "
+              f"[{pm.get('source', '?')}]")
+        phases = {}
+        for c in t.get("chunks") or []:
+            p = phases.setdefault(c.get("phase"), [0, 0.0])
+            p[0] += 1
+            p[1] += c.get("wall_s") or 0.0
+        for phase, (n, wall) in sorted(phases.items()):
+            print(f"    chunks {phase:<16} n={n:<4} wall {wall:.3f}s")
+        counters = {k: v for k, v in (t.get("counters") or {}).items() if v}
+        if counters:
+            print("    counters: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(counters.items())))
+        hist = (t.get("histograms") or {}).get("journal.commit_s") or {}
+        if hist.get("count"):
+            print(f"    journal commit: n={hist['count']} "
+                  f"mean={hist.get('mean', 0):.5f}s "
+                  f"max={hist.get('max', 0):.5f}s")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Render (or validate) a telemetry JSONL event log from the obs plane.
+
+A fit run with ``obs.enable("run.jsonl")`` streams every span and event to
+a JSONL file (schema: ``spark_timeseries_tpu.obs.recorder``).  This tool
+answers the operator questions from that file alone — where did the wall
+clock go (compile vs execute, chunk by chunk), which ladder rungs fired,
+how long did journal commits take, what did memory peak at:
+
+    python tools/obs_report.py RUN.jsonl              # timeline + metrics
+    python tools/obs_report.py RUN.jsonl --json       # machine-readable
+    python tools/obs_report.py RUN.jsonl --check \\
+        [--manifest CKPT_DIR]                         # CI schema gate
+
+``--check`` validates every line against the event schema (and, with
+``--manifest``, the journal manifest's embedded ``telemetry`` block:
+per-chunk span times present, counters present, peak memory non-null) and
+exits 0/1 — the ci.sh telemetry smoke runs exactly this.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+KINDS = ("meta", "span", "event", "metrics")
+CHUNK_PHASES = ("compile+execute", "execute", "resumed", "timeout")
+MEM_SOURCES = ("device", "host_rss")
+
+
+def load_events(path: str):
+    """Parse the JSONL stream; returns (events, errors)."""
+    events, errors = [], []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError as e:
+                    errors.append(f"line {i}: does not parse ({e})")
+                    continue
+                if not isinstance(ev, dict):
+                    errors.append(f"line {i}: not an object")
+                    continue
+                events.append((i, ev))
+    except OSError as e:
+        errors.append(f"cannot read {path}: {e}")
+    return events, errors
+
+
+def validate_events(events, errors) -> list:
+    """Schema check (see obs.recorder docstring); appends to ``errors``."""
+    if not events and not errors:
+        errors.append("no events in stream")
+        return errors
+    if events and events[0][1].get("kind") != "meta":
+        errors.append("first event is not kind=meta")
+    for i, ev in events:
+        kind = ev.get("kind")
+        if kind not in KINDS:
+            errors.append(f"line {i}: unknown kind {kind!r}")
+            continue
+        if not isinstance(ev.get("ts"), (int, float)):
+            errors.append(f"line {i}: missing/non-numeric ts")
+        if kind == "meta":
+            if not ev.get("run_id") or not isinstance(ev.get("schema"), int):
+                errors.append(f"line {i}: meta missing run_id/schema")
+        elif kind == "span":
+            if not isinstance(ev.get("name"), str):
+                errors.append(f"line {i}: span missing name")
+            for f in ("wall_s", "process_s"):
+                v = ev.get(f)
+                if not isinstance(v, (int, float)) or v < 0:
+                    errors.append(f"line {i}: span {f} invalid: {v!r}")
+            if not isinstance(ev.get("depth"), int) or ev["depth"] < 0:
+                errors.append(f"line {i}: span depth invalid")
+        elif kind == "event":
+            if not isinstance(ev.get("name"), str):
+                errors.append(f"line {i}: event missing name")
+        elif kind == "metrics":
+            if not isinstance(ev.get("counters"), dict):
+                errors.append(f"line {i}: metrics missing counters dict")
+    return errors
+
+
+def validate_manifest_telemetry(ckpt_dir: str) -> list:
+    """Validate the journal manifest's embedded ``telemetry`` block."""
+    errors = []
+    path = ckpt_dir
+    if os.path.isdir(path):
+        path = os.path.join(path, "manifest.json")
+    try:
+        with open(path, "rb") as f:
+            m = json.loads(f.read().decode())
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        return [f"manifest {path}: unreadable ({e})"]
+    t = m.get("telemetry")
+    if not isinstance(t, dict):
+        return [f"manifest {path}: no telemetry block"]
+    chunks = t.get("chunks")
+    if not isinstance(chunks, list) or not chunks:
+        errors.append("telemetry.chunks missing/empty")
+    else:
+        for c in chunks:
+            phase = c.get("phase")
+            if phase not in CHUNK_PHASES:
+                errors.append(f"chunk {c.get('lo')}: bad phase {phase!r}")
+            if phase in ("compile+execute", "execute") and not isinstance(
+                    c.get("wall_s"), (int, float)):
+                errors.append(f"chunk {c.get('lo')}: missing wall_s")
+    if not isinstance(t.get("counters"), dict):
+        errors.append("telemetry.counters missing")
+    pm = t.get("peak_memory") or {}
+    if not isinstance(pm.get("bytes"), int) or pm["bytes"] <= 0:
+        errors.append(f"telemetry.peak_memory.bytes invalid: "
+                      f"{pm.get('bytes')!r}")
+    if pm.get("source") not in MEM_SOURCES:
+        errors.append(f"telemetry.peak_memory.source invalid: "
+                      f"{pm.get('source')!r}")
+    return errors
+
+
+def summarize(events) -> dict:
+    """Timeline + final metrics snapshot of the LATEST run in the stream.
+
+    ``obs.enable(path)`` appends (a crashed run's events survive a rerun
+    with the same path), so one file can hold several runs, each starting
+    at its own ``meta`` line — report the last one rather than splicing
+    runs into a garbled timeline.
+    """
+    meta_idx = [i for i, (_, ev) in enumerate(events)
+                if ev.get("kind") == "meta"]
+    run = [ev for _, ev in events[meta_idx[-1]:]] if meta_idx \
+        else [ev for _, ev in events]
+    meta = run[0] if run and run[0].get("kind") == "meta" else {}
+    spans = [ev for ev in run if ev.get("kind") == "span"]
+    points = [ev for ev in run if ev.get("kind") == "event"]
+    metrics = [ev for ev in run if ev.get("kind") == "metrics"]
+    return {
+        "run_id": meta.get("run_id"),
+        "schema": meta.get("schema"),
+        "n_runs_in_stream": max(len(meta_idx), 1),
+        "n_spans": len(spans),
+        "n_events": len(points),
+        "spans": spans,
+        "events": points,
+        "metrics": metrics[-1] if metrics else None,
+    }
+
+
+def _render(s: dict) -> None:
+    extra = (f"  (latest of {s['n_runs_in_stream']} runs in stream)"
+             if s.get("n_runs_in_stream", 1) > 1 else "")
+    print(f"telemetry run {s['run_id']}  schema {s['schema']}  "
+          f"{s['n_spans']} spans, {s['n_events']} events{extra}")
+    rows = sorted(s["spans"] + s["events"],
+                  key=lambda ev: ev.get("t0", ev.get("ts", 0.0)))
+    if rows:
+        t_start = min(ev.get("t0", ev.get("ts", 0.0)) for ev in rows)
+        print("\ntimeline (s from start):")
+        for ev in rows:
+            off = ev.get("t0", ev.get("ts", 0.0)) - t_start
+            indent = "  " * ev.get("depth", 0)
+            attrs = ev.get("attrs") or {}
+            attrs_s = " ".join(f"{k}={v}" for k, v in attrs.items())
+            if ev["kind"] == "span":
+                print(f"  {off:9.3f}  {indent}{ev['name']:<24} "
+                      f"wall {ev['wall_s']:9.4f}s  cpu {ev['process_s']:8.4f}s"
+                      f"  {attrs_s}")
+            else:
+                print(f"  {off:9.3f}  {indent}* {ev['name']:<22} {attrs_s}")
+    m = s["metrics"]
+    if m:
+        print("\ncounters:")
+        for k, v in sorted((m.get("counters") or {}).items()):
+            print(f"  {k:<40} {v}")
+        gauges = m.get("gauges") or {}
+        if gauges:
+            print("gauges:")
+            for k, v in sorted(gauges.items()):
+                print(f"  {k:<40} {v}")
+        hists = m.get("histograms") or {}
+        if hists:
+            print("histograms (count/mean/max seconds):")
+            for k, h in sorted(hists.items()):
+                if h.get("count"):
+                    print(f"  {k:<40} n={h['count']:<6} "
+                          f"mean={h.get('mean', 0):.5f} "
+                          f"max={h.get('max', 0):.5f}")
+    else:
+        print("\n(no metrics snapshot in stream — run obs.disable() or an "
+              "instrumented fit to emit one)")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("events", help="telemetry JSONL path (obs.enable(path))")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the event schema and exit 0/1")
+    ap.add_argument("--manifest", default=None, metavar="CKPT_DIR",
+                    help="with --check: also validate the journal "
+                         "manifest's embedded telemetry block")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary instead of the report")
+    args = ap.parse_args()
+
+    events, errors = load_events(args.events)
+    if args.check:
+        errors = validate_events(events, errors)
+        if args.manifest:
+            errors += validate_manifest_telemetry(args.manifest)
+        if errors:
+            for e in errors:
+                print(f"obs_report: FAIL {e}", file=sys.stderr)
+            sys.exit(1)
+        n = len(events)
+        extra = f" + manifest {args.manifest}" if args.manifest else ""
+        print(f"obs_report: OK — {n} events valid{extra}")
+        return
+    if errors:
+        for e in errors:
+            print(f"obs_report: WARNING {e}", file=sys.stderr)
+    s = summarize(events)
+    if args.json:
+        print(json.dumps(s, indent=1, sort_keys=True, default=repr))
+        return
+    _render(s)
+
+
+if __name__ == "__main__":
+    main()
