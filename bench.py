@@ -1137,12 +1137,21 @@ def check_backend_parity(jnp, on_tpu):
 def _northstar_1m(jnp, order):
     """The literal BASELINE north-star workload, executed (VERDICT r4 item
     1): ARIMA(1,1,1) fit over 1,048,576 series x 1k obs, one sustained run
-    on the chip.  Chunks of 131,072 series are GENERATED ON DEVICE from the
-    exact ARIMA(1,1,1) process (a 4 GB host panel would spend ~20 min in
-    the tunnel and measure the network, not the chip) and fitted
-    back-to-back; the sustained rate is converged series over total fit
-    wall (all dispatch round trips included, compile excluded by a warmup
-    fit on the first chunk).
+    on the chip — now as a JOURNALED-vs-UNJOURNALED pair through ONE
+    pipelined ``fit_chunked`` walk (ISSUE 4).  The panel is GENERATED ON
+    DEVICE from the exact ARIMA(1,1,1) process (a 4 GB host panel would
+    spend ~20 min in the tunnel and measure the network, not the chip);
+    both runs walk it in 131,072-row chunks, compile excluded by a warmup
+    fit on the first chunk's shape.
+
+    The pair is the tentpole's acceptance measurement: the UNJOURNALED
+    walk is the durability-free ceiling; the JOURNALED walk pays the
+    write-ahead commit of every chunk, but on a bounded background
+    committer whose fetch + shard + manifest I/O hides under the next
+    chunk's device compute.  The artifact reports both walls, the
+    journaled/unjournaled ratio, and the driver's measured overlap
+    efficiency (fraction of commit wall the driver never waited for —
+    the acceptance bar is >= 0.8 with the journaled wall within 5%).
     """
     import jax
 
@@ -1167,124 +1176,181 @@ def _northstar_1m(jnp, order):
     def sync(x):
         return float(jnp.sum(jnp.nan_to_num(jnp.ravel(x)[:4])))
 
-    from spark_timeseries_tpu.models.base import align_mode_on_host
-
     warm = gen_chunk(jax.random.key(1000))
     sync(warm)
     r = arima.fit(warm, order)  # compile the 131k-shape fit program
     sync(r.params)
     del warm, r
 
-    # materialize AND align-probe every chunk outside the timed region (the
-    # NaN probe is one host round trip per fresh panel — ~0.12 s of tunnel,
-    # not chip, per chunk; its result caches per array identity).  Inside
-    # the wall each fit pays the serving-path result materialization (the
-    # reliability chunk driver assembles params/converged/status on host —
-    # a few MB per 131k chunk, which also forces the fit's completion)
-    chunks = []
+    # ONE resident [1M, 1k] panel (4 GB f32), assembled by DONATED in-place
+    # placement: a plain jnp.concatenate would transiently hold the parts
+    # AND the output (8 GB), and a generation-time RESOURCE_EXHAUSTED sits
+    # outside the chunk driver's backoff protection.  The per-chunk
+    # align-mode NaN probe rides INSIDE the wall (each walk slice is a
+    # fresh buffer): one fused reduction + host sync per chunk, the honest
+    # serving-path cost of a sliced walk.
+    from functools import partial as _partial
+
+    @_partial(jax.jit, donate_argnums=(0,))
+    def place(panel, chunk, row0):
+        return jax.lax.dynamic_update_slice(panel, chunk, (row0, 0))
+
+    panel = jnp.zeros((chunk_b * n_chunks, t), jnp.float32)
     for i in range(n_chunks):
         v = gen_chunk(jax.random.key(i))
-        sync(v)
-        align_mode_on_host(v)
-        chunks.append(v)
+        panel = place(panel, v, jnp.int32(i * chunk_b))
+        del v
+    sync(panel)
 
-    # every chunk fit goes through the JOURNALED reliability chunk driver
-    # (ISSUE 2): an HBM RESOURCE_EXHAUSTED halves the row count (bounded)
-    # instead of killing the sustained run, and every finished chunk is
-    # committed to a write-ahead journal — a SIGKILL/preemption at chunk 7
-    # loses nothing, and re-running bench.py with the same STSTPU_NORTHSTAR
-    # _CKPT resumes from the committed shards (resume metadata lands in the
-    # artifact either way).  Each device-generated chunk is its own panel,
-    # so each gets a per-chunk journal namespace under one job directory.
-    # resilient=False keeps the measured work identical to a plain fit
-    # (per-row status still comes from the fit program itself); sanitize /
-    # retry-ladder behavior is exercised by the tier-1 fault-injection
-    # tests, not timed here.  The journal commit (host copy + npz shard)
-    # rides INSIDE the timed wall: the sustained rate now measures the
-    # durable serving path, commit cost included.
     import tempfile
 
+    from spark_timeseries_tpu import obs as _obs
     from spark_timeseries_tpu import reliability as _rel
+    from spark_timeseries_tpu.obs.memory import peak_memory as _peak_mem
 
     ckpt_root = os.environ.get("STSTPU_NORTHSTAR_CKPT") or tempfile.mkdtemp(
         prefix="northstar_journal_")
 
-    # VERDICT r5 item 6: the missing half of the headline measurement —
-    # sampled around the sustained run; the chunk driver records the same
-    # reading per chunk in the journal manifest (one shared probe).  On
-    # backends without memory_stats() the probe degrades to host peak RSS
-    # instead of null (ISSUE 3 satellite) — peak_mem_source says which.
-    from spark_timeseries_tpu.obs.memory import peak_memory as _peak_mem
-
     _pm = _peak_mem()  # before the run: warmup/compile already resident
     peak, peak_src = _pm.bytes, _pm.source
-    total_conv, wall = 0.0, 0.0
-    fitted_conv = 0.0  # converged rows actually FITTED this run: a resumed
-    # chunk rehydrates from its shard in ~0 wall, and counting its rows in
-    # the throughput would publish an absurd rate (review finding) — the
-    # sustained figure divides fitted work by fitted wall only
-    status_totals = {}
-    oom_backoffs, chunk_rows_final = 0, chunk_b
-    chunks_committed, chunks_resumed, run_ids = 0, 0, []
-    for i, v in enumerate(chunks):
+
+    def _run(checkpoint_dir):
         t0 = time.perf_counter()
-        r = _rel.fit_chunked(arima.fit, v, chunk_rows=chunk_b,
+        r = _rel.fit_chunked(arima.fit, panel, chunk_rows=chunk_b,
                              resilient=False, order=order,
-                             checkpoint_dir=os.path.join(
-                                 ckpt_root, f"chunk_{i:02d}"))
-        n_conv = float(np.sum(r.converged))
-        j = r.meta.get("journal", {})
-        resumed = bool(j.get("chunks_resumed", 0))
-        if not resumed:
-            wall += time.perf_counter() - t0
-            fitted_conv += n_conv
-        total_conv += n_conv
-        for k, c in r.meta["status_counts"].items():
-            status_totals[k] = status_totals.get(k, 0) + c
-        oom_backoffs += r.meta["oom_backoffs"]
-        chunk_rows_final = min(chunk_rows_final, r.meta["chunk_rows_final"])
-        chunks_committed += j.get("chunks_committed", 0)
-        chunks_resumed += j.get("chunks_resumed", 0)
-        run_ids.append(j.get("run_id"))
-        _pm = _peak_mem()
-        if _pm.bytes and _pm.bytes > (peak or 0):
-            peak, peak_src = _pm.bytes, _pm.source
-        del r
-    del chunks
+                             checkpoint_dir=checkpoint_dir)
+        return r, time.perf_counter() - t0
+
+    # durability-free ceiling first (its walk order also matches the
+    # journaled run, so the pair shares every compiled program)
+    r_plain, wall_plain = _run(None)
+    _pm = _peak_mem()
+    if _pm.bytes and _pm.bytes > (peak or 0):
+        peak, peak_src = _pm.bytes, _pm.source
+
+    # journaled + pipelined walk (ISSUE 4): the write-ahead commit of every
+    # chunk — host fetch, npz shard, fsync, atomic manifest — runs on the
+    # background committer while the device computes the next chunk.
+    # Telemetry rides along (enabled here if the env did not already) so
+    # the artifact carries the compile/execute split and commit-latency
+    # histogram the regression gate diffs against the previous local run.
+    # A re-run with the same STSTPU_NORTHSTAR_CKPT resumes from the
+    # committed shards (chunks_resumed > 0; the wall is then not a
+    # sustained measurement and the rate reports None).
+    obs_was_on = _obs.enabled()
+    if not obs_was_on:
+        _obs.enable()
+    try:
+        r_j, wall_j = _run(ckpt_root)
+        tele = r_j.meta.get("telemetry")
+        # map_series kernel-cache canary (regression-gate input): three
+        # fresh-but-identical lambdas must share ONE compiled kernel (the
+        # cache keys on bytecode, not object identity — panel._cached
+        # _batched), giving a steady 2/3 hit rate.  A keying regression
+        # drops it to 0 and the gate flags the drift — this is the only
+        # bench path that exercises map_series, so the canary IS the
+        # measurement, not a synthetic stand-in.
+        from spark_timeseries_tpu import index as _dtix
+        from spark_timeseries_tpu.panel import TimeSeriesPanel as _Panel
+
+        c0 = (_obs.snapshot() or {}).get("counters", {})
+        tiny = _Panel(
+            _dtix.uniform("2024-01-01", periods=32,
+                          frequency=_dtix.DayFrequency(1)),
+            [f"c{i}" for i in range(4)],
+            jnp.ones((4, 32), jnp.float32))
+        for _ in range(3):
+            tiny.map_series(lambda v: v * 2.0 + 1.0)
+        c1 = (_obs.snapshot() or {}).get("counters", {})
+        _d = lambda k: c1.get(k, 0) - c0.get(k, 0)
+        ms_hits = _d("panel.map_series.cache_hits")
+        ms_misses = _d("panel.map_series.cache_misses")
+    finally:
+        if not obs_was_on:
+            _obs.disable()
+    _pm = _peak_mem()
+    if _pm.bytes and _pm.bytes > (peak or 0):
+        peak, peak_src = _pm.bytes, _pm.source
+
+    j = r_j.meta.get("journal", {})
+    resumed = bool(j.get("chunks_resumed", 0))
+    pipe = r_j.meta.get("pipeline") or {}
     total = chunk_b * n_chunks
-    return {
+    total_conv = float(np.sum(r_j.converged))
+    # the pipelined journaled walk must not change a byte of the result —
+    # NaN-tolerant per field (excluded/ineligible rows carry NaN params by
+    # design, and NaN != NaN under plain array_equal would false-alarm)
+    def _field_eq(f):
+        a = np.asarray(getattr(r_j, f))
+        b = np.asarray(getattr(r_plain, f))
+        return np.array_equal(a, b, equal_nan=a.dtype.kind == "f")
+
+    bitwise_ok = all(_field_eq(f) for f in (
+        "params", "neg_log_likelihood", "converged", "iters", "status"))
+
+    status_totals = dict(r_j.meta["status_counts"])
+    out = {
         "series_total": total,
         "obs_per_series": t,
         "chunks": n_chunks,
-        "wall_s": round(wall, 3),
+        # journaled wall is the headline (the durable serving path);
+        # unjournaled is the ceiling the overlap is measured against
+        "wall_s": round(wall_j, 3),
+        "wall_s_unjournaled": round(wall_plain, 3),
+        "journaled_over_unjournaled": (round(wall_j / wall_plain, 4)
+                                       if wall_plain > 0 else None),
         "converged_frac": round(total_conv / total, 4),
-        # fitted work over fitted wall; None when every chunk was resumed
-        # from a prior run's journal (nothing was measured)
         "sustained_converged_series_per_sec":
-            round(fitted_conv / wall, 1) if wall > 0 else None,
+            round(total_conv / wall_j, 1) if (wall_j > 0 and not resumed)
+            else None,
+        "unjournaled_converged_series_per_sec":
+            round(float(np.sum(r_plain.converged)) / wall_plain, 1)
+            if wall_plain > 0 else None,
+        # ISSUE 4 acceptance: fraction of commit wall time hidden under
+        # device compute, as measured by the committer itself
+        "overlap_efficiency": pipe.get("overlap_efficiency"),
+        "commit_wall_s": pipe.get("commit_wall_s"),
+        "hidden_commit_s": pipe.get("hidden_commit_s"),
+        "pipeline_depth": pipe.get("depth"),
+        "journaled_bitwise_identical": bitwise_ok,
         "peak_hbm_bytes": peak,
         # which probe produced the reading: "device" = real HBM stats,
         # "host_rss" = process peak RSS fallback (CPU runs — never null)
         "peak_mem_source": peak_src,
-        # reliability layer accounting (ISSUE 1): per-row FitStatus totals
-        # and whether any chunk survived only by OOM backoff
         "fit_status_counts": status_totals,
-        "oom_backoffs": oom_backoffs,
-        "chunk_rows_final": chunk_rows_final,
-        "degraded_by_oom_backoff": bool(oom_backoffs),
-        # job durability accounting (ISSUE 2): the run is journaled; a
-        # resumed re-run reports chunks_resumed > 0 and skips their fits
+        "oom_backoffs": r_j.meta["oom_backoffs"],
+        "chunk_rows_final": r_j.meta["chunk_rows_final"],
+        "degraded_by_oom_backoff": bool(r_j.meta["oom_backoffs"]),
         "journal": {
             "dir": ckpt_root,
-            "chunks_committed": chunks_committed,
-            "chunks_resumed": chunks_resumed,
-            "run_ids": run_ids,
+            "chunks_committed": j.get("chunks_committed", 0),
+            "chunks_resumed": j.get("chunks_resumed", 0),
+            "run_ids": [j.get("run_id")],
         },
         "data": "generated on device from the exact ARIMA(1,1,1) process "
-                "(phi 0.6, theta 0.3, d=1), fresh key per chunk; fits "
-                "journaled (write-ahead chunk shards, commit inside the "
-                "timed wall)",
+                "(phi 0.6, theta 0.3, d=1); ONE pipelined journaled walk "
+                "(write-ahead shards on the background committer, commit "
+                "inside the timed wall) vs the unjournaled ceiling",
     }
+    # regression-gate inputs (ROADMAP satellite): the numbers the
+    # throughput headline hides, diffed against the previous local run
+    if tele:
+        chunks_t = tele.get("chunks") or []
+        walls = [c.get("wall_s", 0.0) for c in chunks_t if c.get("wall_s")]
+        cwalls = [c.get("wall_s", 0.0) for c in chunks_t
+                  if c.get("wall_s") and c.get("phase") == "compile+execute"]
+        hist = (tele.get("histograms") or {}).get("journal.commit_s") or {}
+        out["telemetry_gate_inputs"] = {
+            "compile_time_share": (round(sum(cwalls) / sum(walls), 4)
+                                   if walls and sum(walls) > 0 else None),
+            "journal_commit_s_mean": hist.get("mean"),
+            # from the canary above: expected steady state 2/3
+            "map_series_cache_hit_rate": (
+                round(ms_hits / (ms_hits + ms_misses), 4)
+                if (ms_hits + ms_misses) else None),
+            "overlap_efficiency": pipe.get("overlap_efficiency"),
+        }
+    return out
 
 
 def bench_arima_headline(jnp, quick, on_tpu, n_chips, platform, parity=None):
@@ -1373,6 +1439,92 @@ def bench_arima_headline(jnp, quick, on_tpu, n_chips, platform, parity=None):
     }
 
 
+def _telemetry_regression_gate(headline):
+    """Diff this run's telemetry summary against the previous local run.
+
+    ROADMAP satellite: the throughput headline can stay flat while the
+    numbers under it rot — compile-time share creeping up (a new trace in
+    the hot path), journal commit latency growing (fsync regression,
+    bigger shards), the map_series kernel cache suddenly missing, or the
+    pipelined commit overlap collapsing back to serial.  This gate reads
+    the PREVIOUS ``BENCH_LOCAL.json`` tail (where the prior run's
+    ``telemetry_summary`` line survives verbatim), compares the four
+    tracked metrics, and flags drifts beyond tolerance.  Fail-soft by
+    design: a missing prior summary reports ``checked: false`` rather
+    than failing the benchmark.
+
+    Returns ``(telemetry_summary_line, gate_line)`` — both are emitted so
+    the NEXT run finds this run's summary in its own tail.
+    """
+    inputs = (headline.get("northstar_1m") or {}).get("telemetry_gate_inputs")
+    cur = {
+        "metric": "telemetry_summary: regression-gate inputs "
+                  "(compile share, commit latency, map_series cache, "
+                  "overlap; diffed by the next run)",
+        "value": 1.0 if inputs else 0.0,
+        "unit": "available",
+        **(inputs or {}),
+    }
+    prev = None
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_LOCAL.json")
+        with open(path) as f:
+            tail = json.load(f).get("tail", "")
+        for line in tail.splitlines():
+            line = line.strip()
+            if line.startswith("{") and '"telemetry_summary' in line:
+                try:
+                    prev = json.loads(line)  # keep the LAST one in the tail
+                except json.JSONDecodeError:
+                    continue
+    except (OSError, json.JSONDecodeError, AttributeError):
+        prev = None
+    gate = {
+        "metric": "telemetry_regression_gate: drift vs previous "
+                  "BENCH_LOCAL.json telemetry (what the throughput "
+                  "headline hides)",
+        "value": None,
+        "unit": "ok",
+        "checked": False,
+        "ok": None,
+        "drifts": {},
+    }
+    if not inputs or prev is None:
+        gate["reason"] = ("no telemetry inputs this run (north-star not "
+                          "executed or obs unavailable)" if not inputs
+                          else "no previous telemetry_summary in "
+                               "BENCH_LOCAL.json")
+        return cur, gate
+    # shares/rates in [0, 1] gate on ABSOLUTE drift; latency on RELATIVE
+    thresholds = {
+        "compile_time_share": ("abs", 0.15),
+        "journal_commit_s_mean": ("rel", 0.5),
+        "map_series_cache_hit_rate": ("abs", 0.15),
+        "overlap_efficiency": ("abs", 0.15),
+    }
+    drifts, flagged = {}, []
+    for k, (mode, tol) in thresholds.items():
+        a, b = prev.get(k), inputs.get(k)
+        if a is None or b is None:
+            continue
+        delta = abs(b - a) if mode == "abs" else abs(b - a) / max(abs(a), 1e-9)
+        bad = delta > tol
+        drifts[k] = {"prev": a, "cur": b, "drift": round(delta, 4),
+                     "tolerance": tol, "mode": mode, "flagged": bad}
+        if bad:
+            flagged.append(k)
+    if not drifts:
+        # the prior summary carried none of the tracked keys (e.g. a
+        # --quick run): comparing NOTHING must not read as a green gate
+        gate["reason"] = ("previous telemetry_summary has no comparable "
+                         "metrics (northstar-less prior run?)")
+        return cur, gate
+    gate.update(checked=True, ok=not flagged, value=0.0 if flagged else 1.0,
+                drifts=drifts, flagged=flagged)
+    return cur, gate
+
+
 def _summary_line(emitted):
     """One compact JSON line holding every config's key numbers.
 
@@ -1417,7 +1569,9 @@ def _summary_line(emitted):
                 entry["northstar_1m"] = {k: ns.get(k) for k in (
                     "series_total", "wall_s", "converged_frac",
                     "sustained_converged_series_per_sec", "peak_hbm_bytes",
-                    "peak_mem_source")}
+                    "peak_mem_source", "overlap_efficiency",
+                    "journaled_over_unjournaled",
+                    "journaled_bitwise_identical")}
                 j = ns.get("journal") or {}
                 entry["northstar_1m"]["chunks_resumed"] = j.get(
                     "chunks_resumed")
@@ -1452,12 +1606,23 @@ def main():
     args = ap.parse_args()
     wanted = [c.strip() for c in args.configs.split(",") if c.strip()]
 
+    # opt-in persistent compilation cache (ISSUE 4): with
+    # STSTPU_COMPILE_CACHE=<dir> set, a restarted bench (or a journaled
+    # resume) reads compiled executables from disk instead of re-paying
+    # trace+compile for every fit program.  Must run BEFORE the first
+    # device use; no-op when unset or unsupported by this jax build.
+    from spark_timeseries_tpu.utils import compile_cache as _compile_cache
+
+    _cc_dir = _compile_cache.enable_from_env()
+
     import jax
     import jax.numpy as jnp
 
     platform = jax.devices()[0].platform
     on_tpu = platform in ("tpu", "axon")
     n_chips = len(jax.devices())
+    if _cc_dir:
+        _progress(f"persistent compile cache: {_cc_dir}")
 
     emitted = []
 
@@ -1506,6 +1671,12 @@ def main():
             line = bench_arima_headline(jnp, args.quick, on_tpu, n_chips,
                                         platform, parity)
         track(line)
+        # telemetry summary + regression gate (ROADMAP satellite): emitted
+        # AFTER the headline so the summary survives in the artifact tail
+        # for the next run to diff against
+        ts_line, gate_line = _telemetry_regression_gate(line)
+        track(ts_line)
+        track(gate_line)
     # LAST line: the compact all-configs digest — whatever tail the driver
     # keeps, every config's numbers survive
     _emit(_summary_line(emitted))

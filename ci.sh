@@ -36,6 +36,38 @@ python -m pytest tests/test_reliability.py -q -rs -W error::RuntimeWarning "$@"
 # exception (tests/_journal_worker.py orchestrates three worker processes)
 python tests/_journal_worker.py --smoke
 
+# pipelined-committer smoke (ISSUE 4): the pipelined walk (background
+# committer, bounded queue) must be bitwise-identical to the serial
+# pipeline=False walk, report its overlap accounting, and leave a manifest
+# the budget advisor can turn into next-run knobs
+PIPE_SMOKE_DIR=$(python - <<'EOF'
+import os, tempfile
+import numpy as np
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.models import arima
+
+rng = np.random.default_rng(0)
+y = np.cumsum(rng.normal(size=(32, 96)).astype(np.float32), axis=1)
+root = tempfile.mkdtemp(prefix="pipe_smoke_")
+kw = dict(chunk_rows=8, resilient=False, order=(1, 0, 0), max_iters=15)
+ser = rel.fit_chunked(arima.fit, y, checkpoint_dir=os.path.join(root, "ser"),
+                      pipeline=False, **kw)
+pipe = rel.fit_chunked(arima.fit, y, checkpoint_dir=os.path.join(root, "pipe"),
+                       pipeline_depth=3, **kw)
+for f in ("params", "neg_log_likelihood", "converged", "iters", "status"):
+    np.testing.assert_array_equal(np.asarray(getattr(ser, f)),
+                                  np.asarray(getattr(pipe, f)), err_msg=f)
+p = pipe.meta["pipeline"]
+assert p["commits_background"] == 4, p
+assert p["hidden_commit_s"] <= p["commit_wall_s"] + 1e-9, p
+print(root)
+EOF
+)
+python tools/advise_budget.py "$PIPE_SMOKE_DIR/pipe" \
+  | grep -q "pipeline_depth" \
+  || { echo "ci.sh: advise_budget did not print suggestions" >&2; exit 1; }
+rm -rf "$PIPE_SMOKE_DIR"
+
 # telemetry smoke (ISSUE 3): a small journaled chunked fit runs with the
 # obs plane enabled; the JSONL event log AND the manifest's embedded
 # telemetry block (per-chunk compile/execute spans, ladder counters,

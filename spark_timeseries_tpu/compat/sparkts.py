@@ -263,7 +263,8 @@ def _require_checkpoint_dir(durable_kwargs: dict) -> None:
 
 
 def _durable_fit(fit_fn, ts, checkpoint_dir, *, chunk_rows=None,
-                 chunk_budget_s=None, job_budget_s=None, resume="auto"):
+                 chunk_budget_s=None, job_budget_s=None, resume="auto",
+                 pipeline=True, pipeline_depth=2):
     """Route a compat fit through the journaled chunk driver.
 
     The upstream Python API ran fits inside Spark tasks, whose lineage
@@ -275,6 +276,10 @@ def _durable_fit(fit_fn, ts, checkpoint_dir, *, chunk_rows=None,
     keyword-bound partial of the model-module fit so the journal's config
     hash covers the hyperparameters.  Returns the ``[batch?, k]`` params
     with single-series inputs debatched, like the plain path.
+
+    ``pipeline`` / ``pipeline_depth`` control the pipelined committer
+    (``reliability.committer``): commits overlap the next chunk's compute
+    by default, bitwise-identical to the serial ``pipeline=False`` walk.
     """
     from .. import reliability as rel
 
@@ -285,6 +290,7 @@ def _durable_fit(fit_fn, ts, checkpoint_dir, *, chunk_rows=None,
         fit_fn, yb, chunk_rows=chunk_rows, resilient=False,
         checkpoint_dir=checkpoint_dir, resume=resume,
         chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
+        pipeline=pipeline, pipeline_depth=pipeline_depth,
     )
     params = jnp.asarray(res.params)
     return params[0] if single else params
@@ -414,7 +420,8 @@ class ARIMA:
                   **durable_kwargs) -> ARIMAModel:
         """``checkpoint_dir=`` journals the fit for crash/preemption resume
         (``reliability.fit_chunked``); ``chunk_rows`` / ``chunk_budget_s``
-        / ``job_budget_s`` / ``resume`` ride along to the chunk driver."""
+        / ``job_budget_s`` / ``resume`` / ``pipeline`` /
+        ``pipeline_depth`` ride along to the chunk driver."""
         with obs.span("compat.fit_model", model="ARIMA"):
             if checkpoint_dir is not None:
                 import functools

@@ -305,6 +305,40 @@ def fit(
                               structural_ok=pk.css_structural_ok(p, q))
     require_pallas_for_count_evals(count_evals, backend)
 
+    bsz = yb.shape[0]
+    # lazy straggler compile (utils.optim stage-1/stage-2 split): compact
+    # fits run stage 1 as their own program and only dispatch — and
+    # therefore only ever trace+compile — the stage-2 straggler program
+    # when stage 1 actually leaves unconverged rows (ADVICE r5: the inline
+    # two-stage program roughly doubles compile time for batches that never
+    # need it).  count_evals keeps the inline driver (pass accounting
+    # instruments it); the gate mirrors the inline compaction gate.
+    # traced inputs (fit called under an outer jit) cannot host-check the
+    # straggler count — they keep the fully traceable inline program, same
+    # as align_mode_on_host's tracer branch
+    lazy = (compact and not count_evals and method != "hannan-rissanen"
+            and backend in ("pallas", "pallas-interpret")
+            and not isinstance(yb, jax.core.Tracer)
+            and bsz >= _COMPACT_MIN_BATCH
+            and optim.compaction_cap(bsz) < bsz)
+    if lazy:
+        align_mode = align_mode_on_host(yb)
+        run1 = _fit_stage1_program(
+            order, include_intercept, backend, max_iters, float(tol),
+            init_params is not None, align_mode)
+        if init_params is None:
+            out, aux = run1(yb)
+        else:
+            out, aux = run1(yb, jnp.asarray(init_params))
+        # host gate: tiny scalar sync; stage 2 shares stage 1's iteration
+        # budget, so an exhausted budget skips the dispatch entirely (the
+        # scatter of unchanged state would be an identity)
+        if int(aux["carry"].undone) > 0 and int(aux["carry"].k) < max_iters:
+            run2 = _fit_stage2_program(
+                order, include_intercept, backend, max_iters, float(tol),
+                int(yb.shape[1] - d))
+            out = run2(aux)
+        return debatch_fit(out, single, False)
     run = _fit_program(
         order, include_intercept, method, backend, max_iters, float(tol),
         init_params is not None, align_mode_on_host(yb), count_evals,
@@ -317,6 +351,56 @@ def fit(
     return debatch_fit(out, single, count_evals)
 
 
+def _css_prep(yb, init_params, order: Order, include_intercept: bool,
+              backend: str, align_mode: str, has_init: bool):
+    """Shared front half of every CSS fit program: align + difference, the
+    one-time folded layout (pallas backends), the Hannan-Rissanen (or
+    caller-provided) init, the identifiability gate, and the mean-scaling
+    denominator.  ONE implementation serves the inline `_fit_program` and
+    the lazy `_fit_stage1_program` — the `ok` eligibility formulas must
+    never diverge between them (the lazy path serves large batches, the
+    inline one everything else, and the same panel content must get the
+    same eligibility regardless of batch size)."""
+    p, d, q = order
+    k = _n_params(order, include_intercept)
+    with jax.named_scope("arima.align_and_difference"):
+        ya, nv0 = maybe_align(yb, align_mode)  # ragged: NaN head/tail
+        yd = jax.vmap(lambda v: _difference(v, d))(ya)
+        nvd = nv0 - d  # valid length after differencing
+    from ..ops import pallas_kernels as _pk
+
+    y3 = zb3 = None
+    if backend in ("pallas", "pallas-interpret"):
+        # fold ONCE per fit: the init sweeps and every optimizer
+        # evaluation share this layout (css_prefold)
+        y3, zb3 = _pk.css_prefold(yd, order, nvd)
+    with jax.named_scope("arima.hannan_rissanen_init"):
+        if has_init:
+            init = jnp.broadcast_to(init_params, (yd.shape[0], k))
+        elif y3 is not None and _pk.hr_structural_ok(p, q):
+            # fused two-sweep moment kernels: same normal equations,
+            # ~15x less HBM traffic than the shifted-reduce construction
+            init = _pk.hr_init(yd, order, include_intercept, nvd,
+                               interpret=backend == "pallas-interpret",
+                               y3=y3)
+        else:
+            init = hannan_rissanen_batched(yd, order, include_intercept, nvd)
+    # too-short series cannot be fit: need lags + a few dof
+    ok = nvd >= p + q + max(p + q + 1, 1) + k + 2
+    if not has_init:
+        # Hannan-Rissanen's long-AR order m = min(p+q+1, n//4) is static
+        # (shapes), so it is computed from the PADDED length; requiring
+        # nvd >= 4*(p+q+1) ensures m would be p+q+1 either way, keeping
+        # padded and trimmed inits identical inside the supported region
+        ok = ok & (nvd >= 4 * (p + q + 1))
+    # optimize the MEAN log-likelihood (nll / effective obs): same argmin,
+    # but gradients are O(1) so the relative grad-norm stopping rule is
+    # reachable at f32 instead of stalling on the accumulation noise floor
+    # of a ~1k-term sum (the reported nll is unscaled)
+    n_eff = jnp.maximum(nvd - p, 1).astype(yd.dtype)
+    return yd, nvd, y3, zb3, init, ok, n_eff
+
+
 @jit_program
 def _fit_program(order: Order, include_intercept: bool, method: str,
                  backend: str, max_iters: int, tol: float, has_init: bool,
@@ -326,36 +410,9 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
     k = _n_params(order, include_intercept)
 
     def run(yb, init_params=None):
-        with jax.named_scope("arima.align_and_difference"):
-            ya, nv0 = maybe_align(yb, align_mode)  # ragged: NaN head/tail
-            yd = jax.vmap(lambda v: _difference(v, d))(ya)
-            nvd = nv0 - d  # valid length after differencing
-        from ..ops import pallas_kernels as _pk
-
-        y3 = zb3 = None
-        if backend in ("pallas", "pallas-interpret"):
-            # fold ONCE per fit: the init sweeps and every optimizer
-            # evaluation share this layout (css_prefold)
-            y3, zb3 = _pk.css_prefold(yd, order, nvd)
-        with jax.named_scope("arima.hannan_rissanen_init"):
-            if has_init:
-                init = jnp.broadcast_to(init_params, (yd.shape[0], k))
-            elif y3 is not None and _pk.hr_structural_ok(p, q):
-                # fused two-sweep moment kernels: same normal equations,
-                # ~15x less HBM traffic than the shifted-reduce construction
-                init = _pk.hr_init(yd, order, include_intercept, nvd,
-                                   interpret=backend == "pallas-interpret",
-                                   y3=y3)
-            else:
-                init = hannan_rissanen_batched(yd, order, include_intercept, nvd)
-        # too-short series cannot be fit: need lags + a few dof
-        ok = nvd >= p + q + max(p + q + 1, 1) + k + 2
-        if not has_init:
-            # Hannan-Rissanen's long-AR order m = min(p+q+1, n//4) is static
-            # (shapes), so it is computed from the PADDED length; requiring
-            # nvd >= 4*(p+q+1) ensures m would be p+q+1 either way, keeping
-            # padded and trimmed inits identical inside the supported region
-            ok = ok & (nvd >= 4 * (p + q + 1))
+        yd, nvd, y3, zb3, init, ok, n_eff = _css_prep(
+            yb, init_params, order, include_intercept, backend, align_mode,
+            has_init)
         if method == "hannan-rissanen":
             nll = jax.vmap(
                 lambda pr, v, n: css_neg_loglik(pr, v, order, include_intercept, n)
@@ -364,13 +421,10 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
             params = jnp.where(ok[:, None], init, jnp.nan)
             return FitResult(params, jnp.where(ok, nll, jnp.nan), ok, z,
                              derive_status(ok, ok, params))
-        # optimize the MEAN log-likelihood (nll / effective obs): same
-        # argmin, but gradients are O(1) so the relative grad-norm stopping
-        # rule is reachable at f32 instead of stalling on the accumulation
-        # noise floor of a ~1k-term sum (the reported nll is unscaled)
-        n_eff = jnp.maximum(nvd - p, 1).astype(yd.dtype)
         info = None
         if backend in ("pallas", "pallas-interpret"):
+            from ..ops import pallas_kernels as _pk
+
             interp = backend == "pallas-interpret"
             bsz, T = yd.shape
 
@@ -426,6 +480,77 @@ def _fit_program(order: Order, include_intercept: bool, method: str,
             derive_status(ok, res.converged, params),
         )
         return (out, info) if count_evals else out
+
+    return run
+
+
+def _finalize_css_fit(res, ok, n_eff):
+    """Optimizer result -> FitResult (same ops as the inline program)."""
+    params = jnp.where(ok[:, None], res.x, jnp.nan)
+    return FitResult(
+        params, jnp.where(ok, res.f * n_eff, jnp.nan),
+        res.converged & ok, res.iters,
+        derive_status(ok, res.converged, params),
+    )
+
+
+@jit_program
+def _fit_stage1_program(order, include_intercept, backend, max_iters, tol,
+                        has_init, align_mode="general"):
+    """Stage 1 of the lazily compiled compact fit (ADVICE r5): the full
+    prep + lockstep L-BFGS with the straggler early-exit, returning the
+    finalized as-if-done result PLUS the compacted carry — so the stage-2
+    program is only traced/compiled when ``carry.undone`` says rows
+    actually remain.  Pallas backends only (the gate lives in ``fit``)."""
+    def run(yb, init_params=None):
+        yd, nvd, y3, zb3, init, ok, n_eff = _css_prep(
+            yb, init_params, order, include_intercept, backend, align_mode,
+            has_init)
+        from ..ops import pallas_kernels as _pk
+
+        interp = backend == "pallas-interpret"
+        bsz, T = yd.shape
+        cap = optim.compaction_cap(bsz)
+        res1, carry = optim.lbfgs_batched_stage1(
+            lambda P: _pk.css_neg_loglik_folded(
+                P, y3, zb3, T, order, include_intercept, nvd,
+                interpret=interp
+            ) / n_eff,
+            init, straggler_cap=cap, max_iters=max_iters, tol=tol)
+        # repack the compacted objective data HERE (the same folded-COLUMN
+        # gather the inline straggler_fun performs — series ride the lanes,
+        # grid-aligned by the cap), so the stage-2 program is a pure
+        # function of its inputs and compiles against stable shapes
+        tp = y3.shape[0]
+        y3s = y3.reshape(tp, -1)[:, carry.idxc].reshape(tp, cap // 128, 128)
+        zb3s = zb3.reshape(1, -1)[:, carry.idxc].reshape(1, cap // 128, 128)
+        aux = {"carry": carry, "res": res1, "y3s": y3s, "zb3s": zb3s,
+               "nvs": nvd[carry.idxc], "nes": n_eff[carry.idxc],
+               "ok": ok, "n_eff": n_eff}
+        return _finalize_css_fit(res1, ok, n_eff), aux
+
+    return run
+
+
+@jit_program
+def _fit_stage2_program(order, include_intercept, backend, max_iters, tol,
+                        t_len):
+    """Stage 2 of the lazy compact fit: finish the gathered stragglers on
+    the compacted objective and scatter back — compiled only on the first
+    call where stage 1 left unconverged rows (per static config)."""
+    interp = backend == "pallas-interpret"
+
+    def run(aux):
+        from ..ops import pallas_kernels as _pk
+
+        def fb_s(P):
+            return _pk.css_neg_loglik_folded(
+                P, aux["y3s"], aux["zb3s"], t_len, order, include_intercept,
+                aux["nvs"], interpret=interp) / aux["nes"]
+
+        res = optim.lbfgs_batched_stage2(
+            fb_s, aux["res"], aux["carry"], max_iters=max_iters, tol=tol)
+        return _finalize_css_fit(res, aux["ok"], aux["n_eff"])
 
     return run
 

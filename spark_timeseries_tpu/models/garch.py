@@ -150,27 +150,52 @@ def fit(r, *, max_iters: int = 80, tol: Optional[float] = None,
         tol = 1e-7 if rb.dtype == jnp.float64 else 1e-4
     backend = resolve_backend(backend, rb.dtype, rb.shape[1])
     require_pallas_for_count_evals(count_evals, backend)
+    bsz = rb.shape[0]
+    # lazy straggler compile (utils.optim stage-1/stage-2 split, ADVICE r5):
+    # the compacted stage-2 program is traced/compiled only when stage 1
+    # actually leaves unconverged rows — same gate and host check as
+    # models.arima.fit.  count_evals keeps the inline instrumented driver.
+    # traced inputs keep the fully traceable inline program (the lazy gate
+    # needs a host check of the straggler count) — see models.arima.fit
+    lazy = (compact and not count_evals
+            and backend in ("pallas", "pallas-interpret")
+            and not isinstance(rb, jax.core.Tracer)
+            and bsz >= _COMPACT_MIN_BATCH
+            and optim.compaction_cap(bsz) < bsz)
+    if lazy:
+        out, aux = _fit_stage1_program(
+            max_iters, float(tol), backend, align_mode_on_host(rb))(rb)
+        if int(aux["carry"].undone) > 0 and int(aux["carry"].k) < max_iters:
+            out = _fit_stage2_program(max_iters, float(tol), backend)(aux)
+        return debatch_fit(out, single, False)
     out = _fit_program(max_iters, float(tol), backend, align_mode_on_host(rb),
                        count_evals, compact)(rb)
     return debatch_fit(out, single, count_evals)
+
+
+def _garch_prep(rb, align_mode: str):
+    """Shared front half of both GARCH fit programs (inline + lazy
+    stage-1): alignment, the moment-ish start (omega = 0.1*var, alpha=0.1,
+    beta=0.8) in transformed space, and the mean-nll denominator (see
+    models.arima: same argmin, O(1) gradients keep the relative stopping
+    rule reachable at f32).  ONE implementation so the seeds can never
+    diverge between the two paths."""
+    ra, nv = maybe_align(rb, align_mode)
+    var0 = jax.vmap(_masked_var)(ra, nv)
+    nat0 = jnp.stack(
+        [0.1 * jnp.maximum(var0, 1e-10), jnp.full_like(var0, 0.1),
+         jnp.full_like(var0, 0.8)], axis=1
+    )
+    u0 = jax.vmap(_from_natural)(nat0)
+    n_eff = jnp.maximum(nv, 1).astype(ra.dtype)
+    return ra, nv, u0, n_eff
 
 
 @jit_program
 def _fit_program(max_iters, tol, backend, align_mode="general",
                  count_evals=False, compact=True):
     def run(rb):
-        ra, nv = maybe_align(rb, align_mode)
-
-        # moment-ish start: omega = 0.1*var, alpha=0.1, beta=0.8
-        var0 = jax.vmap(_masked_var)(ra, nv)
-        nat0 = jnp.stack(
-            [0.1 * jnp.maximum(var0, 1e-10), jnp.full_like(var0, 0.1),
-             jnp.full_like(var0, 0.8)], axis=1
-        )
-        u0 = jax.vmap(_from_natural)(nat0)
-        # optimize the MEAN nll (see models.arima: same argmin, O(1)
-        # gradients keep the relative stopping rule reachable at f32)
-        n_eff = jnp.maximum(nv, 1).astype(ra.dtype)
+        ra, nv, u0, n_eff = _garch_prep(rb, align_mode)
         if backend in ("pallas", "pallas-interpret"):
             from ..ops import pallas_kernels as pk
 
@@ -222,6 +247,70 @@ def _fit_program(max_iters, tol, backend, align_mode="general",
             derive_status(ok, res.converged, params),
         )
         return (out, info) if count_evals else out
+
+    return run
+
+
+def _finalize_garch_fit(res, ok, n_eff):
+    """Optimizer result -> FitResult (same ops as the inline program)."""
+    params = jnp.where(ok[:, None], jax.vmap(_to_natural)(res.x), jnp.nan)
+    return FitResult(
+        params,
+        jnp.where(ok, res.f * n_eff, jnp.nan),
+        res.converged & ok,
+        res.iters,
+        derive_status(ok, res.converged, params),
+    )
+
+
+@jit_program
+def _fit_stage1_program(max_iters, tol, backend, align_mode="general"):
+    """Stage 1 of the lazily compiled compact GARCH fit (see
+    ``models.arima._fit_stage1_program``): lockstep loop + straggler
+    gather, stage 2 compiled only when needed.  Pallas backends only."""
+
+    def run(rb):
+        ra, nv, u0, n_eff = _garch_prep(rb, align_mode)
+        from ..ops import pallas_kernels as pk
+
+        interp = backend == "pallas-interpret"
+
+        def fb(u):
+            nat = jax.vmap(_to_natural)(u)
+            return pk.garch_neg_loglik(nat, ra, nv, interpret=interp) / n_eff
+
+        cap = optim.compaction_cap(ra.shape[0])
+        res1, carry = optim.lbfgs_batched_stage1(
+            fb, u0, straggler_cap=cap, max_iters=max_iters, tol=tol)
+        ok = nv >= 10
+        # the objective closes over the NATURAL-layout panel, so the
+        # compacted problem's data is a plain row gather, done here so the
+        # stage-2 program is a pure function of its inputs
+        aux = {"carry": carry, "res": res1, "ras": ra[carry.idxc],
+               "nvs": nv[carry.idxc], "nes": n_eff[carry.idxc],
+               "ok": ok, "n_eff": n_eff}
+        return _finalize_garch_fit(res1, ok, n_eff), aux
+
+    return run
+
+
+@jit_program
+def _fit_stage2_program(max_iters, tol, backend):
+    """Stage 2 of the lazy compact GARCH fit: finish the gathered
+    stragglers and scatter back (compiled on first actual need)."""
+    interp = backend == "pallas-interpret"
+
+    def run(aux):
+        from ..ops import pallas_kernels as pk
+
+        def fb_s(u):
+            nat = jax.vmap(_to_natural)(u)
+            return pk.garch_neg_loglik(
+                nat, aux["ras"], aux["nvs"], interpret=interp) / aux["nes"]
+
+        res = optim.lbfgs_batched_stage2(
+            fb_s, aux["res"], aux["carry"], max_iters=max_iters, tol=tol)
+        return _finalize_garch_fit(res, aux["ok"], aux["n_eff"])
 
     return run
 

@@ -36,9 +36,13 @@ Usage::
     obs.disable()                     # final metrics snapshot -> JSONL
 
 Instrumented surfaces: ``reliability.fit_chunked`` / ``resilient_fit`` /
-``sanitize`` / ``journal`` / ``watchdog``, ``TimeSeriesPanel.fit`` /
-``map_series``, the compat ``fit_model`` wrappers, and
-``utils.optim``'s straggler-compaction stage.
+``sanitize`` / ``journal`` / ``watchdog`` / the pipelined ``committer``
+(queue-depth gauge, per-commit ``commit.overlap`` spans, hidden-commit
+counter), ``TimeSeriesPanel.fit`` / ``map_series``, the compat
+``fit_model`` wrappers, ``utils.optim``'s straggler-compaction stage, the
+time-sharded ``ops.seqparallel`` ``sp_*_fit`` entry points (``sp_fit``
+spans with compile/execute first-dispatch tagging), and
+``parallel.mesh.shard_series``.
 """
 
 from . import core, memory, metrics, recorder

@@ -37,9 +37,27 @@ try:  # jax >= 0.6 top-level export
 except ImportError:  # older builds: the experimental module
     from jax.experimental.shard_map import shard_map
 
+from .. import obs
 from ..parallel.mesh import SERIES_AXIS, TIME_AXIS
 
 Order = Tuple[int, int, int]
+
+
+def _sp_fit_span(model: str, mesh: Mesh, values, **knobs):
+    """Telemetry span for one time-sharded fit dispatch (ROADMAP: span
+    coverage for the sharded fit paths).  Mirrors the chunk driver's
+    first-dispatch tagging: the first dispatch of a (model, mesh, shape,
+    dtype, knobs) tuple pays JAX trace+compile (the ``lru_cache``d
+    ``_sp_*_fit_program`` builders trace on first use), later dispatches
+    execute a cached program.  Free no-op when the plane is disabled."""
+    phase = None
+    if obs.enabled():
+        key = ("sp_fit", model, tuple(mesh.shape.items()),
+               tuple(values.shape), str(values.dtype),
+               tuple(sorted(knobs.items())))
+        phase = "compile+execute" if obs.first_dispatch(key) else "execute"
+    return obs.span("sp_fit", model=model, keys=int(values.shape[0]),
+                    n_time=int(values.shape[1]), phase=phase)
 
 
 # ---------------------------------------------------------------------------
@@ -660,9 +678,10 @@ def sp_ewma_fit(mesh: Mesh, values: jax.Array, *, max_iters: int = 40,
     """
     if tol is None:  # same dtype-dependent default as models.ewma.fit
         tol = 1e-8 if values.dtype == jnp.float64 else 1e-4
-    return _sp_ewma_fit_program(
-        mesh, values.shape[1], max_iters, float(tol)
-    )(values)
+    with _sp_fit_span("ewma", mesh, values, max_iters=max_iters, tol=tol):
+        return _sp_ewma_fit_program(
+            mesh, values.shape[1], max_iters, float(tol)
+        )(values)
 
 
 @functools.lru_cache(maxsize=64)
@@ -727,9 +746,10 @@ def sp_garch_fit(mesh: Mesh, values: jax.Array, *, max_iters: int = 80,
     """
     if tol is None:  # same dtype-dependent default as models.garch.fit
         tol = 1e-7 if values.dtype == jnp.float64 else 1e-4
-    return _sp_garch_fit_program(
-        mesh, values.shape[1], max_iters, float(tol)
-    )(values)
+    with _sp_fit_span("garch", mesh, values, max_iters=max_iters, tol=tol):
+        return _sp_garch_fit_program(
+            mesh, values.shape[1], max_iters, float(tol)
+        )(values)
 
 
 @functools.lru_cache(maxsize=64)
@@ -824,9 +844,10 @@ def sp_argarch_fit(mesh: Mesh, values: jax.Array, *, max_iters: int = 100,
     """
     if tol is None:  # same dtype-dependent default as models.garch.fit_argarch
         tol = 1e-7 if values.dtype == jnp.float64 else 1e-4
-    return _sp_argarch_fit_program(
-        mesh, values.shape[1], max_iters, float(tol)
-    )(values)
+    with _sp_fit_span("argarch", mesh, values, max_iters=max_iters, tol=tol):
+        return _sp_argarch_fit_program(
+            mesh, values.shape[1], max_iters, float(tol)
+        )(values)
 
 
 @functools.lru_cache(maxsize=64)
@@ -914,6 +935,8 @@ def sp_arima_fit(mesh: Mesh, values: jax.Array, order: Order = (1, 1, 1), *,
     """
     if tol is None:  # same dtype-dependent default as models.arima.fit
         tol = 1e-6 if values.dtype == jnp.float64 else 1e-4
-    return _sp_arima_fit_program(
-        mesh, values.shape[1], tuple(order), max_iters, float(tol)
-    )(values)
+    with _sp_fit_span("arima", mesh, values, order=tuple(order),
+                      max_iters=max_iters, tol=tol):
+        return _sp_arima_fit_program(
+            mesh, values.shape[1], tuple(order), max_iters, float(tol)
+        )(values)

@@ -355,7 +355,8 @@ class TimeSeriesPanel:
             resilient: bool = True, policy: str = "impute",
             checkpoint_dir: Optional[str] = None, resume: str = "auto",
             chunk_budget_s: Optional[float] = None,
-            job_budget_s: Optional[float] = None, **fit_kwargs):
+            job_budget_s: Optional[float] = None,
+            pipeline: bool = True, pipeline_depth: int = 2, **fit_kwargs):
         """Fit a model family over every series via the resilient chunk driver.
 
         ``model`` is a model-module name (``"arima"``, ``"garch"``,
@@ -377,6 +378,14 @@ class TimeSeriesPanel:
         bound the fit's wall clock: overrunning chunks come back with rows
         flagged ``FitStatus.TIMEOUT`` instead of hanging the job, and are
         retried on the next journaled resume.
+
+        Journaled walks are PIPELINED by default: commits run on a bounded
+        background committer (at most ``pipeline_depth`` in flight, in
+        order) so the device computes the next chunk while the previous
+        chunk's shard and manifest hit disk — bitwise-identical to the
+        serial walk, which ``pipeline=False`` restores (see
+        ``reliability.fit_chunked``; ``meta["pipeline"]`` reports the
+        hidden commit time).
 
         Returns a ``reliability.ResilientFitResult`` whose rows align with
         ``self.keys``; ``.status`` carries per-series ``FitStatus`` codes
@@ -404,6 +413,7 @@ class TimeSeriesPanel:
                 resilient=resilient, policy=policy,
                 checkpoint_dir=checkpoint_dir, resume=resume,
                 chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
+                pipeline=pipeline, pipeline_depth=pipeline_depth,
                 **fit_kwargs,
             )
 

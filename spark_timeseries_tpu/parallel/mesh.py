@@ -85,10 +85,19 @@ def shard_series(values: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
 
     The keys axis must already be padded to a multiple of the mesh's series
     size (``TimeSeriesPanel`` pads with NaN rows at construction).
+
+    The placement is the mesh plane's cross-chip data movement (the analog
+    of Spark's shuffle into hash partitions), so it runs under an
+    ``obs.span`` (ROADMAP: span coverage for the sharded paths) — free
+    no-op when the telemetry plane is disabled.
     """
     if mesh is None:
         return values
-    return jax.device_put(values, series_sharding(mesh))
+    from .. import obs
+
+    with obs.span("mesh.shard_series", keys=int(values.shape[0]),
+                  devices=int(np.prod(list(mesh.shape.values())))):
+        return jax.device_put(values, series_sharding(mesh))
 
 
 @functools.lru_cache(maxsize=None)

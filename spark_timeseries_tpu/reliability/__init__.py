@@ -14,6 +14,10 @@ same guarantees at row granularity:
   backend) before any row is marked ``DIVERGED``.
 - :mod:`.chunked` — :func:`fit_chunked`: chunked execution with bounded
   ``RESOURCE_EXHAUSTED`` backoff and degradation recorded in metadata.
+- :mod:`.committer` — :class:`ChunkCommitter`: the pipelined driver's
+  bounded background commit thread — journal commits and host I/O overlap
+  the next chunk's device compute while preserving the journal's
+  single-writer, in-order commit protocol.
 - :mod:`.journal` — :class:`ChunkJournal`: write-ahead per-chunk npz
   shards + an atomic JSON manifest, so a journaled multi-chunk fit
   (``fit_chunked(..., checkpoint_dir=...)``) survives process death and
@@ -26,8 +30,10 @@ same guarantees at row granularity:
   torn manifests) so every recovery path runs in tier-1 CPU tests.
 """
 
-from . import chunked, faultinject, journal, runner, sanitize, status, watchdog
+from . import (chunked, committer, faultinject, journal, runner, sanitize,
+               status, watchdog)
 from .chunked import OOMBackoffExceeded, fit_chunked, is_resource_exhausted
+from .committer import ChunkCommitter, CommitterStats
 from .journal import (ChunkJournal, JournalError, StaleJournalError,
                       TornManifestError, config_hash, panel_fingerprint)
 from .runner import (ResilientFitResult, RetryRung, default_ladder,
@@ -37,7 +43,9 @@ from .status import FitStatus, merge_status, status_counts
 from .watchdog import Deadline, DeadlineExceeded, call_with_deadline
 
 __all__ = [
+    "ChunkCommitter",
     "ChunkJournal",
+    "CommitterStats",
     "Deadline",
     "DeadlineExceeded",
     "FitStatus",
@@ -50,6 +58,7 @@ __all__ = [
     "TornManifestError",
     "call_with_deadline",
     "chunked",
+    "committer",
     "config_hash",
     "default_ladder",
     "faultinject",

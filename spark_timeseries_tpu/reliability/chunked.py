@@ -1,4 +1,4 @@
-"""Chunked fit execution: OOM backoff, chunk journal, deadline watchdog.
+"""Chunked fit execution: pipelined commits, OOM backoff, journal, watchdog.
 
 The north-star workload (ROADMAP: 1M series x 1k obs) cannot always fit one
 monolithic batch in HBM — and the right chunk size depends on the model,
@@ -26,17 +26,39 @@ for free and a single Python process does not:
   marked TIMEOUT without dispatch.  The job always terminates with exact
   per-row status counts instead of hanging past its SLO, and a later
   resume retries only the TIMEOUT/pending chunks.
+
+**Pipelined execution** (``pipeline=True``, the default): the serial walk
+paid the full journal-commit latency — host fetch, npz shard, fsync,
+manifest rewrite — between every two chunk dispatches, idling the device
+for all of it.  Spark never did: per-partition compute pipelined with
+shuffle/persist I/O under lazy RDD execution (PAPER.md §3).  The rebuild
+of that overlap: finished chunks are handed to a bounded background
+committer (:class:`~.committer.ChunkCommitter`, at most ``pipeline_depth``
+commits in flight) that preserves the journal's single-writer,
+shard-before-manifest, in-order protocol, while the driver thread is
+already slicing and dispatching the next chunk — and, for non-resilient
+fits, JAX async dispatch lets that dispatch land while the previous
+chunk's device computation is still in flight.  Results are
+bitwise-identical to ``pipeline=False`` (same chunk boundaries, same
+compiled programs, same bytes — only where the host fetch and disk I/O
+happen moves), a crash with commits in flight resumes exactly like a
+serial crash (in-order commits: everything after the first in-flight
+commit recomputes), and the OOM-backoff/watchdog paths drain the queue
+deterministically before touching the journal.  ``meta["pipeline"]``
+reports how much commit wall time the overlap hid.
 """
 
 from __future__ import annotations
 
 from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
 from ..obs import memory as memory_probe
+from . import committer as committer_mod
 from . import journal as journal_mod
 from . import watchdog as watchdog_mod
 from .runner import ResilientFitResult, resilient_fit
@@ -94,7 +116,11 @@ class _TimeoutChunk:
 
 
 def _commit_arrays(piece) -> dict:
-    """Host-side arrays of one finished chunk, in the journal shard schema."""
+    """Host-side arrays of one finished chunk, in the journal shard schema.
+
+    Under the pipelined driver this runs on the committer thread, so for
+    non-resilient fits the device->host fetch itself overlaps the next
+    chunk's device compute."""
     return {
         "params": np.asarray(piece.params),
         "nll": np.asarray(piece.neg_log_likelihood),
@@ -119,6 +145,8 @@ def fit_chunked(
     resume: str = "auto",
     chunk_budget_s: Optional[float] = None,
     job_budget_s: Optional[float] = None,
+    pipeline: bool = True,
+    pipeline_depth: int = 2,
     process_index: Optional[int] = None,
     journal_extra: Optional[dict] = None,
     _journal_commit_hook=None,
@@ -154,12 +182,33 @@ def fit_chunked(
     only process 0 commits the job-level ``manifest.json``
     (``process_index`` defaults to ``jax.process_index()``).
 
-    **Deadlines**: ``chunk_budget_s`` bounds each chunk's fit dispatch
-    (overrun -> rows flagged ``TIMEOUT``, walk continues — the compiled
-    computation is abandoned, not cancelled); ``job_budget_s`` bounds the
+    **Pipelining** (``pipeline=True``, default): with a journal attached,
+    the host fetch + shard write + manifest update of a finished chunk run
+    on a background committer thread (at most ``pipeline_depth`` commits
+    in flight, in order) while the driver dispatches the next chunk, so
+    the device no longer idles for the commit latency.  The pipeline
+    changes WHERE the commit I/O happens, never what is computed: results
+    are bitwise-identical to ``pipeline=False``, the journal's
+    single-writer / shard-before-manifest / in-order protocol is
+    preserved, and a crash with commits in flight resumes exactly as a
+    serial crash would (uncommitted chunks recompute).  The pipeline
+    knobs are deliberately EXCLUDED from the journal's config hash — a
+    serial journal resumes under a pipelined run and vice versa.
+    ``pipeline=False`` restores the fully serial walk.
+    ``meta["pipeline"]`` reports the commit wall time, how much of it the
+    driver never waited for (``hidden_commit_s``), and the resulting
+    ``overlap_efficiency``.
+
+    **Deadlines**: ``chunk_budget_s`` bounds each chunk's fit (overrun ->
+    rows flagged ``TIMEOUT``, walk continues — the compiled computation is
+    abandoned, not cancelled; with the budget armed, non-resilient fits
+    block on device completion inside the watchdog window so the budget
+    covers compute, not just async dispatch); ``job_budget_s`` bounds the
     whole walk (once spent, remaining chunks are marked TIMEOUT without
-    dispatch).  Partial results always carry exact status counts, and
-    TIMEOUT chunks are retried on a journaled resume.
+    dispatch).  Both paths drain the commit queue before touching the
+    journal, so the TIMEOUT mark always lands after every earlier commit.
+    Partial results always carry exact status counts, and TIMEOUT chunks
+    are retried on a journaled resume.
 
     ``meta`` records ``chunk_rows_initial`` / ``chunk_rows_final``, every
     backoff and timeout event, ``degraded=True`` whenever a backoff or
@@ -170,11 +219,13 @@ def fit_chunked(
     ``obs.span("chunk")`` whose first dispatch per (fit, shape, dtype) is
     tagged ``compile+execute`` (JAX pays trace+compile there) and the rest
     ``execute``; backoffs, timeouts, and per-row status totals feed the
-    metrics registry, and the per-run summary — per-chunk span times,
-    counters, peak memory (never null: host-RSS fallback) — lands in
-    ``meta["telemetry"]`` and, when journaled, the manifest's
-    ``telemetry`` block.  Disabled (the default), none of this runs and
-    the result is bitwise-identical to the uninstrumented driver.
+    metrics registry; the committer reports a ``committer.queue_depth``
+    gauge, per-commit ``commit.overlap`` spans, and a
+    ``committer.hidden_commit_ms`` counter; and the per-run summary —
+    per-chunk span times, counters, peak memory (never null: host-RSS
+    fallback) — lands in ``meta["telemetry"]`` and, when journaled, the
+    manifest's ``telemetry`` block.  Disabled (the default), none of this
+    runs and the result is bitwise-identical to the uninstrumented driver.
     """
     yb = jnp.asarray(y)
     if yb.ndim != 2:
@@ -188,11 +239,12 @@ def fit_chunked(
     if checkpoint_dir is not None:
         if process_index is None:
             try:
-                import jax
-
                 process_index = jax.process_index()
             except Exception:  # noqa: BLE001 - no backend yet: single process
                 process_index = 0
+        # pipeline knobs deliberately NOT hashed: they move I/O between
+        # threads without changing a byte of the result, and a serial
+        # journal must resume under a pipelined run (and vice versa)
         cfg = journal_mod.config_hash(
             fit_fn, fit_kwargs,
             extra={"chunk_rows": chunk0, "min_chunk_rows": min_chunk_rows,
@@ -209,6 +261,11 @@ def fit_chunked(
             extra=journal_extra,
             commit_hook=_journal_commit_hook,
         )
+    committer = None
+    if journal is not None and pipeline:
+        committer = committer_mod.ChunkCommitter(
+            journal, _commit_arrays, depth=pipeline_depth,
+            probe=memory_probe.peak_memory, status_counts=status_counts)
     deadline = watchdog_mod.Deadline(job_budget_s)
 
     import time as _time
@@ -240,7 +297,7 @@ def fit_chunked(
                "time": int(yb.shape[1]), "dtype": str(yb.dtype)},
     ) if tele else None
 
-    pieces = []
+    pieces = []  # (lo, hi, piece) in walk order; piece may be _TimeoutChunk
     oom_events = []
     timeout_events = []
     # boundaries of committed-but-unloadable (torn-shard) chunks: the
@@ -249,155 +306,266 @@ def fit_chunked(
     # break the bitwise-identical-boundaries contract
     lost_boundaries: dict = {}
     lo = 0
-    while lo < b:
-        if journal is not None:
-            entry = journal.committed(lo)
-            if entry is not None:
-                piece = journal.load_chunk(entry)
-                if piece is not None:
-                    pieces.append(piece)
-                    if tele:
-                        tele_chunks.append({"lo": lo, "hi": int(entry["hi"]),
-                                            "phase": "resumed"})
-                    lo = entry["hi"]
-                    # replay the backoff state in effect when the chunk
-                    # committed, so the resumed walk visits the SAME
-                    # boundaries the uninterrupted run would have
-                    chunk = int(entry.get("chunk_rows_after", chunk))
+
+    def _record_oom(at_row: int, rows: int, e: BaseException) -> int:
+        """Shared backoff bookkeeping for fit-time and commit-time OOMs;
+        returns the halved chunk size (or raises when the budget/floor is
+        spent)."""
+        oom_events.append({
+            "at_row": at_row, "chunk_rows": rows,
+            "error": f"{type(e).__name__}: {e}"[:200],
+        })
+        obs.counter("chunked.oom_backoffs").inc()
+        obs.event("chunk.oom_backoff", at_row=at_row, chunk_rows=rows)
+        if rows <= min_chunk_rows or len(oom_events) > max_backoffs:
+            raise OOMBackoffExceeded(
+                f"chunk of {rows} rows still RESOURCE_EXHAUSTED after "
+                f"{len(oom_events)} backoffs (floor {min_chunk_rows})"
+            ) from e
+        return max(min_chunk_rows, rows // 2)
+
+    def _rollback(err):
+        """Handle a committer-detected failure (the fetch/commit of an
+        async-dispatched chunk raised on the worker thread).
+
+        Non-OOM errors re-raise unchanged.  An OOM rolls the walk back to
+        the failed chunk: everything at/after it is uncommitted (in-order
+        queue), so its pieces are dropped, the chunk size halves, and the
+        walk re-enters at the failed row — the pipelined twin of the
+        fit-time backoff.  Returns the (lo, chunk) to continue from."""
+        e, flo, fhi = err
+        if not is_resource_exhausted(e):
+            raise e
+        new_chunk = _record_oom(flo, fhi - flo, e)
+        pieces[:] = [p for p in pieces if p[0] < flo]
+        if tele:
+            tele_chunks[:] = [r for r in tele_chunks if r["lo"] < flo]
+        return flo, new_chunk
+
+    def _drain_for_journal_write():
+        """Synchronize with the committer before the driver itself writes
+        the journal (TIMEOUT marks, forced torn-shard recommits): after
+        this, every earlier commit is durable and the driver is the only
+        writer.  Returns a pending error tuple instead of raising so the
+        caller can roll back."""
+        if committer is None:
+            return None
+        return committer.drain(raise_pending=False)
+
+    try:
+        while True:
+            if committer is not None:
+                err = committer.take_error()
+                if err is not None:
+                    lo, chunk = _rollback(err)
                     continue
-                lost_boundaries[lo] = (
-                    int(entry["hi"]),
-                    int(entry.get("chunk_rows_after", chunk)))
-        forced = lost_boundaries.get(lo)
-        hi = forced[0] if forced else min(lo + chunk, b)
-        if journal is not None and not forced:
-            # keep the walk on the committed grid: after an OOM backoff
-            # whose halving does not divide the original chunk size, a
-            # free-running hi would sail past the next committed chunk's
-            # lo, orphaning it (never matched again) and double-counting
-            # its rows in the manifest — clamp to the boundary instead
-            nxt = journal.next_committed_lo(lo)
-            if nxt is not None and nxt < hi:
-                hi = nxt
-        if deadline.exceeded():
-            if forced:
+            if lo >= b:
+                # final drain: a commit of one of the last chunks may still
+                # fail (or OOM at fetch) — that must surface (or roll the
+                # walk back) BEFORE assembly reads the pieces
+                err = _drain_for_journal_write()
+                if err is not None:
+                    lo, chunk = _rollback(err)
+                    continue
+                break
+            if journal is not None:
+                entry = journal.committed(lo)
+                if entry is not None:
+                    piece = journal.load_chunk(entry)
+                    if piece is not None:
+                        pieces.append((lo, int(entry["hi"]), piece))
+                        if tele:
+                            tele_chunks.append({"lo": lo,
+                                                "hi": int(entry["hi"]),
+                                                "phase": "resumed"})
+                        lo = entry["hi"]
+                        # replay the backoff state in effect when the chunk
+                        # committed, so the resumed walk visits the SAME
+                        # boundaries the uninterrupted run would have
+                        chunk = int(entry.get("chunk_rows_after", chunk))
+                        continue
+                    lost_boundaries[lo] = (
+                        int(entry["hi"]),
+                        int(entry.get("chunk_rows_after", chunk)))
+            forced = lost_boundaries.get(lo)
+            hi = forced[0] if forced else min(lo + chunk, b)
+            if journal is not None and not forced:
+                # keep the walk on the committed grid: after an OOM backoff
+                # whose halving does not divide the original chunk size, a
+                # free-running hi would sail past the next committed chunk's
+                # lo, orphaning it (never matched again) and double-counting
+                # its rows in the manifest — clamp to the boundary instead
+                nxt = journal.next_committed_lo(lo)
+                if nxt is not None and nxt < hi:
+                    hi = nxt
+            if deadline.exceeded():
+                err = _drain_for_journal_write()
+                if err is not None:
+                    lo, chunk = _rollback(err)
+                    continue
+                if forced:
+                    chunk = forced[1]
+                    lost_boundaries.pop(lo, None)
+                timeout_events.append({
+                    "at_row": lo, "chunk_rows": hi - lo, "dispatched": False,
+                    "budget_s": deadline.budget_s, "scope": "job"})
+                obs.counter("chunked.timeouts.job").inc()
+                obs.event("chunk.timeout", lo=lo, hi=hi, scope="job",
+                          dispatched=False)
+                if tele:
+                    tele_chunks.append({"lo": lo, "hi": hi,
+                                        "phase": "timeout", "scope": "job"})
+                pieces.append((lo, hi, _TimeoutChunk(lo, hi)))
+                if journal is not None:
+                    journal.mark_timeout(lo, hi, scope="job",
+                                         budget_s=deadline.budget_s,
+                                         chunk_rows_after=chunk)
+                lo = hi
+                continue
+            # whole-panel chunk: hand the caller's array through untouched (a
+            # slice would be a fresh device buffer — an extra HBM copy, and a
+            # miss in the per-array-identity align-mode cache callers pre-warm)
+            vals = yb if (lo == 0 and hi == b) else yb[lo:hi]
+
+            def run_chunk(vals=vals):
+                if resilient:
+                    return resilient_fit(
+                        fit_fn, vals, policy=policy, ladder=ladder,
+                        **fit_kwargs)
+                out = fit_fn(vals, **fit_kwargs)
+                if chunk_budget_s is not None:
+                    # with a deadline armed the budget must cover the device
+                    # computation, not just its async dispatch — block here,
+                    # INSIDE the watchdog window
+                    jax.block_until_ready(out)
+                return out
+
+            phase = None
+            if tele:
+                # first dispatch of this (fit config, chunk rows) pays JAX
+                # trace+compile; later dispatches of the same shape execute a
+                # cached program — the split BENCH scraped ad hoc, now
+                # recorded per chunk (a backoff-halved chunk is a NEW shape =
+                # new compile)
+                phase = ("compile+execute"
+                         if obs.first_dispatch((fit_key, hi - lo))
+                         else "execute")
+            sp = obs.span("chunk", lo=lo, hi=hi, phase=phase)
+            t0 = _time.perf_counter()
+            try:
+                with sp:
+                    piece = watchdog_mod.call_with_deadline(
+                        run_chunk, chunk_budget_s,
+                        label=f"chunk rows [{lo}, {hi})")
+            except watchdog_mod.DeadlineExceeded:
+                err = _drain_for_journal_write()
+                if err is not None:
+                    lo, chunk = _rollback(err)
+                    continue
+                if forced:
+                    chunk = forced[1]
+                    lost_boundaries.pop(lo, None)
+                timeout_events.append({
+                    "at_row": lo, "chunk_rows": hi - lo, "dispatched": True,
+                    "budget_s": chunk_budget_s, "scope": "chunk"})
+                obs.counter("chunked.timeouts.chunk").inc()
+                obs.event("chunk.timeout", lo=lo, hi=hi, scope="chunk",
+                          dispatched=True, budget_s=chunk_budget_s)
+                if tele:
+                    tele_chunks.append({"lo": lo, "hi": hi,
+                                        "phase": "timeout", "scope": "chunk",
+                                        **_span_times(sp)})
+                pieces.append((lo, hi, _TimeoutChunk(lo, hi)))
+                if journal is not None:
+                    journal.mark_timeout(lo, hi, scope="chunk",
+                                         budget_s=chunk_budget_s,
+                                         chunk_rows_after=chunk)
+                lo = hi
+                continue
+            except Exception as e:  # noqa: BLE001 - filtered just below
+                if not is_resource_exhausted(e):
+                    raise
+                # drain before re-entering backoff: the journal state is
+                # then deterministic at every backoff decision, and a
+                # failed commit of an EARLIER chunk takes precedence over
+                # this chunk's fit-time OOM (it is earlier in the walk)
+                err = _drain_for_journal_write()
+                if err is not None:
+                    lo, chunk = _rollback(err)
+                    continue
+                if forced:
+                    # a torn-shard recompute is pinned to the committed
+                    # [lo, hi): halving `chunk` would not shrink the dispatch
+                    # (hi stays forced), so retrying is futile — fail with
+                    # the actionable cause instead of burning the backoff
+                    # budget
+                    raise OOMBackoffExceeded(
+                        f"recompute of torn-shard chunk [{lo}, {hi}) hit "
+                        "RESOURCE_EXHAUSTED; its boundaries are fixed by the "
+                        "journal, so backoff cannot help. Free device "
+                        "memory, or restart the job under a fresh "
+                        "checkpoint_dir (or remove this journal explicitly) "
+                        "to let the walk re-chunk."
+                    ) from e
+                chunk = _record_oom(lo, chunk, e)
+                continue
+            if forced:  # torn-shard recompute done: restore the recorded walk
                 chunk = forced[1]
                 lost_boundaries.pop(lo, None)
-            timeout_events.append({
-                "at_row": lo, "chunk_rows": hi - lo, "dispatched": False,
-                "budget_s": deadline.budget_s, "scope": "job"})
-            obs.counter("chunked.timeouts.job").inc()
-            obs.event("chunk.timeout", lo=lo, hi=hi, scope="job",
-                      dispatched=False)
             if tele:
-                tele_chunks.append({"lo": lo, "hi": hi, "phase": "timeout",
-                                    "scope": "job"})
-            pieces.append(_TimeoutChunk(lo, hi))
+                tele_chunks.append({"lo": lo, "hi": hi, "phase": phase,
+                                    **_span_times(sp)})
             if journal is not None:
-                journal.mark_timeout(lo, hi, scope="job",
-                                     budget_s=deadline.budget_s,
-                                     chunk_rows_after=chunk)
+                wall_s = round(_time.perf_counter() - t0, 4)
+                if committer is not None and not forced:
+                    # background commit: the fetch + shard + manifest update
+                    # overlap the next chunk's dispatch/compute.  chunk_rows
+                    # _after is captured NOW (not at commit time) so the
+                    # recorded backoff state matches the serial walk exactly
+                    try:
+                        committer.submit(lo, hi, piece, wall_s=wall_s,
+                                         chunk_rows_after=chunk)
+                    except BaseException as se:
+                        err = committer.take_error()
+                        # only the worker's OWN re-raised error enters the
+                        # rollback path: an unrelated exception (e.g. a
+                        # Ctrl-C landing while submit blocked) must abort,
+                        # not be converted into an OOM retry
+                        if err is None or err[0] is not se:
+                            raise
+                        lo, chunk = _rollback(err)
+                        continue
+                else:
+                    # forced torn-shard recommits stay synchronous: they are
+                    # rare, their boundaries are pinned by the journal, and
+                    # the serial path keeps their edge semantics exact
+                    err = _drain_for_journal_write()
+                    if err is not None:
+                        lo, chunk = _rollback(err)
+                        continue
+                    arrays = _commit_arrays(piece)
+                    pm = memory_probe.peak_memory()
+                    journal.commit_chunk(
+                        lo, hi, arrays,
+                        wall_s=wall_s,
+                        peak_hbm_bytes=pm.bytes,
+                        peak_hbm_source=pm.source,
+                        chunk_rows_after=chunk,
+                        status_counts=status_counts(arrays["status"]),
+                    )
+            pieces.append((lo, hi, piece))
             lo = hi
-            continue
-        # whole-panel chunk: hand the caller's array through untouched (a
-        # slice would be a fresh device buffer — an extra HBM copy, and a
-        # miss in the per-array-identity align-mode cache callers pre-warm)
-        vals = yb if (lo == 0 and hi == b) else yb[lo:hi]
-
-        def run_chunk(vals=vals):
-            if resilient:
-                return resilient_fit(
-                    fit_fn, vals, policy=policy, ladder=ladder, **fit_kwargs)
-            return fit_fn(vals, **fit_kwargs)
-
-        phase = None
-        if tele:
-            # first dispatch of this (fit config, chunk rows) pays JAX
-            # trace+compile; later dispatches of the same shape execute a
-            # cached program — the split BENCH scraped ad hoc, now recorded
-            # per chunk (a backoff-halved chunk is a NEW shape = new compile)
-            phase = ("compile+execute"
-                     if obs.first_dispatch((fit_key, hi - lo))
-                     else "execute")
-        sp = obs.span("chunk", lo=lo, hi=hi, phase=phase)
-        t0 = _time.perf_counter()
-        try:
-            with sp:
-                piece = watchdog_mod.call_with_deadline(
-                    run_chunk, chunk_budget_s,
-                    label=f"chunk rows [{lo}, {hi})")
-        except watchdog_mod.DeadlineExceeded:
-            if forced:
-                chunk = forced[1]
-                lost_boundaries.pop(lo, None)
-            timeout_events.append({
-                "at_row": lo, "chunk_rows": hi - lo, "dispatched": True,
-                "budget_s": chunk_budget_s, "scope": "chunk"})
-            obs.counter("chunked.timeouts.chunk").inc()
-            obs.event("chunk.timeout", lo=lo, hi=hi, scope="chunk",
-                      dispatched=True, budget_s=chunk_budget_s)
-            if tele:
-                tele_chunks.append({"lo": lo, "hi": hi, "phase": "timeout",
-                                    "scope": "chunk", **_span_times(sp)})
-            pieces.append(_TimeoutChunk(lo, hi))
-            if journal is not None:
-                journal.mark_timeout(lo, hi, scope="chunk",
-                                     budget_s=chunk_budget_s,
-                                     chunk_rows_after=chunk)
-            lo = hi
-            continue
-        except Exception as e:  # noqa: BLE001 - filtered just below
-            if not is_resource_exhausted(e):
-                raise
-            if forced:
-                # a torn-shard recompute is pinned to the committed
-                # [lo, hi): halving `chunk` would not shrink the dispatch
-                # (hi stays forced), so retrying is futile — fail with the
-                # actionable cause instead of burning the backoff budget
-                raise OOMBackoffExceeded(
-                    f"recompute of torn-shard chunk [{lo}, {hi}) hit "
-                    "RESOURCE_EXHAUSTED; its boundaries are fixed by the "
-                    "journal, so backoff cannot help. Free device memory, "
-                    "or restart the job under a fresh checkpoint_dir (or "
-                    "remove this journal explicitly) to let the walk "
-                    "re-chunk."
-                ) from e
-            oom_events.append({
-                "at_row": lo, "chunk_rows": chunk,
-                "error": f"{type(e).__name__}: {e}"[:200],
-            })
-            obs.counter("chunked.oom_backoffs").inc()
-            obs.event("chunk.oom_backoff", at_row=lo, chunk_rows=chunk)
-            if chunk <= min_chunk_rows or len(oom_events) > max_backoffs:
-                raise OOMBackoffExceeded(
-                    f"chunk of {chunk} rows still RESOURCE_EXHAUSTED after "
-                    f"{len(oom_events)} backoffs (floor {min_chunk_rows})"
-                ) from e
-            chunk = max(min_chunk_rows, chunk // 2)
-            continue
-        if forced:  # torn-shard recompute done: restore the recorded walk
-            chunk = forced[1]
-            lost_boundaries.pop(lo, None)
-        if tele:
-            tele_chunks.append({"lo": lo, "hi": hi, "phase": phase,
-                                **_span_times(sp)})
-        if journal is not None:
-            arrays = _commit_arrays(piece)
-            pm = memory_probe.peak_memory()
-            journal.commit_chunk(
-                lo, hi, arrays,
-                wall_s=round(_time.perf_counter() - t0, 4),
-                peak_hbm_bytes=pm.bytes,
-                peak_hbm_source=pm.source,
-                chunk_rows_after=chunk,
-                status_counts=status_counts(arrays["status"]),
-            )
-        pieces.append(piece)
-        lo = hi
+    except BaseException:
+        if committer is not None:
+            # the walk is failing: stop the worker without letting a second
+            # (pending) commit error mask the original exception
+            committer.close(raise_pending=False)
+        raise
+    pipe_stats = committer.close() if committer is not None else None
 
     # parameter width for synthesized TIMEOUT rows comes from any finished
     # chunk; an all-TIMEOUT job degenerates to a single NaN column
-    k = next((int(np.asarray(p.params).shape[-1]) for p in pieces
+    k = next((int(np.asarray(p.params).shape[-1]) for _, _, p in pieces
               if not isinstance(p, _TimeoutChunk)), 1)
     dtype = np.dtype(str(yb.dtype))
 
@@ -413,7 +581,7 @@ def fit_chunked(
                 np.asarray(p.converged), np.asarray(p.iters),
                 _piece_status(p))
 
-    mats = [_mat(p) for p in pieces]
+    mats = [_mat(p) for _, _, p in pieces]
     params = np.concatenate([m[0] for m in mats])
     nll = np.concatenate([m[1] for m in mats])
     conv = np.concatenate([m[2] for m in mats])
@@ -433,9 +601,25 @@ def fit_chunked(
     }
     if journal is not None:
         meta["journal"] = journal.accounting()
+    if pipe_stats is not None:
+        hidden = pipe_stats.hidden_s
+        meta["pipeline"] = {
+            "depth": committer.depth,
+            "commits_background": pipe_stats.commits,
+            "commit_wall_s": round(pipe_stats.commit_wall_s, 6),
+            "driver_blocked_s": round(pipe_stats.blocked_s, 6),
+            "hidden_commit_s": round(hidden, 6),
+            "max_queue_depth": pipe_stats.max_queue_depth,
+            # fraction of commit wall the driver never waited for — the
+            # number the bench's journaled-vs-unjournaled pair publishes
+            "overlap_efficiency": (round(hidden / pipe_stats.commit_wall_s, 4)
+                                   if pipe_stats.commit_wall_s > 0 else None),
+        }
+        obs.gauge("committer.hidden_commit_s").set(round(hidden, 6))
+        obs.counter("committer.hidden_commit_ms").add(int(hidden * 1000))
     # ladder/sanitize accounting aggregated across chunks (resilient mode)
     rung_totals: dict = {}
-    for p in pieces:
+    for _, _, p in pieces:
         for r in (getattr(p, "meta", None) or {}).get("ladder", ()):
             agg = rung_totals.setdefault(
                 r["rung"], {"attempted": 0, "rescued": 0})

@@ -47,6 +47,7 @@ import json
 import os
 import subprocess
 import tempfile
+import threading
 import time
 import uuid
 import zipfile
@@ -255,6 +256,12 @@ class ChunkJournal:
         self.run_id = uuid.uuid4().hex[:12]
         self._commit_hook = commit_hook
         self.resumed_entries = 0
+        # the pipelined chunk driver commits from a background committer
+        # thread while the driver thread reads resume state
+        # (committed / next_committed_lo); one reentrant lock keeps the
+        # manifest map coherent without changing the single-WRITER protocol
+        # (the committer is the only writer between submit and drain)
+        self._mu = threading.RLock()
 
         prior = self._load_manifest() if resume != "never" else None
         if resume == "never":
@@ -347,14 +354,16 @@ class ChunkJournal:
 
     def committed(self, lo: int) -> Optional[dict]:
         """The committed manifest entry starting at row ``lo``, if any."""
-        e = self._by_lo.get(int(lo))
-        return e if e is not None and e["status"] == "committed" else None
+        with self._mu:
+            e = self._by_lo.get(int(lo))
+            return e if e is not None and e["status"] == "committed" else None
 
     def next_committed_lo(self, lo: int) -> Optional[int]:
         """Smallest committed-chunk start strictly beyond ``lo`` — the
         boundary a recomputing walk must not run past."""
-        starts = [e["lo"] for e in self._manifest["chunks"]
-                  if e["status"] == "committed" and e["lo"] > int(lo)]
+        with self._mu:
+            starts = [e["lo"] for e in self._manifest["chunks"]
+                      if e["status"] == "committed" and e["lo"] > int(lo)]
         return min(starts) if starts else None
 
     def load_chunk(self, entry: dict) -> Optional[LoadedChunk]:
@@ -382,12 +391,13 @@ class ChunkJournal:
         return piece
 
     def _record(self, entry: dict) -> None:
-        self._manifest["chunks"] = [
-            e for e in self._manifest["chunks"] if e["lo"] != entry["lo"]]
-        self._manifest["chunks"].append(entry)
-        self._manifest["chunks"].sort(key=lambda e: e["lo"])
-        self._by_lo[entry["lo"]] = entry
-        self._write_manifest()
+        with self._mu:
+            self._manifest["chunks"] = [
+                e for e in self._manifest["chunks"] if e["lo"] != entry["lo"]]
+            self._manifest["chunks"].append(entry)
+            self._manifest["chunks"].sort(key=lambda e: e["lo"])
+            self._by_lo[entry["lo"]] = entry
+            self._write_manifest()
         if self._commit_hook is not None:
             # "committed" fires only for durable result chunks: a TIMEOUT
             # mark is bookkeeping, and kill_after_commits counting it would
@@ -441,14 +451,16 @@ class ChunkJournal:
         counters, and peak memory from the journal alone
         (``tools/inspect_journal.py`` prints it, ``tools/obs_report.py
         --manifest`` validates it)."""
-        self._manifest["telemetry"] = telemetry
-        self._write_manifest()
+        with self._mu:
+            self._manifest["telemetry"] = telemetry
+            self._write_manifest()
 
     # -- summary ------------------------------------------------------------
 
     def accounting(self) -> dict:
         """Job-level journal metadata for result ``meta`` / bench artifacts."""
-        chunks = self._manifest["chunks"]
+        with self._mu:
+            chunks = list(self._manifest["chunks"])
         return {
             "dir": self.dir,
             "manifest": os.path.basename(self.manifest_path),

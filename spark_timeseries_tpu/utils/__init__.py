@@ -1,4 +1,4 @@
-from . import optim
+from . import compile_cache, optim
 from .optim import batched_minimize, minimize_lbfgs
 
-__all__ = ["optim", "minimize_lbfgs", "batched_minimize"]
+__all__ = ["compile_cache", "optim", "minimize_lbfgs", "batched_minimize"]

@@ -1,0 +1,90 @@
+"""Opt-in persistent JAX compilation cache for restart-heavy workloads.
+
+The journal (``reliability.journal``) makes a killed panel job resume
+without recomputing committed chunks — but the restarted PROCESS still
+repaid the full trace+compile of every fit program before touching the
+first pending chunk, which at north-star scale is tens of seconds of pure
+recompilation of programs an identical process already built.  JAX ships a
+persistent compilation cache (serialized XLA executables keyed by HLO +
+compile options) that turns that cost into a disk read; this module is the
+library's one switch for it, so the bench, CI, and serving entry points
+agree on how it is enabled:
+
+- :func:`enable_compile_cache` — point JAX at a cache directory and relax
+  the min-size/min-compile-time gates so small fit programs cache too.
+  Safe to call more than once; returns the directory in effect or ``None``
+  when this jax build has no cache support (the call degrades to a no-op
+  rather than failing the fit — same contract as the obs plane).
+- ``STSTPU_COMPILE_CACHE=<dir>`` — environment opt-in honored by
+  :func:`enable_from_env` (wired into ``bench.py``; ``ci.sh`` exports
+  ``JAX_COMPILATION_CACHE_DIR`` which jax honors natively).
+
+Deliberately OPT-IN: a shared default directory would let one user's cache
+poison another's benchmark numbers (first-run compile time is a published
+measurement), and stale caches across jax upgrades are evicted by jax's
+own key, not by us.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["enable_compile_cache", "enable_from_env"]
+
+_ENV_VAR = "STSTPU_COMPILE_CACHE"
+_enabled_dir: Optional[str] = None
+
+
+def enable_compile_cache(cache_dir: str) -> Optional[str]:
+    """Enable jax's persistent compilation cache under ``cache_dir``.
+
+    Returns the directory on success, ``None`` when this jax build lacks
+    the cache (never raises: a missing cache only costs recompiles).  The
+    min-entry-size and min-compile-time gates are relaxed so the chunked
+    fit programs — compiled once per (config, chunk-rows) — are cached
+    regardless of size, which is the whole point for journaled resumes.
+    """
+    global _enabled_dir
+    try:
+        import jax
+
+        cache_dir = os.path.abspath(cache_dir)
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache every program: the default gates skip small/fast compiles,
+        # but a resumed north-star walk re-pays dozens of them at once
+        for knob, v in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                        ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+            try:
+                jax.config.update(knob, v)
+            except Exception:  # noqa: BLE001 - knob renamed/absent: defaults ok
+                pass
+        # jax latches the cache decision per backend at first use: a dir
+        # set AFTER the backend initialized is silently ignored (verified
+        # on jax 0.4.37) — reset the latch so mid-process enabling (bench
+        # main, a serving process flipping the knob) actually takes effect
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc)
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001 - moved/absent: fresh-process only
+            pass
+        _enabled_dir = cache_dir
+        return cache_dir
+    except Exception:  # noqa: BLE001 - no cache support in this build
+        return None
+
+
+def enable_from_env() -> Optional[str]:
+    """Honor ``STSTPU_COMPILE_CACHE=<dir>`` (no-op when unset)."""
+    d = os.environ.get(_ENV_VAR)
+    if not d:
+        return None
+    return enable_compile_cache(d)
+
+
+def enabled_dir() -> Optional[str]:
+    """The cache directory enabled through this module, if any."""
+    return _enabled_dir

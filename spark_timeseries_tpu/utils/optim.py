@@ -286,6 +286,179 @@ def minimize_lbfgs(
     )
 
 
+_rownorm = lambda v: jnp.linalg.norm(v, axis=-1)
+_rowdot = lambda a, b: jnp.sum(a * b, axis=-1)
+_two_loop_b = jax.vmap(_two_loop, in_axes=(0, 0, 0, 0, None, None))
+
+
+def _make_vg_b(fb):
+    """Batched value-and-grad with the non-finite guard rows carry."""
+
+    def vg(x):
+        f, pullback = jax.vjp(fb, x)
+        (g,) = pullback(jnp.ones_like(f))
+        bad = ~jnp.isfinite(f) | ~jnp.all(jnp.isfinite(g), axis=-1)
+        return jnp.where(bad, jnp.inf, f), jnp.where(bad[:, None], 0.0, g)
+
+    return vg
+
+
+def _init_state_b(vg, x0, m, tol):
+    bsz, d = x0.shape
+    dtype = x0.dtype
+    f0, g0 = vg(x0)
+    return _State(
+        k=jnp.zeros((), jnp.int32),
+        x=x0,
+        f=f0,
+        g=g0,
+        s_hist=jnp.zeros((bsz, m, d), dtype),
+        y_hist=jnp.zeros((bsz, m, d), dtype),
+        rho_hist=jnp.zeros((bsz, m), dtype),
+        converged=(_rownorm(g0) < tol) & jnp.isfinite(f0),
+        failed=jnp.isinf(f0),
+        tprev=jnp.ones((bsz,), dtype),
+        bx=x0,
+        bf=f0,
+        bg=g0,
+    )
+
+
+def _make_linesearch_b(fb, *, ftol, max_linesearch, c1):
+    def linesearch(x, f, g, direction, done, t0):
+        # done rows are pre-satisfied: their (frozen) state can never
+        # pass the strict Armijo test, and one such row would otherwise
+        # drag the whole batch through max_linesearch extra objective
+        # evaluations.  Failed trials jump to the minimizer of the
+        # quadratic through (0, f), slope g·dir, and (t, f(t)) (clamped
+        # to [0.1t, 0.5t]): every trial is a FULL-batch objective pass
+        # gated by the worst row, and plain halving needs ~12 of them
+        # per iteration on badly scaled steps
+        gd = _rowdot(g, direction)
+        # noise floor: near convergence the predicted decrease falls
+        # below the objective's f32 evaluation noise and the strict
+        # Armijo test rejects EVERY step size, dragging the whole batch
+        # through deep backtracks; the relaxed accept is resolved by the
+        # ftol rule
+        eps = ftol * jnp.maximum(1.0, jnp.abs(f))
+
+        def body(carry):
+            t, ok, j = carry
+            fnew = fb(x + t[:, None] * direction)
+            fnew = jnp.where(jnp.isfinite(fnew), fnew, jnp.inf)
+            ok_new = ok | (fnew <= f + c1 * t * gd + eps)
+            tq = -gd * t * t / (2.0 * (fnew - f - gd * t))
+            tq = jnp.where(jnp.isfinite(tq), tq, 0.0)
+            # the objective may evaluate in a wider dtype; the carry
+            # must not
+            tq = jnp.clip(tq, 0.1 * t, 0.5 * t).astype(t.dtype)
+            return jnp.where(ok_new, t, tq), ok_new, j + 1
+
+        def cond(carry):
+            _, ok, j = carry
+            return jnp.any(~ok) & (j < max_linesearch)
+
+        t, ok, n_ls = lax.while_loop(cond, body, (t0, done, 0))
+        return t, ok, n_ls
+
+    return linesearch
+
+
+def _make_step_b(fb, *, m, dtype, tol, ftol, max_linesearch, c1):
+    """One lockstep L-BFGS iteration over a batched objective ``fb`` —
+    shared by the inline two-stage driver (:func:`minimize_lbfgs_batched`)
+    and the lazily compiled stage-1/stage-2 split."""
+    vg_fb = _make_vg_b(fb)
+    linesearch = _make_linesearch_b(fb, ftol=ftol,
+                                    max_linesearch=max_linesearch, c1=c1)
+
+    def step(carry):
+        state, iters, ls_hist = carry
+        done = state.converged | state.failed
+        with jax.named_scope("optim.lbfgs_batched.two_loop"):
+            direction = -_two_loop_b(
+                state.g, state.s_hist, state.y_hist, state.rho_hist,
+                state.k, m
+            )
+        descent = _rowdot(state.g, direction) < 0.0
+        direction = jnp.where(descent[:, None], direction, -state.g)
+
+        # rows with no curvature history step along raw steepest
+        # descent, whose scale is arbitrary: bound their first trial
+        # step length by 1.  With history, warm-start from the row's
+        # last accepted step — every extra trial is a FULL-batch
+        # objective pass, so a straggler row that keeps needing tiny
+        # steps must not re-pay the whole backtrack from t=1 every
+        # iteration
+        has_hist = jnp.any(state.rho_hist > 0.0, axis=-1)
+        t0 = jnp.where(
+            has_hist & descent,
+            jnp.minimum(1.0, 4.0 * state.tprev),
+            1.0 / jnp.maximum(1.0, _rownorm(direction)),
+        ).astype(dtype)
+        with jax.named_scope("optim.lbfgs_batched.linesearch"):
+            t, ok, n_ls = linesearch(
+                state.x, state.f, state.g, direction, done, t0)
+        x_new = state.x + t[:, None] * direction
+        with jax.named_scope("optim.lbfgs_batched.value_and_grad"):
+            f_new, g_new = vg_fb(x_new)
+
+        s = x_new - state.x
+        y = g_new - state.g
+        sy = _rowdot(s, y)
+        slot = state.k % m
+        accept = (
+            ok
+            & (f_new <= state.f + ftol * jnp.maximum(1.0, jnp.abs(state.f)))
+            & ~done
+        )
+        # gate history on accept (not just the linesearch ok), matching
+        # the per-series minimize_lbfgs: a step rejected at the
+        # re-evaluation must not poison the curvature history
+        good_pair = (sy > 1e-10) & accept
+        upd = lambda hist, v: hist.at[:, slot].set(
+            jnp.where(good_pair[:, None], v, hist[:, slot])
+        )
+        s_hist = upd(state.s_hist, s)
+        y_hist = upd(state.y_hist, y)
+        rho_hist = state.rho_hist.at[:, slot].set(
+            jnp.where(good_pair, 1.0 / jnp.maximum(sy, 1e-30),
+                      state.rho_hist[:, slot])
+        )
+        x_out = jnp.where(accept[:, None], x_new, state.x)
+        f_out = jnp.where(accept, f_new, state.f)
+        g_out = jnp.where(accept[:, None], g_new, state.g)
+        conv = state.converged | (
+            _rownorm(g_out) < tol * jnp.maximum(1.0, _rownorm(x_out))
+        )
+        conv = conv | (
+            accept
+            & (state.f - f_new <= ftol * jnp.maximum(1.0, jnp.abs(f_new)))
+        )
+        better = f_out < state.bf
+        new_state = _State(
+            k=state.k + 1,
+            x=x_out,
+            f=f_out,
+            g=g_out,
+            s_hist=s_hist,
+            y_hist=y_hist,
+            rho_hist=rho_hist,
+            converged=conv,
+            failed=state.failed | (~ok & ~conv & ~done),
+            tprev=jnp.where(accept, t, state.tprev),
+            bx=jnp.where(better[:, None], x_out, state.bx),
+            bf=jnp.where(better, f_out, state.bf),
+            bg=jnp.where(better[:, None], g_out, state.bg),
+        )
+        iters = jnp.where(done, iters, state.k + 1)
+        if ls_hist is not None:
+            ls_hist = ls_hist.at[state.k].set(n_ls)
+        return new_state, iters, ls_hist
+
+    return step
+
+
 def minimize_lbfgs_batched(
     fun_batched: Callable[[jax.Array], jax.Array],
     x0: jax.Array,
@@ -344,174 +517,17 @@ def minimize_lbfgs_batched(
     cap = straggler_cap if straggler_cap is not None else max(128, bsz // 8)
     compact = straggler_fun is not None and cap < bsz
 
-    def make_vg(fb):
-        def vg(x):
-            f, pullback = jax.vjp(fb, x)
-            (g,) = pullback(jnp.ones_like(f))
-            bad = ~jnp.isfinite(f) | ~jnp.all(jnp.isfinite(g), axis=-1)
-            return jnp.where(bad, jnp.inf, f), jnp.where(bad[:, None], 0.0, g)
-
-        return vg
-
-    vg = make_vg(fun_batched)
-
-    rownorm = lambda v: jnp.linalg.norm(v, axis=-1)
-    rowdot = lambda a, b: jnp.sum(a * b, axis=-1)
-
-    f0, g0 = vg(x0)
-    init = _State(
-        k=jnp.zeros((), jnp.int32),
-        x=x0,
-        f=f0,
-        g=g0,
-        s_hist=jnp.zeros((bsz, m, d), dtype),
-        y_hist=jnp.zeros((bsz, m, d), dtype),
-        rho_hist=jnp.zeros((bsz, m), dtype),
-        converged=(rownorm(g0) < tol) & jnp.isfinite(f0),
-        failed=jnp.isinf(f0),
-        tprev=jnp.ones((bsz,), dtype),
-        bx=x0,
-        bf=f0,
-        bg=g0,
-    )
+    knobs = dict(m=m, dtype=dtype, tol=tol, ftol=ftol,
+                 max_linesearch=max_linesearch, c1=c1)
+    vg = _make_vg_b(fun_batched)
+    init = _init_state_b(vg, x0, m, tol)
     iters0 = jnp.zeros((bsz,), jnp.int32)
-
-    two_loop_b = jax.vmap(_two_loop, in_axes=(0, 0, 0, 0, None, None))
-
-    def make_linesearch(fb):
-        def linesearch(x, f, g, direction, done, t0):
-            # done rows are pre-satisfied: their (frozen) state can never
-            # pass the strict Armijo test, and one such row would otherwise
-            # drag the whole batch through max_linesearch extra objective
-            # evaluations.  Failed trials jump to the minimizer of the
-            # quadratic through (0, f), slope g·dir, and (t, f(t)) (clamped
-            # to [0.1t, 0.5t]): every trial is a FULL-batch objective pass
-            # gated by the worst row, and plain halving needs ~12 of them
-            # per iteration on badly scaled steps
-            gd = rowdot(g, direction)
-            # noise floor: near convergence the predicted decrease falls
-            # below the objective's f32 evaluation noise and the strict
-            # Armijo test rejects EVERY step size, dragging the whole batch
-            # through deep backtracks; the relaxed accept is resolved by the
-            # ftol rule
-            eps = ftol * jnp.maximum(1.0, jnp.abs(f))
-
-            def body(carry):
-                t, ok, j = carry
-                fnew = fb(x + t[:, None] * direction)
-                fnew = jnp.where(jnp.isfinite(fnew), fnew, jnp.inf)
-                ok_new = ok | (fnew <= f + c1 * t * gd + eps)
-                tq = -gd * t * t / (2.0 * (fnew - f - gd * t))
-                tq = jnp.where(jnp.isfinite(tq), tq, 0.0)
-                # the objective may evaluate in a wider dtype; the carry
-                # must not
-                tq = jnp.clip(tq, 0.1 * t, 0.5 * t).astype(t.dtype)
-                return jnp.where(ok_new, t, tq), ok_new, j + 1
-
-            def cond(carry):
-                _, ok, j = carry
-                return jnp.any(~ok) & (j < max_linesearch)
-
-            t, ok, n_ls = lax.while_loop(cond, body, (t0, done, 0))
-            return t, ok, n_ls
-
-        return linesearch
-
-    def make_step(fb):
-        vg_fb = make_vg(fb)
-        linesearch = make_linesearch(fb)
-
-        def step(carry):
-            state, iters, ls_hist = carry
-            done = state.converged | state.failed
-            with jax.named_scope("optim.lbfgs_batched.two_loop"):
-                direction = -two_loop_b(
-                    state.g, state.s_hist, state.y_hist, state.rho_hist,
-                    state.k, m
-                )
-            descent = rowdot(state.g, direction) < 0.0
-            direction = jnp.where(descent[:, None], direction, -state.g)
-
-            # rows with no curvature history step along raw steepest
-            # descent, whose scale is arbitrary: bound their first trial
-            # step length by 1.  With history, warm-start from the row's
-            # last accepted step — every extra trial is a FULL-batch
-            # objective pass, so a straggler row that keeps needing tiny
-            # steps must not re-pay the whole backtrack from t=1 every
-            # iteration
-            has_hist = jnp.any(state.rho_hist > 0.0, axis=-1)
-            t0 = jnp.where(
-                has_hist & descent,
-                jnp.minimum(1.0, 4.0 * state.tprev),
-                1.0 / jnp.maximum(1.0, rownorm(direction)),
-            ).astype(dtype)
-            with jax.named_scope("optim.lbfgs_batched.linesearch"):
-                t, ok, n_ls = linesearch(
-                    state.x, state.f, state.g, direction, done, t0)
-            x_new = state.x + t[:, None] * direction
-            with jax.named_scope("optim.lbfgs_batched.value_and_grad"):
-                f_new, g_new = vg_fb(x_new)
-
-            s = x_new - state.x
-            y = g_new - state.g
-            sy = rowdot(s, y)
-            slot = state.k % m
-            accept = (
-                ok
-                & (f_new <= state.f + ftol * jnp.maximum(1.0, jnp.abs(state.f)))
-                & ~done
-            )
-            # gate history on accept (not just the linesearch ok), matching
-            # the per-series minimize_lbfgs: a step rejected at the
-            # re-evaluation must not poison the curvature history
-            good_pair = (sy > 1e-10) & accept
-            upd = lambda hist, v: hist.at[:, slot].set(
-                jnp.where(good_pair[:, None], v, hist[:, slot])
-            )
-            s_hist = upd(state.s_hist, s)
-            y_hist = upd(state.y_hist, y)
-            rho_hist = state.rho_hist.at[:, slot].set(
-                jnp.where(good_pair, 1.0 / jnp.maximum(sy, 1e-30),
-                          state.rho_hist[:, slot])
-            )
-            x_out = jnp.where(accept[:, None], x_new, state.x)
-            f_out = jnp.where(accept, f_new, state.f)
-            g_out = jnp.where(accept[:, None], g_new, state.g)
-            conv = state.converged | (
-                rownorm(g_out) < tol * jnp.maximum(1.0, rownorm(x_out))
-            )
-            conv = conv | (
-                accept
-                & (state.f - f_new <= ftol * jnp.maximum(1.0, jnp.abs(f_new)))
-            )
-            better = f_out < state.bf
-            new_state = _State(
-                k=state.k + 1,
-                x=x_out,
-                f=f_out,
-                g=g_out,
-                s_hist=s_hist,
-                y_hist=y_hist,
-                rho_hist=rho_hist,
-                converged=conv,
-                failed=state.failed | (~ok & ~conv & ~done),
-                tprev=jnp.where(accept, t, state.tprev),
-                bx=jnp.where(better[:, None], x_out, state.bx),
-                bf=jnp.where(better, f_out, state.bf),
-                bg=jnp.where(better[:, None], g_out, state.bg),
-            )
-            iters = jnp.where(done, iters, state.k + 1)
-            if ls_hist is not None:
-                ls_hist = ls_hist.at[state.k].set(n_ls)
-            return new_state, iters, ls_hist
-
-        return step
 
     def undone_count(state):
         return jnp.sum(~(state.converged | state.failed))
 
     ls0 = jnp.zeros((max_iters,), jnp.int32) if count_evals else None
-    step_full = make_step(fun_batched)
+    step_full = _make_step_b(fun_batched, **knobs)
 
     def cond_full(carry):
         state, _, _ = carry
@@ -561,7 +577,7 @@ def minimize_lbfgs_batched(
             tprev=take(stage1.tprev),
             bx=take(stage1.bx), bf=take(stage1.bf), bg=take(stage1.bg),
         )
-        step_sub = make_step(straggler_fun(idxc))
+        step_sub = _make_step_b(straggler_fun(idxc), **knobs)
 
         def cond_sub(carry):
             state, _, _ = carry
@@ -587,12 +603,199 @@ def minimize_lbfgs_batched(
         f=final.bf,
         converged=final.converged & jnp.isfinite(final.bf),
         iters=iters,
-        grad_norm=rownorm(final.bg),
+        grad_norm=_rownorm(final.bg),
     )
     if not count_evals:
         return result
     return result, {"ls_evals": ls_hist, "compact_at": compact_at,
                     "cap": cap if compact else 0}
+
+
+# -- lazily compiled straggler compaction (stage-1 / stage-2 split) ----------
+#
+# The inline driver above traces and compiles the compacted stage-2 program
+# into every compact fit — even when stage 1 converges all rows and the
+# sub-loop would run zero iterations, roughly doubling fit compile time for
+# batches that never need it (ADVICE r5).  The split below lets a model fit
+# run stage 1 as its own compiled program that ALSO returns the compacted
+# straggler state; the host then checks the (tiny) undone count and only
+# dispatches — and therefore only ever traces/compiles — the stage-2 program
+# when stragglers actually remain.  The decision is a pure function of the
+# fit's inputs (same data -> same undone count -> same programs), so
+# journaled resumes stay bitwise-reproducible per config.
+
+
+class StragglerCarry(NamedTuple):
+    """Stage-1 exit state a lazily compiled stage 2 resumes from.
+
+    ``state`` is the full optimizer state of the (at most ``cap``)
+    unconverged rows, gathered exactly as the inline driver gathers them;
+    ``idx`` are the scatter indices (fill value ``bsz`` -> dropped on
+    scatter), ``idxc`` the clamped gather indices model code uses to
+    repack the objective's data for the compacted problem.  ``undone``
+    and ``k`` are the host-checkable dispatch gate: stage 2 is worth
+    dispatching iff ``undone > 0`` and ``k < max_iters`` (the shared
+    budget — see the truncation-contract tripwire in
+    :func:`minimize_lbfgs_batched`)."""
+
+    state: _State  # compacted [cap, ...] optimizer state
+    idx: jax.Array  # [cap] scatter indices (fill = bsz: dropped)
+    idxc: jax.Array  # [cap] clamped gather indices
+    iters: jax.Array  # [bsz] per-row iteration counts at stage-1 exit
+    undone: jax.Array  # [] int32 unconverged-row count at stage-1 exit
+    k: jax.Array  # [] int32 stage-1 exit iteration
+
+
+def lbfgs_batched_stage1(
+    fun_batched: Callable[[jax.Array], jax.Array],
+    x0: jax.Array,
+    *,
+    straggler_cap: int,
+    max_iters: int = 50,
+    history: int = 8,
+    tol: float = 1e-6,
+    ftol: float | None = None,
+    max_linesearch: int = 20,
+    c1: float = 1e-4,
+) -> "tuple[LBFGSResult, StragglerCarry]":
+    """Stage 1 of the compacted batched L-BFGS, as a standalone traceable.
+
+    Runs the lockstep loop with the same early exit as the inline driver
+    (stop once at most ``straggler_cap`` rows remain unconverged), then
+    gathers the straggler state into the ``[cap, ...]`` layout and returns
+    ``(result_as_if_done, carry)``.  When no rows remain unconverged the
+    result IS the final answer (the inline stage-2 loop would have run
+    zero iterations and scattered the state back unchanged); otherwise the
+    caller dispatches :func:`lbfgs_batched_stage2` — compiled only then —
+    with a compacted objective built from ``carry.idxc``.
+
+    ``straggler_cap`` must be < the batch size (callers gate on
+    :func:`compaction_cap`); semantics otherwise match
+    :func:`minimize_lbfgs_batched` (no ``count_evals``: pass accounting
+    stays on the inline driver, which the profiler instruments).
+    """
+    bsz, _ = x0.shape
+    m = history
+    dtype = x0.dtype
+    if ftol is None:
+        ftol = 1e-9 if dtype == jnp.float64 else 1e-6
+    cap = int(straggler_cap)
+    if cap >= bsz:
+        raise ValueError(
+            f"straggler_cap {cap} must be < batch {bsz} (an uncompacted fit "
+            "has no stage 2 to defer — use minimize_lbfgs_batched)")
+    knobs = dict(m=m, dtype=dtype, tol=tol, ftol=ftol,
+                 max_linesearch=max_linesearch, c1=c1)
+    vg = _make_vg_b(fun_batched)
+    init = _init_state_b(vg, x0, m, tol)
+    iters0 = jnp.zeros((bsz,), jnp.int32)
+    step_full = _make_step_b(fun_batched, **knobs)
+
+    def cond_full(carry):
+        state, _, _ = carry
+        undone = jnp.sum(~(state.converged | state.failed))
+        # keep lockstepping only while the stragglers outnumber the cap
+        return (state.k < max_iters) & (undone > cap)
+
+    stage1, iters, _ = lax.while_loop(cond_full, step_full,
+                                      (init, iters0, None))
+    undone1 = ~(stage1.converged | stage1.failed)
+    # same gather as the inline driver: out-of-range fill indices read row
+    # bsz-1 and are dropped on the scatter.  The TRUNCATION CONTRACT
+    # (ADVICE r5) carries over unchanged: at stage-1 exit with k == max_iters
+    # and more than cap rows undone this gather drops the excess — benign
+    # only because stage 2 shares the exhausted budget, which here is
+    # enforced twice: the tripwire assert in lbfgs_batched_stage2 AND the
+    # host gate (carry.k < max_iters) that skips the dispatch entirely.
+    idx = jnp.nonzero(undone1, size=cap, fill_value=bsz)[0]
+    idxc = jnp.minimum(idx, bsz - 1)
+    take = lambda a: a[idxc]
+    sub = _State(
+        k=stage1.k,
+        x=take(stage1.x), f=take(stage1.f), g=take(stage1.g),
+        s_hist=take(stage1.s_hist), y_hist=take(stage1.y_hist),
+        rho_hist=take(stage1.rho_hist),
+        converged=take(stage1.converged), failed=take(stage1.failed),
+        tprev=take(stage1.tprev),
+        bx=take(stage1.bx), bf=take(stage1.bf), bg=take(stage1.bg),
+    )
+    result = LBFGSResult(
+        x=stage1.bx,
+        f=stage1.bf,
+        converged=stage1.converged & jnp.isfinite(stage1.bf),
+        iters=iters,
+        grad_norm=_rownorm(stage1.bg),
+    )
+    carry = StragglerCarry(state=sub, idx=idx, idxc=idxc, iters=iters,
+                           undone=jnp.sum(undone1).astype(jnp.int32),
+                           k=stage1.k)
+    return result, carry
+
+
+def lbfgs_batched_stage2(
+    fun_sub_batched: Callable[[jax.Array], jax.Array],
+    full: LBFGSResult,
+    carry: StragglerCarry,
+    *,
+    max_iters: int = 50,
+    history: int = 8,
+    tol: float = 1e-6,
+    ftol: float | None = None,
+    max_linesearch: int = 20,
+    c1: float = 1e-4,
+) -> LBFGSResult:
+    """Stage 2 of the lazy split: finish the compacted stragglers.
+
+    ``fun_sub_batched`` is the compacted objective over the ``[cap, d]``
+    problem (the model builds it from ``carry.idxc`` — e.g. a row gather
+    of the panel, or the folded-column repack for the ARIMA kernel);
+    ``full`` is stage 1's as-if-done result, into which the finished
+    straggler rows are scattered.  Budget is SHARED with stage 1
+    (``carry.k`` continues counting toward the same ``max_iters``) —
+    see the truncation-contract tripwire below.
+    """
+    m = history
+    dtype = carry.state.x.dtype
+    if ftol is None:
+        ftol = 1e-9 if dtype == jnp.float64 else 1e-6
+    # TRUNCATION CONTRACT (ADVICE r5): the stage-1 size=cap gather silently
+    # drops the excess when stage 1 exits at max_iters with more than cap
+    # rows undone — benign only because stage 2 shares the same exhausted
+    # iteration budget.  Any change that gives stage 2 its OWN budget must
+    # first make the gather lossless — this assert is the tripwire.
+    stage2_max_iters = max_iters
+    assert stage2_max_iters == max_iters, (
+        "stage-2 straggler budget must equal max_iters while the size=cap "
+        "gather can truncate at max_iters (ADVICE r5: make the gather "
+        "lossless before giving stage 2 its own budget)")
+    # this Python block runs once per TRACE of the stage-2 program — which,
+    # unlike the inline driver, only ever happens when stragglers actually
+    # remained — so the counter now counts NEEDED stage-2 compiles
+    obs.counter("optim.stage2_compact_traces").inc()
+    knobs = dict(m=m, dtype=dtype, tol=tol, ftol=ftol,
+                 max_linesearch=max_linesearch, c1=c1)
+    step_sub = _make_step_b(fun_sub_batched, **knobs)
+
+    def cond_sub(c):
+        state, _, _ = c
+        return (state.k < stage2_max_iters) & jnp.any(
+            ~(state.converged | state.failed))
+
+    sub_f, sub_iters, _ = lax.while_loop(
+        cond_sub, step_sub, (carry.state, carry.iters[carry.idxc], None))
+    put = lambda a, s: a.at[carry.idx].set(s, mode="drop")
+    # scatter semantics match the inline driver's state scatter followed by
+    # its finalize: per scattered row, converged & isfinite(bf) and the
+    # grad norm are computed from the SUB state, untouched rows keep stage
+    # 1's values verbatim
+    return LBFGSResult(
+        x=put(full.x, sub_f.bx),
+        f=put(full.f, sub_f.bf),
+        converged=put(full.converged,
+                      sub_f.converged & jnp.isfinite(sub_f.bf)),
+        iters=put(full.iters, sub_iters),
+        grad_norm=put(full.grad_norm, _rownorm(sub_f.bg)),
+    )
 
 
 def batched_minimize(

@@ -90,6 +90,36 @@ class TestLBFGS:
         )
 
 
+def _straggler_problem(bsz=64, d=3, seed=0, spread=True):
+    rng = np.random.default_rng(seed)
+    # per-row quartic bowls with very different conditioning so rows
+    # converge at very different iterations (stragglers exist); with
+    # spread=False every row is the SAME well-conditioned problem, so the
+    # whole batch converges on one iteration (no stragglers ever remain)
+    if spread:
+        scales = jnp.asarray(
+            rng.uniform(0.05, 50.0, size=(bsz, d)).astype(np.float32))
+        target = jnp.asarray(rng.normal(size=(bsz, d)).astype(np.float32))
+    else:
+        scales = jnp.ones((bsz, d), jnp.float32)
+        target = jnp.broadcast_to(
+            jnp.asarray(rng.normal(size=(1, d)).astype(np.float32)),
+            (bsz, d))
+
+    def fb_rows(x, sc, tg):
+        r = (x - tg) * sc
+        return jnp.sum(r**2 + 0.1 * r**4, axis=-1)
+
+    fun = lambda x: fb_rows(x, scales, target)
+
+    def straggler_fun(idx):
+        sc, tg = scales[idx], target[idx]
+        return lambda x: fb_rows(x, sc, tg)
+
+    x0 = jnp.zeros((bsz, d), jnp.float32)
+    return fun, straggler_fun, x0, target
+
+
 class TestStragglerCompaction:
     """minimize_lbfgs_batched with straggler compaction must reproduce the
     uncompacted run exactly: per-row trajectories are independent of batch
@@ -97,25 +127,7 @@ class TestStragglerCompaction:
     not what they compute."""
 
     def _problem(self, bsz=64, d=3, seed=0):
-        rng = np.random.default_rng(seed)
-        # per-row quartic bowls with very different conditioning so rows
-        # converge at very different iterations (stragglers exist)
-        scales = jnp.asarray(
-            rng.uniform(0.05, 50.0, size=(bsz, d)).astype(np.float32))
-        target = jnp.asarray(rng.normal(size=(bsz, d)).astype(np.float32))
-
-        def fb_rows(x, sc, tg):
-            r = (x - tg) * sc
-            return jnp.sum(r**2 + 0.1 * r**4, axis=-1)
-
-        fun = lambda x: fb_rows(x, scales, target)
-
-        def straggler_fun(idx):
-            sc, tg = scales[idx], target[idx]
-            return lambda x: fb_rows(x, sc, tg)
-
-        x0 = jnp.zeros((bsz, d), jnp.float32)
-        return fun, straggler_fun, x0, target
+        return _straggler_problem(bsz=bsz, d=d, seed=seed)
 
     def test_matches_uncompacted(self):
         fun, straggler_fun, x0, _ = self._problem()
@@ -177,3 +189,75 @@ class TestStragglerCompaction:
         np.testing.assert_allclose(np.asarray(ref.x)[both],
                                    np.asarray(got.x)[both],
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestLazyStage2Split:
+    """The stage-1/stage-2 split (ISSUE 4 satellite, ADVICE r5) must
+    reproduce the inline compacted driver: stage 1 is the same lockstep
+    loop with the same early exit, the gather is the same gather, and a
+    dispatched stage 2 continues the same trajectories — only WHERE the
+    stage-2 program is traced/compiled moves (to the first call that
+    actually has stragglers)."""
+
+    def test_split_matches_inline_compaction(self):
+        fun, straggler_fun, x0, _ = _straggler_problem()
+        ref = optim.minimize_lbfgs_batched(
+            fun, x0, max_iters=80, straggler_fun=straggler_fun,
+            straggler_cap=16)
+        res1, carry = optim.lbfgs_batched_stage1(
+            fun, x0, straggler_cap=16, max_iters=80)
+        # mixed conditioning leaves stragglers at stage-1 exit
+        assert int(carry.undone) > 0
+        assert int(carry.k) < 80
+        got = optim.lbfgs_batched_stage2(
+            straggler_fun(carry.idxc), res1, carry, max_iters=80)
+        np.testing.assert_array_equal(np.asarray(ref.converged),
+                                      np.asarray(got.converged))
+        np.testing.assert_allclose(np.asarray(ref.x), np.asarray(got.x),
+                                   rtol=0, atol=0)
+        np.testing.assert_allclose(np.asarray(ref.f), np.asarray(got.f),
+                                   rtol=0, atol=0)
+        np.testing.assert_array_equal(np.asarray(ref.iters),
+                                      np.asarray(got.iters))
+        np.testing.assert_allclose(np.asarray(ref.grad_norm),
+                                   np.asarray(got.grad_norm),
+                                   rtol=0, atol=0)
+
+    def test_no_stragglers_means_no_stage2(self):
+        # uniform conditioning: every row converges on the same iteration,
+        # so the straggler count jumps straight from "all" to zero and the
+        # host gate (carry.undone == 0) skips — and therefore never
+        # compiles — stage 2; stage 1's result must already be final
+        fun, straggler_fun, x0, _ = _straggler_problem(spread=False)
+        ref = optim.minimize_lbfgs_batched(
+            fun, x0, max_iters=80, straggler_fun=straggler_fun,
+            straggler_cap=16)
+        res1, carry = optim.lbfgs_batched_stage1(
+            fun, x0, straggler_cap=16, max_iters=80)
+        assert int(carry.undone) == 0
+        np.testing.assert_allclose(np.asarray(ref.x), np.asarray(res1.x),
+                                   rtol=0, atol=0)
+        np.testing.assert_array_equal(np.asarray(ref.converged),
+                                      np.asarray(res1.converged))
+        np.testing.assert_array_equal(np.asarray(ref.iters),
+                                      np.asarray(res1.iters))
+
+    def test_stage1_requires_compacting_cap(self):
+        fun, _, x0, _ = _straggler_problem(bsz=8)
+        with pytest.raises(ValueError, match="straggler_cap"):
+            optim.lbfgs_batched_stage1(fun, x0, straggler_cap=8, max_iters=10)
+
+    def test_exhausted_budget_stage2_is_identity(self):
+        # stage 1 exits at max_iters with > cap rows undone: the truncated
+        # gather is benign because stage 2 shares the exhausted budget —
+        # dispatching it anyway must scatter the state back unchanged
+        fun, straggler_fun, x0, _ = _straggler_problem()
+        res1, carry = optim.lbfgs_batched_stage1(
+            fun, x0, straggler_cap=4, max_iters=3)
+        assert int(carry.k) == 3 and int(carry.undone) > 4
+        got = optim.lbfgs_batched_stage2(
+            straggler_fun(carry.idxc), res1, carry, max_iters=3)
+        np.testing.assert_allclose(np.asarray(res1.x), np.asarray(got.x),
+                                   rtol=0, atol=0)
+        np.testing.assert_array_equal(np.asarray(res1.iters),
+                                      np.asarray(got.iters))

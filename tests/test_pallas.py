@@ -988,6 +988,23 @@ def test_arima_fit_straggler_compaction_parity(monkeypatch):
     assert med < 1e-2
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep: tier-2 (`-m slow`), see pyproject markers
+def test_arima_lazy_stage2_split_parity(monkeypatch):
+    # the lazily compiled stage-1/stage-2 split (ISSUE 4 satellite, ADVICE
+    # r5) replaces the inline compaction on the default no-count_evals
+    # path: it must hold the same distribution-level parity bar vs the
+    # uncompacted program (the split is a different pair of compiled
+    # programs, so bitwise trajectories are out of scope — same contract
+    # as test_arima_fit_straggler_compaction_parity above)
+    b, t = 2048, 64
+    y = jnp.asarray(_arma_panel(b, t, seed=78))
+    ref = arima.fit(y, (1, 1, 1), backend="pallas-interpret", max_iters=15,
+                    compact=False)
+    monkeypatch.setattr(arima, "_COMPACT_MIN_BATCH", 2048)
+    got = arima.fit(y, (1, 1, 1), backend="pallas-interpret", max_iters=15)
+    _dist_parity(ref, got)
+
+
 def _dist_parity(ref, got, conv_floor=0.45):
     conv_ref = np.asarray(ref.converged)
     conv_got = np.asarray(got.converged)

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Budget advisor: turn a finished journal into knobs for the NEXT run.
+
+A journaled chunk walk (``reliability.fit_chunked(..., checkpoint_dir=``)
+records per chunk what an operator would otherwise have to guess for the
+next run of the same config hash: how long a chunk really takes (wall_s,
+split into trace+compile vs steady-state execute by the telemetry block),
+how far OOM backoff had to shrink the chunks (``chunk_rows_after``), which
+chunks blew their deadline, and how long each journal commit took.  This
+tool reads one manifest and prints suggested
+
+- ``chunk_rows``      — the largest size the run actually sustained (post
+                        OOM backoff), so the next run skips the halving
+                        dance and its wasted dispatches;
+- ``chunk_budget_s``  — headroom over the slowest observed chunk,
+                        including the cold compile chunk, so the watchdog
+                        catches real hangs without killing honest work;
+- ``job_budget_s``    — the same headroom over the whole walk;
+- ``pipeline_depth``  — enough in-flight commits to keep the device busy:
+                        commit latency divided by steady-state execute
+                        wall, +1 (clamped to [1, 8] — past that the queue
+                        only buys crash-loss, not overlap).
+
+    python tools/advise_budget.py CHECKPOINT_DIR [--json]
+
+Suggestions only apply to a run with the SAME config hash and panel (both
+printed): a different model/order/chunk layout re-derives everything.
+Exits 2 on a torn manifest (same condition a resume rejects).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+from inspect_journal import load_manifest  # same directory
+
+
+def _percentile(xs, q):
+    xs = sorted(xs)
+    if not xs:
+        return None
+    i = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return xs[i]
+
+
+def advise(m: dict) -> dict:
+    chunks = sorted(m.get("chunks", []), key=lambda e: e["lo"])
+    committed = [e for e in chunks if e["status"] == "committed"]
+    timeouts = [e for e in chunks if e["status"] == "TIMEOUT"]
+    if not committed:
+        return {"error": "no committed chunks to learn from",
+                "config_hash": m.get("config_hash")}
+
+    walls = [e["wall_s"] for e in committed if e.get("wall_s") is not None]
+    sizes = [e["hi"] - e["lo"] for e in committed]
+    after = [e.get("chunk_rows_after") for e in committed
+             if e.get("chunk_rows_after")]
+    requested = int(m.get("chunk_rows") or max(sizes))
+
+    # -- chunk_rows: the size the run proved it can hold ---------------------
+    sustained = min(after) if after else max(sizes)
+    oom_shrunk = sustained < requested
+    chunk_rows = sustained
+
+    # -- compile vs execute split (telemetry block when present) -------------
+    tele = m.get("telemetry") or {}
+    exec_walls, compile_walls = [], []
+    for c in tele.get("chunks") or []:
+        w = c.get("wall_s")
+        if w is None:
+            continue
+        (compile_walls if c.get("phase") == "compile+execute"
+         else exec_walls).append(w)
+    # fall back to manifest wall_s when the run had no telemetry: treat the
+    # first chunk as the compile chunk (that is where JAX pays trace+compile)
+    if not exec_walls and walls:
+        compile_walls = walls[:1]
+        exec_walls = walls[1:] or walls[:1]
+
+    # -- chunk_budget_s: 2x the slowest honest chunk (compile included) ------
+    chunk_budget_s = None
+    if walls or compile_walls:
+        slowest = max(walls + compile_walls)
+        chunk_budget_s = math.ceil(2.0 * slowest)
+        # a run that actually timed out at a tighter budget than the new
+        # suggestion is evidence the old budget was too tight, not that the
+        # chunks hang — note it rather than silently raising the bound
+    job_budget_s = None
+    if walls:
+        n_chunks_next = max(1, -(-int(m.get("n_rows", sum(sizes)))
+                                 // max(1, chunk_rows)))
+        per_chunk = _percentile(exec_walls, 0.9) or max(walls)
+        cold = max(compile_walls) if compile_walls else per_chunk
+        job_budget_s = math.ceil(1.5 * (cold + per_chunk * n_chunks_next))
+
+    # -- pipeline_depth: hide commit latency under execute wall --------------
+    commit = ((tele.get("histograms") or {}).get("journal.commit_s") or {})
+    pipeline_depth = 2  # the driver default: one commit hides under one fit
+    commit_mean = commit.get("mean")
+    exec_mean = (sum(exec_walls) / len(exec_walls)) if exec_walls else None
+    if commit_mean and exec_mean and exec_mean > 0:
+        pipeline_depth = max(1, min(8, math.ceil(commit_mean / exec_mean) + 1))
+
+    return {
+        "config_hash": m.get("config_hash"),
+        "panel_fingerprint": m.get("panel_fingerprint"),
+        "observed": {
+            "chunks_committed": len(committed),
+            "chunks_timeout": len(timeouts),
+            "chunk_rows_requested": requested,
+            "chunk_rows_sustained": sustained,
+            "oom_backoff_engaged": oom_shrunk,
+            "chunk_wall_s_max": max(walls) if walls else None,
+            "chunk_wall_s_p90": _percentile(walls, 0.9) if walls else None,
+            "execute_wall_s_mean": (round(exec_mean, 4)
+                                    if exec_mean is not None else None),
+            "compile_wall_s_max": (max(compile_walls)
+                                   if compile_walls else None),
+            "commit_s_mean": commit_mean,
+            "commit_s_max": commit.get("max"),
+        },
+        "suggest": {
+            "chunk_rows": chunk_rows,
+            "chunk_budget_s": chunk_budget_s,
+            "job_budget_s": job_budget_s,
+            "pipeline_depth": pipeline_depth,
+        },
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="journal directory or manifest path")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable advice instead of the table")
+    args = ap.parse_args()
+    m = load_manifest(args.path)
+    a = advise(m)
+    if args.json:
+        print(json.dumps(a, indent=1, sort_keys=True))
+        return
+    if "error" in a:
+        sys.exit(f"advise_budget: {a['error']} (config {a['config_hash']})")
+    o, s = a["observed"], a["suggest"]
+    print(f"journal {args.path}")
+    print(f"  config {a['config_hash']}  panel {a['panel_fingerprint']}")
+    print(f"  observed: {o['chunks_committed']} committed / "
+          f"{o['chunks_timeout']} TIMEOUT chunks; "
+          f"chunk_rows {o['chunk_rows_requested']} requested -> "
+          f"{o['chunk_rows_sustained']} sustained"
+          + ("  (OOM backoff engaged)" if o["oom_backoff_engaged"] else ""))
+    if o["chunk_wall_s_max"] is not None:
+        print(f"  walls: chunk max {o['chunk_wall_s_max']}s "
+              f"p90 {o['chunk_wall_s_p90']}s"
+              + (f"; execute mean {o['execute_wall_s_mean']}s"
+                 if o["execute_wall_s_mean"] is not None else "")
+              + (f"; compile max {o['compile_wall_s_max']}s"
+                 if o["compile_wall_s_max"] is not None else ""))
+    if o["commit_s_mean"] is not None:
+        print(f"  journal commit: mean {o['commit_s_mean']}s "
+              f"max {o['commit_s_max']}s")
+    print("  suggest for the next run of this config hash:")
+    print(f"    chunk_rows     = {s['chunk_rows']}")
+    print(f"    chunk_budget_s = {s['chunk_budget_s']}")
+    print(f"    job_budget_s   = {s['job_budget_s']}")
+    print(f"    pipeline_depth = {s['pipeline_depth']}")
+
+
+if __name__ == "__main__":
+    main()
