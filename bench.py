@@ -1241,7 +1241,16 @@ def _northstar_1m(jnp, order):
     if not obs_was_on:
         _obs.enable()
     try:
+        # ISSUE 5 acceptance: the sliced walk must pay ZERO per-chunk
+        # align-probe host syncs — the static plan probes the panel at
+        # most once per walk (and not at all here: the unjournaled walk
+        # above already warmed the per-array-identity cache), counted by
+        # models.base.align_mode_on_host via obs
+        a0 = (_obs.snapshot() or {}).get("counters", {})
         r_j, wall_j = _run(ckpt_root)
+        a1 = (_obs.snapshot() or {}).get("counters", {})
+        align_probes = (a1.get("align.host_probes", 0)
+                        - a0.get("align.host_probes", 0))
         tele = r_j.meta.get("telemetry")
         # map_series kernel-cache canary (regression-gate input): three
         # fresh-but-identical lambdas must share ONE compiled kernel (the
@@ -1312,6 +1321,20 @@ def _northstar_1m(jnp, order):
         "commit_wall_s": pipe.get("commit_wall_s"),
         "hidden_commit_s": pipe.get("hidden_commit_s"),
         "pipeline_depth": pipe.get("depth"),
+        # ISSUE 5 acceptance: the input side of the pipeline — fraction of
+        # slice-staging wall hidden under compute, the align plan the walk
+        # ran under, and the host-sync probe count during the journaled
+        # walk (must be <= 1: the static plan probes at most once, never
+        # per chunk)
+        "input_overlap_efficiency": pipe.get("input_overlap_efficiency"),
+        "staging_wall_s": pipe.get("staging_wall_s"),
+        "hidden_staging_s": pipe.get("hidden_staging_s"),
+        "prefetch_depth": pipe.get("prefetch_depth"),
+        "end_to_end_overlap_efficiency":
+            pipe.get("end_to_end_overlap_efficiency"),
+        "align_mode": r_j.meta.get("align_mode"),
+        "align_probes_journaled_walk": align_probes,
+        "zero_per_chunk_align_syncs": align_probes <= 1,
         "journaled_bitwise_identical": bitwise_ok,
         "peak_hbm_bytes": peak,
         # which probe produced the reading: "device" = real HBM stats,
@@ -1349,6 +1372,7 @@ def _northstar_1m(jnp, order):
                 round(ms_hits / (ms_hits + ms_misses), 4)
                 if (ms_hits + ms_misses) else None),
             "overlap_efficiency": pipe.get("overlap_efficiency"),
+            "input_overlap_efficiency": pipe.get("input_overlap_efficiency"),
         }
     return out
 
@@ -1448,8 +1472,10 @@ def _telemetry_regression_gate(headline):
     bigger shards), the map_series kernel cache suddenly missing, or the
     pipelined commit overlap collapsing back to serial.  This gate reads
     the PREVIOUS ``BENCH_LOCAL.json`` tail (where the prior run's
-    ``telemetry_summary`` line survives verbatim), compares the four
-    tracked metrics, and flags drifts beyond tolerance.  Fail-soft by
+    ``telemetry_summary`` line survives verbatim), compares the tracked
+    metrics (compile share, commit latency, map_series cache rate, and
+    both overlap efficiencies — commit-side and input-staging), and flags
+    drifts beyond tolerance.  Fail-soft by
     design: a missing prior summary reports ``checked: false`` rather
     than failing the benchmark.
 
@@ -1502,6 +1528,7 @@ def _telemetry_regression_gate(headline):
         "journal_commit_s_mean": ("rel", 0.5),
         "map_series_cache_hit_rate": ("abs", 0.15),
         "overlap_efficiency": ("abs", 0.15),
+        "input_overlap_efficiency": ("abs", 0.15),
     }
     drifts, flagged = {}, []
     for k, (mode, tol) in thresholds.items():
@@ -1570,6 +1597,9 @@ def _summary_line(emitted):
                     "series_total", "wall_s", "converged_frac",
                     "sustained_converged_series_per_sec", "peak_hbm_bytes",
                     "peak_mem_source", "overlap_efficiency",
+                    "input_overlap_efficiency",
+                    "end_to_end_overlap_efficiency",
+                    "zero_per_chunk_align_syncs",
                     "journaled_over_unjournaled",
                     "journaled_bitwise_identical")}
                 j = ns.get("journal") or {}

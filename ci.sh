@@ -68,6 +68,53 @@ python tools/advise_budget.py "$PIPE_SMOKE_DIR/pipe" \
   || { echo "ci.sh: advise_budget did not print suggestions" >&2; exit 1; }
 rm -rf "$PIPE_SMOKE_DIR"
 
+# dispatch-ahead input smoke (ISSUE 5): a short journaled PREFETCHED walk
+# (static align plan + background slice staging, telemetry on) must be
+# bitwise-identical to the serial walk, journal its input-staging overlap
+# into the manifest telemetry block, pass the obs_report schema gate, and
+# give the budget advisor enough to suggest prefetch_depth and the align
+# hint for the next run
+PREFETCH_SMOKE_DIR=$(python - <<'EOF'
+import json, os, tempfile
+import numpy as np
+from spark_timeseries_tpu import obs
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.models import arima
+
+rng = np.random.default_rng(0)
+y = np.cumsum(rng.normal(size=(32, 96)).astype(np.float32), axis=1)
+root = tempfile.mkdtemp(prefix="prefetch_smoke_")
+kw = dict(chunk_rows=8, resilient=False, order=(1, 0, 0), max_iters=15)
+ser = rel.fit_chunked(arima.fit, y, pipeline=False, **kw)
+obs.enable(os.path.join(root, "events.jsonl"))
+pre = rel.fit_chunked(arima.fit, y, prefetch_depth=2,
+                      checkpoint_dir=os.path.join(root, "journal"), **kw)
+obs.disable()
+for f in ("params", "neg_log_likelihood", "converged", "iters", "status"):
+    np.testing.assert_array_equal(np.asarray(getattr(ser, f)),
+                                  np.asarray(getattr(pre, f)), err_msg=f)
+p = pre.meta["pipeline"]
+assert p["staged_hits"] == 3 and p["staged_misses"] == 1, p
+assert p["hidden_staging_s"] <= p["staging_wall_s"] + 1e-9, p
+assert pre.meta["align_mode"] in ("dense", "no-trailing", "general")
+# the manifest records the staging overlap for the budget advisor
+m = json.load(open(os.path.join(root, "journal", "manifest.json")))
+st = m["telemetry"]["input_staging"]
+assert st["chunks_staged"] == 3 and "input_overlap_efficiency" in st, st
+assert m["telemetry"]["align_mode"] == pre.meta["align_mode"]
+print(root)
+EOF
+)
+python tools/obs_report.py --check "$PREFETCH_SMOKE_DIR/events.jsonl" \
+  --manifest "$PREFETCH_SMOKE_DIR/journal"
+python tools/advise_budget.py "$PREFETCH_SMOKE_DIR/journal" \
+  | grep -q "prefetch_depth" \
+  || { echo "ci.sh: advise_budget did not suggest prefetch_depth" >&2; exit 1; }
+python tools/advise_budget.py "$PREFETCH_SMOKE_DIR/journal" \
+  | grep -q "align_mode" \
+  || { echo "ci.sh: advise_budget did not report the align plan" >&2; exit 1; }
+rm -rf "$PREFETCH_SMOKE_DIR"
+
 # telemetry smoke (ISSUE 3): a small journaled chunked fit runs with the
 # obs plane enabled; the JSONL event log AND the manifest's embedded
 # telemetry block (per-chunk compile/execute spans, ladder counters,

@@ -264,7 +264,8 @@ def _require_checkpoint_dir(durable_kwargs: dict) -> None:
 
 def _durable_fit(fit_fn, ts, checkpoint_dir, *, chunk_rows=None,
                  chunk_budget_s=None, job_budget_s=None, resume="auto",
-                 pipeline=True, pipeline_depth=2):
+                 pipeline=True, pipeline_depth=2, prefetch_depth=1,
+                 align_mode=None):
     """Route a compat fit through the journaled chunk driver.
 
     The upstream Python API ran fits inside Spark tasks, whose lineage
@@ -280,6 +281,10 @@ def _durable_fit(fit_fn, ts, checkpoint_dir, *, chunk_rows=None,
     ``pipeline`` / ``pipeline_depth`` control the pipelined committer
     (``reliability.committer``): commits overlap the next chunk's compute
     by default, bitwise-identical to the serial ``pipeline=False`` walk.
+    ``prefetch_depth`` (default 1; 0 disables) stages the next chunk's
+    device slice while the current one computes, and ``align_mode=``
+    pre-supplies the walk's static alignment plan
+    (``reliability.fit_chunked`` / ``models.base.resolve_align_mode``).
     """
     from .. import reliability as rel
 
@@ -291,6 +296,7 @@ def _durable_fit(fit_fn, ts, checkpoint_dir, *, chunk_rows=None,
         checkpoint_dir=checkpoint_dir, resume=resume,
         chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
         pipeline=pipeline, pipeline_depth=pipeline_depth,
+        prefetch_depth=prefetch_depth, align_mode=align_mode,
     )
     params = jnp.asarray(res.params)
     return params[0] if single else params
@@ -417,11 +423,15 @@ class ARIMA:
     def fit_model(p: int, d: int, q: int, ts, include_intercept: bool = True,
                   method: str = "css-cgd", user_init_params=None,
                   checkpoint_dir: Optional[str] = None,
+                  align_mode: Optional[str] = None,
                   **durable_kwargs) -> ARIMAModel:
         """``checkpoint_dir=`` journals the fit for crash/preemption resume
         (``reliability.fit_chunked``); ``chunk_rows`` / ``chunk_budget_s``
         / ``job_budget_s`` / ``resume`` / ``pipeline`` /
-        ``pipeline_depth`` ride along to the chunk driver."""
+        ``pipeline_depth`` / ``prefetch_depth`` ride along to the chunk
+        driver.  ``align_mode=`` is the static alignment hint
+        (``models.base.resolve_align_mode``) — valid with or without a
+        journal."""
         with obs.span("compat.fit_model", model="ARIMA"):
             if checkpoint_dir is not None:
                 import functools
@@ -431,11 +441,13 @@ class ARIMA:
                                       include_intercept=include_intercept,
                                       method=method,
                                       init_params=user_init_params),
-                    ts, checkpoint_dir, **durable_kwargs)
+                    ts, checkpoint_dir, align_mode=align_mode,
+                    **durable_kwargs)
                 return ARIMAModel(p, d, q, params, include_intercept)
             _require_checkpoint_dir(durable_kwargs)
             res = _arima.fit(jnp.asarray(ts), (p, d, q), include_intercept,
-                             method=method, init_params=user_init_params)
+                             method=method, init_params=user_init_params,
+                             align_mode=align_mode)
             return ARIMAModel(p, d, q, res.params, include_intercept)
 
 
@@ -497,13 +509,16 @@ class EWMAModel(_ModelBase):
 class EWMA:
     @staticmethod
     def fit_model(ts, checkpoint_dir: Optional[str] = None,
+                  align_mode: Optional[str] = None,
                   **durable_kwargs) -> EWMAModel:
         with obs.span("compat.fit_model", model="EWMA"):
             if checkpoint_dir is not None:
                 return EWMAModel(_durable_fit(_ewma.fit, ts, checkpoint_dir,
+                                              align_mode=align_mode,
                                               **durable_kwargs))
             _require_checkpoint_dir(durable_kwargs)
-            return EWMAModel(_ewma.fit(jnp.asarray(ts)).params)
+            return EWMAModel(_ewma.fit(jnp.asarray(ts),
+                                       align_mode=align_mode).params)
 
 
 class GARCHModel(_ModelBase):
@@ -538,13 +553,16 @@ class GARCHModel(_ModelBase):
 class GARCH:
     @staticmethod
     def fit_model(ts, checkpoint_dir: Optional[str] = None,
+                  align_mode: Optional[str] = None,
                   **durable_kwargs) -> GARCHModel:
         with obs.span("compat.fit_model", model="GARCH"):
             if checkpoint_dir is not None:
                 return GARCHModel(_durable_fit(_garch.fit, ts, checkpoint_dir,
+                                               align_mode=align_mode,
                                                **durable_kwargs))
             _require_checkpoint_dir(durable_kwargs)
-            return GARCHModel(_garch.fit(jnp.asarray(ts)).params)
+            return GARCHModel(_garch.fit(jnp.asarray(ts),
+                                         align_mode=align_mode).params)
 
 
 class ARGARCHModel(_ModelBase):
@@ -554,9 +572,10 @@ class ARGARCHModel(_ModelBase):
 
 class ARGARCH:
     @staticmethod
-    def fit_model(ts) -> ARGARCHModel:
+    def fit_model(ts, align_mode: Optional[str] = None) -> ARGARCHModel:
         with obs.span("compat.fit_model", model="ARGARCH"):
-            return ARGARCHModel(_garch.fit_argarch(jnp.asarray(ts)).params)
+            return ARGARCHModel(_garch.fit_argarch(
+                jnp.asarray(ts), align_mode=align_mode).params)
 
 
 class HoltWintersModel(_ModelBase):
@@ -588,6 +607,7 @@ class HoltWinters:
     def fit_model(ts, period: int, model_type: str = "additive",
                   method: str = "BOBYQA",
                   checkpoint_dir: Optional[str] = None,
+                  align_mode: Optional[str] = None,
                   **durable_kwargs) -> HoltWintersModel:
         # upstream's only optimizer is BOBYQA; here the bounded problem is
         # solved by sigmoid-transformed L-BFGS, so both names map to it
@@ -600,10 +620,12 @@ class HoltWinters:
                 params = _durable_fit(
                     functools.partial(_hw.fit, period=period,
                                       model_type=model_type),
-                    ts, checkpoint_dir, **durable_kwargs)
+                    ts, checkpoint_dir, align_mode=align_mode,
+                    **durable_kwargs)
                 return HoltWintersModel(params, period, model_type)
             _require_checkpoint_dir(durable_kwargs)
-            res = _hw.fit(jnp.asarray(ts), period, model_type=model_type)
+            res = _hw.fit(jnp.asarray(ts), period, model_type=model_type,
+                          align_mode=align_mode)
             return HoltWintersModel(res.params, period, model_type)
 
 

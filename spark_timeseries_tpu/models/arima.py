@@ -39,7 +39,7 @@ from ..utils.linalg import ridge_solve as _ridge_solve
 from .base import (FitResult, align_mode_on_host, align_right, debatch,
                    debatch_fit, derive_status, ensure_batched, jit_program,
                    maybe_align, require_pallas_for_count_evals,
-                   resolve_backend)
+                   resolve_align_mode, resolve_backend)
 
 Order = Tuple[int, int, int]
 
@@ -259,6 +259,7 @@ def fit(
     backend: str = "auto",
     count_evals: bool = False,
     compact: bool = True,
+    align_mode: Optional[str] = None,
 ) -> FitResult:
     """Fit ARIMA(p,d,q) to one series ``[time]`` or a batch ``[batch, time]``.
 
@@ -285,6 +286,14 @@ def fit(
     and — while parity-gated at the distribution level — is a different
     compiled program, so individual rows on flat/non-convex stretches can
     reach different (equally valid) optima than an uncompacted run.
+
+    ``align_mode`` (``"dense"`` / ``"no-trailing"`` / ``"general"``) is a
+    static alignment hint that skips the per-panel NaN probe and its host
+    sync (``base.resolve_align_mode``) — the chunk driver threads the
+    panel-level mode into every sliced chunk fit.  Hint contract: an
+    unknown name raises; a hint too strong for the data surfaces as
+    flagged rows (DIVERGED under ``"dense"``, EXCLUDED with NaN params
+    under ``"no-trailing"``), never as silently wrong estimates.
 
     ``FitResult.status`` reports per-row ``reliability.FitStatus`` codes
     (OK / DIVERGED / EXCLUDED for a plain fit).
@@ -321,8 +330,8 @@ def fit(
             and not isinstance(yb, jax.core.Tracer)
             and bsz >= _COMPACT_MIN_BATCH
             and optim.compaction_cap(bsz) < bsz)
+    align_mode = resolve_align_mode(yb, align_mode)
     if lazy:
-        align_mode = align_mode_on_host(yb)
         run1 = _fit_stage1_program(
             order, include_intercept, backend, max_iters, float(tol),
             init_params is not None, align_mode)
@@ -341,7 +350,7 @@ def fit(
         return debatch_fit(out, single, False)
     run = _fit_program(
         order, include_intercept, method, backend, max_iters, float(tol),
-        init_params is not None, align_mode_on_host(yb), count_evals,
+        init_params is not None, align_mode, count_evals,
         compact,
     )
     if init_params is None:

@@ -18,10 +18,12 @@ from __future__ import annotations
 
 import functools
 import weakref
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+
+from .. import obs
 
 
 def jit_program(builder):
@@ -134,6 +136,38 @@ def debatch_fit(out, single: bool, count_evals: bool):
     return debatch(out, single)
 
 
+ALIGN_MODES = ("dense", "no-trailing", "general")
+
+
+def resolve_align_mode(yb, align_mode: Optional[str] = None) -> str:
+    """Resolve a fit's static alignment mode: caller hint or host probe.
+
+    ``align_mode=None`` (the default) probes the panel on the host
+    (:func:`align_mode_on_host` — one fused reduction + one host sync,
+    cached per array identity).  A non-None hint skips the probe and the
+    sync entirely: the chunk driver (``reliability.fit_chunked``) computes
+    the panel's mode ONCE per walk and threads it into every chunk fit as
+    a static argument, so a sliced walk pays zero per-chunk probe syncs.
+
+    **Hint contract** (wrong hint = flagged rows, never silently wrong
+    numbers): an unknown mode name raises ``ValueError``; a WEAKER mode
+    than the data needs (``"general"`` on a dense panel) is always
+    numerically correct, only slower; a STRONGER mode than the data
+    supports surfaces per row — under ``"dense"`` any NaN poisons that
+    row's objective (``converged=False``, status ``DIVERGED``), and under
+    ``"no-trailing"`` a row whose last position is NaN is excluded
+    (``n_valid=0``, NaN params, status ``EXCLUDED``) by the guard in
+    :func:`maybe_align` rather than fitted against a zero-filled tail
+    with an inflated valid span.
+    """
+    if align_mode is None:
+        return align_mode_on_host(yb)
+    if align_mode not in ALIGN_MODES:
+        raise ValueError(
+            f"unknown align_mode {align_mode!r} (one of {ALIGN_MODES})")
+    return align_mode
+
+
 def align_mode_on_host(yb) -> str:
     """Static alignment mode for a fit program: how much work the per-row
     right-alignment actually needs on THIS panel.
@@ -161,6 +195,9 @@ def align_mode_on_host(yb) -> str:
     hit = _align_mode_cache.get(key)
     if hit is not None and hit[0]() is yb:
         return hit[1]
+    # each probe is a device round-trip (host sync); counted so drivers can
+    # verify a sliced chunk walk really paid ONE probe, not one per chunk
+    obs.counter("align.host_probes").inc()
     try:
         nan_any, nan_last = _nan_probe(yb)
     except RuntimeError:
@@ -214,6 +251,16 @@ def maybe_align(yb, mode: str):
         nv = yb.shape[1] - first
         t = jnp.arange(yb.shape[1])[None, :]
         ya = jnp.where(t >= first[:, None], jnp.nan_to_num(yb), 0.0)
+        # hint guard (resolve_align_mode contract): a row whose LAST
+        # position is NaN violates "no-trailing" — exclude it (n_valid=0,
+        # NaN values) instead of silently fitting a zero-filled tail with
+        # an inflated valid span.  The host probe never derives this mode
+        # when such rows exist, so on probe-derived panels ``bad`` is
+        # all-False and the select is numerically a no-op; one column read
+        # is the entire cost of making a wrong caller hint loud.
+        bad = jnp.isnan(yb[:, -1])
+        ya = jnp.where(bad[:, None], jnp.nan, ya)
+        nv = jnp.where(bad, 0, nv)
         return ya, nv.astype(jnp.int32)
     ya, nv = jax.vmap(align_right)(yb)
     return ya, nv.astype(jnp.int32)

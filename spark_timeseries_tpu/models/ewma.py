@@ -19,9 +19,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import (FitResult, align_mode_on_host, align_right, debatch,
+from .base import (FitResult, align_right, debatch,
                    derive_status, ensure_batched, maybe_align,
-                   jit_program, resolve_backend)
+                   jit_program, resolve_align_mode, resolve_backend)
 
 
 def smooth(alpha, x, n_valid=None):
@@ -74,20 +74,26 @@ def sse(alpha, x, n_valid=None):
 
 
 def fit(y, *, max_iters: int = 40, tol: Optional[float] = None,
-        backend: str = "auto") -> FitResult:
+        backend: str = "auto", align_mode: Optional[str] = None) -> FitResult:
     """Fit ``alpha`` per series by SSE minimization -> params ``[batch?, 1]``.
 
     Leading/trailing NaNs are tolerated (right-aligned masking); series with
     fewer than 3 valid points come back NaN with ``converged=False``.
     ``backend``: ``"scan"`` (portable), ``"pallas"`` (fused TPU kernel), or
     ``"auto"`` (pallas when ``ops.pallas_kernels.supported`` says so).
+
+    ``align_mode`` is the static alignment hint (``base.resolve_align_mode``)
+    the chunk driver threads through sliced walks to skip the per-chunk NaN
+    probe; a hint too strong for the data flags the violating rows
+    (DIVERGED / EXCLUDED) instead of silently misfitting them.
     """
     yb, single = ensure_batched(y)
     if tol is None:
         tol = 1e-8 if yb.dtype == jnp.float64 else 1e-4
     backend = resolve_backend(backend, yb.dtype, yb.shape[1])
     return debatch(
-        _fit_program(max_iters, float(tol), backend, align_mode_on_host(yb))(yb),
+        _fit_program(max_iters, float(tol), backend,
+                     resolve_align_mode(yb, align_mode))(yb),
         single,
     )
 

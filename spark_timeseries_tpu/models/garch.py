@@ -23,11 +23,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import (FitResult, align_mode_on_host, align_right, debatch,
+from .base import (FitResult, debatch,
                    debatch_fit, derive_status,
                    require_pallas_for_count_evals,
                    ensure_batched, maybe_align,
-                   jit_program, resolve_backend)
+                   jit_program, resolve_align_mode, resolve_backend)
 
 
 # -- transforms -------------------------------------------------------------
@@ -134,7 +134,7 @@ _COMPACT_MIN_BATCH = optim.COMPACT_MIN_BATCH
 
 def fit(r, *, max_iters: int = 80, tol: Optional[float] = None,
         backend: str = "auto", count_evals: bool = False,
-        compact: bool = True) -> FitResult:
+        compact: bool = True, align_mode: Optional[str] = None) -> FitResult:
     """Fit GARCH(1,1) per series -> natural params ``[batch?, 3]``.
 
     ``count_evals=True`` (pallas backend only) returns ``(FitResult, info)``
@@ -144,6 +144,11 @@ def fit(r, *, max_iters: int = 80, tol: Optional[float] = None,
     reproducibility (it engages on the pallas backend at batches >=
     ``utils.optim.COMPACT_MIN_BATCH`` = 4096 and is a different compiled
     program — bitwise outputs can differ from the uncompacted run).
+
+    ``align_mode`` is the static alignment hint (``base.resolve_align_mode``)
+    the chunk driver threads through sliced walks to skip the per-chunk NaN
+    probe; a hint too strong for the data flags the violating rows
+    (DIVERGED / EXCLUDED) instead of silently misfitting them.
     ``FitResult.status`` carries per-row ``reliability.FitStatus`` codes."""
     rb, single = ensure_batched(r)
     if tol is None:
@@ -151,6 +156,7 @@ def fit(r, *, max_iters: int = 80, tol: Optional[float] = None,
     backend = resolve_backend(backend, rb.dtype, rb.shape[1])
     require_pallas_for_count_evals(count_evals, backend)
     bsz = rb.shape[0]
+    align_mode = resolve_align_mode(rb, align_mode)
     # lazy straggler compile (utils.optim stage-1/stage-2 split, ADVICE r5):
     # the compacted stage-2 program is traced/compiled only when stage 1
     # actually leaves unconverged rows — same gate and host check as
@@ -164,11 +170,11 @@ def fit(r, *, max_iters: int = 80, tol: Optional[float] = None,
             and optim.compaction_cap(bsz) < bsz)
     if lazy:
         out, aux = _fit_stage1_program(
-            max_iters, float(tol), backend, align_mode_on_host(rb))(rb)
+            max_iters, float(tol), backend, align_mode)(rb)
         if int(aux["carry"].undone) > 0 and int(aux["carry"].k) < max_iters:
             out = _fit_stage2_program(max_iters, float(tol), backend)(aux)
         return debatch_fit(out, single, False)
-    out = _fit_program(max_iters, float(tol), backend, align_mode_on_host(rb),
+    out = _fit_program(max_iters, float(tol), backend, align_mode,
                        count_evals, compact)(rb)
     return debatch_fit(out, single, count_evals)
 
@@ -403,68 +409,122 @@ def argarch_neg_log_likelihood(params, y, n_valid=None):
 
 
 def fit_argarch(y, *, max_iters: int = 100, tol: Optional[float] = None,
-                backend: str = "auto", compact: bool = True) -> FitResult:
+                backend: str = "auto", compact: bool = True,
+                align_mode: Optional[str] = None) -> FitResult:
     """Fit AR(1)+GARCH(1,1) -> natural params ``[batch?, 5]``
     (reference ``ARGARCH.fitModel``).
 
     ``compact=False`` disables straggler compaction (see :func:`fit`);
+    ``align_mode`` is the static alignment hint (``base.resolve_align_mode``)
+    — a hint too strong for the data flags the violating rows instead of
+    silently misfitting them;
     ``FitResult.status`` carries per-row ``reliability.FitStatus`` codes."""
     yb, single = ensure_batched(y)
     if tol is None:
         tol = 1e-7 if yb.dtype == jnp.float64 else 1e-4
     backend = resolve_backend(backend, yb.dtype, yb.shape[1])
+    bsz = yb.shape[0]
+    align_mode = resolve_align_mode(yb, align_mode)
+    # lazy straggler compile: same stage-1/stage-2 split (and gate) as
+    # fit() above — the compacted stage-2 program is traced/compiled only
+    # when stage 1 actually leaves unconverged rows (ROADMAP follow-on)
+    lazy = (compact and backend in ("pallas", "pallas-interpret")
+            and not isinstance(yb, jax.core.Tracer)
+            and bsz >= _COMPACT_MIN_BATCH
+            and optim.compaction_cap(bsz) < bsz)
+    if lazy:
+        out, aux = _fit_argarch_stage1_program(
+            max_iters, float(tol), backend, align_mode)(yb)
+        if int(aux["carry"].undone) > 0 and int(aux["carry"].k) < max_iters:
+            out = _fit_argarch_stage2_program(
+                max_iters, float(tol), backend)(aux)
+        return debatch(out, single)
     return debatch(
-        _fit_argarch_program(max_iters, float(tol), backend, compact)(yb),
+        _fit_argarch_program(max_iters, float(tol), backend, compact,
+                             align_mode)(yb),
         single)
 
 
+def _argarch_prep(yb, align_mode: str):
+    """Shared front half of the ARGARCH fit programs (inline + lazy
+    stage-1): alignment, the AR(1)-by-autocorrelation + GARCH-moment init
+    in transformed space, and the mean-nll denominator.  ONE implementation
+    so the seeds can never diverge between the two paths (see
+    :func:`_garch_prep`)."""
+    ya, nv = maybe_align(yb, align_mode)
+
+    # init: OLS-ish AR(1) by autocorrelation, then GARCH moments on resid
+    # (masked over each right-aligned valid span)
+    T = ya.shape[1]
+    m = (jnp.arange(T)[None, :] >= (T - nv)[:, None]).astype(ya.dtype)
+    nvf = jnp.maximum(nv, 1).astype(ya.dtype)
+    mean = jnp.sum(ya * m, axis=1) / nvf
+    yc = (ya - mean[:, None]) * m
+    phi0 = jnp.sum(yc[:, 1:] * yc[:, :-1], axis=1) / jnp.maximum(
+        jnp.sum(yc * yc, axis=1), 1e-12
+    )
+    phi0 = jnp.clip(phi0, -0.95, 0.95)
+    c0 = mean * (1.0 - phi0)
+    resid = (ya[:, 1:] - c0[:, None] - phi0[:, None] * ya[:, :-1]) * m[:, 1:]
+    resid_var = jnp.sum(resid**2, axis=1) / nvf
+    nat0 = jnp.stack(
+        [
+            c0,
+            phi0,
+            0.1 * jnp.maximum(resid_var, 1e-8),
+            jnp.full_like(c0, 0.1),
+            jnp.full_like(c0, 0.8),
+        ],
+        axis=1,
+    )
+    u0 = jax.vmap(_argarch_from_natural)(nat0)
+    n_eff = jnp.maximum(nv - 1, 1).astype(ya.dtype)
+    return ya, nv, u0, n_eff
+
+
+def _finalize_argarch_fit(res, ok, n_eff):
+    """Optimizer result -> FitResult (same ops as the inline program)."""
+    params = jnp.where(
+        ok[:, None], jax.vmap(_argarch_to_natural)(res.x), jnp.nan)
+    return FitResult(
+        params,
+        jnp.where(ok, res.f * n_eff, jnp.nan),
+        res.converged & ok,
+        res.iters,
+        derive_status(ok, res.converged, params),
+    )
+
+
+def _argarch_fb(ya, prev, nv, n_eff, interp):
+    """The fused ARGARCH objective over the natural-layout panel — shared
+    by the inline program, its straggler subset, and both lazy stages (the
+    compacted data is a plain row gather of each closed-over array)."""
+    from ..ops import pallas_kernels as pk
+
+    t_idx = jnp.arange(ya.shape[1])
+    start = ya.shape[1] - nv
+
+    def fb(u):
+        nat = jax.vmap(_argarch_to_natural)(u)
+        r = ya - nat[:, 0:1] - nat[:, 1:2] * prev
+        # condition on the first valid observation (see
+        # argarch_neg_log_likelihood): its residual is excluded
+        r = jnp.where(t_idx[None, :] <= start[:, None], 0.0, r)
+        return pk.garch_neg_loglik(nat[:, 2:], r, nv - 1,
+                                   interpret=interp) / n_eff
+
+    return fb
+
+
 @jit_program
-def _fit_argarch_program(max_iters, tol, backend, compact=True):
+def _fit_argarch_program(max_iters, tol, backend, compact=True,
+                         align_mode="general"):
     def run(yb):
-        ya, nv = jax.vmap(align_right)(yb)
-
-        # init: OLS-ish AR(1) by autocorrelation, then GARCH moments on resid
-        # (masked over each right-aligned valid span)
-        T = ya.shape[1]
-        m = (jnp.arange(T)[None, :] >= (T - nv)[:, None]).astype(ya.dtype)
-        nvf = jnp.maximum(nv, 1).astype(ya.dtype)
-        mean = jnp.sum(ya * m, axis=1) / nvf
-        yc = (ya - mean[:, None]) * m
-        phi0 = jnp.sum(yc[:, 1:] * yc[:, :-1], axis=1) / jnp.maximum(
-            jnp.sum(yc * yc, axis=1), 1e-12
-        )
-        phi0 = jnp.clip(phi0, -0.95, 0.95)
-        c0 = mean * (1.0 - phi0)
-        resid = (ya[:, 1:] - c0[:, None] - phi0[:, None] * ya[:, :-1]) * m[:, 1:]
-        resid_var = jnp.sum(resid**2, axis=1) / nvf
-        nat0 = jnp.stack(
-            [
-                c0,
-                phi0,
-                0.1 * jnp.maximum(resid_var, 1e-8),
-                jnp.full_like(c0, 0.1),
-                jnp.full_like(c0, 0.8),
-            ],
-            axis=1,
-        )
-        u0 = jax.vmap(_argarch_from_natural)(nat0)
-        n_eff = jnp.maximum(nv - 1, 1).astype(ya.dtype)
+        ya, nv, u0, n_eff = _argarch_prep(yb, align_mode)
         if backend in ("pallas", "pallas-interpret"):
-            from ..ops import pallas_kernels as pk
-
             interp = backend == "pallas-interpret"
-            T = ya.shape[1]
-            t_idx = jnp.arange(T)
-            start = T - nv
             prev = jnp.concatenate([ya[:, :1], ya[:, :-1]], axis=1)
-
-            def fb(u):
-                nat = jax.vmap(_argarch_to_natural)(u)
-                r = ya - nat[:, 0:1] - nat[:, 1:2] * prev
-                # condition on the first valid observation (see
-                # argarch_neg_log_likelihood): its residual is excluded
-                r = jnp.where(t_idx[None, :] <= start[:, None], 0.0, r)
-                return pk.garch_neg_loglik(nat[:, 2:], r, nv - 1, interpret=interp) / n_eff
+            fb = _argarch_fb(ya, prev, nv, n_eff, interp)
 
             # straggler compaction: row gathers, as in fit()
             bsz = ya.shape[0]
@@ -473,17 +533,8 @@ def _fit_argarch_program(max_iters, tol, backend, compact=True):
             if compact and bsz >= _COMPACT_MIN_BATCH:
 
                 def straggler_fun(idxc):
-                    yas, prevs = ya[idxc], prev[idxc]
-                    starts, nvs, nes = start[idxc], nv[idxc], n_eff[idxc]
-
-                    def fb_s(u):
-                        nat = jax.vmap(_argarch_to_natural)(u)
-                        r = yas - nat[:, 0:1] - nat[:, 1:2] * prevs
-                        r = jnp.where(t_idx[None, :] <= starts[:, None], 0.0, r)
-                        return pk.garch_neg_loglik(
-                            nat[:, 2:], r, nvs - 1, interpret=interp) / nes
-
-                    return fb_s
+                    return _argarch_fb(ya[idxc], prev[idxc], nv[idxc],
+                                       n_eff[idxc], interp)
 
             res = optim.minimize_lbfgs_batched(
                 fb, u0, max_iters=max_iters, tol=tol,
@@ -497,15 +548,49 @@ def _fit_argarch_program(max_iters, tol, backend, compact=True):
                 obj_scaled, u0, (ya, nv, n_eff), max_iters=max_iters, tol=tol
             )
         ok = nv >= 12
-        params = jnp.where(
-            ok[:, None], jax.vmap(_argarch_to_natural)(res.x), jnp.nan)
-        return FitResult(
-            params,
-            jnp.where(ok, res.f * n_eff, jnp.nan),
-            res.converged & ok,
-            res.iters,
-            derive_status(ok, res.converged, params),
-        )
+        return _finalize_argarch_fit(res, ok, n_eff)
+
+    return run
+
+
+@jit_program
+def _fit_argarch_stage1_program(max_iters, tol, backend, align_mode="general"):
+    """Stage 1 of the lazily compiled compact ARGARCH fit (see
+    ``models.arima._fit_stage1_program``): lockstep loop + straggler
+    gather, stage 2 compiled only when needed.  Pallas backends only."""
+
+    def run(yb):
+        ya, nv, u0, n_eff = _argarch_prep(yb, align_mode)
+        interp = backend == "pallas-interpret"
+        prev = jnp.concatenate([ya[:, :1], ya[:, :-1]], axis=1)
+        fb = _argarch_fb(ya, prev, nv, n_eff, interp)
+        cap = optim.compaction_cap(ya.shape[0])
+        res1, carry = optim.lbfgs_batched_stage1(
+            fb, u0, straggler_cap=cap, max_iters=max_iters, tol=tol)
+        ok = nv >= 12
+        # the objective closes over the NATURAL-layout panel, so the
+        # compacted problem's data is a plain row gather of each array,
+        # done here so the stage-2 program is a pure function of its inputs
+        aux = {"carry": carry, "res": res1, "yas": ya[carry.idxc],
+               "prevs": prev[carry.idxc], "nvs": nv[carry.idxc],
+               "nes": n_eff[carry.idxc], "ok": ok, "n_eff": n_eff}
+        return _finalize_argarch_fit(res1, ok, n_eff), aux
+
+    return run
+
+
+@jit_program
+def _fit_argarch_stage2_program(max_iters, tol, backend):
+    """Stage 2 of the lazy compact ARGARCH fit: finish the gathered
+    stragglers and scatter back (compiled on first actual need)."""
+    interp = backend == "pallas-interpret"
+
+    def run(aux):
+        fb_s = _argarch_fb(aux["yas"], aux["prevs"], aux["nvs"],
+                           aux["nes"], interp)
+        res = optim.lbfgs_batched_stage2(
+            fb_s, aux["res"], aux["carry"], max_iters=max_iters, tol=tol)
+        return _finalize_argarch_fit(res, aux["ok"], aux["n_eff"])
 
     return run
 

@@ -21,11 +21,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import (FitResult, align_mode_on_host, align_right, debatch,
+from .base import (FitResult, align_right, debatch,
                    debatch_fit, derive_status,
                    require_pallas_for_count_evals,
                    ensure_batched, maybe_align,
-                   jit_program, resolve_backend)
+                   jit_program, resolve_align_mode, resolve_backend)
 
 
 def _init_state(y, period: int, multiplicative: bool, start=None):
@@ -126,6 +126,7 @@ def fit(
     count_evals: bool = False,
     compact: bool = True,
     n_starts: Optional[int] = None,
+    align_mode: Optional[str] = None,
 ) -> FitResult:
     """Fit (alpha, beta, gamma) per series -> params ``[batch?, 3]``.
 
@@ -149,6 +150,11 @@ def fit(
     preferring converged starts — so rows stranded in a bad local optimum
     of the non-convex (especially multiplicative) SSE surface are rescued
     by a better basin instead of shipping a 0.7-drift parameter tail.
+
+    ``align_mode`` is the static alignment hint (``base.resolve_align_mode``)
+    the chunk driver threads through sliced walks to skip the per-chunk NaN
+    probe; a hint too strong for the data flags the violating rows
+    (DIVERGED / EXCLUDED) instead of silently misfitting them.
     ``FitResult.status`` carries per-row ``reliability.FitStatus`` codes."""
     if model_type not in ("additive", "multiplicative"):
         raise ValueError(f"model_type must be additive|multiplicative, got {model_type!r}")
@@ -173,8 +179,41 @@ def fit(
     backend = resolve_backend(backend, yb.dtype, yb.shape[1],
                               structural_ok=pk.hw_structural_ok(period))
     require_pallas_for_count_evals(count_evals, backend)
+    align_mode = resolve_align_mode(yb, align_mode)
+    bsz = yb.shape[0]
+    # lazy straggler compile (utils.optim stage-1/stage-2 split): the
+    # compacted stage-2 program is traced/compiled only when a start's
+    # stage 1 actually leaves unconverged rows — same gate and host check
+    # as models.arima.fit, extended with a PER-START carry: the seeded
+    # multi-start runs several optimizer passes per fit, and each start
+    # gates its own stage-2 dispatch; the ONE stage-2 program (stable
+    # shapes across starts) is shared by every start that needs it, and
+    # the basin selection re-merges only when some start re-ran.
+    lazy = (compact and not count_evals
+            and backend in ("pallas", "pallas-interpret")
+            and not isinstance(yb, jax.core.Tracer)
+            and bsz >= _COMPACT_MIN_BATCH
+            and optim.compaction_cap(bsz) < bsz)
+    if lazy:
+        out, aux = _fit_stage1_program(
+            period, multiplicative, max_iters, float(tol), backend,
+            align_mode, n_starts)(yb)
+        finished, redo = [], False
+        for a in aux["starts"]:
+            c = a["carry"]
+            if int(c.undone) > 0 and int(c.k) < max_iters:
+                finished.append(_fit_stage2_program(
+                    period, multiplicative, max_iters, float(tol),
+                    backend)(a))
+                redo = True
+            else:
+                finished.append(a["res"])
+        if redo:
+            out = _merge_starts_program(n_starts)(
+                tuple(finished), aux["ok"], aux["n_err"])
+        return debatch_fit(out, single, False)
     out = _fit_program(period, multiplicative, max_iters, float(tol), backend,
-                       align_mode_on_host(yb), count_evals, compact,
+                       align_mode, count_evals, compact,
                        n_starts)(yb)
     return debatch_fit(out, single, count_evals)
 
@@ -257,63 +296,173 @@ def _fit_program(period, multiplicative, max_iters, tol, backend,
                 return r, None
 
         # seeded multi-start: run the optimizer from each init and keep,
-        # per row, the best basin.  Selection is two-stage and designed to
-        # be DETERMINISTIC ACROSS PRECISIONS (PRECISION.md: the
-        # multiplicative surface has near-tied local optima, and picking
-        # by raw SSE order lets f32 and f64 flip coins on which basin
-        # float noise ranks first, shipping a fat cross-precision
-        # parameter-drift tail):
-        #   1. candidates = converged starts (all starts when none
-        #      converged) within 0.1% relative of the row's best final
-        #      objective — statistically indistinguishable fits;
-        #   2. among candidates, prefer the SMOOTHEST model (smallest
-        #      alpha+beta+gamma; basins sit far apart in parameter space,
-        #      so this comparison is float-noise-robust), ties to the
-        #      earliest start.
-        # Pass accounting (count_evals) reports the first start's passes;
-        # n_starts rides in the info dict as a multiplier.
+        # per row, the best basin (_select_best_start).  Pass accounting
+        # (count_evals) reports the first start's passes; n_starts rides
+        # in the info dict as a multiplier.
         res, info = one_start(_MULTISTART_NATS[0], count_evals)
         if info is not None:
             info = {**info, "n_starts": n_starts}
         if n_starts > 1:
             starts = [res] + [one_start(_MULTISTART_NATS[s], False)[0]
                               for s in range(1, n_starts)]
-            xs = jnp.stack([r.x for r in starts])  # [S, B, 3]
-            fs = jnp.stack([jnp.nan_to_num(r.f, nan=jnp.inf, posinf=jnp.inf)
-                            for r in starts])
-            convs = jnp.stack([r.converged for r in starts])
-            any_conv = convs.any(axis=0)
-            eligible = jnp.where(any_conv[None, :], convs, True)
-            f_elig = jnp.where(eligible, fs, jnp.inf)
-            best_f = jnp.min(f_elig, axis=0)
-            near = eligible & (f_elig <= best_f[None, :] * (1 + 1e-3) + 1e-12)
-            smooth = jnp.sum(
-                optim.sigmoid_to_interval(xs, 0.0, 1.0), axis=-1)
-            sel = jnp.argmin(jnp.where(near, smooth, jnp.inf), axis=0)
-            take = lambda field: jnp.take_along_axis(  # noqa: E731
-                jnp.stack([getattr(r, field) for r in starts]),
-                sel[None, :], axis=0)[0]
-            merged = {
-                "x": jnp.take_along_axis(
-                    xs, sel[None, :, None], axis=0)[0],
-                "f": take("f"),
-                "converged": take("converged"),
-                "iters": take("iters"),
-            }
-            if hasattr(res, "grad_norm"):
-                merged["grad_norm"] = take("grad_norm")
-            res = res._replace(**merged)
+            res = _select_best_start(starts)
         ok = nv >= 2 * period  # seed needs two full seasons of real data
-        params = jnp.where(
-            ok[:, None], optim.sigmoid_to_interval(res.x, 0.0, 1.0), jnp.nan)
-        out = FitResult(
-            params,
-            jnp.where(ok, res.f * n_err, jnp.nan),  # report the SSE as before
-            res.converged & ok,
-            res.iters,
-            derive_status(ok, res.converged, params),
-        )
+        out = _finalize_hw_fit(res, ok, n_err)
         return (out, info) if count_evals else out
+
+    return run
+
+
+def _select_best_start(starts):
+    """Per-row basin selection across seeded multi-start results.
+
+    Selection is two-stage and designed to be DETERMINISTIC ACROSS
+    PRECISIONS (PRECISION.md: the multiplicative surface has near-tied
+    local optima, and picking by raw SSE order lets f32 and f64 flip
+    coins on which basin float noise ranks first, shipping a fat
+    cross-precision parameter-drift tail):
+
+    1. candidates = converged starts (all starts when none converged)
+       within 0.1% relative of the row's best final objective —
+       statistically indistinguishable fits;
+    2. among candidates, prefer the SMOOTHEST model (smallest
+       alpha+beta+gamma; basins sit far apart in parameter space, so this
+       comparison is float-noise-robust), ties to the earliest start.
+
+    ONE implementation serves the inline multi-start program and the lazy
+    stage-1/stage-2 split's re-merge — the basin choice must never diverge
+    between them.
+    """
+    if len(starts) == 1:
+        return starts[0]
+    res = starts[0]
+    xs = jnp.stack([r.x for r in starts])  # [S, B, 3]
+    fs = jnp.stack([jnp.nan_to_num(r.f, nan=jnp.inf, posinf=jnp.inf)
+                    for r in starts])
+    convs = jnp.stack([r.converged for r in starts])
+    any_conv = convs.any(axis=0)
+    eligible = jnp.where(any_conv[None, :], convs, True)
+    f_elig = jnp.where(eligible, fs, jnp.inf)
+    best_f = jnp.min(f_elig, axis=0)
+    near = eligible & (f_elig <= best_f[None, :] * (1 + 1e-3) + 1e-12)
+    smooth = jnp.sum(
+        optim.sigmoid_to_interval(xs, 0.0, 1.0), axis=-1)
+    sel = jnp.argmin(jnp.where(near, smooth, jnp.inf), axis=0)
+    take = lambda field: jnp.take_along_axis(  # noqa: E731
+        jnp.stack([getattr(r, field) for r in starts]),
+        sel[None, :], axis=0)[0]
+    merged = {
+        "x": jnp.take_along_axis(
+            xs, sel[None, :, None], axis=0)[0],
+        "f": take("f"),
+        "converged": take("converged"),
+        "iters": take("iters"),
+    }
+    if hasattr(res, "grad_norm"):
+        merged["grad_norm"] = take("grad_norm")
+    return res._replace(**merged)
+
+
+def _finalize_hw_fit(res, ok, n_err):
+    """Optimizer result -> FitResult (same ops as the inline program);
+    the reported objective is the unscaled SSE."""
+    params = jnp.where(
+        ok[:, None], optim.sigmoid_to_interval(res.x, 0.0, 1.0), jnp.nan)
+    return FitResult(
+        params,
+        jnp.where(ok, res.f * n_err, jnp.nan),
+        res.converged & ok,
+        res.iters,
+        derive_status(ok, res.converged, params),
+    )
+
+
+@jit_program
+def _fit_stage1_program(period, multiplicative, max_iters, tol, backend,
+                        align_mode="general", n_starts=1):
+    """Stage 1 of the lazily compiled compact Holt-Winters fit: the full
+    prep (alignment + one-time seed state) and, PER SEEDED START, the
+    lockstep L-BFGS with the straggler early-exit — returning the
+    finalized as-if-done merged result PLUS one compacted carry per start,
+    so the stage-2 program is traced/compiled only when some start's
+    ``carry.undone`` says rows actually remain (and dispatched only for
+    those starts).  Pallas backends only (the gate lives in ``fit``)."""
+
+    def run(yb):
+        ya, nv = maybe_align(yb, align_mode)
+        n_err = jnp.maximum(nv - period, 1).astype(yb.dtype)
+        from ..ops import pallas_kernels as pk
+
+        interp = backend == "pallas-interpret"
+        # seeds are data-only: compute ONCE and share across every start
+        # (same contract as the inline program)
+        seeds = pk.hw_seeds(
+            ya, period, multiplicative,
+            None if align_mode == "dense" else nv)
+
+        def fb(u):
+            nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
+            return pk.hw_sse_seeded(
+                nat, ya, seeds, period, multiplicative, interpret=interp
+            ) / n_err
+
+        bsz = ya.shape[0]
+        cap = optim.compaction_cap(bsz)
+        results, starts_aux = [], []
+        for s in range(n_starts):
+            u0 = jnp.broadcast_to(
+                optim.interval_to_sigmoid(
+                    jnp.asarray(_MULTISTART_NATS[s], yb.dtype), 0.0, 1.0),
+                (bsz, 3))
+            res1, carry = optim.lbfgs_batched_stage1(
+                fb, u0, straggler_cap=cap, max_iters=max_iters, tol=tol)
+            # gather the compacted objective data HERE (plain row gathers
+            # of the natural-layout panel + per-row seed state) so the
+            # stage-2 program is a pure function of its inputs and keeps
+            # stable shapes across starts — ONE compiled stage-2 program
+            # serves every start that needs it
+            starts_aux.append({
+                "carry": carry, "res": res1, "yas": ya[carry.idxc],
+                "seeds_s": tuple(x[carry.idxc] for x in seeds),
+                "nes": n_err[carry.idxc]})
+            results.append(res1)
+        ok = nv >= 2 * period
+        out = _finalize_hw_fit(_select_best_start(results), ok, n_err)
+        return out, {"starts": tuple(starts_aux), "ok": ok, "n_err": n_err}
+
+    return run
+
+
+@jit_program
+def _fit_stage2_program(period, multiplicative, max_iters, tol, backend):
+    """Stage 2 of the lazy compact Holt-Winters fit: finish ONE start's
+    gathered stragglers on the compacted objective and scatter back into
+    that start's full-batch result — compiled on the first call where any
+    start left unconverged rows, then reused by every such start."""
+    interp = backend == "pallas-interpret"
+
+    def run(aux_s):
+        from ..ops import pallas_kernels as pk
+
+        def fb_s(u):
+            nat = optim.sigmoid_to_interval(u, 0.0, 1.0)
+            return pk.hw_sse_seeded(
+                nat, aux_s["yas"], aux_s["seeds_s"], period, multiplicative,
+                interpret=interp) / aux_s["nes"]
+
+        return optim.lbfgs_batched_stage2(
+            fb_s, aux_s["res"], aux_s["carry"], max_iters=max_iters, tol=tol)
+
+    return run
+
+
+@jit_program
+def _merge_starts_program(n_starts):
+    """Re-merge the per-start results after lazy stage-2 dispatches: the
+    same basin selection + finalize the inline program applies."""
+
+    def run(results, ok, n_err):
+        return _finalize_hw_fit(_select_best_start(list(results)), ok, n_err)
 
     return run
 

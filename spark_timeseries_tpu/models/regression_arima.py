@@ -17,8 +17,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from typing import Optional
+
 from ..utils.linalg import ols as _ols
-from .base import FitResult, debatch, derive_status, jit_program
+from .base import (ALIGN_MODES, FitResult, debatch, derive_status,
+                   jit_program)
 
 
 def _design(X):
@@ -80,8 +83,18 @@ def _co_program(max_iter):
     return run
 
 
-def fit(y, X, method: str = "cochrane-orcutt", **kwargs) -> FitResult:
-    """Reference ``RegressionARIMA.fitModel`` dispatcher."""
+def fit(y, X, method: str = "cochrane-orcutt", *,
+        align_mode: Optional[str] = None, **kwargs) -> FitResult:
+    """Reference ``RegressionARIMA.fitModel`` dispatcher.
+
+    ``align_mode`` is accepted for chunk-driver uniformity
+    (``base.resolve_align_mode``): the name is validated, but Cochrane-
+    Orcutt has no ragged-panel alignment — the design requires dense
+    ``y``/``X`` (NaNs propagate to NaN params, flagged by ``status``).
+    """
+    if align_mode is not None and align_mode not in ALIGN_MODES:
+        raise ValueError(
+            f"unknown align_mode {align_mode!r} (one of {ALIGN_MODES})")
     if method not in ("cochrane-orcutt", "cochrane_orcutt"):
         raise ValueError(f"unknown method {method!r} (supported: cochrane-orcutt)")
     return fit_cochrane_orcutt(y, X, **kwargs)

@@ -356,7 +356,9 @@ class TimeSeriesPanel:
             checkpoint_dir: Optional[str] = None, resume: str = "auto",
             chunk_budget_s: Optional[float] = None,
             job_budget_s: Optional[float] = None,
-            pipeline: bool = True, pipeline_depth: int = 2, **fit_kwargs):
+            pipeline: bool = True, pipeline_depth: int = 2,
+            prefetch_depth: int = 1, align_mode: Optional[str] = None,
+            **fit_kwargs):
         """Fit a model family over every series via the resilient chunk driver.
 
         ``model`` is a model-module name (``"arima"``, ``"garch"``,
@@ -385,7 +387,13 @@ class TimeSeriesPanel:
         chunk's shard and manifest hit disk — bitwise-identical to the
         serial walk, which ``pipeline=False`` restores (see
         ``reliability.fit_chunked``; ``meta["pipeline"]`` reports the
-        hidden commit time).
+        hidden commit time).  The INPUT side is pipelined too: sliced
+        walks compute one static align-mode plan for the whole panel
+        (``align_mode=`` pre-supplies it and skips even that probe) and
+        stage chunk N+1's device slice on a background prefetcher while
+        chunk N computes (``prefetch_depth``, default 1; 0 disables) —
+        stage ∥ compute ∥ commit, still bitwise-identical to the serial
+        walk.
 
         Returns a ``reliability.ResilientFitResult`` whose rows align with
         ``self.keys``; ``.status`` carries per-series ``FitStatus`` codes
@@ -414,6 +422,7 @@ class TimeSeriesPanel:
                 checkpoint_dir=checkpoint_dir, resume=resume,
                 chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
                 pipeline=pipeline, pipeline_depth=pipeline_depth,
+                prefetch_depth=prefetch_depth, align_mode=align_mode,
                 **fit_kwargs,
             )
 

@@ -18,6 +18,10 @@ same guarantees at row granularity:
   bounded background commit thread — journal commits and host I/O overlap
   the next chunk's device compute while preserving the journal's
   single-writer, in-order commit protocol.
+- :mod:`.prefetcher` — :class:`ChunkPrefetcher`: the input half of the
+  pipeline — a bounded background stager that materializes chunk N+1's
+  device slice while chunk N computes (stage ∥ compute ∥ commit), with
+  driver-controlled invalidation on OOM backoff and rollback.
 - :mod:`.journal` — :class:`ChunkJournal`: write-ahead per-chunk npz
   shards + an atomic JSON manifest, so a journaled multi-chunk fit
   (``fit_chunked(..., checkpoint_dir=...)``) survives process death and
@@ -30,10 +34,11 @@ same guarantees at row granularity:
   torn manifests) so every recovery path runs in tier-1 CPU tests.
 """
 
-from . import (chunked, committer, faultinject, journal, runner, sanitize,
-               status, watchdog)
+from . import (chunked, committer, faultinject, journal, prefetcher, runner,
+               sanitize, status, watchdog)
 from .chunked import OOMBackoffExceeded, fit_chunked, is_resource_exhausted
 from .committer import ChunkCommitter, CommitterStats
+from .prefetcher import ChunkPrefetcher, PrefetchStats
 from .journal import (ChunkJournal, JournalError, StaleJournalError,
                       TornManifestError, config_hash, panel_fingerprint)
 from .runner import (ResilientFitResult, RetryRung, default_ladder,
@@ -45,7 +50,9 @@ from .watchdog import Deadline, DeadlineExceeded, call_with_deadline
 __all__ = [
     "ChunkCommitter",
     "ChunkJournal",
+    "ChunkPrefetcher",
     "CommitterStats",
+    "PrefetchStats",
     "Deadline",
     "DeadlineExceeded",
     "FitStatus",
@@ -67,6 +74,7 @@ __all__ = [
     "journal",
     "merge_status",
     "panel_fingerprint",
+    "prefetcher",
     "resilient_fit",
     "runner",
     "sanitize",

@@ -46,6 +46,14 @@ serial crash (in-order commits: everything after the first in-flight
 commit recomputes), and the OOM-backoff/watchdog paths drain the queue
 deterministically before touching the journal.  ``meta["pipeline"]``
 reports how much commit wall time the overlap hid.
+
+**Dispatch-ahead input** (ISSUE 5) closes the other half: a static
+align-mode plan (computed once per walk, threaded into every chunk fit)
+removes the per-chunk NaN-probe host sync, and a bounded background
+:class:`~.prefetcher.ChunkPrefetcher` stages chunk N+1's device slice
+while chunk N computes — the steady state is stage N+1 ∥ compute N ∥
+commit N−1, with the input-side overlap accounted next to the commit-side
+numbers in ``meta["pipeline"]``.
 """
 
 from __future__ import annotations
@@ -60,8 +68,9 @@ from .. import obs
 from ..obs import memory as memory_probe
 from . import committer as committer_mod
 from . import journal as journal_mod
+from . import prefetcher as prefetcher_mod
 from . import watchdog as watchdog_mod
-from .runner import ResilientFitResult, resilient_fit
+from .runner import ResilientFitResult, _accepted_kwargs, resilient_fit
 from .status import STATUS_DTYPE, FitStatus, status_counts
 
 __all__ = ["OOMBackoffExceeded", "is_resource_exhausted", "fit_chunked"]
@@ -147,6 +156,8 @@ def fit_chunked(
     job_budget_s: Optional[float] = None,
     pipeline: bool = True,
     pipeline_depth: int = 2,
+    prefetch_depth: int = 1,
+    align_mode: Optional[str] = None,
     process_index: Optional[int] = None,
     journal_extra: Optional[dict] = None,
     _journal_commit_hook=None,
@@ -199,6 +210,38 @@ def fit_chunked(
     driver never waited for (``hidden_commit_s``), and the resulting
     ``overlap_efficiency``.
 
+    **Input staging** (the other half of the pipeline): while chunk N
+    computes, a background :class:`~.prefetcher.ChunkPrefetcher` stages
+    chunk N+1's device slice (at most ``prefetch_depth`` slices ahead,
+    default 1 — the classic double buffer), so in steady state the walk
+    runs stage N+1 ∥ compute N ∥ commit N−1.  The staged buffer is the
+    SAME ``yb[lo:hi]`` the serial driver slices (identical bytes); the
+    driver predicts the next span on the committed grid (resume clamping
+    and torn-shard boundaries included) and invalidates staged slices
+    whenever OOM backoff or a committer rollback re-chunks the walk, so a
+    stale prediction degrades to an inline slice, never a wrong one.
+    ``prefetch_depth=0`` (or ``pipeline=False``) disables staging.
+    ``meta["pipeline"]`` gains the input-side accounting
+    (``staging_wall_s`` / ``hidden_staging_s`` /
+    ``input_overlap_efficiency``) and the combined
+    ``end_to_end_overlap_efficiency``.
+
+    **Static align-mode plan**: when ``fit_fn`` accepts the ``align_mode``
+    hint (every bundled model fit does — ``models.base.resolve_align_mode``),
+    a sliced walk computes the panel's alignment mode ONCE and threads it
+    into every chunk fit as a static argument, eliminating the per-chunk
+    NaN-probe host sync and the per-array-identity align-cache misses on
+    fresh slice buffers.  The panel-level mode is a row-wise property, so
+    it is exact for every row slice.  Pass ``align_mode=`` to skip even
+    the one probe (the journal's config hash covers the resolved mode, so
+    a resumed run must use the same plan); a hint too strong for the data
+    flags the violating rows instead of silently misfitting them (see
+    ``resolve_align_mode``).  Resilient walks downgrade the hint to
+    ``"general"`` for chunks the sanitizer actually modified
+    (``runner.resilient_fit``), keeping the hint sound when repairs
+    change a chunk's NaN pattern.  ``meta["align_mode"]`` records the
+    plan.
+
     **Deadlines**: ``chunk_budget_s`` bounds each chunk's fit (overrun ->
     rows flagged ``TIMEOUT``, walk continues — the compiled computation is
     abandoned, not cancelled; with the budget armed, non-resilient fits
@@ -235,6 +278,48 @@ def fit_chunked(
     chunk = max(1, min(chunk, b))
     chunk0 = chunk
 
+    # static align-mode plan: resolve the panel's alignment mode ONCE (or
+    # take the caller's hint) and thread it into every chunk fit as a
+    # static argument — the per-chunk NaN probe (one host sync per sliced
+    # chunk) disappears.  The mode is a row-wise property of the panel, so
+    # the panel-level answer is exact for every row slice.  Injected
+    # BEFORE the journal's config hash is computed: the plan changes which
+    # compiled program fits the chunks, so a resume must run the same one.
+    from ..models import base as model_base
+
+    import inspect as _inspect
+
+    def _explicit_align_param(fn) -> bool:
+        try:
+            return "align_mode" in _inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            return False
+
+    fit_takes_align = "align_mode" in _accepted_kwargs(
+        fit_fn, {"align_mode": None})
+    if align_mode is not None:
+        # a caller-provided hint is an explicit opt-in: a **kwargs fit_fn
+        # is trusted to forward it (the caller asserted it can)
+        if not fit_takes_align:
+            raise TypeError(
+                "align_mode= was given but fit_fn does not accept an "
+                "align_mode keyword (the hint would be silently dropped)")
+        fit_kwargs = {**fit_kwargs,
+                      "align_mode": model_base.resolve_align_mode(
+                          yb, align_mode)}
+    elif (_explicit_align_param(fit_fn) and chunk < b
+          and "align_mode" not in fit_kwargs):
+        # AUTO-injection requires align_mode as an explicitly NAMED
+        # parameter — a bare **kwargs does not count (a third-party
+        # `def my_fit(y, **opts)` forwarding to a strict solver would
+        # blow up on, or silently absorb, a keyword it never asked for).
+        # Only sliced walks benefit: a whole-panel chunk hands the
+        # caller's array through and the model's own per-array probe
+        # cache holds
+        fit_kwargs = {**fit_kwargs,
+                      "align_mode": model_base.align_mode_on_host(yb)}
+    plan_mode = fit_kwargs.get("align_mode") if fit_takes_align else None
+
     journal = None
     if checkpoint_dir is not None:
         if process_index is None:
@@ -266,6 +351,12 @@ def fit_chunked(
         committer = committer_mod.ChunkCommitter(
             journal, _commit_arrays, depth=pipeline_depth,
             probe=memory_probe.peak_memory, status_counts=status_counts)
+    # input-side pipeline: stage chunk N+1's slice while chunk N computes.
+    # Only sliced walks stage (a whole-panel chunk has no next slice), and
+    # pipeline=False stays the fully serial escape hatch for BOTH halves
+    prefetcher = None
+    if pipeline and prefetch_depth and chunk < b:
+        prefetcher = prefetcher_mod.ChunkPrefetcher(yb, depth=prefetch_depth)
     deadline = watchdog_mod.Deadline(job_budget_s)
 
     import time as _time
@@ -308,9 +399,13 @@ def fit_chunked(
     lo = 0
 
     def _record_oom(at_row: int, rows: int, e: BaseException) -> int:
-        """Shared backoff bookkeeping for fit-time and commit-time OOMs;
-        returns the halved chunk size (or raises when the budget/floor is
-        spent)."""
+        """Shared backoff bookkeeping for fit-time, staging-time, and
+        commit-time OOMs; returns the halved chunk size (or raises when
+        the budget/floor is spent).  Every staged slice is invalidated
+        first: the halved boundary makes every prefetch prediction wrong,
+        and a freed staged buffer is exactly the HBM the retry needs."""
+        if prefetcher is not None:
+            prefetcher.invalidate()
         oom_events.append({
             "at_row": at_row, "chunk_rows": rows,
             "error": f"{type(e).__name__}: {e}"[:200],
@@ -341,6 +436,28 @@ def fit_chunked(
         if tele:
             tele_chunks[:] = [r for r in tele_chunks if r["lo"] < flo]
         return flo, new_chunk
+
+    def _next_span(nlo: int, cur_chunk: int):
+        """The span the walk will visit after the current chunk — the
+        prefetcher's prediction.  Mirrors the walk's own boundary logic
+        exactly: torn-shard forced boundaries, then the committed-grid
+        clamp (a staged slice must never sail past a committed chunk's
+        ``lo``).  Returns None at the panel end or when the next span is
+        already committed (the resume path loads it from its shard — no
+        device slice needed)."""
+        if nlo >= b:
+            return None
+        if journal is not None and journal.committed(nlo) is not None:
+            return None
+        forced = lost_boundaries.get(nlo)
+        if forced:
+            return nlo, forced[0]
+        nhi = min(nlo + cur_chunk, b)
+        if journal is not None:
+            nxt = journal.next_committed_lo(nlo)
+            if nxt is not None and nxt < nhi:
+                nhi = nxt
+        return nlo, nhi
 
     def _drain_for_journal_write():
         """Synchronize with the committer before the driver itself writes
@@ -422,12 +539,44 @@ def fit_chunked(
                                          chunk_rows_after=chunk)
                 lo = hi
                 continue
-            # whole-panel chunk: hand the caller's array through untouched (a
-            # slice would be a fresh device buffer — an extra HBM copy, and a
-            # miss in the per-array-identity align-mode cache callers pre-warm)
-            vals = yb if (lo == 0 and hi == b) else yb[lo:hi]
-
-            def run_chunk(vals=vals):
+            def run_chunk(lo=lo, hi=hi, chunk=chunk):
+                # lo/hi/chunk are DEFAULT-ARG SNAPSHOTS, not closure reads:
+                # a watchdog-abandoned thread keeps running after the driver
+                # has mutated the loop variables, and it must keep operating
+                # on ITS chunk's span — never take() the live chunk's staged
+                # slice or slice a torn lo/hi pair mid-update (the pre-
+                # prefetcher code snapshotted `vals` itself for the same
+                # reason).
+                # acquire this chunk's values INSIDE the watchdog window:
+                # the whole-panel chunk hands the caller's array through
+                # untouched (a slice would be a fresh device buffer — an
+                # extra HBM copy, and a miss in the per-array-identity
+                # align-mode cache callers pre-warm); sliced chunks come
+                # from the prefetcher when the staged prediction matched.
+                # A staged slice can be queued behind an ABANDONED
+                # (timed-out) computation, so the wait on it must be
+                # bounded by the same budget as the compute it feeds — and
+                # a staging-time RESOURCE_EXHAUSTED surfaces here, through
+                # the watchdog, into the same backoff ladder as a fit-time
+                # one.
+                if lo == 0 and hi == b:
+                    vals = yb
+                elif prefetcher is not None:
+                    vals = prefetcher.take(lo, hi)
+                else:
+                    vals = yb[lo:hi]
+                if prefetcher is not None:
+                    # stage the next spans now (up to depth ahead — take()
+                    # just freed this chunk's slot), so they materialize
+                    # while this chunk computes (and, for resilient fits,
+                    # while the ladder blocks on host work)
+                    nlo = hi
+                    for _ in range(prefetcher.depth):
+                        nxt = _next_span(nlo, chunk)
+                        if nxt is None:
+                            break
+                        prefetcher.schedule(*nxt)
+                        nlo = nxt[1]
                 if resilient:
                     return resilient_fit(
                         fit_fn, vals, policy=policy, ladder=ladder,
@@ -560,8 +709,11 @@ def fit_chunked(
             # the walk is failing: stop the worker without letting a second
             # (pending) commit error mask the original exception
             committer.close(raise_pending=False)
+        if prefetcher is not None:
+            prefetcher.close()
         raise
     pipe_stats = committer.close() if committer is not None else None
+    pf_stats = prefetcher.close() if prefetcher is not None else None
 
     # parameter width for synthesized TIMEOUT rows comes from any finished
     # chunk; an all-TIMEOUT job degenerates to a single NaN column
@@ -601,22 +753,54 @@ def fit_chunked(
     }
     if journal is not None:
         meta["journal"] = journal.accounting()
-    if pipe_stats is not None:
-        hidden = pipe_stats.hidden_s
-        meta["pipeline"] = {
-            "depth": committer.depth,
-            "commits_background": pipe_stats.commits,
-            "commit_wall_s": round(pipe_stats.commit_wall_s, 6),
-            "driver_blocked_s": round(pipe_stats.blocked_s, 6),
-            "hidden_commit_s": round(hidden, 6),
-            "max_queue_depth": pipe_stats.max_queue_depth,
-            # fraction of commit wall the driver never waited for — the
-            # number the bench's journaled-vs-unjournaled pair publishes
-            "overlap_efficiency": (round(hidden / pipe_stats.commit_wall_s, 4)
-                                   if pipe_stats.commit_wall_s > 0 else None),
-        }
-        obs.gauge("committer.hidden_commit_s").set(round(hidden, 6))
-        obs.counter("committer.hidden_commit_ms").add(int(hidden * 1000))
+    if plan_mode is not None:
+        meta["align_mode"] = plan_mode
+    if pipe_stats is not None or pf_stats is not None:
+        pipe_meta = {}
+        if pipe_stats is not None:
+            hidden = pipe_stats.hidden_s
+            pipe_meta.update({
+                "depth": committer.depth,
+                "commits_background": pipe_stats.commits,
+                "commit_wall_s": round(pipe_stats.commit_wall_s, 6),
+                "driver_blocked_s": round(pipe_stats.blocked_s, 6),
+                "hidden_commit_s": round(hidden, 6),
+                "max_queue_depth": pipe_stats.max_queue_depth,
+                # fraction of commit wall the driver never waited for — the
+                # number the bench's journaled-vs-unjournaled pair publishes
+                "overlap_efficiency": (
+                    round(hidden / pipe_stats.commit_wall_s, 4)
+                    if pipe_stats.commit_wall_s > 0 else None),
+            })
+            obs.gauge("committer.hidden_commit_s").set(round(hidden, 6))
+            obs.counter("committer.hidden_commit_ms").add(int(hidden * 1000))
+        if pf_stats is not None:
+            ph = pf_stats.hidden_s
+            pipe_meta.update({
+                "prefetch_depth": prefetcher.depth,
+                "chunks_staged": pf_stats.staged,
+                "staged_hits": pf_stats.hits,
+                "staged_misses": pf_stats.misses,
+                "staged_invalidated": pf_stats.invalidated,
+                "staging_wall_s": round(pf_stats.staging_wall_s, 6),
+                "staging_blocked_s": round(pf_stats.blocked_s, 6),
+                "hidden_staging_s": round(ph, 6),
+                # fraction of input-staging wall hidden under compute
+                "input_overlap_efficiency": (
+                    round(ph / pf_stats.staging_wall_s, 4)
+                    if pf_stats.staging_wall_s > 0 else None),
+            })
+            obs.counter("prefetch.hidden_staging_ms").add(int(ph * 1000))
+        # end-to-end: of ALL the overlap-eligible wall (journal commits +
+        # input staging), the fraction the driver never waited for — the
+        # single number that says "the walk is dispatch-ahead end to end"
+        total_wall = ((pipe_stats.commit_wall_s if pipe_stats else 0.0)
+                      + (pf_stats.staging_wall_s if pf_stats else 0.0))
+        total_hidden = ((pipe_stats.hidden_s if pipe_stats else 0.0)
+                        + (pf_stats.hidden_s if pf_stats else 0.0))
+        pipe_meta["end_to_end_overlap_efficiency"] = (
+            round(total_hidden / total_wall, 4) if total_wall > 0 else None)
+        meta["pipeline"] = pipe_meta
     # ladder/sanitize accounting aggregated across chunks (resilient mode)
     rung_totals: dict = {}
     for _, _, p in pieces:
@@ -633,7 +817,20 @@ def fit_chunked(
                 obs.counter(f"fit_status.{name}").add(v)
         # summary() is None if the plane was disabled mid-run: drop the
         # block entirely rather than crash or journal a null
-        telemetry = obs.summary(counters_since=counters0, chunks=tele_chunks)
+        extra_tele = {}
+        if plan_mode is not None:
+            extra_tele["align_mode"] = plan_mode
+        if pf_stats is not None:
+            # the input-staging overlap numbers ride into the manifest so
+            # tools/advise_budget.py can suggest prefetch_depth (and the
+            # align hint) for the next run of this config
+            extra_tele["input_staging"] = {
+                k: meta["pipeline"][k] for k in (
+                    "prefetch_depth", "chunks_staged", "staged_hits",
+                    "staged_misses", "staging_wall_s", "hidden_staging_s",
+                    "input_overlap_efficiency")}
+        telemetry = obs.summary(counters_since=counters0, chunks=tele_chunks,
+                                **extra_tele)
         if telemetry is not None:
             meta["telemetry"] = telemetry
             if journal is not None:

@@ -1,0 +1,250 @@
+"""Background chunk prefetcher: stage the NEXT chunk's device slice while
+the current chunk computes.
+
+The pipelined chunk driver (PR 4) hid the journal's *output* side — host
+fetch + shard + manifest I/O run on the :class:`~.committer.ChunkCommitter`
+while the device computes the next chunk.  The *input* side still stalled
+the driver: each walk slice ``yb[lo:hi]`` is a fresh device buffer staged
+when the driver reaches the chunk, and for resilient fits (which block on
+host-side assembly per chunk) the slice of chunk N+1 could not even
+dispatch until chunk N's host work finished.  This module is the input
+half of that pipeline — the producer of a training-style input pipeline,
+mirroring the committer's design: ONE daemon worker thread that drains a
+bounded FIFO of staging requests, and for each
+
+1. dispatches the slice ``panel[lo:hi]`` (the SAME expression the serial
+   driver uses, so the compiled slice program and the resulting bytes are
+   identical), and
+2. blocks until the buffer is materialized on device
+   (``jax.block_until_ready``), so a taken slice never re-pays the copy.
+
+With the committer draining finished chunks behind the walk and the
+prefetcher staging slices ahead of it, the steady state is the full
+three-stage overlap: **stage N+1 ∥ compute N ∥ commit N−1**.
+
+**Prediction, not speculation**: the driver schedules exactly the spans
+the walk will visit next (up to ``depth`` consecutive ones, with
+committed-grid clamping, torn-shard forced boundaries, and the current
+chunk size all applied by the driver before scheduling).  When the walk deviates anyway — an OOM backoff halves the
+chunk size, a committer rollback rewinds the walk — the driver
+**invalidates** the staged slices; a ``take`` that finds no matching span
+simply slices inline (a recorded miss), so a stale prediction can cost at
+most the work it saved, never correctness: the staged buffer either IS
+``panel[lo:hi]`` for the requested span or it is not used.
+
+**Bounded depth** (``prefetch_depth``, default 1): at most ``depth``
+staged-but-untaken slices exist at any time, bounding the extra device
+memory to ``depth`` chunk buffers.  Depth 1 is the classic double buffer
+(chunk N computing, chunk N+1 staged).
+
+**Errors** never vanish into the worker: a staging failure (typically an
+XLA ``RESOURCE_EXHAUSTED`` — the slice is a fresh HBM allocation) is
+delivered at ``take`` for that span, where the chunk driver's normal
+fit-time OOM handling rolls it into the backoff ladder.
+
+**Accounting**: the worker records the staging wall per slice; ``take``
+records the driver wall spent waiting on an in-flight staging.  Their
+difference is the input-staging cost the overlap hid —
+``stats().hidden_s`` — published next to the committer's numbers as
+``meta["pipeline"]`` input-side fields and the
+``input_overlap_efficiency``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import NamedTuple
+
+import jax
+
+from .. import obs
+
+__all__ = ["ChunkPrefetcher", "PrefetchStats"]
+
+_STOP = object()
+
+
+class PrefetchStats(NamedTuple):
+    """Driver-facing accounting of one prefetcher's lifetime."""
+
+    staged: int  # slices the worker finished staging
+    hits: int  # takes served from a staged/in-flight slice
+    misses: int  # takes that had to slice inline
+    staging_wall_s: float  # total dispatch+materialize wall in the worker
+    blocked_s: float  # driver wall spent waiting in take()
+    invalidated: int  # staged/pending slices dropped by the driver
+
+    @property
+    def hidden_s(self) -> float:
+        """Staging wall the driver never waited for — hidden under the
+        previous chunk's compute (and host work)."""
+        return max(0.0, self.staging_wall_s - self.blocked_s)
+
+
+class _Slot:
+    """One staged (or in-flight) slice."""
+
+    __slots__ = ("event", "value", "error", "cancelled")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error = None
+        self.cancelled = False
+
+
+class ChunkPrefetcher:
+    """Bounded background slice stager for one chunk walk over ``panel``.
+
+    ``schedule(lo, hi)`` requests staging of ``panel[lo:hi]`` (ignored
+    when ``depth`` slices are already staged/in flight, or the span is
+    already scheduled); ``take(lo, hi)`` returns the staged buffer when
+    the prediction matched (waiting out an in-flight staging) and slices
+    inline otherwise; ``invalidate()`` drops every staged/pending slice
+    (OOM backoff / rollback re-chunked the walk).  ``close()`` stops the
+    worker and returns :class:`PrefetchStats`.
+    """
+
+    def __init__(self, panel, *, depth: int = 1):
+        self._panel = panel
+        self.depth = max(1, int(depth))
+        self._q: queue.Queue = queue.Queue()
+        self._slots: dict = {}  # (lo, hi) -> _Slot
+        self._lock = threading.Lock()
+        self._staged = 0
+        self._hits = 0
+        self._misses = 0
+        self._staging_wall_s = 0.0
+        self._blocked_s = 0.0
+        self._invalidated = 0
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name="chunk-prefetcher")
+        self._worker.start()
+
+    # -- worker side --------------------------------------------------------
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            lo, hi, slot = item
+            # drop the tuple's slice reference immediately: the worker
+            # blocks in q.get() between requests, and a lingering local
+            # would pin the previous staged buffer (= one chunk of HBM)
+            # for that whole idle stretch
+            item = None
+            if slot.cancelled:
+                slot.event.set()
+                slot = None
+                continue
+            t0 = time.perf_counter()
+            try:
+                with obs.span("stage.overlap", lo=lo, hi=hi):
+                    # the SAME slice expression the serial driver uses:
+                    # identical compiled program, identical bytes
+                    vals = self._panel[lo:hi]
+                    jax.block_until_ready(vals)
+                slot.value = vals
+                vals = None
+            except BaseException as e:  # noqa: BLE001 - re-raised at take()
+                slot.error = e
+            wall = time.perf_counter() - t0
+            with self._lock:
+                self._staging_wall_s += wall
+                if slot.error is None and not slot.cancelled:
+                    self._staged += 1
+                cancelled = slot.cancelled
+            if cancelled:
+                # invalidated mid-staging: free the buffer BEFORE signaling
+                # — invalidate() waits on this event precisely so the HBM is
+                # back when its caller (the OOM-backoff retry) dispatches
+                slot.value = None
+            obs.counter("prefetch.staged").inc()
+            slot.event.set()
+            slot = None
+
+    # -- driver side --------------------------------------------------------
+
+    def schedule(self, lo: int, hi: int) -> None:
+        """Request staging of ``panel[lo:hi]`` (bounded, idempotent)."""
+        if self._closed:
+            return
+        lo, hi = int(lo), int(hi)
+        with self._lock:
+            if (lo, hi) in self._slots or len(self._slots) >= self.depth:
+                return
+            slot = _Slot()
+            self._slots[(lo, hi)] = slot
+        self._q.put((lo, hi, slot))
+        obs.gauge("prefetch.queue_depth").set(len(self._slots))
+
+    def take(self, lo: int, hi: int):
+        """The slice for ``[lo, hi)`` — staged when predicted, inline
+        otherwise.  Also drops staged slices the walk has passed (their
+        ``lo`` is behind the requested one), so a resume-skipped span
+        cannot pin a depth slot forever.  Re-raises a staging-time error
+        (e.g. RESOURCE_EXHAUSTED) in the driver."""
+        lo, hi = int(lo), int(hi)
+        with self._lock:
+            slot = self._slots.pop((lo, hi), None)
+            stale = [k for k in self._slots if k[0] < hi]
+            for k in stale:
+                self._slots.pop(k).cancelled = True
+            self._invalidated += len(stale)
+        if slot is None:
+            with self._lock:
+                self._misses += 1
+            obs.counter("prefetch.misses").inc()
+            return self._panel[lo:hi]
+        t0 = time.perf_counter()
+        slot.event.wait()
+        blocked = time.perf_counter() - t0
+        with self._lock:
+            self._blocked_s += blocked
+            if slot.error is None:
+                self._hits += 1
+        if slot.error is not None:
+            raise slot.error
+        obs.counter("prefetch.hits").inc()
+        return slot.value
+
+    def invalidate(self) -> None:
+        """Drop every staged/pending slice — the walk re-chunked (OOM
+        backoff halved the boundary, or a committer rollback rewound it),
+        so every prediction is now wrong.  Blocks until any IN-FLIGHT
+        staging has finished and its buffer is released: the caller is
+        typically the OOM-backoff path, and a freed staged slice is
+        exactly the HBM the halved retry needs — returning while the
+        worker still holds the doomed buffer would make the retry re-OOM
+        and burn a backoff level for nothing.  The wait is bounded: the
+        worker sets every slot's event, including on a staging-time error
+        and for cancelled-before-start requests."""
+        with self._lock:
+            dropped = list(self._slots.values())
+            for slot in dropped:
+                slot.cancelled = True
+            self._invalidated += len(dropped)
+            self._slots.clear()
+        for slot in dropped:
+            slot.event.wait()
+            slot.value = None
+        obs.gauge("prefetch.queue_depth").set(0)
+
+    def close(self) -> PrefetchStats:
+        """Stop the worker, drop staged slices, and return lifetime stats."""
+        if not self._closed:
+            self._closed = True
+            self.invalidate()
+            self._q.put(_STOP)
+            self._worker.join(timeout=30.0)
+        return self.stats()
+
+    def stats(self) -> PrefetchStats:
+        with self._lock:
+            return PrefetchStats(self._staged, self._hits, self._misses,
+                                 self._staging_wall_s, self._blocked_s,
+                                 self._invalidated)

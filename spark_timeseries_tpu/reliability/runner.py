@@ -156,6 +156,13 @@ def resilient_fit(
     recorded in ``meta`` either way), pass a custom ``ladder`` without the
     scan rung, or ``ladder=()`` to disable retries entirely.
 
+    An ``align_mode=`` entry in ``fit_kwargs`` (the chunk driver's static
+    alignment plan) is forwarded to ``fit_fn`` only when its signature
+    accepts it, and is downgraded to ``"general"`` whenever the sanitizer
+    actually repaired or excluded rows — the repairs change the panel's
+    NaN pattern, so a stronger panel-level claim may no longer hold on
+    the cleaned values.
+
     Healthy rows are fitted bit-identically to a direct ``fit_fn`` call on
     the SANITIZED panel: the ladder only ever re-fits the failed subset,
     scattering recovered rows back without touching their neighbors.  (A
@@ -169,6 +176,15 @@ def resilient_fit(
         yb = yb[None, :]
     b = yb.shape[0]
 
+    # static align-mode hint (the chunk driver's per-walk plan): held back
+    # from the fit until the sanitizer has run — repairs and exclusions
+    # CHANGE the panel's NaN pattern (imputed gaps, inf->NaN edges, rows
+    # NaN-ed out), so a panel-level "dense"/"no-trailing" claim may no
+    # longer hold on the cleaned values.  Untouched chunks keep the fast
+    # plan; touched chunks downgrade to the always-correct "general" path
+    # (deterministic per chunk content, so journaled resumes reproduce it)
+    align_hint = fit_kwargs.pop("align_mode", None)
+
     if sanitize:
         rep = _sanitize(yb, policy=policy)
         y_clean, status, san_meta = rep.values, rep.status.copy(), rep.meta
@@ -176,6 +192,11 @@ def resilient_fit(
         y_clean = yb
         status = np.zeros(b, STATUS_DTYPE)
         san_meta = {"policy": "off"}
+    if align_hint is not None:
+        if san_meta.get("rows_sanitized") or san_meta.get("rows_excluded"):
+            align_hint = "general"
+        if "align_mode" in _accepted_kwargs(fit_fn, {"align_mode": None}):
+            fit_kwargs = {**fit_kwargs, "align_mode": align_hint}
 
     with obs.span("fit.primary", rows=b):
         res = fit_fn(y_clean, **fit_kwargs)

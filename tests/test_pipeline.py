@@ -346,10 +346,20 @@ class TestOverlapAccounting:
         assert p["hidden_commit_s"] <= p["commit_wall_s"] + 1e-9
         if p["overlap_efficiency"] is not None:
             assert 0.0 <= p["overlap_efficiency"] <= 1.0
-        # unjournaled and serial walks carry no pipeline accounting
-        assert "pipeline" not in _fit(y).meta
+        # the input side rides in the same block (ISSUE 5)
+        assert p["prefetch_depth"] == 1
+        assert p["hidden_staging_s"] <= p["staging_wall_s"] + 1e-9
+        # an unjournaled pipelined walk carries ONLY the input-staging
+        # accounting (no committer ran); the serial walk carries none
+        up = _fit(y).meta["pipeline"]
+        assert "commits_background" not in up
+        assert up["chunks_staged"] + up["staged_misses"] >= 4 - 1
         assert "pipeline" not in _fit(y, str(tmp_path / "s"),
                                       pipeline=False).meta
+        # prefetch_depth=0 disables staging without touching the committer
+        r0 = _fit(y, str(tmp_path / "z"), prefetch_depth=0)
+        assert "chunks_staged" not in r0.meta["pipeline"]
+        assert r0.meta["pipeline"]["commits_background"] == 4
 
     def test_committer_metrics_registered(self, tmp_path):
         obs.enable()

@@ -19,7 +19,16 @@ tool reads one manifest and prints suggested
 - ``pipeline_depth``  — enough in-flight commits to keep the device busy:
                         commit latency divided by steady-state execute
                         wall, +1 (clamped to [1, 8] — past that the queue
-                        only buys crash-loss, not overlap).
+                        only buys crash-loss, not overlap);
+- ``prefetch_depth``  — enough staged input slices to never block on the
+                        copy: mean slice-staging wall divided by execute
+                        wall, clamped to [1, 4] (each extra slot pins one
+                        more chunk of HBM, so depth stays at the measured
+                        need — 1, the classic double buffer, when staging
+                        already hides);
+- ``align_mode``      — the walk's recorded static alignment plan, so the
+                        next run passes the hint and skips even the one
+                        per-walk NaN-probe host sync.
 
     python tools/advise_budget.py CHECKPOINT_DIR [--json]
 
@@ -104,6 +113,20 @@ def advise(m: dict) -> dict:
     if commit_mean and exec_mean and exec_mean > 0:
         pipeline_depth = max(1, min(8, math.ceil(commit_mean / exec_mean) + 1))
 
+    # -- prefetch_depth: hide input staging under execute wall ---------------
+    # the manifest's telemetry block records the walk's input-staging
+    # accounting (reliability.prefetcher) and the static align-mode plan;
+    # a run without them (prefetch disabled, pre-ISSUE-5 journal) keeps the
+    # driver default and suggests no hint
+    staging = tele.get("input_staging") or {}
+    align_mode = tele.get("align_mode")
+    prefetch_depth = 1  # the driver default: the classic double buffer
+    staged = staging.get("chunks_staged") or 0
+    staging_mean = ((staging.get("staging_wall_s") or 0.0) / staged
+                    if staged else None)
+    if staging_mean and exec_mean and exec_mean > 0:
+        prefetch_depth = max(1, min(4, math.ceil(staging_mean / exec_mean)))
+
     return {
         "config_hash": m.get("config_hash"),
         "panel_fingerprint": m.get("panel_fingerprint"),
@@ -121,12 +144,19 @@ def advise(m: dict) -> dict:
                                    if compile_walls else None),
             "commit_s_mean": commit_mean,
             "commit_s_max": commit.get("max"),
+            "staging_wall_s_mean": (round(staging_mean, 4)
+                                    if staging_mean is not None else None),
+            "input_overlap_efficiency":
+                staging.get("input_overlap_efficiency"),
+            "align_mode": align_mode,
         },
         "suggest": {
             "chunk_rows": chunk_rows,
             "chunk_budget_s": chunk_budget_s,
             "job_budget_s": job_budget_s,
             "pipeline_depth": pipeline_depth,
+            "prefetch_depth": prefetch_depth,
+            "align_mode": align_mode,
         },
     }
 
@@ -162,11 +192,18 @@ def main():
     if o["commit_s_mean"] is not None:
         print(f"  journal commit: mean {o['commit_s_mean']}s "
               f"max {o['commit_s_max']}s")
+    if o["staging_wall_s_mean"] is not None:
+        print(f"  input staging: mean {o['staging_wall_s_mean']}s/slice"
+              + (f", overlap {o['input_overlap_efficiency']}"
+                 if o["input_overlap_efficiency"] is not None else ""))
     print("  suggest for the next run of this config hash:")
     print(f"    chunk_rows     = {s['chunk_rows']}")
     print(f"    chunk_budget_s = {s['chunk_budget_s']}")
     print(f"    job_budget_s   = {s['job_budget_s']}")
     print(f"    pipeline_depth = {s['pipeline_depth']}")
+    print(f"    prefetch_depth = {s['prefetch_depth']}")
+    if s["align_mode"] is not None:
+        print(f"    align_mode     = {s['align_mode']!r}")
 
 
 if __name__ == "__main__":

@@ -122,6 +122,22 @@ def validate_manifest_telemetry(ckpt_dir: str) -> list:
     if pm.get("source") not in MEM_SOURCES:
         errors.append(f"telemetry.peak_memory.source invalid: "
                       f"{pm.get('source')!r}")
+    # input-staging block (ISSUE 5): optional — serial/unprefetched walks
+    # journal none — but when present it must be well-formed, since
+    # tools/advise_budget.py derives prefetch_depth from it
+    st = t.get("input_staging")
+    if st is not None:
+        if not isinstance(st, dict):
+            errors.append(f"telemetry.input_staging not a dict: {st!r}")
+        else:
+            for k in ("chunks_staged", "staged_hits", "staged_misses"):
+                if not isinstance(st.get(k), int):
+                    errors.append(f"telemetry.input_staging.{k} invalid: "
+                                  f"{st.get(k)!r}")
+            for k in ("staging_wall_s", "hidden_staging_s"):
+                if not isinstance(st.get(k), (int, float)):
+                    errors.append(f"telemetry.input_staging.{k} invalid: "
+                                  f"{st.get(k)!r}")
     return errors
 
 
