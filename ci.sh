@@ -145,7 +145,55 @@ python tools/inspect_journal.py "$OBS_SMOKE_DIR/journal" \
   || { echo "ci.sh: inspect_journal did not print the telemetry summary" >&2; exit 1; }
 rm -rf "$OBS_SMOKE_DIR"
 
-# the driver's multi-chip artifact, same environment
+# sharded kill-and-resume smoke (ISSUE 6): a journaled SHARDED walk (8
+# forced CPU devices, one prefetch->compute->commit lane per device) is
+# SIGKILLed mid-job with several lanes in flight, resumed, and the resumed
+# result must be BITWISE-identical to an uninterrupted sharded run AND to
+# the single-device walk of the same panel, with exactly ONE merged job
+# manifest (written by shard/process 0) accounting for every chunk
+python tests/_sharded_worker.py --smoke
+
+# sharded tooling smoke (ISSUE 6): a short journaled sharded walk with
+# telemetry on must produce a merged manifest whose `shards` block passes
+# the obs_report schema gate, render one timeline lane per shard, and give
+# the budget advisor enough to suggest the shard count for the next run
+SHARDED_SMOKE_DIR=$(python - <<'EOF'
+import json, os, tempfile
+import numpy as np
+from spark_timeseries_tpu import obs
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.models import arima
+
+root = tempfile.mkdtemp(prefix="sharded_smoke_")
+rng = np.random.default_rng(0)
+y = np.cumsum(rng.normal(size=(32, 96)).astype(np.float32), axis=1)
+obs.enable(os.path.join(root, "events.jsonl"))
+res = rel.fit_chunked(arima.fit, y, chunk_rows=2, resilient=False,
+                      order=(1, 0, 0), max_iters=15, shard=True,
+                      checkpoint_dir=os.path.join(root, "journal"))
+obs.disable()
+assert res.meta["shards"]["n_shards"] == 8, res.meta["shards"]
+m = json.load(open(os.path.join(root, "journal", "manifest.json")))
+assert m["merged_from_shards"] == 8 and len(m["shards"]) == 8
+assert all(c.get("shard_id") is not None for c in m["chunks"])
+# per-lane overlap is a journaled fact, not just an in-memory meta dict
+assert len(m["telemetry"]["shards_pipeline"]) == 8, \
+    m["telemetry"].get("shards_pipeline")
+print(root)
+EOF
+)
+python tools/obs_report.py --check "$SHARDED_SMOKE_DIR/events.jsonl" \
+  --manifest "$SHARDED_SMOKE_DIR/journal"
+python tools/obs_report.py "$SHARDED_SMOKE_DIR/events.jsonl" \
+  | grep -q "sharded lanes" \
+  || { echo "ci.sh: obs_report did not render per-shard lanes" >&2; exit 1; }
+python tools/advise_budget.py "$SHARDED_SMOKE_DIR/journal" \
+  | grep -q "shards         =" \
+  || { echo "ci.sh: advise_budget did not suggest a shard count" >&2; exit 1; }
+rm -rf "$SHARDED_SMOKE_DIR"
+
+# the driver's multi-chip artifact, same environment (now includes the
+# sharded journaled chunk walk next to the SPMD mesh paths)
 python - <<'EOF'
 import __graft_entry__ as g
 g.dryrun_multichip(8)

@@ -265,7 +265,7 @@ def _require_checkpoint_dir(durable_kwargs: dict) -> None:
 def _durable_fit(fit_fn, ts, checkpoint_dir, *, chunk_rows=None,
                  chunk_budget_s=None, job_budget_s=None, resume="auto",
                  pipeline=True, pipeline_depth=2, prefetch_depth=1,
-                 align_mode=None):
+                 align_mode=None, shard=False, mesh=None):
     """Route a compat fit through the journaled chunk driver.
 
     The upstream Python API ran fits inside Spark tasks, whose lineage
@@ -285,6 +285,10 @@ def _durable_fit(fit_fn, ts, checkpoint_dir, *, chunk_rows=None,
     device slice while the current one computes, and ``align_mode=``
     pre-supplies the walk's static alignment plan
     (``reliability.fit_chunked`` / ``models.base.resolve_align_mode``).
+    ``shard=True`` (or ``mesh=``) scales the walk across the device mesh
+    — one journaled lane per series-axis device, bitwise-identical to
+    the single-device walk (``reliability.fit_chunked`` sharded
+    execution).
     """
     from .. import reliability as rel
 
@@ -297,6 +301,7 @@ def _durable_fit(fit_fn, ts, checkpoint_dir, *, chunk_rows=None,
         chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
         pipeline=pipeline, pipeline_depth=pipeline_depth,
         prefetch_depth=prefetch_depth, align_mode=align_mode,
+        shard=shard, mesh=mesh,
     )
     params = jnp.asarray(res.params)
     return params[0] if single else params

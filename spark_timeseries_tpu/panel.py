@@ -358,6 +358,7 @@ class TimeSeriesPanel:
             job_budget_s: Optional[float] = None,
             pipeline: bool = True, pipeline_depth: int = 2,
             prefetch_depth: int = 1, align_mode: Optional[str] = None,
+            shard: bool = False, mesh=None,
             **fit_kwargs):
         """Fit a model family over every series via the resilient chunk driver.
 
@@ -395,6 +396,15 @@ class TimeSeriesPanel:
         stage ∥ compute ∥ commit, still bitwise-identical to the serial
         walk.
 
+        ``shard=True`` (or an explicit ``mesh=``) scales the whole walk
+        across the device mesh: one journaled prefetch → compute → commit
+        lane per series-axis device, bitwise-identical to the
+        single-device walk on the same panel, with shard/process 0
+        merging the per-shard journals into one job manifest (see
+        ``reliability.fit_chunked`` sharded execution).  Note this is the
+        chunk DRIVER's mesh knob, independent of the panel's own
+        ``mesh``-attached SPMD fit path.
+
         Returns a ``reliability.ResilientFitResult`` whose rows align with
         ``self.keys``; ``.status`` carries per-series ``FitStatus`` codes
         and ``.meta`` the chunk/ladder/journal accounting.  This is the
@@ -423,6 +433,7 @@ class TimeSeriesPanel:
                 chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
                 pipeline=pipeline, pipeline_depth=pipeline_depth,
                 prefetch_depth=prefetch_depth, align_mode=align_mode,
+                shard=shard, mesh=mesh,
                 **fit_kwargs,
             )
 

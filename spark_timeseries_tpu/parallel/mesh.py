@@ -18,6 +18,25 @@ Sequence-sharding (the optional ``"time"`` axis) is provided for very long
 series: reductions over time decompose into per-shard partials + ``psum``,
 and scans hand carries across shards via ``ppermute`` (see
 ``ops/seqparallel.py``).
+
+**Who uses what** (reconciled with the driver, ISSUE 6): two distinct
+consumers ride this module.  *SPMD fits* (``panel.fit_*`` over a
+mesh-attached panel, ``ops/seqparallel.py``) place ONE global array with
+:func:`series_sharding` and let XLA partition one program across the
+mesh.  The *durable chunk driver* (``reliability.fit_chunked(shard=True)``
+/ ``mesh=``) instead runs one prefetch→compute→commit LANE per
+series-axis device: :func:`lane_values` hands each lane its
+device-resident block of rows — via a single
+``NamedSharding(mesh, P("series", None))`` placement when the lane spans
+are the even split, per-device ``device_put`` otherwise — and the lane
+spans come from ``reliability.plan.shard_spans``, which partitions the
+CHUNK GRID (whole chunks per shard, the same "a series is never split
+across chips" invariant, coarsened to chunks) so the sharded walk visits
+exactly the single-device walk's chunk boundaries and stays
+bitwise-identical to it.  Under ``jax.distributed`` build the global
+panel with :func:`distribute_panel`
+(``jax.make_array_from_process_local_data``); each process then runs the
+lanes of its own addressable shards.
 """
 
 from __future__ import annotations
@@ -98,6 +117,129 @@ def shard_series(values: jax.Array, mesh: Optional[Mesh]) -> jax.Array:
     with obs.span("mesh.shard_series", keys=int(values.shape[0]),
                   devices=int(np.prod(list(mesh.shape.values())))):
         return jax.device_put(values, series_sharding(mesh))
+
+
+def series_devices(mesh: Mesh) -> list:
+    """The devices along the series axis, in shard order — the lane owners
+    of a sharded chunk walk (one lane per entry).
+
+    The sharded DRIVER is 1-D by design: each lane runs a whole fit
+    program on one device (time replicated), so a 2-D ``(series, time)``
+    mesh — whose time axis belongs to the SPMD sequence-parallel kernels,
+    not the chunk walk — is rejected rather than silently collapsed.
+    """
+    if TIME_AXIS in mesh.axis_names and mesh.shape[TIME_AXIS] > 1:
+        raise ValueError(
+            "the sharded chunk walk needs a 1-D (series,) mesh; "
+            "time-sharding belongs to the SPMD fit path (ops/seqparallel), "
+            f"got axes {mesh.axis_names} with shape {dict(mesh.shape)}")
+    return list(mesh.devices.flat)
+
+
+def distribute_panel(local_rows, mesh: Mesh) -> jax.Array:
+    """Build the GLOBAL ``[keys, time]`` panel from this process's local
+    rows — the multi-host ingest step of a sharded chunk walk.
+
+    Single-process this is just the series-sharded placement; under
+    ``jax.distributed`` it is ``jax.make_array_from_process_local_data``:
+    every process contributes the rows it holds, and the returned global
+    array's addressable shards are exactly the lanes this process will
+    run (``reliability.fit_chunked(..., mesh=mesh)``).
+    """
+    sharding = series_sharding(mesh)
+    if jax.process_count() <= 1:
+        return jax.device_put(jax.numpy.asarray(local_rows), sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(local_rows))
+
+
+def lane_values(values, mesh: Mesh, spans) -> list:
+    """Place each lane's row block on its series-axis device.
+
+    ``spans`` is the chunk-grid partition from
+    ``reliability.plan.shard_spans`` (ascending, contiguous, covering the
+    panel).  Returns ``[(shard_id, lo, hi, device, lane_array), ...]`` for
+    the lanes THIS process runs; each ``lane_array`` holds rows
+    ``[lo, hi)`` resident on ``device``.
+
+    Placement strategy, in order:
+
+    - ``values`` is already a multi-process global array (built with
+      :func:`distribute_panel`): the lanes ARE its addressable shards —
+      zero data movement, but the sharding's split must match ``spans``
+      (chunk-grid-aligned), else the caller must repartition.
+    - the spans are the even split of the panel over all mesh devices
+      (the north-star layout): ONE ``NamedSharding`` placement of the
+      whole panel, lanes read from its addressable shards.
+    - otherwise: one ``device_put`` of each span's slice to its device
+      (uneven tails, fewer chunks than devices).
+
+    Either way the lane bytes are exactly ``values[lo:hi]`` — the
+    placement moves data, never changes it.
+    """
+    devs = series_devices(mesh)
+    spans = [(int(lo), int(hi)) for lo, hi in spans]
+    if len(spans) > len(devs):
+        raise ValueError(
+            f"{len(spans)} lane spans but only {len(devs)} series devices")
+    pidx = jax.process_index()
+    out = []
+    if isinstance(values, jax.Array) and not values.is_fully_addressable:
+        by_row = {}
+        for s in values.addressable_shards:
+            by_row[int(s.index[0].start or 0)] = s
+        claimed = set()
+        for i, (lo, hi) in enumerate(spans):
+            s = by_row.get(lo)
+            if s is None:
+                continue  # another process's lane
+            if int(s.data.shape[0]) != hi - lo:
+                raise ValueError(
+                    f"global panel shard at row {lo} holds "
+                    f"{int(s.data.shape[0])} rows but the chunk-grid lane "
+                    f"wants {hi - lo}; choose chunk_rows so the chunk grid "
+                    "matches the even device split (or repartition with "
+                    "distribute_panel)")
+            claimed.add(lo)
+            out.append((i, lo, hi, list(s.data.devices())[0], s.data))
+        # a local shard NO span starts at would silently compute nothing —
+        # on a process where no shard start hits a span lo, the size check
+        # above never fires, so the misalignment must be caught here
+        unclaimed = sorted(set(by_row) - claimed)
+        if unclaimed:
+            raise ValueError(
+                f"global panel shards starting at rows {unclaimed} are not "
+                "claimed by any chunk-grid lane span; choose chunk_rows so "
+                "shard boundaries land on the chunk grid (or repartition "
+                "with distribute_panel)")
+        return out
+    n_rows = int(values.shape[0])
+    sizes = {hi - lo for lo, hi in spans}
+    even = (len(spans) == len(devs) and len(sizes) == 1
+            and n_rows % len(devs) == 0
+            and all(d.process_index == pidx for d in devs))
+    with obs_span("mesh.shard_lanes", keys=n_rows, lanes=len(spans),
+                  devices=len(devs)):
+        if even:
+            g = jax.device_put(values, series_sharding(mesh))
+            shards = sorted(g.addressable_shards,
+                            key=lambda s: int(s.index[0].start or 0))
+            for i, ((lo, hi), s) in enumerate(zip(spans, shards)):
+                out.append((i, lo, hi, list(s.data.devices())[0], s.data))
+        else:
+            for i, (lo, hi) in enumerate(spans):
+                d = devs[i]
+                if d.process_index != pidx:
+                    continue
+                out.append((i, lo, hi, d, jax.device_put(values[lo:hi], d)))
+    return out
+
+
+def obs_span(name, **attrs):
+    """Lazy obs import (parallel must stay importable before obs)."""
+    from .. import obs
+
+    return obs.span(name, **attrs)
 
 
 @functools.lru_cache(maxsize=None)

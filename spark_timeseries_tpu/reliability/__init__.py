@@ -14,6 +14,12 @@ same guarantees at row granularity:
   backend) before any row is marked ``DIVERGED``.
 - :mod:`.chunked` — :func:`fit_chunked`: chunked execution with bounded
   ``RESOURCE_EXHAUSTED`` backoff and degradation recorded in metadata.
+- :mod:`.plan` — :class:`ExecutionPlan` / :class:`LaneRunner`: the walk's
+  configuration as data (spans, lanes, budgets) and the per-lane
+  scheduler that owns one prefetch → compute → commit pipeline; the
+  serial, pipelined, and mesh-sharded walks are all the same plan with
+  one-vs-many lanes (``fit_chunked(shard=True)`` runs one lane per mesh
+  device, bitwise-identical to the single-device walk).
 - :mod:`.committer` — :class:`ChunkCommitter`: the pipelined driver's
   bounded background commit thread — journal commits and host I/O overlap
   the next chunk's device compute while preserving the journal's
@@ -34,13 +40,15 @@ same guarantees at row granularity:
   torn manifests) so every recovery path runs in tier-1 CPU tests.
 """
 
-from . import (chunked, committer, faultinject, journal, prefetcher, runner,
-               sanitize, status, watchdog)
+from . import (chunked, committer, faultinject, journal, plan, prefetcher,
+               runner, sanitize, status, watchdog)
 from .chunked import OOMBackoffExceeded, fit_chunked, is_resource_exhausted
 from .committer import ChunkCommitter, CommitterStats
+from .plan import ExecutionPlan, LaneRunner, LaneSpec, shard_spans
 from .prefetcher import ChunkPrefetcher, PrefetchStats
 from .journal import (ChunkJournal, JournalError, StaleJournalError,
-                      TornManifestError, config_hash, panel_fingerprint)
+                      TornManifestError, config_hash, merge_job_manifest,
+                      panel_fingerprint)
 from .runner import (ResilientFitResult, RetryRung, default_ladder,
                      resilient_fit)
 from .sanitize import SanitizeReport, sanitize
@@ -55,8 +63,11 @@ __all__ = [
     "PrefetchStats",
     "Deadline",
     "DeadlineExceeded",
+    "ExecutionPlan",
     "FitStatus",
     "JournalError",
+    "LaneRunner",
+    "LaneSpec",
     "OOMBackoffExceeded",
     "ResilientFitResult",
     "RetryRung",
@@ -72,9 +83,12 @@ __all__ = [
     "fit_chunked",
     "is_resource_exhausted",
     "journal",
+    "merge_job_manifest",
     "merge_status",
     "panel_fingerprint",
+    "plan",
     "prefetcher",
+    "shard_spans",
     "resilient_fit",
     "runner",
     "sanitize",

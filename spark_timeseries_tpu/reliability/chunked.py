@@ -27,37 +27,38 @@ for free and a single Python process does not:
   per-row status counts instead of hanging past its SLO, and a later
   resume retries only the TIMEOUT/pending chunks.
 
-**Pipelined execution** (``pipeline=True``, the default): the serial walk
-paid the full journal-commit latency — host fetch, npz shard, fsync,
-manifest rewrite — between every two chunk dispatches, idling the device
-for all of it.  Spark never did: per-partition compute pipelined with
-shuffle/persist I/O under lazy RDD execution (PAPER.md §3).  The rebuild
-of that overlap: finished chunks are handed to a bounded background
-committer (:class:`~.committer.ChunkCommitter`, at most ``pipeline_depth``
-commits in flight) that preserves the journal's single-writer,
-shard-before-manifest, in-order protocol, while the driver thread is
-already slicing and dispatching the next chunk — and, for non-resilient
-fits, JAX async dispatch lets that dispatch land while the previous
-chunk's device computation is still in flight.  Results are
-bitwise-identical to ``pipeline=False`` (same chunk boundaries, same
-compiled programs, same bytes — only where the host fetch and disk I/O
-happen moves), a crash with commits in flight resumes exactly like a
-serial crash (in-order commits: everything after the first in-flight
-commit recomputes), and the OOM-backoff/watchdog paths drain the queue
-deterministically before touching the journal.  ``meta["pipeline"]``
-reports how much commit wall time the overlap hid.
-
-**Dispatch-ahead input** (ISSUE 5) closes the other half: a static
-align-mode plan (computed once per walk, threaded into every chunk fit)
-removes the per-chunk NaN-probe host sync, and a bounded background
+**Pipelined execution** (``pipeline=True``, the default): finished chunks
+are handed to a bounded background committer
+(:class:`~.committer.ChunkCommitter`) that preserves the journal's
+single-writer, shard-before-manifest, in-order protocol while the driver
+thread is already slicing and dispatching the next chunk; a background
 :class:`~.prefetcher.ChunkPrefetcher` stages chunk N+1's device slice
-while chunk N computes — the steady state is stage N+1 ∥ compute N ∥
-commit N−1, with the input-side overlap accounted next to the commit-side
-numbers in ``meta["pipeline"]``.
+while chunk N computes, under a static align-mode plan computed once per
+walk.  The steady state is stage N+1 ∥ compute N ∥ commit N−1, results
+are bitwise-identical to ``pipeline=False``, and ``meta["pipeline"]``
+reports how much commit and staging wall the overlap hid.
+
+**Sharded execution** (ISSUE 6): everything above ran on ONE device.  With
+``shard=True`` (or an explicit ``mesh=``) the walk's configuration is
+compiled into an :class:`~.plan.ExecutionPlan` whose lanes partition the
+CHUNK GRID contiguously across the mesh's series-axis devices, and one
+:class:`~.plan.LaneRunner` per shard — each with its own journal
+namespace, committer, and prefetcher — walks its span concurrently while
+the job deadline and the obs registry stay shared.  Shard boundaries
+always land on the single-device walk's chunk boundaries, so the sharded
+result is bitwise-identical to the single-device walk on the same panel;
+shard/process 0 merges the per-shard manifests into ONE job manifest
+(``journal.merge_job_manifest``) and ONE shard-tagged telemetry timeline,
+and a crash/preemption resume replays only the shards/chunks that did not
+commit.  Under ``jax.distributed`` each process runs the lanes of its own
+addressable shards (build the global panel with
+``parallel.mesh.distribute_panel``) and returns its local rows.
 """
 
 from __future__ import annotations
 
+import os
+import threading
 from typing import Callable, Optional
 
 import jax
@@ -65,78 +66,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
-from ..obs import memory as memory_probe
-from . import committer as committer_mod
 from . import journal as journal_mod
-from . import prefetcher as prefetcher_mod
+from . import plan as plan_mod
 from . import watchdog as watchdog_mod
-from .runner import ResilientFitResult, _accepted_kwargs, resilient_fit
+from .plan import (ExecutionPlan, LaneRunner, LaneSpec, OOMBackoffExceeded,
+                   _TimeoutChunk, _piece_status, is_resource_exhausted)
+from .runner import ResilientFitResult, _accepted_kwargs
 from .status import STATUS_DTYPE, FitStatus, status_counts
 
 __all__ = ["OOMBackoffExceeded", "is_resource_exhausted", "fit_chunked"]
-
-# substrings the XLA runtime uses for allocation failure; the simulated OOM
-# of reliability.faultinject raises with the same marker so tier-1 CPU tests
-# drive this path without a real HBM exhaustion
-_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
-
-
-class OOMBackoffExceeded(RuntimeError):
-    """Raised when the minimum chunk size still exhausts device memory."""
-
-
-def is_resource_exhausted(e: BaseException) -> bool:
-    """True for XLA RESOURCE_EXHAUSTED-style allocation failures.
-
-    ``jaxlib``'s ``XlaRuntimeError`` subclasses ``RuntimeError``, so the
-    check is message-based on RuntimeError/MemoryError rather than pinned
-    to a jaxlib exception type that moves between releases.
-    """
-    if isinstance(e, MemoryError):
-        return True
-    if not isinstance(e, RuntimeError):
-        return False
-    msg = str(e)
-    return any(m in msg for m in _OOM_MARKERS)
-
-
-def _span_times(sp) -> dict:
-    """Wall/process times of a closed chunk span, or ``{}`` when the plane
-    was disabled mid-run (the span degraded to the shared no-op whose
-    times are None — telemetry may lose a row's timings but must never
-    crash the fit it observes)."""
-    if sp.wall_s is None:
-        return {}
-    out = {"wall_s": round(sp.wall_s, 6)}
-    if sp.process_s is not None:
-        out["process_s"] = round(sp.process_s, 6)
-    return out
-
-
-class _TimeoutChunk:
-    """Placeholder for a chunk whose fit never finished; materialized into
-    NaN-param / ``TIMEOUT``-status rows once the parameter width is known
-    (from any finished chunk) at assembly time."""
-
-    __slots__ = ("lo", "hi")
-
-    def __init__(self, lo: int, hi: int):
-        self.lo, self.hi = lo, hi
-
-
-def _commit_arrays(piece) -> dict:
-    """Host-side arrays of one finished chunk, in the journal shard schema.
-
-    Under the pipelined driver this runs on the committer thread, so for
-    non-resilient fits the device->host fetch itself overlaps the next
-    chunk's device compute."""
-    return {
-        "params": np.asarray(piece.params),
-        "nll": np.asarray(piece.neg_log_likelihood),
-        "converged": np.asarray(piece.converged),
-        "iters": np.asarray(piece.iters),
-        "status": _piece_status(piece),
-    }
 
 
 @obs.dump_on_failure("fit_chunked")
@@ -158,6 +96,8 @@ def fit_chunked(
     pipeline_depth: int = 2,
     prefetch_depth: int = 1,
     align_mode: Optional[str] = None,
+    mesh=None,
+    shard: bool = False,
     process_index: Optional[int] = None,
     journal_extra: Optional[dict] = None,
     _journal_commit_hook=None,
@@ -170,8 +110,8 @@ def fit_chunked(
     called directly and per-row status comes from the model's own status
     output.  On a ``RESOURCE_EXHAUSTED`` failure the chunk size halves
     (never below ``min_chunk_rows``) and the chunk is retried, at most
-    ``max_backoffs`` times across the whole run; exhausting the budget (or
-    OOMing at the floor) raises :class:`OOMBackoffExceeded`.
+    ``max_backoffs`` times per lane; exhausting the budget (or OOMing at
+    the floor) raises :class:`OOMBackoffExceeded`.
 
     **Durability** (``checkpoint_dir=``): finished chunks are committed to
     a write-ahead journal (:class:`~.journal.ChunkJournal`) — npz shard
@@ -188,10 +128,10 @@ def fit_chunked(
     and a different job must claim a fresh directory (or the operator
     removes the old one explicitly).  ``resume="never"`` reruns the same
     job from scratch, ignoring its committed chunks; ``"require"`` demands
-    a resumable manifest.  Under
-    ``jax.distributed`` every process journals into its own namespace and
-    only process 0 commits the job-level ``manifest.json``
-    (``process_index`` defaults to ``jax.process_index()``).
+    a resumable manifest.  Under ``jax.distributed`` every process
+    journals into its own namespace and only process 0 commits the
+    job-level ``manifest.json`` (``process_index`` defaults to
+    ``jax.process_index()``).
 
     **Pipelining** (``pipeline=True``, default): with a journal attached,
     the host fetch + shard write + manifest update of a finished chunk run
@@ -242,16 +182,42 @@ def fit_chunked(
     change a chunk's NaN pattern.  ``meta["align_mode"]`` records the
     plan.
 
+    **Sharded execution** (``shard=True`` or ``mesh=``): the chunk grid is
+    partitioned contiguously across the mesh's series-axis devices
+    (:func:`~.plan.shard_spans` — every shard owns whole chunks, so shard
+    boundaries ARE single-device chunk boundaries) and one
+    :class:`~.plan.LaneRunner` per shard walks its span concurrently,
+    each with its own prefetch → compute → commit pipeline over its
+    device-resident slice (``parallel.mesh.lane_values`` places the
+    panel, using one ``NamedSharding(mesh, P("series", None))`` placement
+    when the spans are the even split).  The sharded result is
+    bitwise-identical to the single-device walk on the same panel.  With
+    ``shard=True`` and no ``chunk_rows``, each shard gets one chunk.
+    Journaled sharded walks commit into per-shard namespaces
+    (``shard_00000/…``) and shard/process 0 merges them into ONE
+    ``manifest.json`` (with a ``shards`` block and shard-tagged telemetry
+    timeline) after the lanes join; a resume rebuilds the same lanes
+    (same mesh/shard count — a changed shard layout is rejected as stale)
+    and replays only uncommitted chunks, and the merged manifest can even
+    be adopted by a later SINGLE-device walk of the same job (plan knobs
+    are excluded from the config hash).  ``meta["shards"]`` records the
+    lane layout; ``meta["pipeline"]`` aggregates the lanes and reports
+    per-shard overlap in ``meta["pipeline"]["shards"]``.  Under
+    ``jax.distributed`` each process runs the lanes of its addressable
+    shards and returns its LOCAL rows (build the global panel with
+    ``parallel.mesh.distribute_panel``).
+
     **Deadlines**: ``chunk_budget_s`` bounds each chunk's fit (overrun ->
     rows flagged ``TIMEOUT``, walk continues — the compiled computation is
     abandoned, not cancelled; with the budget armed, non-resilient fits
     block on device completion inside the watchdog window so the budget
     covers compute, not just async dispatch); ``job_budget_s`` bounds the
     whole walk (once spent, remaining chunks are marked TIMEOUT without
-    dispatch).  Both paths drain the commit queue before touching the
-    journal, so the TIMEOUT mark always lands after every earlier commit.
-    Partial results always carry exact status counts, and TIMEOUT chunks
-    are retried on a journaled resume.
+    dispatch — the deadline is shared by every lane).  Both paths drain
+    the commit queue before touching the journal, so the TIMEOUT mark
+    always lands after every earlier commit.  Partial results always
+    carry exact status counts, and TIMEOUT chunks are retried on a
+    journaled resume.
 
     ``meta`` records ``chunk_rows_initial`` / ``chunk_rows_final``, every
     backoff and timeout event, ``degraded=True`` whenever a backoff or
@@ -265,26 +231,68 @@ def fit_chunked(
     metrics registry; the committer reports a ``committer.queue_depth``
     gauge, per-commit ``commit.overlap`` spans, and a
     ``committer.hidden_commit_ms`` counter; and the per-run summary —
-    per-chunk span times, counters, peak memory (never null: host-RSS
-    fallback) — lands in ``meta["telemetry"]`` and, when journaled, the
-    manifest's ``telemetry`` block.  Disabled (the default), none of this
-    runs and the result is bitwise-identical to the uninstrumented driver.
+    per-chunk span times (shard-tagged under a sharded plan), counters,
+    peak memory (never null: host-RSS fallback) — lands in
+    ``meta["telemetry"]`` and, when journaled, the manifest's
+    ``telemetry`` block.  Disabled (the default), none of this runs and
+    the result is bitwise-identical to the uninstrumented driver.
     """
     yb = jnp.asarray(y)
     if yb.ndim != 2:
         raise ValueError(f"fit_chunked expects [batch, time], got {yb.shape}")
     b = yb.shape[0]
+
+    # -- lane layout (the sharded half of the ExecutionPlan) -----------------
+    # resolved BEFORE the align plan and the journal: the shard count can
+    # pick the default chunk size, and lane placement is the mesh plane's
+    # data distribution step
+    use_mesh = mesh
+    if use_mesh is not None or shard:
+        # lazy: parallel must stay importable without the driver and
+        # vice versa, and unsharded walks never pay the import
+        from ..parallel import mesh as meshlib
+    if use_mesh is None and shard:
+        use_mesh = meshlib.default_mesh()
+    n_shards = 1
+    if use_mesh is not None:
+        n_shards = len(meshlib.series_devices(use_mesh))
+        if chunk_rows is None and n_shards > 1:
+            # shard=True without a chunk size: one chunk per shard — the
+            # coarsest layout that still gives every device a lane
+            chunk_rows = -(-b // n_shards)
     chunk = int(chunk_rows) if chunk_rows else b
     chunk = max(1, min(chunk, b))
     chunk0 = chunk
+
+    spans = [(0, b)]
+    lanes = None  # [(shard_id, lo, hi, device, lane_values), ...]
+    if use_mesh is not None and n_shards > 1:
+        spans = list(plan_mod.shard_spans(b, chunk0, n_shards))
+        if len(spans) > 1:
+            try:
+                lanes = meshlib.lane_values(yb, use_mesh, spans)
+            except BaseException:
+                # lane placement fails per-process (local shard layout):
+                # on a journaled job the OTHER processes will block in the
+                # timeout-less pre-merge barrier — join it so the error
+                # surfaces instead of hanging the survivors (unjournaled
+                # jobs have no barrier: joining one would hang US)
+                if checkpoint_dir is not None:
+                    _distributed_barrier()
+                raise
+    sharded = lanes is not None
+    if not sharded:
+        spans = [(0, b)]
+        lanes = [(0, 0, b, None, yb)]
 
     # static align-mode plan: resolve the panel's alignment mode ONCE (or
     # take the caller's hint) and thread it into every chunk fit as a
     # static argument — the per-chunk NaN probe (one host sync per sliced
     # chunk) disappears.  The mode is a row-wise property of the panel, so
-    # the panel-level answer is exact for every row slice.  Injected
-    # BEFORE the journal's config hash is computed: the plan changes which
-    # compiled program fits the chunks, so a resume must run the same one.
+    # the panel-level answer is exact for every row slice (and for every
+    # shard's slice).  Injected BEFORE the journal's config hash is
+    # computed: the plan changes which compiled program fits the chunks,
+    # so a resume must run the same one.
     from ..models import base as model_base
 
     import inspect as _inspect
@@ -307,7 +315,7 @@ def fit_chunked(
         fit_kwargs = {**fit_kwargs,
                       "align_mode": model_base.resolve_align_mode(
                           yb, align_mode)}
-    elif (_explicit_align_param(fit_fn) and chunk < b
+    elif (_explicit_align_param(fit_fn) and (chunk < b or sharded)
           and "align_mode" not in fit_kwargs):
         # AUTO-injection requires align_mode as an explicitly NAMED
         # parameter — a bare **kwargs does not count (a third-party
@@ -315,57 +323,91 @@ def fit_chunked(
         # blow up on, or silently absorb, a keyword it never asked for).
         # Only sliced walks benefit: a whole-panel chunk hands the
         # caller's array through and the model's own per-array probe
-        # cache holds
+        # cache holds.  A sharded walk always slices (every lane array is
+        # a fresh buffer), so it always plans.
         fit_kwargs = {**fit_kwargs,
                       "align_mode": model_base.align_mode_on_host(yb)}
     plan_mode = fit_kwargs.get("align_mode") if fit_takes_align else None
 
-    journal = None
+    # -- journal(s) ----------------------------------------------------------
+    journals = None
+    cfg = fp = None
     if checkpoint_dir is not None:
         if process_index is None:
             try:
                 process_index = jax.process_index()
             except Exception:  # noqa: BLE001 - no backend yet: single process
                 process_index = 0
-        # pipeline knobs deliberately NOT hashed: they move I/O between
-        # threads without changing a byte of the result, and a serial
-        # journal must resume under a pipelined run (and vice versa)
+        # pipeline/shard knobs deliberately NOT hashed: they move I/O and
+        # compute between threads and devices without changing a byte of
+        # the result, so a serial journal resumes under a pipelined run
+        # (and vice versa), and a merged sharded manifest is adopted by a
+        # later single-device walk.  The reverse direction is NOT adoption:
+        # a sharded walk starts fresh shard namespaces and recomputes
+        # chunks a root/serial manifest already holds (identical bytes,
+        # just repeated work)
         cfg = journal_mod.config_hash(
             fit_fn, fit_kwargs,
             extra={"chunk_rows": chunk0, "min_chunk_rows": min_chunk_rows,
                    "resilient": resilient, "policy": policy,
                    "ladder": "default" if ladder is None else repr(ladder)})
-        journal = journal_mod.ChunkJournal(
-            checkpoint_dir,
-            config_hash=cfg,
-            panel_fingerprint=journal_mod.panel_fingerprint(yb),
-            n_rows=b,
-            chunk_rows=chunk0,
-            resume=resume,
-            process_index=process_index,
-            extra=journal_extra,
-            commit_hook=_journal_commit_hook,
-        )
-    committer = None
-    if journal is not None and pipeline:
-        committer = committer_mod.ChunkCommitter(
-            journal, _commit_arrays, depth=pipeline_depth,
-            probe=memory_probe.peak_memory, status_counts=status_counts)
-    # input-side pipeline: stage chunk N+1's slice while chunk N computes.
-    # Only sliced walks stage (a whole-panel chunk has no next slice), and
-    # pipeline=False stays the fully serial escape hatch for BOTH halves
-    prefetcher = None
-    if pipeline and prefetch_depth and chunk < b:
-        prefetcher = prefetcher_mod.ChunkPrefetcher(yb, depth=prefetch_depth)
+        fp = _fingerprint(yb)
+        if not sharded:
+            journals = [journal_mod.ChunkJournal(
+                checkpoint_dir,
+                config_hash=cfg,
+                panel_fingerprint=fp,
+                n_rows=b,
+                chunk_rows=chunk0,
+                resume=resume,
+                process_index=process_index,
+                extra=journal_extra,
+                commit_hook=_journal_commit_hook,
+            )]
+        else:
+            # one journal namespace per shard (shard_00000/…): lanes are
+            # concurrent writers, and the journal's single-writer rule is
+            # per namespace.  The shard layout rides in `extra` so a
+            # resume under a DIFFERENT mesh is rejected as stale instead
+            # of splicing mismatched spans.
+            journals = []
+            try:
+                # lanes never open the root manifest, so a foreign job's
+                # durable state in this dir would survive unnoticed until
+                # the merge destroyed it — reject it BEFORE any compute,
+                # like the single-device journal does
+                journal_mod.check_root_manifest(
+                    checkpoint_dir, config_hash=cfg,
+                    panel_fingerprint=fp, n_rows=b)
+                for (sid, slo, shi, _dev, _vals) in lanes:
+                    extra = dict(journal_extra or {})
+                    extra.update({"shard_id": sid, "shard_lo": slo,
+                                  "shard_hi": shi, "n_shards": len(spans)})
+                    journals.append(journal_mod.ChunkJournal(
+                        checkpoint_dir,
+                        config_hash=cfg,
+                        panel_fingerprint=fp,
+                        n_rows=b,
+                        chunk_rows=chunk0,
+                        resume=resume,
+                        process_index=process_index,
+                        shard_index=sid,
+                        extra=extra,
+                        commit_hook=_journal_commit_hook,
+                    ))
+            except BaseException:
+                # stale/torn LOCAL journal state is asymmetric across
+                # processes: peers with clean disks will finish their
+                # lanes and block in the timeout-less pre-merge barrier —
+                # join it so the error surfaces cluster-wide
+                _distributed_barrier()
+                raise
     deadline = watchdog_mod.Deadline(job_budget_s)
-
-    import time as _time
 
     # per-chunk telemetry rows for meta["telemetry"] / the manifest block;
     # None (not empty) when disabled so the disabled path allocates nothing
     # and meta stays byte-identical to the uninstrumented driver
     tele = obs.enabled()
-    tele_chunks = [] if tele else None
     # counter baseline at fit start: the registry is run-wide (one
     # obs.enable() can span many fits), but THIS fit's summary must report
     # its own activity — counters are emitted as deltas from here, so fit
@@ -388,332 +430,87 @@ def fit_chunked(
                "time": int(yb.shape[1]), "dtype": str(yb.dtype)},
     ) if tele else None
 
-    pieces = []  # (lo, hi, piece) in walk order; piece may be _TimeoutChunk
-    oom_events = []
-    timeout_events = []
-    # boundaries of committed-but-unloadable (torn-shard) chunks: the
-    # recompute must cover the EXACT recorded [lo, hi) — deriving hi from
-    # the current chunk size could overlap a later committed chunk and
-    # break the bitwise-identical-boundaries contract
-    lost_boundaries: dict = {}
-    lo = 0
-
-    def _record_oom(at_row: int, rows: int, e: BaseException) -> int:
-        """Shared backoff bookkeeping for fit-time, staging-time, and
-        commit-time OOMs; returns the halved chunk size (or raises when
-        the budget/floor is spent).  Every staged slice is invalidated
-        first: the halved boundary makes every prefetch prediction wrong,
-        and a freed staged buffer is exactly the HBM the retry needs."""
-        if prefetcher is not None:
-            prefetcher.invalidate()
-        oom_events.append({
-            "at_row": at_row, "chunk_rows": rows,
-            "error": f"{type(e).__name__}: {e}"[:200],
-        })
-        obs.counter("chunked.oom_backoffs").inc()
-        obs.event("chunk.oom_backoff", at_row=at_row, chunk_rows=rows)
-        if rows <= min_chunk_rows or len(oom_events) > max_backoffs:
-            raise OOMBackoffExceeded(
-                f"chunk of {rows} rows still RESOURCE_EXHAUSTED after "
-                f"{len(oom_events)} backoffs (floor {min_chunk_rows})"
-            ) from e
-        return max(min_chunk_rows, rows // 2)
-
-    def _rollback(err):
-        """Handle a committer-detected failure (the fetch/commit of an
-        async-dispatched chunk raised on the worker thread).
-
-        Non-OOM errors re-raise unchanged.  An OOM rolls the walk back to
-        the failed chunk: everything at/after it is uncommitted (in-order
-        queue), so its pieces are dropped, the chunk size halves, and the
-        walk re-enters at the failed row — the pipelined twin of the
-        fit-time backoff.  Returns the (lo, chunk) to continue from."""
-        e, flo, fhi = err
-        if not is_resource_exhausted(e):
-            raise e
-        new_chunk = _record_oom(flo, fhi - flo, e)
-        pieces[:] = [p for p in pieces if p[0] < flo]
-        if tele:
-            tele_chunks[:] = [r for r in tele_chunks if r["lo"] < flo]
-        return flo, new_chunk
-
-    def _next_span(nlo: int, cur_chunk: int):
-        """The span the walk will visit after the current chunk — the
-        prefetcher's prediction.  Mirrors the walk's own boundary logic
-        exactly: torn-shard forced boundaries, then the committed-grid
-        clamp (a staged slice must never sail past a committed chunk's
-        ``lo``).  Returns None at the panel end or when the next span is
-        already committed (the resume path loads it from its shard — no
-        device slice needed)."""
-        if nlo >= b:
-            return None
-        if journal is not None and journal.committed(nlo) is not None:
-            return None
-        forced = lost_boundaries.get(nlo)
-        if forced:
-            return nlo, forced[0]
-        nhi = min(nlo + cur_chunk, b)
-        if journal is not None:
-            nxt = journal.next_committed_lo(nlo)
-            if nxt is not None and nxt < nhi:
-                nhi = nxt
-        return nlo, nhi
-
-    def _drain_for_journal_write():
-        """Synchronize with the committer before the driver itself writes
-        the journal (TIMEOUT marks, forced torn-shard recommits): after
-        this, every earlier commit is durable and the driver is the only
-        writer.  Returns a pending error tuple instead of raising so the
-        caller can roll back."""
-        if committer is None:
-            return None
-        return committer.drain(raise_pending=False)
-
+    # -- the plan, then its lanes -------------------------------------------
+    lane_specs = tuple(LaneSpec(sid, slo, shi, dev)
+                       for (sid, slo, shi, dev, _vals) in lanes)
+    plan = ExecutionPlan(
+        n_rows=b,
+        chunk_rows=chunk0,
+        min_chunk_rows=min_chunk_rows,
+        max_backoffs=max_backoffs,
+        resilient=resilient,
+        policy=policy,
+        ladder=ladder,
+        checkpoint_dir=checkpoint_dir,
+        resume=resume,
+        chunk_budget_s=chunk_budget_s,
+        job_budget_s=job_budget_s,
+        pipeline=pipeline,
+        pipeline_depth=pipeline_depth,
+        prefetch_depth=prefetch_depth,
+        align_mode=plan_mode,
+        lanes=lane_specs,
+        process_index=int(process_index or 0),
+        n_shards=len(spans) if sharded else 1,
+    )
+    runners = [
+        LaneRunner(plan, spec, fit_fn, fit_kwargs, vals,
+                   journal=journals[i] if journals is not None else None,
+                   deadline=deadline, tele=tele, fit_key=fit_key)
+        for i, (spec, (_sid, _lo, _hi, _dev, vals))
+        in enumerate(zip(lane_specs, lanes))
+    ]
     try:
-        while True:
-            if committer is not None:
-                err = committer.take_error()
-                if err is not None:
-                    lo, chunk = _rollback(err)
-                    continue
-            if lo >= b:
-                # final drain: a commit of one of the last chunks may still
-                # fail (or OOM at fetch) — that must surface (or roll the
-                # walk back) BEFORE assembly reads the pieces
-                err = _drain_for_journal_write()
-                if err is not None:
-                    lo, chunk = _rollback(err)
-                    continue
-                break
-            if journal is not None:
-                entry = journal.committed(lo)
-                if entry is not None:
-                    piece = journal.load_chunk(entry)
-                    if piece is not None:
-                        pieces.append((lo, int(entry["hi"]), piece))
-                        if tele:
-                            tele_chunks.append({"lo": lo,
-                                                "hi": int(entry["hi"]),
-                                                "phase": "resumed"})
-                        lo = entry["hi"]
-                        # replay the backoff state in effect when the chunk
-                        # committed, so the resumed walk visits the SAME
-                        # boundaries the uninterrupted run would have
-                        chunk = int(entry.get("chunk_rows_after", chunk))
-                        continue
-                    lost_boundaries[lo] = (
-                        int(entry["hi"]),
-                        int(entry.get("chunk_rows_after", chunk)))
-            forced = lost_boundaries.get(lo)
-            hi = forced[0] if forced else min(lo + chunk, b)
-            if journal is not None and not forced:
-                # keep the walk on the committed grid: after an OOM backoff
-                # whose halving does not divide the original chunk size, a
-                # free-running hi would sail past the next committed chunk's
-                # lo, orphaning it (never matched again) and double-counting
-                # its rows in the manifest — clamp to the boundary instead
-                nxt = journal.next_committed_lo(lo)
-                if nxt is not None and nxt < hi:
-                    hi = nxt
-            if deadline.exceeded():
-                err = _drain_for_journal_write()
-                if err is not None:
-                    lo, chunk = _rollback(err)
-                    continue
-                if forced:
-                    chunk = forced[1]
-                    lost_boundaries.pop(lo, None)
-                timeout_events.append({
-                    "at_row": lo, "chunk_rows": hi - lo, "dispatched": False,
-                    "budget_s": deadline.budget_s, "scope": "job"})
-                obs.counter("chunked.timeouts.job").inc()
-                obs.event("chunk.timeout", lo=lo, hi=hi, scope="job",
-                          dispatched=False)
-                if tele:
-                    tele_chunks.append({"lo": lo, "hi": hi,
-                                        "phase": "timeout", "scope": "job"})
-                pieces.append((lo, hi, _TimeoutChunk(lo, hi)))
-                if journal is not None:
-                    journal.mark_timeout(lo, hi, scope="job",
-                                         budget_s=deadline.budget_s,
-                                         chunk_rows_after=chunk)
-                lo = hi
-                continue
-            def run_chunk(lo=lo, hi=hi, chunk=chunk):
-                # lo/hi/chunk are DEFAULT-ARG SNAPSHOTS, not closure reads:
-                # a watchdog-abandoned thread keeps running after the driver
-                # has mutated the loop variables, and it must keep operating
-                # on ITS chunk's span — never take() the live chunk's staged
-                # slice or slice a torn lo/hi pair mid-update (the pre-
-                # prefetcher code snapshotted `vals` itself for the same
-                # reason).
-                # acquire this chunk's values INSIDE the watchdog window:
-                # the whole-panel chunk hands the caller's array through
-                # untouched (a slice would be a fresh device buffer — an
-                # extra HBM copy, and a miss in the per-array-identity
-                # align-mode cache callers pre-warm); sliced chunks come
-                # from the prefetcher when the staged prediction matched.
-                # A staged slice can be queued behind an ABANDONED
-                # (timed-out) computation, so the wait on it must be
-                # bounded by the same budget as the compute it feeds — and
-                # a staging-time RESOURCE_EXHAUSTED surfaces here, through
-                # the watchdog, into the same backoff ladder as a fit-time
-                # one.
-                if lo == 0 and hi == b:
-                    vals = yb
-                elif prefetcher is not None:
-                    vals = prefetcher.take(lo, hi)
-                else:
-                    vals = yb[lo:hi]
-                if prefetcher is not None:
-                    # stage the next spans now (up to depth ahead — take()
-                    # just freed this chunk's slot), so they materialize
-                    # while this chunk computes (and, for resilient fits,
-                    # while the ladder blocks on host work)
-                    nlo = hi
-                    for _ in range(prefetcher.depth):
-                        nxt = _next_span(nlo, chunk)
-                        if nxt is None:
-                            break
-                        prefetcher.schedule(*nxt)
-                        nlo = nxt[1]
-                if resilient:
-                    return resilient_fit(
-                        fit_fn, vals, policy=policy, ladder=ladder,
-                        **fit_kwargs)
-                out = fit_fn(vals, **fit_kwargs)
-                if chunk_budget_s is not None:
-                    # with a deadline armed the budget must cover the device
-                    # computation, not just its async dispatch — block here,
-                    # INSIDE the watchdog window
-                    jax.block_until_ready(out)
-                return out
+        if len(runners) == 1:
+            results = [runners[0].run()]
+        else:
+            results = [None] * len(runners)
+            errors = [None] * len(runners)
 
-            phase = None
-            if tele:
-                # first dispatch of this (fit config, chunk rows) pays JAX
-                # trace+compile; later dispatches of the same shape execute a
-                # cached program — the split BENCH scraped ad hoc, now
-                # recorded per chunk (a backoff-halved chunk is a NEW shape =
-                # new compile)
-                phase = ("compile+execute"
-                         if obs.first_dispatch((fit_key, hi - lo))
-                         else "execute")
-            sp = obs.span("chunk", lo=lo, hi=hi, phase=phase)
-            t0 = _time.perf_counter()
-            try:
-                with sp:
-                    piece = watchdog_mod.call_with_deadline(
-                        run_chunk, chunk_budget_s,
-                        label=f"chunk rows [{lo}, {hi})")
-            except watchdog_mod.DeadlineExceeded:
-                err = _drain_for_journal_write()
-                if err is not None:
-                    lo, chunk = _rollback(err)
-                    continue
-                if forced:
-                    chunk = forced[1]
-                    lost_boundaries.pop(lo, None)
-                timeout_events.append({
-                    "at_row": lo, "chunk_rows": hi - lo, "dispatched": True,
-                    "budget_s": chunk_budget_s, "scope": "chunk"})
-                obs.counter("chunked.timeouts.chunk").inc()
-                obs.event("chunk.timeout", lo=lo, hi=hi, scope="chunk",
-                          dispatched=True, budget_s=chunk_budget_s)
-                if tele:
-                    tele_chunks.append({"lo": lo, "hi": hi,
-                                        "phase": "timeout", "scope": "chunk",
-                                        **_span_times(sp)})
-                pieces.append((lo, hi, _TimeoutChunk(lo, hi)))
-                if journal is not None:
-                    journal.mark_timeout(lo, hi, scope="chunk",
-                                         budget_s=chunk_budget_s,
-                                         chunk_rows_after=chunk)
-                lo = hi
-                continue
-            except Exception as e:  # noqa: BLE001 - filtered just below
-                if not is_resource_exhausted(e):
-                    raise
-                # drain before re-entering backoff: the journal state is
-                # then deterministic at every backoff decision, and a
-                # failed commit of an EARLIER chunk takes precedence over
-                # this chunk's fit-time OOM (it is earlier in the walk)
-                err = _drain_for_journal_write()
-                if err is not None:
-                    lo, chunk = _rollback(err)
-                    continue
-                if forced:
-                    # a torn-shard recompute is pinned to the committed
-                    # [lo, hi): halving `chunk` would not shrink the dispatch
-                    # (hi stays forced), so retrying is futile — fail with
-                    # the actionable cause instead of burning the backoff
-                    # budget
-                    raise OOMBackoffExceeded(
-                        f"recompute of torn-shard chunk [{lo}, {hi}) hit "
-                        "RESOURCE_EXHAUSTED; its boundaries are fixed by the "
-                        "journal, so backoff cannot help. Free device "
-                        "memory, or restart the job under a fresh "
-                        "checkpoint_dir (or remove this journal explicitly) "
-                        "to let the walk re-chunk."
-                    ) from e
-                chunk = _record_oom(lo, chunk, e)
-                continue
-            if forced:  # torn-shard recompute done: restore the recorded walk
-                chunk = forced[1]
-                lost_boundaries.pop(lo, None)
-            if tele:
-                tele_chunks.append({"lo": lo, "hi": hi, "phase": phase,
-                                    **_span_times(sp)})
-            if journal is not None:
-                wall_s = round(_time.perf_counter() - t0, 4)
-                if committer is not None and not forced:
-                    # background commit: the fetch + shard + manifest update
-                    # overlap the next chunk's dispatch/compute.  chunk_rows
-                    # _after is captured NOW (not at commit time) so the
-                    # recorded backoff state matches the serial walk exactly
-                    try:
-                        committer.submit(lo, hi, piece, wall_s=wall_s,
-                                         chunk_rows_after=chunk)
-                    except BaseException as se:
-                        err = committer.take_error()
-                        # only the worker's OWN re-raised error enters the
-                        # rollback path: an unrelated exception (e.g. a
-                        # Ctrl-C landing while submit blocked) must abort,
-                        # not be converted into an OOM retry
-                        if err is None or err[0] is not se:
-                            raise
-                        lo, chunk = _rollback(err)
-                        continue
-                else:
-                    # forced torn-shard recommits stay synchronous: they are
-                    # rare, their boundaries are pinned by the journal, and
-                    # the serial path keeps their edge semantics exact
-                    err = _drain_for_journal_write()
-                    if err is not None:
-                        lo, chunk = _rollback(err)
-                        continue
-                    arrays = _commit_arrays(piece)
-                    pm = memory_probe.peak_memory()
-                    journal.commit_chunk(
-                        lo, hi, arrays,
-                        wall_s=wall_s,
-                        peak_hbm_bytes=pm.bytes,
-                        peak_hbm_source=pm.source,
-                        chunk_rows_after=chunk,
-                        status_counts=status_counts(arrays["status"]),
-                    )
-            pieces.append((lo, hi, piece))
-            lo = hi
+            def _drive(i):
+                try:
+                    results[i] = runners[i].run()
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    errors[i] = e
+
+            threads = [threading.Thread(target=_drive, args=(i,), daemon=True,
+                                        name=f"chunk-lane-{r.spec.shard_id}")
+                       for i, r in enumerate(runners)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            first = next((e for e in errors if e is not None), None)
+            if first is not None:
+                # the failing lane already closed its own committer/
+                # prefetcher; the OTHER lanes ran to completion (their
+                # journals keep their commits — a resume replays only
+                # what is missing)
+                raise first
+            results = [r for r in results if r is not None]
+            results.sort(key=lambda r: r.spec.lo)
     except BaseException:
-        if committer is not None:
-            # the walk is failing: stop the worker without letting a second
-            # (pending) commit error mask the original exception
-            committer.close(raise_pending=False)
-        if prefetcher is not None:
-            prefetcher.close()
+        # peer processes of a journaled sharded job are (or will be)
+        # blocked in the pre-merge barrier, which has no timeout: a
+        # process whose lane failed must still JOIN it so the error
+        # surfaces cluster-wide instead of hanging the survivors (the
+        # barrier is best-effort and a no-op single-process)
+        if journals is not None and sharded:
+            _distributed_barrier()
         raise
-    pipe_stats = committer.close() if committer is not None else None
-    pf_stats = prefetcher.close() if prefetcher is not None else None
+
+    # -- merge lanes ---------------------------------------------------------
+    pieces = [p for r in results for p in r.pieces]
+    oom_events, timeout_events = [], []
+    for r in results:
+        tag = {"shard": r.spec.shard_id} if sharded else {}
+        oom_events.extend({**ev, **tag} for ev in r.oom_events)
+        timeout_events.extend({**ev, **tag} for ev in r.timeout_events)
+    chunk_final = min((r.chunk_final for r in results), default=chunk0)
+    tele_chunks = None
+    if tele:
+        tele_chunks = [row for r in results for row in (r.tele_chunks or [])]
+        tele_chunks.sort(key=lambda c: c["lo"])
 
     # parameter width for synthesized TIMEOUT rows comes from any finished
     # chunk; an all-TIMEOUT job degenerates to a single NaN column
@@ -734,15 +531,25 @@ def fit_chunked(
                 _piece_status(p))
 
     mats = [_mat(p) for _, _, p in pieces]
-    params = np.concatenate([m[0] for m in mats])
-    nll = np.concatenate([m[1] for m in mats])
-    conv = np.concatenate([m[2] for m in mats])
-    iters = np.concatenate([m[3] for m in mats])
-    status = np.concatenate([m[4] for m in mats])
+    if mats:
+        params = np.concatenate([m[0] for m in mats])
+        nll = np.concatenate([m[1] for m in mats])
+        conv = np.concatenate([m[2] for m in mats])
+        iters = np.concatenate([m[3] for m in mats])
+        status = np.concatenate([m[4] for m in mats])
+    else:
+        # a jax.distributed process whose addressable devices own no lane
+        # (fewer local spans than mesh devices): its LOCAL result is
+        # legitimately empty — it still joins the manifest barrier below
+        params = np.zeros((0, k), dtype)
+        nll = np.zeros(0, dtype)
+        conv = np.zeros(0, bool)
+        iters = np.zeros(0, np.int32)
+        status = np.zeros(0, STATUS_DTYPE)
 
     meta = {
         "chunk_rows_initial": chunk0,
-        "chunk_rows_final": chunk,
+        "chunk_rows_final": chunk_final,
         "chunks_run": len(pieces),
         "oom_backoffs": len(oom_events),
         "oom_events": oom_events,
@@ -751,55 +558,19 @@ def fit_chunked(
         "degraded": bool(oom_events or timeout_events),
         "status_counts": status_counts(status),
     }
-    if journal is not None:
-        meta["journal"] = journal.accounting()
+    if sharded:
+        meta["shards"] = {
+            "n_shards": len(spans),
+            "spans": [[int(slo), int(shi)] for slo, shi in spans],
+            "lanes_run": len(results),
+            "devices": [str(spec.device) for spec in lane_specs],
+        }
+    if journals is not None and not sharded:
+        meta["journal"] = journals[0].accounting()
     if plan_mode is not None:
         meta["align_mode"] = plan_mode
-    if pipe_stats is not None or pf_stats is not None:
-        pipe_meta = {}
-        if pipe_stats is not None:
-            hidden = pipe_stats.hidden_s
-            pipe_meta.update({
-                "depth": committer.depth,
-                "commits_background": pipe_stats.commits,
-                "commit_wall_s": round(pipe_stats.commit_wall_s, 6),
-                "driver_blocked_s": round(pipe_stats.blocked_s, 6),
-                "hidden_commit_s": round(hidden, 6),
-                "max_queue_depth": pipe_stats.max_queue_depth,
-                # fraction of commit wall the driver never waited for — the
-                # number the bench's journaled-vs-unjournaled pair publishes
-                "overlap_efficiency": (
-                    round(hidden / pipe_stats.commit_wall_s, 4)
-                    if pipe_stats.commit_wall_s > 0 else None),
-            })
-            obs.gauge("committer.hidden_commit_s").set(round(hidden, 6))
-            obs.counter("committer.hidden_commit_ms").add(int(hidden * 1000))
-        if pf_stats is not None:
-            ph = pf_stats.hidden_s
-            pipe_meta.update({
-                "prefetch_depth": prefetcher.depth,
-                "chunks_staged": pf_stats.staged,
-                "staged_hits": pf_stats.hits,
-                "staged_misses": pf_stats.misses,
-                "staged_invalidated": pf_stats.invalidated,
-                "staging_wall_s": round(pf_stats.staging_wall_s, 6),
-                "staging_blocked_s": round(pf_stats.blocked_s, 6),
-                "hidden_staging_s": round(ph, 6),
-                # fraction of input-staging wall hidden under compute
-                "input_overlap_efficiency": (
-                    round(ph / pf_stats.staging_wall_s, 4)
-                    if pf_stats.staging_wall_s > 0 else None),
-            })
-            obs.counter("prefetch.hidden_staging_ms").add(int(ph * 1000))
-        # end-to-end: of ALL the overlap-eligible wall (journal commits +
-        # input staging), the fraction the driver never waited for — the
-        # single number that says "the walk is dispatch-ahead end to end"
-        total_wall = ((pipe_stats.commit_wall_s if pipe_stats else 0.0)
-                      + (pf_stats.staging_wall_s if pf_stats else 0.0))
-        total_hidden = ((pipe_stats.hidden_s if pipe_stats else 0.0)
-                        + (pf_stats.hidden_s if pf_stats else 0.0))
-        pipe_meta["end_to_end_overlap_efficiency"] = (
-            round(total_hidden / total_wall, 4) if total_wall > 0 else None)
+    pipe_meta = _pipeline_meta(results, sharded)
+    if pipe_meta is not None:
         meta["pipeline"] = pipe_meta
     # ladder/sanitize accounting aggregated across chunks (resilient mode)
     rung_totals: dict = {}
@@ -811,6 +582,8 @@ def fit_chunked(
             agg["rescued"] += r["rescued"]
     if rung_totals:
         meta["ladder_totals"] = rung_totals
+
+    telemetry = None
     if tele:
         for name, v in meta["status_counts"].items():
             if v:
@@ -820,31 +593,185 @@ def fit_chunked(
         extra_tele = {}
         if plan_mode is not None:
             extra_tele["align_mode"] = plan_mode
-        if pf_stats is not None:
+        if pipe_meta is not None and "staging_wall_s" in pipe_meta:
             # the input-staging overlap numbers ride into the manifest so
             # tools/advise_budget.py can suggest prefetch_depth (and the
             # align hint) for the next run of this config
             extra_tele["input_staging"] = {
-                k: meta["pipeline"][k] for k in (
+                k2: pipe_meta[k2] for k2 in (
                     "prefetch_depth", "chunks_staged", "staged_hits",
                     "staged_misses", "staging_wall_s", "hidden_staging_s",
                     "input_overlap_efficiency")}
+        if pipe_meta is not None and "shards" in pipe_meta:
+            # per-lane commit/staging overlap rides into the merged job
+            # manifest so a straggler lane is a journaled fact, not a
+            # vanished meta dict (bench gates on it; advise_budget reads it)
+            extra_tele["shards_pipeline"] = pipe_meta["shards"]
         telemetry = obs.summary(counters_since=counters0, chunks=tele_chunks,
                                 **extra_tele)
         if telemetry is not None:
             meta["telemetry"] = telemetry
-            if journal is not None:
-                journal.record_telemetry(telemetry)
+            if journals is not None and not sharded:
+                journals[0].record_telemetry(telemetry)
             obs.emit_metrics()
+
+    if journals is not None and sharded:
+        # shard/process 0 is the single writer of the job-level manifest:
+        # merge every shard namespace (chunks re-pathed shard-relative and
+        # tagged with their shard id, a `shards` block, the merged
+        # telemetry timeline) into ONE manifest.json after the lanes join
+        acct = None
+        if int(process_index or 0) == 0:
+            _distributed_barrier()
+            acct = journal_mod.merge_job_manifest(
+                checkpoint_dir,
+                config_hash=cfg,
+                panel_fingerprint=fp,
+                n_rows=b,
+                chunk_rows=chunk0,
+                spans=spans,
+                telemetry=telemetry,
+                extra=journal_extra,
+            )
+        else:
+            _distributed_barrier()
+            # a process may own ZERO local lanes (fewer spans than its
+            # addressable devices): journals is then empty, but the job
+            # root is just the checkpoint dir
+            root = (journals[0].dir.rsplit("/shard_", 1)[0] if journals
+                    else os.path.abspath(checkpoint_dir))
+            acct = {"dir": root,
+                    "manifest": None, "merged_shards": None,
+                    "config_hash": cfg,
+                    "process_index": int(process_index or 0)}
+        acct["chunks_resumed"] = sum(j.resumed_entries for j in journals)
+        meta["journal"] = acct
     return ResilientFitResult(params, nll, conv, iters, status, meta)
 
 
-def _piece_status(p) -> np.ndarray:
-    """Status of one chunk result; synthesized when the fit has none."""
-    status = getattr(p, "status", None)
-    conv = np.asarray(p.converged)
-    if status is None:
-        finite = np.isfinite(np.asarray(p.params)).all(axis=-1)
-        return np.where(conv & finite, FitStatus.OK,
-                        FitStatus.DIVERGED).astype(STATUS_DTYPE)
-    return np.asarray(status).astype(STATUS_DTYPE)
+def _pipeline_meta(results, sharded: bool) -> Optional[dict]:
+    """``meta["pipeline"]`` merged across lanes.
+
+    The single-lane block is byte-identical to the pre-plan driver's; a
+    sharded plan sums the lanes (total commit/staging wall vs total driver
+    blocked wall) and adds a per-shard breakdown so a slow lane is visible
+    behind the aggregate.
+    """
+    pipes = [(r.spec.shard_id, r.pipe_stats, r.committer_depth)
+             for r in results if r.pipe_stats is not None]
+    pfs = [(r.spec.shard_id, r.pf_stats, r.prefetch_depth)
+           for r in results if r.pf_stats is not None]
+    if not pipes and not pfs:
+        return None
+    pipe_meta = {}
+    commit_wall = hidden_commit = 0.0
+    if pipes:
+        commit_wall = sum(s.commit_wall_s for _, s, _ in pipes)
+        hidden_commit = sum(s.hidden_s for _, s, _ in pipes)
+        pipe_meta.update({
+            "depth": pipes[0][2],
+            "commits_background": sum(s.commits for _, s, _ in pipes),
+            "commit_wall_s": round(commit_wall, 6),
+            "driver_blocked_s": round(
+                sum(s.blocked_s for _, s, _ in pipes), 6),
+            "hidden_commit_s": round(hidden_commit, 6),
+            "max_queue_depth": max(s.max_queue_depth for _, s, _ in pipes),
+            # fraction of commit wall the driver never waited for — the
+            # number the bench's journaled-vs-unjournaled pair publishes
+            "overlap_efficiency": (
+                round(hidden_commit / commit_wall, 4)
+                if commit_wall > 0 else None),
+        })
+        obs.gauge("committer.hidden_commit_s").set(round(hidden_commit, 6))
+        obs.counter("committer.hidden_commit_ms").add(
+            int(hidden_commit * 1000))
+    staging_wall = hidden_staging = 0.0
+    if pfs:
+        staging_wall = sum(s.staging_wall_s for _, s, _ in pfs)
+        hidden_staging = sum(s.hidden_s for _, s, _ in pfs)
+        pipe_meta.update({
+            "prefetch_depth": pfs[0][2],
+            "chunks_staged": sum(s.staged for _, s, _ in pfs),
+            "staged_hits": sum(s.hits for _, s, _ in pfs),
+            "staged_misses": sum(s.misses for _, s, _ in pfs),
+            "staged_invalidated": sum(s.invalidated for _, s, _ in pfs),
+            "staging_wall_s": round(staging_wall, 6),
+            "staging_blocked_s": round(
+                sum(s.blocked_s for _, s, _ in pfs), 6),
+            "hidden_staging_s": round(hidden_staging, 6),
+            # fraction of input-staging wall hidden under compute
+            "input_overlap_efficiency": (
+                round(hidden_staging / staging_wall, 4)
+                if staging_wall > 0 else None),
+        })
+        obs.counter("prefetch.hidden_staging_ms").add(
+            int(hidden_staging * 1000))
+    # end-to-end: of ALL the overlap-eligible wall (journal commits +
+    # input staging), the fraction the driver never waited for — the
+    # single number that says "the walk is dispatch-ahead end to end"
+    total_wall = commit_wall + staging_wall
+    total_hidden = hidden_commit + hidden_staging
+    pipe_meta["end_to_end_overlap_efficiency"] = (
+        round(total_hidden / total_wall, 4) if total_wall > 0 else None)
+    if sharded:
+        by_shard: dict = {}
+        for sid, s, _d in pipes:
+            by_shard.setdefault(sid, {"shard": sid})
+            by_shard[sid].update({
+                "commits_background": s.commits,
+                "commit_wall_s": round(s.commit_wall_s, 6),
+                "hidden_commit_s": round(s.hidden_s, 6),
+                "overlap_efficiency": (
+                    round(s.hidden_s / s.commit_wall_s, 4)
+                    if s.commit_wall_s > 0 else None),
+            })
+        for sid, s, _d in pfs:
+            by_shard.setdefault(sid, {"shard": sid})
+            by_shard[sid].update({
+                "chunks_staged": s.staged,
+                "staging_wall_s": round(s.staging_wall_s, 6),
+                "hidden_staging_s": round(s.hidden_s, 6),
+                "input_overlap_efficiency": (
+                    round(s.hidden_s / s.staging_wall_s, 4)
+                    if s.staging_wall_s > 0 else None),
+            })
+        pipe_meta["shards"] = [by_shard[sid] for sid in sorted(by_shard)]
+    return pipe_meta
+
+
+def _fingerprint(yb) -> str:
+    """Panel fingerprint, tolerant of multi-process global arrays (whose
+    rows are not all addressable here — sampling them would need a
+    collective): those fall back to a shape/dtype/sharding fingerprint,
+    which is weaker but consistent across the processes of one job."""
+    try:
+        addressable = getattr(yb, "is_fully_addressable", True)
+    except Exception:  # noqa: BLE001 - duck typing over jax versions
+        addressable = True
+    if addressable:
+        return journal_mod.panel_fingerprint(yb)
+    import hashlib
+
+    h = hashlib.sha256(
+        f"global:{yb.shape}:{yb.dtype}:{yb.sharding}".encode())
+    return h.hexdigest()[:16]
+
+
+def _distributed_barrier() -> None:
+    """Best-effort cross-process barrier before the job-manifest merge:
+    process 0 must not merge shard manifests other processes are still
+    writing.  No-op (and never fatal) single-process or on backends
+    without collectives."""
+    try:
+        if jax.process_count() <= 1:
+            return
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ststpu-sharded-merge")
+    except Exception:  # noqa: BLE001 - barrier is best-effort by design
+        import warnings
+
+        warnings.warn(
+            "fit_chunked: cross-process barrier before the job-manifest "
+            "merge failed; the merged manifest may briefly lag the last "
+            "shard commits", stacklevel=2)

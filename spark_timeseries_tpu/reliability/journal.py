@@ -37,6 +37,18 @@ rejected (:class:`TornManifestError`) rather than silently started over.
 commits ``manifest.json`` — the job-level manifest tooling and post-mortems
 read (``tools/inspect_journal.py``) — mirroring the Spark driver being the
 single writer of job state while executors own their shuffle files.
+
+**Sharded lanes** (ISSUE 6) extend the same rule one level down: a sharded
+chunk walk gives every mesh shard its own namespace (``shard_00000/...``)
+with a shard-local manifest — lanes are concurrent writers, and the
+single-writer protocol is per namespace — and after the lanes join,
+shard/process 0 calls :func:`merge_job_manifest` to fold the shard
+manifests into the ONE job-level ``manifest.json``: merged chunk entries
+(shard-relative npz paths, tagged ``shard_id``), a ``shards`` block with
+per-shard accounting, and the merged telemetry timeline.  Because the
+shard spans sit on the single-device chunk grid and plan knobs are
+excluded from the config hash, the merged manifest is itself resumable —
+even by a later single-device walk of the same job.
 """
 
 from __future__ import annotations
@@ -64,6 +76,7 @@ __all__ = [
     "StaleJournalError",
     "TornManifestError",
     "config_hash",
+    "merge_job_manifest",
     "panel_fingerprint",
 ]
 
@@ -218,7 +231,15 @@ class ChunkJournal:
     ``process_index`` selects the namespace: process 0 owns the job-level
     ``manifest.json`` at the directory root; every other process works
     under ``proc_{i:05d}/`` with a manifest named for it, so concurrent
-    multi-host writers never race on one file.
+    multi-host writers never race on one file.  ``shard_index`` (sharded
+    chunk walks) namespaces one lane of ONE job the same way — the journal
+    lives under ``shard_{i:05d}/`` with a manifest named for the shard,
+    regardless of process (a shard id is globally unique across the
+    mesh's processes), and the job-level root ``manifest.json`` is written
+    only by :func:`merge_job_manifest` after the lanes join.  A shard
+    journal whose recorded span (``extra`` keys ``shard_lo``/``shard_hi``/
+    ``n_shards``) does not match the new run's lane layout is STALE: the
+    mesh changed, and resuming would replay another lane's boundaries.
 
     ``commit_hook(event, lo)`` is a test/fault-injection surface called
     with ``"shard_written"`` (shard durable, manifest not yet updated) and
@@ -236,20 +257,31 @@ class ChunkJournal:
         chunk_rows: int,
         resume: str = "auto",
         process_index: int = 0,
+        shard_index: Optional[int] = None,
         extra: Optional[dict] = None,
         commit_hook: Optional[Callable[[str, int], None]] = None,
     ):
         if resume not in RESUME_MODES:
             raise ValueError(f"resume must be one of {RESUME_MODES}, got {resume!r}")
         self.process_index = int(process_index)
+        self.shard_index = None if shard_index is None else int(shard_index)
         root = os.path.abspath(directory)
-        self.dir = root if self.process_index == 0 else os.path.join(
-            root, f"proc_{self.process_index:05d}")
+        if self.shard_index is not None:
+            # one lane of a sharded walk: shard ids are globally unique
+            # across the mesh's processes, so the shard namespace alone
+            # keeps concurrent writers apart (no proc_ nesting needed)
+            self.dir = os.path.join(root, f"shard_{self.shard_index:05d}")
+        else:
+            self.dir = root if self.process_index == 0 else os.path.join(
+                root, f"proc_{self.process_index:05d}")
         os.makedirs(self.dir, exist_ok=True)
-        self.manifest_path = os.path.join(
-            self.dir,
-            MANIFEST if self.process_index == 0
-            else f"manifest.proc_{self.process_index:05d}.json")
+        if self.shard_index is not None:
+            manifest_name = f"manifest.shard_{self.shard_index:05d}.json"
+        elif self.process_index == 0:
+            manifest_name = MANIFEST
+        else:
+            manifest_name = f"manifest.proc_{self.process_index:05d}.json"
+        self.manifest_path = os.path.join(self.dir, manifest_name)
         self.config_hash = config_hash
         self.panel_fingerprint = panel_fingerprint
         self.n_rows = int(n_rows)
@@ -271,6 +303,20 @@ class ChunkJournal:
         if resume == "require" and prior is None:
             raise JournalError(
                 f"resume='require' but no manifest at {self.manifest_path}")
+        if prior is not None and self.shard_index is not None:
+            # a shard journal belongs to ONE lane layout: if the mesh (and
+            # with it this shard's span) changed, replaying these chunks
+            # would splice another lane's boundaries into the new walk
+            pex = prior.get("extra") or {}
+            nex = dict(extra or {})
+            bad = [k for k in ("shard_lo", "shard_hi", "n_shards")
+                   if k in nex and pex.get(k) != nex[k]]
+            if bad:
+                raise StaleJournalError(
+                    f"{self.manifest_path} was written under a different "
+                    f"shard layout ({'; '.join(f'{k} {pex.get(k)} != {nex[k]}' for k in bad)}). "
+                    "Resume a sharded job with the same mesh/shard count, "
+                    "or point checkpoint_dir at a fresh directory.")
         if prior is not None:
             self._manifest = prior
             head = _git_commit()
@@ -300,6 +346,8 @@ class ChunkJournal:
                 "n_rows": self.n_rows,
                 "chunk_rows": int(chunk_rows),
                 "process_index": self.process_index,
+                **({"shard_index": self.shard_index}
+                   if self.shard_index is not None else {}),
                 "extra": dict(extra or {}),
                 "resumes": [],
                 "chunks": [],
@@ -474,3 +522,176 @@ class ChunkJournal:
             "chunks_resumed": self.resumed_entries,
             "resumes": len(self._manifest.get("resumes", [])),
         }
+
+
+def check_root_manifest(directory: str, *, config_hash: str,
+                        panel_fingerprint: str, n_rows: int) -> None:
+    """Raise if the job-level ``manifest.json`` at ``directory`` belongs to
+    a DIFFERENT job (config hash / panel fingerprint / row count mismatch)
+    or is torn; no-op when absent or matching.
+
+    A sharded walk's lanes only ever open shard namespaces, so without
+    this check a foreign root manifest would survive untouched until the
+    merge destroyed it — the single-device path rejects the same
+    situation at ``ChunkJournal`` construction.
+    """
+    root_mp = os.path.join(os.path.abspath(directory), MANIFEST)
+    if not os.path.exists(root_mp):
+        return
+    try:
+        with open(root_mp, "rb") as f:
+            prior = json.loads(f.read().decode())
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise TornManifestError(
+            f"{root_mp} does not parse ({e}); inspect/remove the journal "
+            "directory explicitly — it will not be silently overwritten "
+            "by a shard merge.") from e
+    mismatches = []
+    if prior.get("config_hash") != config_hash:
+        mismatches.append("config_hash")
+    if prior.get("panel_fingerprint") != panel_fingerprint:
+        mismatches.append("panel_fingerprint")
+    if int(prior.get("n_rows", -1)) != int(n_rows):
+        mismatches.append("n_rows")
+    if mismatches:
+        raise StaleJournalError(
+            f"root manifest {root_mp} belongs to a different job "
+            f"({', '.join(mismatches)} mismatch); merging this sharded "
+            "walk would destroy that job's durable state — use a fresh "
+            "checkpoint_dir or remove the stale journal explicitly.")
+
+
+def merge_job_manifest(
+    directory: str,
+    *,
+    config_hash: str,
+    panel_fingerprint: str,
+    n_rows: int,
+    chunk_rows: int,
+    spans,
+    telemetry: Optional[dict] = None,
+    extra: Optional[dict] = None,
+) -> dict:
+    """Fold the shard-namespace manifests of a sharded walk into the ONE
+    job-level ``manifest.json`` at the journal root, and return the merged
+    accounting.
+
+    Called by shard/process 0 AFTER the lanes join — it is the only writer
+    of the root manifest, mirroring the per-process single-writer rule.
+    ``spans`` is the run's lane layout (``plan.shard_spans``); a shard
+    manifest recorded under a different job (config hash, fingerprint,
+    row count) or a different lane layout is STALE and raises rather than
+    splicing foreign chunks into the job record.  Missing shard manifests
+    are tolerated (a lane that crashed before its first commit, or another
+    process's lane on a non-shared filesystem): their chunks simply stay
+    pending, and a resume recomputes them.
+
+    Merged chunk entries keep their npz shards where the lanes wrote them
+    — the ``shard`` path is re-rooted relative to the journal root and
+    each entry gains its ``shard_id`` — so the merged manifest itself
+    satisfies the resume contract: the same sharded job resumes lane by
+    lane from the shard namespaces, and a later SINGLE-device walk of the
+    same (panel, config) can adopt the merged root manifest directly
+    (plan knobs are excluded from the config hash; the chunk grid is
+    shared by construction).
+    """
+    root = os.path.abspath(directory)
+    # the root manifest is another job's write-ahead record until proven
+    # otherwise: a sharded walk's lanes only ever open shard namespaces,
+    # so the merge is the last line of defense — mirror ChunkJournal's
+    # never-silently-overwrite contract (the driver also calls
+    # check_root_manifest up front to fail BEFORE any compute)
+    check_root_manifest(root, config_hash=config_hash,
+                        panel_fingerprint=panel_fingerprint, n_rows=n_rows)
+    spans = [(int(lo), int(hi)) for lo, hi in spans]
+    shards, chunks = [], []
+    run_id = None
+    for sid, (slo, shi) in enumerate(spans):
+        d = f"shard_{sid:05d}"
+        mp = os.path.join(root, d, f"manifest.{d}.json")
+        if not os.path.exists(mp):
+            shards.append({"shard_id": sid, "lo": slo, "hi": shi,
+                           "dir": d, "manifest": None, "run_id": None,
+                           "chunks_committed": 0, "chunks_timeout": 0,
+                           "resumes": 0})
+            continue
+        try:
+            with open(mp, "rb") as f:
+                m = json.loads(f.read().decode())
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise TornManifestError(
+                f"shard manifest {mp} does not parse ({e}); inspect/remove "
+                "the journal directory explicitly.") from e
+        mismatches = []
+        if m.get("config_hash") != config_hash:
+            mismatches.append("config_hash")
+        if m.get("panel_fingerprint") != panel_fingerprint:
+            mismatches.append("panel_fingerprint")
+        if int(m.get("n_rows", -1)) != int(n_rows):
+            mismatches.append("n_rows")
+        mex = m.get("extra") or {}
+        if (mex.get("shard_lo"), mex.get("shard_hi")) != (slo, shi) or \
+                mex.get("n_shards") != len(spans):
+            mismatches.append("shard layout")
+        if mismatches:
+            raise StaleJournalError(
+                f"shard manifest {mp} belongs to a different job/layout "
+                f"({', '.join(mismatches)} mismatch); remove the stale "
+                "journal explicitly or use a fresh checkpoint_dir.")
+        if run_id is None:
+            run_id = m.get("run_id")
+        entries = []
+        for e in m.get("chunks", []):
+            e2 = dict(e)
+            e2["shard_id"] = sid
+            if "shard" in e2:
+                e2["shard"] = f"{d}/{e2['shard']}"
+            entries.append(e2)
+        chunks.extend(entries)
+        shards.append({
+            "shard_id": sid, "lo": slo, "hi": shi, "dir": d,
+            "manifest": os.path.basename(mp), "run_id": m.get("run_id"),
+            "chunks_committed": sum(1 for e in entries
+                                    if e["status"] == "committed"),
+            "chunks_timeout": sum(1 for e in entries
+                                  if e["status"] == "TIMEOUT"),
+            "resumes": len(m.get("resumes") or []),
+        })
+    chunks.sort(key=lambda e: e["lo"])
+    manifest = {
+        "journal_version": JOURNAL_VERSION,
+        "run_id": run_id or uuid.uuid4().hex[:12],
+        "created_at": time.time(),
+        "updated_at": time.time(),
+        "git_commit": _git_commit(),
+        "config_hash": config_hash,
+        "panel_fingerprint": panel_fingerprint,
+        "n_rows": int(n_rows),
+        "chunk_rows": int(chunk_rows),
+        "process_index": 0,
+        "merged_from_shards": len(spans),
+        "extra": dict(extra or {}),
+        "resumes": [],
+        "chunks": chunks,
+        "shards": shards,
+    }
+    if telemetry is not None:
+        manifest["telemetry"] = telemetry
+    _atomic_write_bytes(
+        os.path.join(root, MANIFEST),
+        (json.dumps(manifest, indent=1, sort_keys=True) + "\n").encode())
+    obs.event("journal.merged", shards=len(spans),
+              chunks=len(chunks))
+    return {
+        "dir": root,
+        "manifest": MANIFEST,
+        "run_id": manifest["run_id"],
+        "config_hash": config_hash,
+        "process_index": 0,
+        "merged_shards": len(spans),
+        "chunks_committed": sum(s["chunks_committed"] for s in shards),
+        "chunks_timeout": sum(s["chunks_timeout"] for s in shards),
+        "shards": shards,
+    }
+
+

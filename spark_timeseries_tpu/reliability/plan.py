@@ -1,0 +1,659 @@
+"""Execution plan + lane scheduler: the chunk walk as data, then as code.
+
+Through PR 5 the durable pipelined walk lived as one hand-wired loop inside
+``reliability.chunked.fit_chunked``: prefetcher, committer, watchdog, and
+journal were constructed inline and driven by closures, and the whole
+arrangement assumed ONE device and ONE lane.  This module is the refactor
+ROADMAP called the right first move for scale-out: the walk's
+configuration becomes an explicit :class:`ExecutionPlan` (spans, lanes,
+budgets as *data*), and the walk itself becomes :class:`LaneRunner` — the
+per-lane scheduler that owns exactly one prefetch → compute → commit
+pipeline over one contiguous row span.
+
+**One plan, one to N lanes.**  The serial walk, the pipelined walk, and
+the sharded walk are the SAME ``ExecutionPlan`` with different knob values
+and one-vs-many :class:`LaneSpec` entries.  A single-lane plan reproduces
+the PR 1–5 driver bit for bit; a sharded plan (``fit_chunked(shard=True)``
+or ``mesh=``) partitions the CHUNK GRID into contiguous per-shard spans —
+each mesh device owns a contiguous block of whole chunks, the sharded
+twin of the reference's "every partition owns whole series" invariant —
+and runs one ``LaneRunner`` per shard concurrently, each dispatching to
+its own device.  Because shard boundaries always land on the single-device
+walk's chunk boundaries, every chunk is the same rows through the same
+compiled program either way, so the sharded result is bitwise-identical
+to the single-device walk on the same panel.
+
+**Durability composes unchanged.**  Each lane journals into its own shard
+namespace (``shard_00000/…`` — the per-process namespace rule of
+:mod:`.journal`, extended down to lanes), and the driver's shard 0 merges
+the shard manifests into ONE job manifest after the lanes join.  A
+crash/preemption resume rebuilds the same plan, and each lane replays only
+its own uncommitted chunks.
+
+Plan knobs (lanes, mesh, pipeline depths) are deliberately EXCLUDED from
+the journal's config hash: they move work between threads and devices
+without changing a byte of any chunk, so a journal written by the
+pre-plan single-device driver resumes under a SINGLE-lane plan, and a
+merged sharded job manifest can even be adopted by a later single-device
+walk (the merged entries keep their shard-relative paths).  The reverse
+is not adoption: a sharded plan's lanes journal into fresh shard
+namespaces, so chunks a root/serial manifest already committed are
+recomputed (identical bytes, just repeated work), never spliced.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .. import obs
+from ..obs import memory as memory_probe
+from . import committer as committer_mod
+from . import prefetcher as prefetcher_mod
+from . import watchdog as watchdog_mod
+from .runner import resilient_fit
+from .status import FitStatus, STATUS_DTYPE, status_counts
+
+__all__ = [
+    "ExecutionPlan",
+    "LaneRunner",
+    "LaneSpec",
+    "OOMBackoffExceeded",
+    "is_resource_exhausted",
+    "shard_spans",
+]
+
+# substrings the XLA runtime uses for allocation failure; the simulated OOM
+# of reliability.faultinject raises with the same marker so tier-1 CPU tests
+# drive this path without a real HBM exhaustion
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+class OOMBackoffExceeded(RuntimeError):
+    """Raised when the minimum chunk size still exhausts device memory."""
+
+
+def is_resource_exhausted(e: BaseException) -> bool:
+    """True for XLA RESOURCE_EXHAUSTED-style allocation failures.
+
+    ``jaxlib``'s ``XlaRuntimeError`` subclasses ``RuntimeError``, so the
+    check is message-based on RuntimeError/MemoryError rather than pinned
+    to a jaxlib exception type that moves between releases.
+    """
+    if isinstance(e, MemoryError):
+        return True
+    if not isinstance(e, RuntimeError):
+        return False
+    msg = str(e)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+class LaneSpec(NamedTuple):
+    """One lane of the walk: a contiguous row span and (optionally) the
+    device that owns it.  ``device=None`` means "wherever the caller's
+    panel lives" — the single-device walk."""
+
+    shard_id: int
+    lo: int  # global row offset (inclusive)
+    hi: int  # global row offset (exclusive)
+    device: Optional[object] = None  # jax.Device for sharded lanes
+
+
+class ExecutionPlan(NamedTuple):
+    """The whole walk as data: spans, lanes, budgets, pipeline knobs.
+
+    Built once per ``fit_chunked`` call (and rebuilt identically on a
+    journaled resume — everything that decides a chunk's BYTES is covered
+    by the journal config hash; everything here that is not hashed only
+    decides WHERE/WHEN work happens).
+    """
+
+    n_rows: int
+    chunk_rows: int  # initial chunk size (chunk0)
+    min_chunk_rows: int
+    max_backoffs: int  # per-lane OOM backoff budget
+    resilient: bool
+    policy: str
+    ladder: Optional[tuple]
+    checkpoint_dir: Optional[str]
+    resume: str
+    chunk_budget_s: Optional[float]
+    job_budget_s: Optional[float]
+    pipeline: bool
+    pipeline_depth: int
+    prefetch_depth: int
+    align_mode: Optional[str]  # resolved static plan mode (None: no hint)
+    lanes: Tuple[LaneSpec, ...]  # the lanes THIS process runs
+    process_index: int
+    # GLOBAL shard count: under jax.distributed a process may run a single
+    # lane (or none) of a genuinely sharded walk, and its telemetry/events
+    # must still carry shard tags so the merged timeline stays per-lane
+    n_shards: int = 1
+
+    @property
+    def sharded(self) -> bool:
+        return self.n_shards > 1
+
+
+def shard_spans(n_rows: int, chunk_rows: int,
+                n_shards: int) -> Sequence[Tuple[int, int]]:
+    """Partition the chunk grid into at most ``n_shards`` contiguous spans.
+
+    The unit of distribution is the CHUNK, not the row: every span is a
+    whole number of ``chunk_rows`` chunks (the last span absorbs the
+    ragged tail), so a sharded walk visits exactly the chunk boundaries
+    the single-device walk would — the invariant the bitwise-identity
+    contract rests on.  Shards are balanced to within one chunk; when
+    there are fewer chunks than shards, the extra shards get no lane.
+    """
+    n_rows = int(n_rows)
+    chunk_rows = max(1, int(chunk_rows))
+    n_chunks = -(-n_rows // chunk_rows)
+    n_lanes = max(1, min(int(n_shards), n_chunks))
+    q, r = divmod(n_chunks, n_lanes)
+    spans, start = [], 0
+    for i in range(n_lanes):
+        take = q + (1 if i < r else 0)
+        lo = start * chunk_rows
+        start += take
+        hi = min(start * chunk_rows, n_rows)
+        spans.append((lo, hi))
+    return spans
+
+
+def _span_times(sp) -> dict:
+    """Wall/process times of a closed chunk span, or ``{}`` when the plane
+    was disabled mid-run (the span degraded to the shared no-op whose
+    times are None — telemetry may lose a row's timings but must never
+    crash the fit it observes)."""
+    if sp.wall_s is None:
+        return {}
+    out = {"wall_s": round(sp.wall_s, 6)}
+    if sp.process_s is not None:
+        out["process_s"] = round(sp.process_s, 6)
+    return out
+
+
+class _TimeoutChunk:
+    """Placeholder for a chunk whose fit never finished; materialized into
+    NaN-param / ``TIMEOUT``-status rows once the parameter width is known
+    (from any finished chunk) at assembly time."""
+
+    __slots__ = ("lo", "hi")
+
+    def __init__(self, lo: int, hi: int):
+        self.lo, self.hi = lo, hi
+
+
+def _piece_status(p) -> np.ndarray:
+    """Status of one chunk result; synthesized when the fit has none."""
+    status = getattr(p, "status", None)
+    conv = np.asarray(p.converged)
+    if status is None:
+        finite = np.isfinite(np.asarray(p.params)).all(axis=-1)
+        return np.where(conv & finite, FitStatus.OK,
+                        FitStatus.DIVERGED).astype(STATUS_DTYPE)
+    return np.asarray(status).astype(STATUS_DTYPE)
+
+
+def _commit_arrays(piece) -> dict:
+    """Host-side arrays of one finished chunk, in the journal shard schema.
+
+    Under the pipelined driver this runs on the committer thread, so for
+    non-resilient fits the device->host fetch itself overlaps the next
+    chunk's device compute."""
+    return {
+        "params": np.asarray(piece.params),
+        "nll": np.asarray(piece.neg_log_likelihood),
+        "converged": np.asarray(piece.converged),
+        "iters": np.asarray(piece.iters),
+        "status": _piece_status(piece),
+    }
+
+
+class _LaneView:
+    """Offset view over a lane's device-local panel: translates the walk's
+    GLOBAL row spans into the lane array's local rows, so the prefetcher
+    and the inline slice path share one expression (and the staged bytes
+    are exactly the bytes the inline slice would produce)."""
+
+    __slots__ = ("arr", "base")
+
+    def __init__(self, arr, base: int):
+        self.arr = arr
+        self.base = int(base)
+
+    def __getitem__(self, s: slice):
+        return self.arr[s.start - self.base:s.stop - self.base]
+
+
+class LaneResult(NamedTuple):
+    """Everything one lane hands back to the driver for merging."""
+
+    spec: LaneSpec
+    pieces: list  # (lo, hi, piece) in walk order; piece may be _TimeoutChunk
+    oom_events: list
+    timeout_events: list
+    tele_chunks: Optional[list]
+    pipe_stats: Optional[committer_mod.CommitterStats]
+    pf_stats: Optional[prefetcher_mod.PrefetchStats]
+    chunk_final: int
+    committer_depth: Optional[int]
+    prefetch_depth: Optional[int]
+
+
+class LaneRunner:
+    """One prefetch → compute → commit lane over one contiguous row span.
+
+    This IS the former ``fit_chunked`` loop, verbatim in behavior: the
+    single-lane plan reproduces the PR 1–5 driver (same chunk boundaries,
+    same journal protocol, same backoff/timeout/rollback semantics, same
+    bytes).  A sharded plan runs several of these concurrently, one per
+    mesh device, each against its own journal namespace and its own
+    committer/prefetcher pair; the shared pieces of state are the job
+    :class:`~.watchdog.Deadline` (wall clock is global) and the obs
+    metrics registry (counters are merged accounting by design).
+
+    ``values`` is the lane's device-local panel whose row 0 is global row
+    ``spec.lo``; the walk itself runs in GLOBAL row coordinates so journal
+    entries, telemetry rows, and result assembly agree across lanes.
+    """
+
+    def __init__(self, plan: ExecutionPlan, spec: LaneSpec, fit_fn: Callable,
+                 fit_kwargs: dict, values, *, journal=None, deadline=None,
+                 tele: bool = False, fit_key=None):
+        self.plan = plan
+        self.spec = spec
+        self.fit_fn = fit_fn
+        self.fit_kwargs = fit_kwargs
+        self.values = values
+        self.journal = journal
+        self.deadline = deadline or watchdog_mod.Deadline(plan.job_budget_s)
+        self.tele = tele
+        self.fit_key = fit_key
+        # obs attrs tagged with the shard id ONLY for sharded plans: the
+        # single-lane walk's spans/events/meta stay byte-identical to the
+        # pre-plan driver
+        self.tag = {"shard": spec.shard_id} if plan.sharded else {}
+
+        span_rows = spec.hi - spec.lo
+        self.chunk = max(1, min(plan.chunk_rows, span_rows))
+        self.committer = None
+        if journal is not None and plan.pipeline:
+            self.committer = committer_mod.ChunkCommitter(
+                journal, _commit_arrays, depth=plan.pipeline_depth,
+                probe=memory_probe.peak_memory, status_counts=status_counts)
+        # input-side pipeline: stage chunk N+1's slice while chunk N
+        # computes.  Only sliced walks stage (a whole-span chunk has no
+        # next slice), and pipeline=False stays the fully serial escape
+        # hatch for BOTH halves
+        self.prefetcher = None
+        if plan.pipeline and plan.prefetch_depth and self.chunk < span_rows:
+            panel = values if spec.lo == 0 else _LaneView(values, spec.lo)
+            self.prefetcher = prefetcher_mod.ChunkPrefetcher(
+                panel, depth=plan.prefetch_depth)
+
+        self.pieces: list = []
+        self.oom_events: list = []
+        self.timeout_events: list = []
+        self.tele_chunks: Optional[list] = [] if tele else None
+        # boundaries of committed-but-unloadable (torn-shard) chunks: the
+        # recompute must cover the EXACT recorded [lo, hi) — deriving hi
+        # from the current chunk size could overlap a later committed chunk
+        # and break the bitwise-identical-boundaries contract
+        self.lost_boundaries: dict = {}
+
+    # -- slicing -------------------------------------------------------------
+
+    def _slice(self, lo: int, hi: int):
+        base = self.spec.lo
+        return self.values[lo - base:hi - base]
+
+    # -- backoff / rollback --------------------------------------------------
+
+    def _record_oom(self, at_row: int, rows: int, e: BaseException) -> int:
+        """Shared backoff bookkeeping for fit-time, staging-time, and
+        commit-time OOMs; returns the halved chunk size (or raises when
+        the budget/floor is spent).  Every staged slice is invalidated
+        first: the halved boundary makes every prefetch prediction wrong,
+        and a freed staged buffer is exactly the HBM the retry needs."""
+        plan = self.plan
+        if self.prefetcher is not None:
+            self.prefetcher.invalidate()
+        self.oom_events.append({
+            "at_row": at_row, "chunk_rows": rows,
+            "error": f"{type(e).__name__}: {e}"[:200],
+        })
+        obs.counter("chunked.oom_backoffs").inc()
+        obs.event("chunk.oom_backoff", at_row=at_row, chunk_rows=rows,
+                  **self.tag)
+        if rows <= plan.min_chunk_rows or len(self.oom_events) > plan.max_backoffs:
+            raise OOMBackoffExceeded(
+                f"chunk of {rows} rows still RESOURCE_EXHAUSTED after "
+                f"{len(self.oom_events)} backoffs (floor {plan.min_chunk_rows})"
+            ) from e
+        return max(plan.min_chunk_rows, rows // 2)
+
+    def _rollback(self, err):
+        """Handle a committer-detected failure (the fetch/commit of an
+        async-dispatched chunk raised on the worker thread).
+
+        Non-OOM errors re-raise unchanged.  An OOM rolls the walk back to
+        the failed chunk: everything at/after it is uncommitted (in-order
+        queue), so its pieces are dropped, the chunk size halves, and the
+        walk re-enters at the failed row — the pipelined twin of the
+        fit-time backoff.  Returns the (lo, chunk) to continue from."""
+        e, flo, fhi = err
+        if not is_resource_exhausted(e):
+            raise e
+        new_chunk = self._record_oom(flo, fhi - flo, e)
+        self.pieces[:] = [p for p in self.pieces if p[0] < flo]
+        if self.tele:
+            self.tele_chunks[:] = [r for r in self.tele_chunks
+                                   if r["lo"] < flo]
+        return flo, new_chunk
+
+    def _next_span(self, nlo: int, cur_chunk: int):
+        """The span the walk will visit after the current chunk — the
+        prefetcher's prediction.  Mirrors the walk's own boundary logic
+        exactly: torn-shard forced boundaries, then the committed-grid
+        clamp (a staged slice must never sail past a committed chunk's
+        ``lo``).  Returns None at the lane end or when the next span is
+        already committed (the resume path loads it from its shard — no
+        device slice needed)."""
+        if nlo >= self.spec.hi:
+            return None
+        journal = self.journal
+        if journal is not None and journal.committed(nlo) is not None:
+            return None
+        forced = self.lost_boundaries.get(nlo)
+        if forced:
+            return nlo, forced[0]
+        nhi = min(nlo + cur_chunk, self.spec.hi)
+        if journal is not None:
+            nxt = journal.next_committed_lo(nlo)
+            if nxt is not None and nxt < nhi:
+                nhi = nxt
+        return nlo, nhi
+
+    def _drain_for_journal_write(self):
+        """Synchronize with the committer before the driver itself writes
+        the journal (TIMEOUT marks, forced torn-shard recommits): after
+        this, every earlier commit is durable and the driver is the only
+        writer.  Returns a pending error tuple instead of raising so the
+        caller can roll back."""
+        if self.committer is None:
+            return None
+        return self.committer.drain(raise_pending=False)
+
+    # -- the walk ------------------------------------------------------------
+
+    def run(self) -> LaneResult:
+        try:
+            self._walk()
+        except BaseException:
+            if self.committer is not None:
+                # the walk is failing: stop the worker without letting a
+                # second (pending) commit error mask the original exception
+                self.committer.close(raise_pending=False)
+            if self.prefetcher is not None:
+                self.prefetcher.close()
+            raise
+        pipe_stats = (self.committer.close()
+                      if self.committer is not None else None)
+        pf_stats = (self.prefetcher.close()
+                    if self.prefetcher is not None else None)
+        return LaneResult(
+            self.spec, self.pieces, self.oom_events, self.timeout_events,
+            self.tele_chunks, pipe_stats, pf_stats, self.chunk,
+            self.committer.depth if self.committer is not None else None,
+            self.prefetcher.depth if self.prefetcher is not None else None)
+
+    def _walk(self) -> None:
+        plan, spec = self.plan, self.spec
+        journal, deadline = self.journal, self.deadline
+        tele = self.tele
+        fit_fn, fit_kwargs = self.fit_fn, self.fit_kwargs
+        lo = spec.lo
+        while True:
+            if self.committer is not None:
+                err = self.committer.take_error()
+                if err is not None:
+                    lo, self.chunk = self._rollback(err)
+                    continue
+            if lo >= spec.hi:
+                # final drain: a commit of one of the last chunks may still
+                # fail (or OOM at fetch) — that must surface (or roll the
+                # walk back) BEFORE assembly reads the pieces
+                err = self._drain_for_journal_write()
+                if err is not None:
+                    lo, self.chunk = self._rollback(err)
+                    continue
+                break
+            if journal is not None:
+                entry = journal.committed(lo)
+                if entry is not None:
+                    piece = journal.load_chunk(entry)
+                    if piece is not None:
+                        self.pieces.append((lo, int(entry["hi"]), piece))
+                        if tele:
+                            self.tele_chunks.append(
+                                {"lo": lo, "hi": int(entry["hi"]),
+                                 "phase": "resumed", **self.tag})
+                        lo = entry["hi"]
+                        # replay the backoff state in effect when the chunk
+                        # committed, so the resumed walk visits the SAME
+                        # boundaries the uninterrupted run would have
+                        self.chunk = int(entry.get("chunk_rows_after",
+                                                   self.chunk))
+                        continue
+                    self.lost_boundaries[lo] = (
+                        int(entry["hi"]),
+                        int(entry.get("chunk_rows_after", self.chunk)))
+            forced = self.lost_boundaries.get(lo)
+            hi = forced[0] if forced else min(lo + self.chunk, spec.hi)
+            if journal is not None and not forced:
+                # keep the walk on the committed grid: after an OOM backoff
+                # whose halving does not divide the original chunk size, a
+                # free-running hi would sail past the next committed chunk's
+                # lo, orphaning it (never matched again) and double-counting
+                # its rows in the manifest — clamp to the boundary instead
+                nxt = journal.next_committed_lo(lo)
+                if nxt is not None and nxt < hi:
+                    hi = nxt
+            if deadline.exceeded():
+                err = self._drain_for_journal_write()
+                if err is not None:
+                    lo, self.chunk = self._rollback(err)
+                    continue
+                if forced:
+                    self.chunk = forced[1]
+                    self.lost_boundaries.pop(lo, None)
+                self.timeout_events.append({
+                    "at_row": lo, "chunk_rows": hi - lo, "dispatched": False,
+                    "budget_s": deadline.budget_s, "scope": "job"})
+                obs.counter("chunked.timeouts.job").inc()
+                obs.event("chunk.timeout", lo=lo, hi=hi, scope="job",
+                          dispatched=False, **self.tag)
+                if tele:
+                    self.tele_chunks.append({"lo": lo, "hi": hi,
+                                             "phase": "timeout",
+                                             "scope": "job", **self.tag})
+                self.pieces.append((lo, hi, _TimeoutChunk(lo, hi)))
+                if journal is not None:
+                    journal.mark_timeout(lo, hi, scope="job",
+                                         budget_s=deadline.budget_s,
+                                         chunk_rows_after=self.chunk)
+                lo = hi
+                continue
+
+            def run_chunk(lo=lo, hi=hi, chunk=self.chunk):
+                # lo/hi/chunk are DEFAULT-ARG SNAPSHOTS, not closure reads:
+                # a watchdog-abandoned thread keeps running after the driver
+                # has mutated the loop variables, and it must keep operating
+                # on ITS chunk's span — never take() the live chunk's staged
+                # slice or slice a torn lo/hi pair mid-update.
+                # acquire this chunk's values INSIDE the watchdog window:
+                # the whole-span chunk hands the lane's array through
+                # untouched (a slice would be a fresh device buffer — an
+                # extra HBM copy, and a miss in the per-array-identity
+                # align-mode cache callers pre-warm); sliced chunks come
+                # from the prefetcher when the staged prediction matched.
+                # A staged slice can be queued behind an ABANDONED
+                # (timed-out) computation, so the wait on it must be
+                # bounded by the same budget as the compute it feeds — and
+                # a staging-time RESOURCE_EXHAUSTED surfaces here, through
+                # the watchdog, into the same backoff ladder as a fit-time
+                # one.
+                if lo == spec.lo and hi == spec.hi:
+                    vals = self.values
+                elif self.prefetcher is not None:
+                    vals = self.prefetcher.take(lo, hi)
+                else:
+                    vals = self._slice(lo, hi)
+                if self.prefetcher is not None:
+                    # stage the next spans now (up to depth ahead — take()
+                    # just freed this chunk's slot), so they materialize
+                    # while this chunk computes (and, for resilient fits,
+                    # while the ladder blocks on host work)
+                    nlo = hi
+                    for _ in range(self.prefetcher.depth):
+                        nxt = self._next_span(nlo, chunk)
+                        if nxt is None:
+                            break
+                        self.prefetcher.schedule(*nxt)
+                        nlo = nxt[1]
+                if plan.resilient:
+                    return resilient_fit(
+                        fit_fn, vals, policy=plan.policy, ladder=plan.ladder,
+                        **fit_kwargs)
+                out = fit_fn(vals, **fit_kwargs)
+                if plan.chunk_budget_s is not None:
+                    # with a deadline armed the budget must cover the device
+                    # computation, not just its async dispatch — block here,
+                    # INSIDE the watchdog window
+                    jax.block_until_ready(out)
+                return out
+
+            phase = None
+            if tele:
+                # first dispatch of this (fit config, chunk rows) pays JAX
+                # trace+compile; later dispatches of the same shape execute
+                # a cached program — the split BENCH scraped ad hoc, now
+                # recorded per chunk (a backoff-halved chunk is a NEW shape
+                # = new compile).  Keyed per SHARD: executables are cached
+                # per device placement, so every lane's first chunk pays
+                # its own compile, not just the first lane to dispatch
+                phase = ("compile+execute"
+                         if obs.first_dispatch(
+                             (self.fit_key, self.spec.shard_id, hi - lo))
+                         else "execute")
+            sp = obs.span("chunk", lo=lo, hi=hi, phase=phase, **self.tag)
+            t0 = time.perf_counter()
+            try:
+                with sp:
+                    piece = watchdog_mod.call_with_deadline(
+                        run_chunk, plan.chunk_budget_s,
+                        label=f"chunk rows [{lo}, {hi})")
+            except watchdog_mod.DeadlineExceeded:
+                err = self._drain_for_journal_write()
+                if err is not None:
+                    lo, self.chunk = self._rollback(err)
+                    continue
+                if forced:
+                    self.chunk = forced[1]
+                    self.lost_boundaries.pop(lo, None)
+                self.timeout_events.append({
+                    "at_row": lo, "chunk_rows": hi - lo, "dispatched": True,
+                    "budget_s": plan.chunk_budget_s, "scope": "chunk"})
+                obs.counter("chunked.timeouts.chunk").inc()
+                obs.event("chunk.timeout", lo=lo, hi=hi, scope="chunk",
+                          dispatched=True, budget_s=plan.chunk_budget_s,
+                          **self.tag)
+                if tele:
+                    self.tele_chunks.append(
+                        {"lo": lo, "hi": hi, "phase": "timeout",
+                         "scope": "chunk", **self.tag, **_span_times(sp)})
+                self.pieces.append((lo, hi, _TimeoutChunk(lo, hi)))
+                if journal is not None:
+                    journal.mark_timeout(lo, hi, scope="chunk",
+                                         budget_s=plan.chunk_budget_s,
+                                         chunk_rows_after=self.chunk)
+                lo = hi
+                continue
+            except Exception as e:  # noqa: BLE001 - filtered just below
+                if not is_resource_exhausted(e):
+                    raise
+                # drain before re-entering backoff: the journal state is
+                # then deterministic at every backoff decision, and a
+                # failed commit of an EARLIER chunk takes precedence over
+                # this chunk's fit-time OOM (it is earlier in the walk)
+                err = self._drain_for_journal_write()
+                if err is not None:
+                    lo, self.chunk = self._rollback(err)
+                    continue
+                if forced:
+                    # a torn-shard recompute is pinned to the committed
+                    # [lo, hi): halving `chunk` would not shrink the
+                    # dispatch (hi stays forced), so retrying is futile —
+                    # fail with the actionable cause instead of burning the
+                    # backoff budget
+                    raise OOMBackoffExceeded(
+                        f"recompute of torn-shard chunk [{lo}, {hi}) hit "
+                        "RESOURCE_EXHAUSTED; its boundaries are fixed by the "
+                        "journal, so backoff cannot help. Free device "
+                        "memory, or restart the job under a fresh "
+                        "checkpoint_dir (or remove this journal explicitly) "
+                        "to let the walk re-chunk."
+                    ) from e
+                self.chunk = self._record_oom(lo, self.chunk, e)
+                continue
+            if forced:  # torn-shard recompute done: restore the recorded walk
+                self.chunk = forced[1]
+                self.lost_boundaries.pop(lo, None)
+            if tele:
+                self.tele_chunks.append({"lo": lo, "hi": hi, "phase": phase,
+                                         **self.tag, **_span_times(sp)})
+            if journal is not None:
+                wall_s = round(time.perf_counter() - t0, 4)
+                if self.committer is not None and not forced:
+                    # background commit: the fetch + shard + manifest update
+                    # overlap the next chunk's dispatch/compute.  chunk_rows
+                    # _after is captured NOW (not at commit time) so the
+                    # recorded backoff state matches the serial walk exactly
+                    try:
+                        self.committer.submit(lo, hi, piece, wall_s=wall_s,
+                                              chunk_rows_after=self.chunk)
+                    except BaseException as se:
+                        err = self.committer.take_error()
+                        # only the worker's OWN re-raised error enters the
+                        # rollback path: an unrelated exception (e.g. a
+                        # Ctrl-C landing while submit blocked) must abort,
+                        # not be converted into an OOM retry
+                        if err is None or err[0] is not se:
+                            raise
+                        lo, self.chunk = self._rollback(err)
+                        continue
+                else:
+                    # forced torn-shard recommits stay synchronous: they are
+                    # rare, their boundaries are pinned by the journal, and
+                    # the serial path keeps their edge semantics exact
+                    err = self._drain_for_journal_write()
+                    if err is not None:
+                        lo, self.chunk = self._rollback(err)
+                        continue
+                    arrays = _commit_arrays(piece)
+                    pm = memory_probe.peak_memory()
+                    journal.commit_chunk(
+                        lo, hi, arrays,
+                        wall_s=wall_s,
+                        peak_hbm_bytes=pm.bytes,
+                        peak_hbm_source=pm.source,
+                        chunk_rows_after=self.chunk,
+                        status_counts=status_counts(arrays["status"]),
+                    )
+            self.pieces.append((lo, hi, piece))
+            lo = hi

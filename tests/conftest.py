@@ -39,3 +39,15 @@ def cpu_devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 forced CPU devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(scope="session")
+def lane_mesh(cpu_devices):
+    """1-D ``(series,)`` mesh over all 8 forced CPU devices — the sharded
+    chunk-walk fixture (ISSUE 6).  Because the forced-device env above runs
+    before any jax import, sharded-walk tests execute in tier-1 directly
+    (no subprocess, no skip): every lane dispatches to its own XLA CPU
+    device exactly as it would to a TPU chip."""
+    from spark_timeseries_tpu.parallel import mesh as meshlib
+
+    return meshlib.default_mesh()

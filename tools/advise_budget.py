@@ -29,6 +29,18 @@ tool reads one manifest and prints suggested
 - ``align_mode``      — the walk's recorded static alignment plan, so the
                         next run passes the hint and skips even the one
                         per-walk NaN-probe host sync.
+- ``shards``          — how many mesh lanes the next run should walk
+                        (``fit_chunked(shard=True)`` / ``mesh=``): for a
+                        merged sharded manifest, the lanes that actually
+                        committed work (an idle lane is a wasted chip);
+                        for a single-device manifest, the chunk count —
+                        every chunk can be its own lane, and the mesh
+                        clamps to its device count at runtime.  Per-shard
+                        ``chunk_rows`` is resized so every lane walks at
+                        least two chunks (a one-chunk lane has nothing to
+                        overlap its commit/staging under), with the
+                        per-shard wall balance printed so a straggler
+                        lane is visible.
 
     python tools/advise_budget.py CHECKPOINT_DIR [--json]
 
@@ -127,6 +139,45 @@ def advise(m: dict) -> dict:
     if staging_mean and exec_mean and exec_mean > 0:
         prefetch_depth = max(1, min(4, math.ceil(staging_mean / exec_mean)))
 
+    # -- shards: lanes for the next run's mesh walk (ISSUE 6) ----------------
+    # a merged sharded manifest records which lanes actually carried work
+    # and how their walls balanced; a single-device manifest still says how
+    # many lanes the chunk grid COULD feed (the mesh clamps to its devices)
+    n_rows = int(m.get("n_rows", sum(sizes)))
+    shards_block = m.get("shards") or []
+    shard_obs = None
+    if shards_block:
+        worked = [s for s in shards_block
+                  if (s.get("chunks_committed") or s.get("chunks_timeout"))]
+        lane_walls = {}
+        for e in chunks:
+            sid = e.get("shard_id")
+            if sid is not None and e.get("wall_s") is not None:
+                lane_walls[sid] = lane_walls.get(sid, 0.0) + e["wall_s"]
+        balance = None
+        if lane_walls:
+            mean_w = sum(lane_walls.values()) / len(lane_walls)
+            balance = (round(max(lane_walls.values()) / mean_w, 4)
+                       if mean_w > 0 else None)
+        shard_obs = {
+            "n_shards": len(shards_block),
+            "lanes_with_work": len(worked),
+            "shard_wall_balance": balance,  # max lane wall / mean lane wall
+            "lane_walls_s": {str(k): round(v, 4)
+                             for k, v in sorted(lane_walls.items())},
+        }
+        shards_suggest = max(1, len(worked))
+    else:
+        # unsharded run: each chunk can become a lane (the coarsest useful
+        # split); the runtime mesh clamps this to its device count
+        shards_suggest = max(1, -(-n_rows // max(1, chunk_rows)))
+    # per-shard chunk_rows: every lane should walk >= 2 chunks so its
+    # commit/staging has a next chunk to hide under — never grow past the
+    # OOM-sustained size
+    rows_per_shard = -(-n_rows // shards_suggest)
+    chunk_rows_sharded = max(1, min(chunk_rows, -(-rows_per_shard // 2))) \
+        if shards_suggest > 1 else chunk_rows
+
     return {
         "config_hash": m.get("config_hash"),
         "panel_fingerprint": m.get("panel_fingerprint"),
@@ -149,6 +200,7 @@ def advise(m: dict) -> dict:
             "input_overlap_efficiency":
                 staging.get("input_overlap_efficiency"),
             "align_mode": align_mode,
+            "shards": shard_obs,
         },
         "suggest": {
             "chunk_rows": chunk_rows,
@@ -157,6 +209,8 @@ def advise(m: dict) -> dict:
             "pipeline_depth": pipeline_depth,
             "prefetch_depth": prefetch_depth,
             "align_mode": align_mode,
+            "shards": shards_suggest,
+            "chunk_rows_per_shard": chunk_rows_sharded,
         },
     }
 
@@ -196,6 +250,12 @@ def main():
         print(f"  input staging: mean {o['staging_wall_s_mean']}s/slice"
               + (f", overlap {o['input_overlap_efficiency']}"
                  if o["input_overlap_efficiency"] is not None else ""))
+    if o["shards"] is not None:
+        so = o["shards"]
+        print(f"  sharded lanes: {so['lanes_with_work']}/{so['n_shards']} "
+              "carried work"
+              + (f"; wall balance max/mean {so['shard_wall_balance']}"
+                 if so["shard_wall_balance"] is not None else ""))
     print("  suggest for the next run of this config hash:")
     print(f"    chunk_rows     = {s['chunk_rows']}")
     print(f"    chunk_budget_s = {s['chunk_budget_s']}")
@@ -204,6 +264,11 @@ def main():
     print(f"    prefetch_depth = {s['prefetch_depth']}")
     if s["align_mode"] is not None:
         print(f"    align_mode     = {s['align_mode']!r}")
+    print(f"    shards         = {s['shards']}  (shard=True/mesh=; clamped "
+          "to the mesh's series devices at runtime)")
+    if s["shards"] > 1:
+        print(f"    chunk_rows (per-shard walk) = {s['chunk_rows_per_shard']}"
+              "  (>= 2 chunks per lane so commits/staging overlap)")
 
 
 if __name__ == "__main__":

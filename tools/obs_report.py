@@ -16,6 +16,12 @@ how long did journal commits take, what did memory peak at:
 ``--manifest``, the journal manifest's embedded ``telemetry`` block:
 per-chunk span times present, counters present, peak memory non-null) and
 exits 0/1 — the ci.sh telemetry smoke runs exactly this.
+
+Sharded walks (ISSUE 6): a merged job manifest carries a ``shards`` block
+and shard-tagged chunk entries/telemetry rows; ``--check --manifest``
+validates that block (contiguous spans, in-range shard ids, shard-rooted
+npz paths), and the rendered timeline splits into ONE LANE PER SHARD so
+the eight concurrent walks read as eight rows, not one interleaved blur.
 """
 
 from __future__ import annotations
@@ -138,6 +144,86 @@ def validate_manifest_telemetry(ckpt_dir: str) -> list:
                 if not isinstance(st.get(k), (int, float)):
                     errors.append(f"telemetry.input_staging.{k} invalid: "
                                   f"{st.get(k)!r}")
+    errors += validate_manifest_shards(m, path)
+    return errors
+
+
+def validate_manifest_shards(m: dict, path: str) -> list:
+    """Validate a merged sharded-job manifest's ``shards`` block (ISSUE 6).
+
+    Unsharded manifests (no block) pass untouched.  A merged manifest must
+    carry contiguous per-shard spans covering the panel, per-shard
+    accounting, chunk entries tagged with an in-range ``shard_id`` whose
+    row range sits inside their shard's span and whose npz path is rooted
+    in that shard's namespace, and (when telemetry rode along) shard tags
+    on the merged timeline rows.
+    """
+    shards = m.get("shards")
+    if shards is None and not m.get("merged_from_shards"):
+        return []
+    errors = []
+    if not isinstance(shards, list) or not shards:
+        return [f"manifest {path}: merged_from_shards set but shards "
+                "block missing/empty"]
+    if m.get("merged_from_shards") != len(shards):
+        errors.append(f"shards block has {len(shards)} entries but "
+                      f"merged_from_shards={m.get('merged_from_shards')}")
+    prev_hi = 0
+    for i, s in enumerate(shards):
+        if not isinstance(s, dict):
+            errors.append(f"shards[{i}] is not an object: {s!r}")
+            continue
+        if s.get("shard_id") != i:
+            errors.append(f"shards[{i}].shard_id is {s.get('shard_id')!r}")
+        lo, hi = s.get("lo"), s.get("hi")
+        if not isinstance(lo, int) or not isinstance(hi, int) or lo >= hi:
+            errors.append(f"shards[{i}] span invalid: [{lo!r}, {hi!r})")
+            continue
+        if lo != prev_hi:
+            errors.append(f"shards[{i}] span not contiguous: lo {lo} "
+                          f"after hi {prev_hi}")
+        prev_hi = hi
+        for k in ("chunks_committed", "chunks_timeout"):
+            if not isinstance(s.get(k), int) or s[k] < 0:
+                errors.append(f"shards[{i}].{k} invalid: {s.get(k)!r}")
+        if not isinstance(s.get("dir"), str):
+            errors.append(f"shards[{i}].dir invalid: {s.get('dir')!r}")
+    n_rows = m.get("n_rows")
+    if isinstance(n_rows, int) and prev_hi and prev_hi != n_rows:
+        errors.append(f"shard spans cover [0, {prev_hi}) but n_rows is "
+                      f"{n_rows}")
+    # spans only from well-formed entries: a malformed shard was already
+    # reported above, and chunks pointing at it get the not-in-block error
+    spans = {s.get("shard_id"): (s["lo"], s["hi"]) for s in shards
+             if isinstance(s, dict)
+             and isinstance(s.get("lo"), int) and isinstance(s.get("hi"), int)}
+    for c in m.get("chunks", []):
+        sid = c.get("shard_id")
+        if sid is None:
+            # a later single-device walk ADOPTING the merged manifest
+            # commits retried chunks at the root, untagged and root-rooted
+            # — the documented one-directional adoption contract, not a
+            # merge bug
+            continue
+        span = spans.get(sid)
+        if span is None:
+            errors.append(f"chunk {c.get('lo')}: shard_id {sid!r} not in "
+                          "the shards block")
+            continue
+        if not (span[0] <= c.get("lo", -1) and c.get("hi", 1 << 60) <= span[1]):
+            errors.append(f"chunk [{c.get('lo')}, {c.get('hi')}) outside "
+                          f"its shard {sid} span {span}")
+        d = next((s.get("dir") for s in shards
+                  if isinstance(s, dict) and s.get("shard_id") == sid), None)
+        if "shard" in c and isinstance(d, str) and \
+                not str(c["shard"]).startswith(d + "/"):
+            errors.append(f"chunk {c.get('lo')}: npz path {c['shard']!r} "
+                          f"not rooted in shard namespace {d!r}")
+    for row in ((m.get("telemetry") or {}).get("chunks") or []):
+        sid = row.get("shard")
+        if sid is not None and sid not in spans:
+            errors.append(f"telemetry chunk {row.get('lo')}: shard tag "
+                          f"{sid!r} not in the shards block")
     return errors
 
 
@@ -178,18 +264,46 @@ def _render(s: dict) -> None:
                   key=lambda ev: ev.get("t0", ev.get("ts", 0.0)))
     if rows:
         t_start = min(ev.get("t0", ev.get("ts", 0.0)) for ev in rows)
-        print("\ntimeline (s from start):")
-        for ev in rows:
+
+        def _row(ev, pad="  "):
             off = ev.get("t0", ev.get("ts", 0.0)) - t_start
             indent = "  " * ev.get("depth", 0)
             attrs = ev.get("attrs") or {}
             attrs_s = " ".join(f"{k}={v}" for k, v in attrs.items())
             if ev["kind"] == "span":
-                print(f"  {off:9.3f}  {indent}{ev['name']:<24} "
+                print(f"{pad}{off:9.3f}  {indent}{ev['name']:<24} "
                       f"wall {ev['wall_s']:9.4f}s  cpu {ev['process_s']:8.4f}s"
                       f"  {attrs_s}")
             else:
-                print(f"  {off:9.3f}  {indent}* {ev['name']:<22} {attrs_s}")
+                print(f"{pad}{off:9.3f}  {indent}* {ev['name']:<22} {attrs_s}")
+
+        # sharded walks (ISSUE 6) tag every lane's spans/events with its
+        # shard id: split the merged stream into ONE LANE PER SHARD so the
+        # concurrent walks read as parallel rows, with the driver-level
+        # rows (merge, panel spans) kept in their own section
+        lanes = sorted({(ev.get("attrs") or {}).get("shard") for ev in rows
+                        if (ev.get("attrs") or {}).get("shard") is not None})
+        if lanes:
+            drv = [ev for ev in rows
+                   if (ev.get("attrs") or {}).get("shard") is None]
+            print(f"\ntimeline (s from start; {len(lanes)} sharded lanes):")
+            for sid in lanes:
+                mine = [ev for ev in rows
+                        if (ev.get("attrs") or {}).get("shard") == sid]
+                wall = sum(ev.get("wall_s", 0.0) for ev in mine
+                           if ev["kind"] == "span")
+                print(f"  lane shard={sid}  ({len(mine)} rows, "
+                      f"span wall {wall:.4f}s):")
+                for ev in mine:
+                    _row(ev, pad="    ")
+            if drv:
+                print("  driver:")
+                for ev in drv:
+                    _row(ev, pad="    ")
+        else:
+            print("\ntimeline (s from start):")
+            for ev in rows:
+                _row(ev)
     m = s["metrics"]
     if m:
         print("\ncounters:")
@@ -249,4 +363,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BrokenPipeError:
+        # downstream closed early (`obs_report … | grep -q`, ci.sh under
+        # pipefail): not an error — mirror the standard CLI convention
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
