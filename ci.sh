@@ -153,6 +153,15 @@ rm -rf "$OBS_SMOKE_DIR"
 # manifest (written by shard/process 0) accounting for every chunk
 python tests/_sharded_worker.py --smoke
 
+# host-resident kill-and-resume smoke (ISSUE 7): a journaled walk over a
+# panel that lives in HOST RAM — 4x oversubscribed against a virtual
+# one-chunk device budget, each chunk staged H2D through the pinned-style
+# staging pool — is SIGKILLed with staged buffers in flight, resumed, and
+# the result must be BITWISE-identical to the in-HBM walk, with the
+# donated-buffer device footprint staying O(chunk) and the staging-pool
+# telemetry block journaled and validated by `obs_report --check`
+python tests/_hostwalk_worker.py --smoke
+
 # sharded tooling smoke (ISSUE 6): a short journaled sharded walk with
 # telemetry on must produce a merged manifest whose `shards` block passes
 # the obs_report schema gate, render one timeline lane per shard, and give

@@ -289,12 +289,28 @@ def _durable_fit(fit_fn, ts, checkpoint_dir, *, chunk_rows=None,
     — one journaled lane per series-axis device, bitwise-identical to
     the single-device walk (``reliability.fit_chunked`` sharded
     execution).
+
+    ``ts`` may also be a ``reliability.ChunkSource`` (e.g. a host
+    ``np.ndarray`` wrapped in ``HostChunkSource``) or an npz
+    shard-directory path (str / ``os.PathLike``, opened via
+    ``reliability.as_source``): the walk then runs HOST-RESIDENT,
+    staging each chunk H2D through the source's staging pool instead of
+    requiring the panel in device memory — the compat caller's
+    one-argument opt-in to larger-than-HBM panels.  Plain arrays keep
+    today's device-resident path; wrap in a source explicitly to opt a
+    resident-sized ndarray into host staging.
     """
+    import os as _os
+
     from .. import reliability as rel
 
-    a = jnp.asarray(ts)
-    single = a.ndim == 1
-    yb = jnp.atleast_2d(a)
+    if isinstance(ts, (rel.ChunkSource, str, _os.PathLike)):
+        single = False  # sources are 2-D panels by construction
+        yb = rel.as_source(ts)
+    else:
+        a = jnp.asarray(ts)
+        single = a.ndim == 1
+        yb = jnp.atleast_2d(a)
     res = rel.fit_chunked(
         fit_fn, yb, chunk_rows=chunk_rows, resilient=False,
         checkpoint_dir=checkpoint_dir, resume=resume,

@@ -353,7 +353,12 @@ def summary(counters_since: Optional[dict] = None, **extra) -> Optional[dict]:
         "run_id": st.run_id,
         "jsonl_path": rec.jsonl_path if rec else None,
         "events_recorded": rec.events_emitted if rec else 0,
-        "peak_memory": {"bytes": pm.bytes, "source": pm.source},
+        "peak_memory": {"bytes": pm.bytes, "source": pm.source,
+                        # present only when a host-resident walk staged
+                        # through a pool — the disabled-path/no-pool
+                        # summary stays byte-identical to pre-ISSUE-7
+                        **({"staging_pool_bytes": pm.staging_pool_bytes}
+                           if pm.staging_pool_bytes is not None else {})},
         **snap,
     }
     out.update(extra)

@@ -9,14 +9,56 @@ null on a working interpreter: it prefers the device allocator's
 falls back to the process's peak resident set via ``resource.getrusage``
 (the closest host-side analog), always reporting WHICH source produced
 the number so a dashboard cannot mistake host RSS for HBM.
+
+Host-resident chunk walks (ISSUE 7) stage their H2D copies through
+reusable staging-pool buffers (``reliability.source.StagingPool``); those
+pools :func:`register_staging_pool` themselves here, and the probe
+reports their combined peak host footprint as ``staging_pool_bytes`` next
+to the device/RSS reading — an oversubscribed run's manifest then carries
+both the device peak AND the staging RAM that made it possible, instead
+of undercounting the job's real footprint.
 """
 
 from __future__ import annotations
 
 import sys
+import weakref
 from typing import NamedTuple, Optional
 
-__all__ = ["PeakMemory", "peak_memory"]
+__all__ = ["PeakMemory", "peak_memory", "register_staging_pool"]
+
+# staging pools currently alive in this process (weak: a pool's lifetime
+# belongs to its ChunkSource, never to the probe).  The lock covers both
+# registration and iteration: the probe runs on committer worker threads
+# while another thread may be constructing a source, and an unguarded
+# WeakSet walk would raise "set changed size during iteration" out of a
+# diagnostics-only reading.
+import threading as _threading
+
+_staging_pools: "weakref.WeakSet" = weakref.WeakSet()
+_staging_pools_mu = _threading.Lock()
+
+
+def register_staging_pool(pool) -> None:
+    """Track a staging pool so :func:`peak_memory` reports its bytes.
+
+    ``pool`` must expose ``peak_host_bytes`` (an int attribute); the
+    registry holds it weakly.
+    """
+    with _staging_pools_mu:
+        _staging_pools.add(pool)
+
+
+def _staging_pool_peak() -> Optional[int]:
+    with _staging_pools_mu:
+        pools = list(_staging_pools)
+    total = 0
+    for p in pools:
+        try:
+            total += int(p.peak_host_bytes)
+        except Exception:  # noqa: BLE001 - diagnostics only
+            continue
+    return total or None
 
 
 class PeakMemory(NamedTuple):
@@ -24,6 +66,10 @@ class PeakMemory(NamedTuple):
 
     bytes: Optional[int]  # None only when every probe failed
     source: str  # "device" | "host_rss" | "unavailable"
+    # combined peak host bytes of registered H2D staging pools (None when
+    # no host-resident walk ran) — reported alongside, never folded into
+    # ``bytes``: staging RAM is host memory regardless of ``source``
+    staging_pool_bytes: Optional[int] = None
 
 
 def _device_peak() -> Optional[int]:
@@ -57,10 +103,11 @@ def peak_memory() -> PeakMemory:
     CPU it degrades to host peak RSS rather than ``None`` — the source
     field says which, and consumers must label accordingly.
     """
+    sp = _staging_pool_peak()
     b = _device_peak()
     if b is not None:
-        return PeakMemory(b, "device")
+        return PeakMemory(b, "device", sp)
     b = _host_peak_rss()
     if b is not None:
-        return PeakMemory(b, "host_rss")
-    return PeakMemory(None, "unavailable")
+        return PeakMemory(b, "host_rss", sp)
+    return PeakMemory(None, "unavailable", sp)

@@ -358,7 +358,7 @@ class TimeSeriesPanel:
             job_budget_s: Optional[float] = None,
             pipeline: bool = True, pipeline_depth: int = 2,
             prefetch_depth: int = 1, align_mode: Optional[str] = None,
-            shard: bool = False, mesh=None,
+            shard: bool = False, mesh=None, source=None,
             **fit_kwargs):
         """Fit a model family over every series via the resilient chunk driver.
 
@@ -405,6 +405,16 @@ class TimeSeriesPanel:
         chunk DRIVER's mesh knob, independent of the panel's own
         ``mesh``-attached SPMD fit path.
 
+        ``source=`` opts the walk into **host-resident execution** for
+        panels larger than device memory (``reliability.source``): pass a
+        host ``np.ndarray``, an npz shard directory path, or any
+        ``ChunkSource`` holding THIS panel's values — shape must match —
+        and the walk stages each chunk H2D through a reusable staging
+        pool instead of requiring the panel resident in HBM, with results
+        bitwise-identical to the in-HBM walk.  The panel's own (device)
+        values are then never touched; construct such a panel with a
+        cheap placeholder or use ``reliability.fit_chunked`` directly.
+
         Returns a ``reliability.ResilientFitResult`` whose rows align with
         ``self.keys``; ``.status`` carries per-series ``FitStatus`` codes
         and ``.meta`` the chunk/ladder/journal accounting.  This is the
@@ -423,11 +433,23 @@ class TimeSeriesPanel:
             fit_fn = mod.fit
         from .reliability import fit_chunked
 
+        if source is not None:
+            from .reliability import source as source_mod
+
+            src = source_mod.as_source(source)
+            if tuple(src.shape) != (int(self.n_series), int(self.n_time)):
+                raise ValueError(
+                    f"source shape {src.shape} does not match this panel "
+                    f"({self.n_series} series x {self.n_time} obs); the "
+                    "source must hold the panel's own values")
+            values = src
+        else:
+            values = self.series_values()
         model_name = (model if isinstance(model, str)
                       else getattr(model, "__qualname__", repr(model)))
         with obs.span("panel.fit", model=model_name, n_series=self.n_series):
             return fit_chunked(
-                fit_fn, self.series_values(), chunk_rows=chunk_rows,
+                fit_fn, values, chunk_rows=chunk_rows,
                 resilient=resilient, policy=policy,
                 checkpoint_dir=checkpoint_dir, resume=resume,
                 chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,

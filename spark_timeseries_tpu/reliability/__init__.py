@@ -28,6 +28,12 @@ same guarantees at row granularity:
   pipeline — a bounded background stager that materializes chunk N+1's
   device slice while chunk N computes (stage ∥ compute ∥ commit), with
   driver-controlled invalidation on OOM backoff and rollback.
+- :mod:`.source` — :class:`ChunkSource`: where the panel's rows live —
+  device array (today's path), host ``np.ndarray``, or an npz shard
+  directory — so ``fit_chunked(fit_fn, as_source(...))`` walks panels
+  that NEVER fully reside on device: chunks are staged H2D through a
+  pool of reusable host buffers and donated back to the allocator as the
+  walk passes, bounding steady-state device footprint at O(chunk).
 - :mod:`.journal` — :class:`ChunkJournal`: write-ahead per-chunk npz
   shards + an atomic JSON manifest, so a journaled multi-chunk fit
   (``fit_chunked(..., checkpoint_dir=...)``) survives process death and
@@ -41,14 +47,17 @@ same guarantees at row granularity:
 """
 
 from . import (chunked, committer, faultinject, journal, plan, prefetcher,
-               runner, sanitize, status, watchdog)
+               runner, sanitize, source, status, watchdog)
 from .chunked import OOMBackoffExceeded, fit_chunked, is_resource_exhausted
 from .committer import ChunkCommitter, CommitterStats
 from .plan import ExecutionPlan, LaneRunner, LaneSpec, shard_spans
 from .prefetcher import ChunkPrefetcher, PrefetchStats
-from .journal import (ChunkJournal, JournalError, StaleJournalError,
-                      TornManifestError, config_hash, merge_job_manifest,
-                      panel_fingerprint)
+from .journal import (ChunkJournal, JournalError, MergeWarmer,
+                      StaleJournalError, TornManifestError, config_hash,
+                      merge_job_manifest, panel_fingerprint)
+from .source import (ChunkSource, DeviceChunkSource, HostChunkSource,
+                     NpzShardSource, SourceError, StagingPool, as_source,
+                     write_npz_shards)
 from .runner import (ResilientFitResult, RetryRung, default_ladder,
                      resilient_fit)
 from .sanitize import SanitizeReport, sanitize
@@ -59,8 +68,17 @@ __all__ = [
     "ChunkCommitter",
     "ChunkJournal",
     "ChunkPrefetcher",
+    "ChunkSource",
     "CommitterStats",
+    "DeviceChunkSource",
+    "HostChunkSource",
+    "MergeWarmer",
+    "NpzShardSource",
     "PrefetchStats",
+    "SourceError",
+    "StagingPool",
+    "as_source",
+    "write_npz_shards",
     "Deadline",
     "DeadlineExceeded",
     "ExecutionPlan",
@@ -92,6 +110,7 @@ __all__ = [
     "resilient_fit",
     "runner",
     "sanitize",
+    "source",
     "status",
     "status_counts",
     "watchdog",

@@ -38,6 +38,20 @@ walk.  The steady state is stage N+1 ∥ compute N ∥ commit N−1, results
 are bitwise-identical to ``pipeline=False``, and ``meta["pipeline"]``
 reports how much commit and staging wall the overlap hid.
 
+**Host-resident panels** (ISSUE 7): everything above assumed the panel
+resident in device memory before the walk began.  Passing a
+:class:`~.source.ChunkSource` instead of an array (host ``np.ndarray``
+via ``HostChunkSource``, an npz shard directory via ``NpzShardSource``,
+or anything ``as_source`` coerces) walks a panel that NEVER fully
+resides on device: each chunk is staged H2D through the source's pool of
+reusable host buffers — prefetched ahead of the walk by the same
+:class:`~.prefetcher.ChunkPrefetcher` — and the staged device buffer is
+donated back to the allocator the moment its chunk's fit has consumed
+it, so steady-state device footprint is O(chunk), not O(panel).  The
+staged bytes are exactly ``panel[lo:hi]``, so the host-resident walk is
+bitwise-identical to the in-HBM walk and journals cross-resume between
+residencies.
+
 **Sharded execution** (ISSUE 6): everything above ran on ONE device.  With
 ``shard=True`` (or an explicit ``mesh=``) the walk's configuration is
 compiled into an :class:`~.plan.ExecutionPlan` whose lanes partition the
@@ -68,6 +82,7 @@ import numpy as np
 from .. import obs
 from . import journal as journal_mod
 from . import plan as plan_mod
+from . import source as source_mod
 from . import watchdog as watchdog_mod
 from .plan import (ExecutionPlan, LaneRunner, LaneSpec, OOMBackoffExceeded,
                    _TimeoutChunk, _piece_status, is_resource_exhausted)
@@ -104,6 +119,16 @@ def fit_chunked(
     **fit_kwargs,
 ) -> ResilientFitResult:
     """Fit ``y [B, T]`` in row chunks of at most ``chunk_rows``.
+
+    ``y`` is a device-placeable array — or a :class:`~.source.ChunkSource`
+    for panels that must NOT fully reside on device (host RAM, npz shard
+    directories): the walk then stages each chunk H2D through the
+    source's staging pool as it arrives, at the same chunk boundaries,
+    producing bitwise-identical results (see the module docstring's
+    host-resident section; ``meta["source"]`` and
+    ``meta["pipeline"]["staging_pool"]`` carry the staging accounting,
+    and sources without an explicit ``chunk_rows`` default to the
+    source's natural chunking, e.g. npz shard size).
 
     Each chunk runs through :func:`~.runner.resilient_fit` (sanitize +
     retry ladder) unless ``resilient=False``, in which case ``fit_fn`` is
@@ -237,10 +262,43 @@ def fit_chunked(
     ``telemetry`` block.  Disabled (the default), none of this runs and
     the result is bitwise-identical to the uninstrumented driver.
     """
-    yb = jnp.asarray(y)
-    if yb.ndim != 2:
-        raise ValueError(f"fit_chunked expects [batch, time], got {yb.shape}")
-    b = yb.shape[0]
+    # -- chunk source (ISSUE 7) ----------------------------------------------
+    # `y` may be a ChunkSource instead of an array: the panel then lives
+    # wherever the source says (host RAM, an npz shard directory) and every
+    # chunk is staged H2D through the source's pinned-style staging pool as
+    # the walk reaches it — the panel NEVER fully resides on device.  A
+    # DeviceChunkSource unwraps to the resident-array walk, byte-identical
+    # to passing the array itself.
+    src = None
+    if isinstance(y, source_mod.ChunkSource):
+        if isinstance(y, source_mod.DeviceChunkSource):
+            yb = y.array
+        else:
+            src = y
+            yb = None
+            if chunk_rows is None and src.default_chunk_rows:
+                # sources know their natural chunking — shard size for
+                # npz dirs, a bounded slice for host arrays — and the
+                # grid lands there unless the caller says otherwise (a
+                # whole-panel default chunk would stage the oversubscribed
+                # panel in one slice and defeat the point)
+                chunk_rows = src.default_chunk_rows
+    else:
+        yb = jnp.asarray(y)
+    if src is not None:
+        b, t_len = src.shape
+        panel_dtype = src.dtype
+        src_stats0 = src.stats()
+        # peak_live_device_bytes must be THIS walk's high-water mark (the
+        # O(chunk) footprint consumers assert), not a previous walk's
+        src.reset_peak_live()
+    else:
+        if yb.ndim != 2:
+            raise ValueError(
+                f"fit_chunked expects [batch, time], got {yb.shape}")
+        b = yb.shape[0]
+        t_len = int(yb.shape[1])
+        panel_dtype = np.dtype(str(yb.dtype))
 
     # -- lane layout (the sharded half of the ExecutionPlan) -----------------
     # resolved BEFORE the align plan and the journal: the shard count can
@@ -269,21 +327,51 @@ def fit_chunked(
     if use_mesh is not None and n_shards > 1:
         spans = list(plan_mod.shard_spans(b, chunk0, n_shards))
         if len(spans) > 1:
-            try:
-                lanes = meshlib.lane_values(yb, use_mesh, spans)
-            except BaseException:
-                # lane placement fails per-process (local shard layout):
-                # on a journaled job the OTHER processes will block in the
-                # timeout-less pre-merge barrier — join it so the error
-                # surfaces instead of hanging the survivors (unjournaled
-                # jobs have no barrier: joining one would hang US)
-                if checkpoint_dir is not None:
-                    _distributed_barrier()
-                raise
+            if src is not None:
+                # source-backed lanes need no device placement up front:
+                # each lane stages ONLY its own spans, H2D to its device,
+                # as its walk reaches them.  Host RAM is process-local,
+                # so a source-backed sharded walk is SINGLE-process —
+                # enforced here, before any journal namespace is opened:
+                # under jax.distributed every process would otherwise
+                # build lanes for ALL spans (duplicate work, concurrent
+                # writers on the same shard namespaces) and die at
+                # device_put to a non-addressable device.  The multi-host
+                # path distributes device arrays (distribute_panel).
+                try:
+                    n_procs = jax.process_count()
+                except Exception:  # noqa: BLE001 - no backend: 1 process
+                    n_procs = 1
+                if n_procs > 1:
+                    raise ValueError(
+                        "sharded walks over a ChunkSource are "
+                        "single-process (host RAM/disk is process-local); "
+                        "under jax.distributed build a global device "
+                        "panel with parallel.mesh.distribute_panel "
+                        "instead of a source")
+                devs = meshlib.series_devices(use_mesh)
+                lanes = [(sid, slo, shi, devs[sid],
+                          source_mod.SourceLane(src, base=slo,
+                                                device=devs[sid]))
+                         for sid, (slo, shi) in enumerate(spans)]
+            else:
+                try:
+                    lanes = meshlib.lane_values(yb, use_mesh, spans)
+                except BaseException:
+                    # lane placement fails per-process (local shard
+                    # layout): on a journaled job the OTHER processes will
+                    # block in the timeout-less pre-merge barrier — join
+                    # it so the error surfaces instead of hanging the
+                    # survivors (unjournaled jobs have no barrier: joining
+                    # one would hang US)
+                    if checkpoint_dir is not None:
+                        _distributed_barrier()
+                    raise
     sharded = lanes is not None
     if not sharded:
         spans = [(0, b)]
-        lanes = [(0, 0, b, None, yb)]
+        lanes = [(0, 0, b, None,
+                  source_mod.SourceLane(src) if src is not None else yb)]
 
     # static align-mode plan: resolve the panel's alignment mode ONCE (or
     # take the caller's hint) and thread it into every chunk fit as a
@@ -314,8 +402,9 @@ def fit_chunked(
                 "align_mode keyword (the hint would be silently dropped)")
         fit_kwargs = {**fit_kwargs,
                       "align_mode": model_base.resolve_align_mode(
-                          yb, align_mode)}
-    elif (_explicit_align_param(fit_fn) and (chunk < b or sharded)
+                          yb if src is None else src, align_mode)}
+    elif (_explicit_align_param(fit_fn)
+          and (src is not None or chunk < b or sharded)
           and "align_mode" not in fit_kwargs):
         # AUTO-injection requires align_mode as an explicitly NAMED
         # parameter — a bare **kwargs does not count (a third-party
@@ -324,15 +413,38 @@ def fit_chunked(
         # Only sliced walks benefit: a whole-panel chunk hands the
         # caller's array through and the model's own per-array probe
         # cache holds.  A sharded walk always slices (every lane array is
-        # a fresh buffer), so it always plans.
+        # a fresh buffer), so it always plans — and a SOURCE walk always
+        # stages fresh buffers, so it plans too, probing on the HOST
+        # (streamed through the source: the panel never touches the
+        # device for the probe).
         fit_kwargs = {**fit_kwargs,
-                      "align_mode": model_base.align_mode_on_host(yb)}
+                      "align_mode": (src.align_mode() if src is not None
+                                     else model_base.align_mode_on_host(yb))}
     plan_mode = fit_kwargs.get("align_mode") if fit_takes_align else None
 
     # -- journal(s) ----------------------------------------------------------
+    if src is not None:
+        # the source spelling rides in the manifest `extra` (NOT the config
+        # hash: the bytes are the panel's, not the placement's — an in-HBM
+        # journal resumes under a host-RAM walk and vice versa, both
+        # fingerprinting sampled VALUES; npz shard dirs fingerprint by
+        # shard identity and so journal in their own domain) so
+        # post-mortems and the budget advisor can see what the walk read
+        # and how big the panel really was
+        journal_extra = {**(journal_extra or {}),
+                         "source": {"kind": src.kind,
+                                    "panel_bytes": int(src.nbytes)}}
     journals = None
     cfg = fp = None
     if checkpoint_dir is not None:
+        # EVERY journaled walk records the panel's geometry (extra, not
+        # hashed): the budget advisor needs panel bytes from an IN-HBM
+        # manifest to say "the next run of this panel should go
+        # host-resident" — advice that is moot once a source already ran
+        journal_extra = {
+            **(journal_extra or {}),
+            "panel": {"bytes": int(b) * int(t_len) * panel_dtype.itemsize,
+                      "time": int(t_len), "dtype": str(panel_dtype)}}
         if process_index is None:
             try:
                 process_index = jax.process_index()
@@ -351,7 +463,7 @@ def fit_chunked(
             extra={"chunk_rows": chunk0, "min_chunk_rows": min_chunk_rows,
                    "resilient": resilient, "policy": policy,
                    "ladder": "default" if ladder is None else repr(ladder)})
-        fp = _fingerprint(yb)
+        fp = src.fingerprint() if src is not None else _fingerprint(yb)
         if not sharded:
             journals = [journal_mod.ChunkJournal(
                 checkpoint_dir,
@@ -427,7 +539,7 @@ def fit_chunked(
         fit_fn, fit_kwargs,
         extra={"resilient": resilient, "policy": policy,
                "ladder": "default" if ladder is None else repr(ladder),
-               "time": int(yb.shape[1]), "dtype": str(yb.dtype)},
+               "time": t_len, "dtype": str(panel_dtype)},
     ) if tele else None
 
     # -- the plan, then its lanes -------------------------------------------
@@ -460,6 +572,16 @@ def fit_chunked(
         for i, (spec, (_sid, _lo, _hi, _dev, vals))
         in enumerate(zip(lane_specs, lanes))
     ]
+    # overlap the root-manifest merge with the last lanes' tails (ISSUE 7
+    # satellite, PR-6 follow-on): while slower lanes finish, shard/process 0
+    # already READS and parses the shard manifests the committed lanes have
+    # written — the merge after the barrier then only re-reads manifests
+    # that changed since.  Read-only by construction: the root manifest's
+    # single writer is still merge_job_manifest, after the lanes join.
+    warmer = None
+    if (journals is not None and sharded and len(runners) > 1
+            and int(process_index or 0) == 0):
+        warmer = journal_mod.MergeWarmer(checkpoint_dir, len(spans))
     try:
         if len(runners) == 1:
             results = [runners[0].run()]
@@ -490,6 +612,8 @@ def fit_chunked(
             results = [r for r in results if r is not None]
             results.sort(key=lambda r: r.spec.lo)
     except BaseException:
+        if warmer is not None:
+            warmer.stop()
         # peer processes of a journaled sharded job are (or will be)
         # blocked in the pre-merge barrier, which has no timeout: a
         # process whose lane failed must still JOIN it so the error
@@ -516,7 +640,7 @@ def fit_chunked(
     # chunk; an all-TIMEOUT job degenerates to a single NaN column
     k = next((int(np.asarray(p.params).shape[-1]) for _, _, p in pieces
               if not isinstance(p, _TimeoutChunk)), 1)
-    dtype = np.dtype(str(yb.dtype))
+    dtype = panel_dtype
 
     def _mat(p):
         if isinstance(p, _TimeoutChunk):
@@ -570,6 +694,21 @@ def fit_chunked(
     if plan_mode is not None:
         meta["align_mode"] = plan_mode
     pipe_meta = _pipeline_meta(results, sharded)
+    if src is not None:
+        # host-resident accounting (ISSUE 7): the staging pool's
+        # hit/reuse counts, the H2D copy wall/bytes, and the
+        # donated-buffer high-water mark (peak_live_device_bytes — the
+        # O(chunk) steady-state device footprint the oversubscribed bench
+        # asserts).  Deltas against the walk's start, so a source shared
+        # across walks reports per-walk numbers.
+        src_staging = src.stats_delta(src_stats0)
+        meta["source"] = {"kind": src.kind,
+                          "panel_bytes": int(src.nbytes),
+                          "shape": [int(b), int(t_len)],
+                          "staging_pool": src_staging}
+        if pipe_meta is None:
+            pipe_meta = {}  # serial source walks still report staging
+        pipe_meta["staging_pool"] = src_staging
     if pipe_meta is not None:
         meta["pipeline"] = pipe_meta
     # ladder/sanitize accounting aggregated across chunks (resilient mode)
@@ -593,15 +732,19 @@ def fit_chunked(
         extra_tele = {}
         if plan_mode is not None:
             extra_tele["align_mode"] = plan_mode
-        if pipe_meta is not None and "staging_wall_s" in pipe_meta:
+        if pipe_meta is not None and ("staging_wall_s" in pipe_meta
+                                      or "staging_pool" in pipe_meta):
             # the input-staging overlap numbers ride into the manifest so
             # tools/advise_budget.py can suggest prefetch_depth (and the
-            # align hint) for the next run of this config
+            # align hint) for the next run of this config; host-resident
+            # walks add the staging-pool block (pool reuse, H2D wall,
+            # donated-buffer peak) even when the walk ran serially
             extra_tele["input_staging"] = {
                 k2: pipe_meta[k2] for k2 in (
                     "prefetch_depth", "chunks_staged", "staged_hits",
                     "staged_misses", "staging_wall_s", "hidden_staging_s",
-                    "input_overlap_efficiency")}
+                    "input_overlap_efficiency", "staging_pool")
+                if k2 in pipe_meta}
         if pipe_meta is not None and "shards" in pipe_meta:
             # per-lane commit/staging overlap rides into the merged job
             # manifest so a straggler lane is a journaled fact, not a
@@ -632,6 +775,7 @@ def fit_chunked(
                 spans=spans,
                 telemetry=telemetry,
                 extra=journal_extra,
+                cache=warmer.stop() if warmer is not None else None,
             )
         else:
             _distributed_barrier()

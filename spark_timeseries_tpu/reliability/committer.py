@@ -136,6 +136,9 @@ class ChunkCommitter:
                 pm = self._probe()
                 info.setdefault("peak_hbm_bytes", pm.bytes)
                 info.setdefault("peak_hbm_source", pm.source)
+                sp = getattr(pm, "staging_pool_bytes", None)
+                if sp is not None:  # host-resident walk staged through a pool
+                    info.setdefault("peak_staging_pool_bytes", sp)
             if self._status_counts is not None:
                 info.setdefault("status_counts",
                                 self._status_counts(arrays["status"]))

@@ -73,6 +73,7 @@ __all__ = [
     "ChunkJournal",
     "JournalError",
     "LoadedChunk",
+    "MergeWarmer",
     "StaleJournalError",
     "TornManifestError",
     "config_hash",
@@ -561,6 +562,71 @@ def check_root_manifest(directory: str, *, config_hash: str,
             "checkpoint_dir or remove the stale journal explicitly.")
 
 
+class MergeWarmer:
+    """Overlap the sharded root-manifest merge with the last lanes' tails.
+
+    A sharded walk's fast lanes finish (and atomically commit their shard
+    manifests) while stragglers are still computing; the merge used to
+    start only after EVERY lane joined, re-reading and re-parsing all the
+    shard manifests on the critical path.  The warmer is a read-only
+    background poller shard/process 0 runs while its lanes are still out:
+    it watches each ``shard_?????/manifest.shard_?????.json``, parses any
+    version it has not seen (keyed by ``(mtime_ns, size)`` — shard
+    manifests are written by atomic replace, so a stat change IS a new
+    complete version), and hands the cache to
+    :func:`merge_job_manifest(cache=...)`, which re-reads only manifests
+    that changed after their last warm parse.
+
+    The single-writer rule is untouched: the warmer never writes anything
+    — the root manifest is still written once, by the merge, after the
+    barrier.  A parse failure is simply not cached (the merge re-reads
+    and raises its own, properly attributed, error).
+    """
+
+    def __init__(self, directory: str, n_shards: int,
+                 interval_s: float = 0.05):
+        self.root = os.path.abspath(directory)
+        self.paths = [
+            os.path.join(self.root, f"shard_{sid:05d}",
+                         f"manifest.shard_{sid:05d}.json")
+            for sid in range(int(n_shards))]
+        self.interval_s = float(interval_s)
+        self._cache: dict = {}  # path -> ((mtime_ns, size), manifest)
+        self._stop = threading.Event()
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name="merge-warmer")
+        self._worker.start()
+
+    def _poll_once(self) -> None:
+        for path in self.paths:
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue  # lane has not committed its manifest yet
+            sig = (st.st_mtime_ns, st.st_size)
+            hit = self._cache.get(path)
+            if hit is not None and hit[0] == sig:
+                continue
+            try:
+                with open(path, "rb") as f:
+                    m = json.loads(f.read().decode())
+            except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+                continue  # merge will re-read and attribute the error
+            self._cache[path] = (sig, m)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._poll_once()
+
+    def stop(self) -> dict:
+        """Stop polling and return the warm cache (one final sweep first,
+        so lanes that committed in the last interval are still warm)."""
+        self._stop.set()
+        self._worker.join(timeout=30.0)
+        self._poll_once()
+        return self._cache
+
+
 def merge_job_manifest(
     directory: str,
     *,
@@ -571,6 +637,7 @@ def merge_job_manifest(
     spans,
     telemetry: Optional[dict] = None,
     extra: Optional[dict] = None,
+    cache: Optional[dict] = None,
 ) -> dict:
     """Fold the shard-namespace manifests of a sharded walk into the ONE
     job-level ``manifest.json`` at the journal root, and return the merged
@@ -594,6 +661,12 @@ def merge_job_manifest(
     same (panel, config) can adopt the merged root manifest directly
     (plan knobs are excluded from the config hash; the chunk grid is
     shared by construction).
+
+    ``cache`` (a :meth:`MergeWarmer.stop` result) short-circuits the read
+    and parse of shard manifests whose ``(mtime_ns, size)`` signature is
+    unchanged since the warmer saw them — the merge I/O then overlapped
+    the last lanes' tails instead of following them.  Validation runs on
+    the cached parse exactly as on a fresh read.
     """
     root = os.path.abspath(directory)
     # the root manifest is another job's write-ahead record until proven
@@ -615,13 +688,25 @@ def merge_job_manifest(
                            "chunks_committed": 0, "chunks_timeout": 0,
                            "resumes": 0})
             continue
-        try:
-            with open(mp, "rb") as f:
-                m = json.loads(f.read().decode())
-        except (json.JSONDecodeError, UnicodeDecodeError) as e:
-            raise TornManifestError(
-                f"shard manifest {mp} does not parse ({e}); inspect/remove "
-                "the journal directory explicitly.") from e
+        m = None
+        if cache is not None:
+            hit = cache.get(mp)
+            if hit is not None:
+                try:
+                    st = os.stat(mp)
+                    if (st.st_mtime_ns, st.st_size) == hit[0]:
+                        m = hit[1]  # warm parse still current
+                except OSError:
+                    pass
+        if m is None:
+            try:
+                with open(mp, "rb") as f:
+                    m = json.loads(f.read().decode())
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                raise TornManifestError(
+                    f"shard manifest {mp} does not parse ({e}); "
+                    "inspect/remove the journal directory explicitly."
+                ) from e
         mismatches = []
         if m.get("config_hash") != config_hash:
             mismatches.append("config_hash")

@@ -53,6 +53,7 @@ from .. import obs
 from ..obs import memory as memory_probe
 from . import committer as committer_mod
 from . import prefetcher as prefetcher_mod
+from . import source as source_mod
 from . import watchdog as watchdog_mod
 from .runner import resilient_fit
 from .status import FitStatus, STATUS_DTYPE, status_counts
@@ -278,6 +279,12 @@ class LaneRunner:
         # single-lane walk's spans/events/meta stay byte-identical to the
         # pre-plan driver
         self.tag = {"shard": spec.shard_id} if plan.sharded else {}
+        # source-backed lanes (ISSUE 7): `values` is a SourceLane over a
+        # host-resident ChunkSource — every chunk, including a whole-span
+        # one, must be STAGED (there is no resident device array to hand
+        # through), and the staged buffer is donated back to the allocator
+        # the moment the chunk's fit drops it
+        self._from_source = isinstance(values, source_mod.SourceLane)
 
         span_rows = spec.hi - spec.lo
         self.chunk = max(1, min(plan.chunk_rows, span_rows))
@@ -507,8 +514,10 @@ class LaneRunner:
                 # bounded by the same budget as the compute it feeds — and
                 # a staging-time RESOURCE_EXHAUSTED surfaces here, through
                 # the watchdog, into the same backoff ladder as a fit-time
-                # one.
-                if lo == spec.lo and hi == spec.hi:
+                # one.  A source-backed lane never hands `values` through:
+                # a whole-span chunk still stages H2D (the panel lives in
+                # host RAM/disk, not on device).
+                if lo == spec.lo and hi == spec.hi and not self._from_source:
                     vals = self.values
                 elif self.prefetcher is not None:
                     vals = self.prefetcher.take(lo, hi)
@@ -654,6 +663,11 @@ class LaneRunner:
                         peak_hbm_source=pm.source,
                         chunk_rows_after=self.chunk,
                         status_counts=status_counts(arrays["status"]),
+                        # host-resident walks: the staging RAM behind the
+                        # device peak, so oversubscribed post-mortems see
+                        # the job's whole footprint (obs.memory)
+                        **({"peak_staging_pool_bytes": pm.staging_pool_bytes}
+                           if pm.staging_pool_bytes is not None else {}),
                     )
             self.pieces.append((lo, hi, piece))
             lo = hi

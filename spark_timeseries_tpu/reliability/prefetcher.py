@@ -18,6 +18,16 @@ bounded FIFO of staging requests, and for each
 2. blocks until the buffer is materialized on device
    (``jax.block_until_ready``), so a taken slice never re-pays the copy.
 
+``panel`` can also be a lane view over a :class:`~.source.ChunkSource`
+(ISSUE 7): the "slice" is then a genuine host→device staging — host read
+into a pooled pinned-style buffer plus an H2D copy — and this worker is
+what makes the copy ASYNC: chunk N+1's transfer rides here while chunk N
+computes, which for host-resident panels is the difference between
+walking at device speed and walking at PCIe speed.  The staged buffer is
+handed to the driver with no reference retained (slot cleared at take),
+so the device allocator recycles chunk N's HBM for chunk N+2 — the
+donated-buffer half of the O(chunk)-footprint contract.
+
 With the committer draining finished chunks behind the walk and the
 prefetcher staging slices ahead of it, the steady state is the full
 three-stage overlap: **stage N+1 ∥ compute N ∥ commit N−1**.

@@ -1,0 +1,190 @@
+"""Subprocess worker for the host-resident (larger-than-HBM) walk smokes.
+
+ISSUE 7: a journaled chunk walk over a panel that lives in HOST RAM
+(``reliability.HostChunkSource``) — each chunk staged H2D through the
+pinned-style staging pool, prefetched ahead of the walk — must survive a
+real SIGKILL (landing while a staged buffer is in flight) and resume to a
+result BITWISE-identical to the in-HBM walk of the same panel.  The panel
+is treated as oversubscribed against a deliberately tiny VIRTUAL device
+budget (one chunk of "HBM"): the walk's donated-buffer accounting must
+show the staged device footprint stayed O(chunk), never O(panel).
+
+Modes:
+    --run --dir D --mode host|device [--kill-after N] [--out F] [--obs F]
+        one journaled fit over the deterministic AR(1) panel; with
+        --kill-after the process dies mid-run (exit by SIGKILL), else the
+        result arrays + walk meta are saved to F.
+    --smoke
+        full orchestration (used by ci.sh): host-resident child killed
+        after 2 durable commits (prefetch_depth=2 keeps staging in
+        flight), resume with telemetry on, bitwise-compare against an
+        in-HBM walk, check the staging-pool manifest block and the
+        O(chunk) footprint bound, run obs_report --check --manifest, and
+        print PASS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+CHUNK_ROWS = 8
+N_ROWS = 32
+N_OBS = 120
+PREFETCH_DEPTH = 2
+# virtual device budget: ONE chunk of "HBM" — the panel is 4x oversubscribed
+VIRTUAL_BUDGET_BYTES = CHUNK_ROWS * N_OBS * 4
+
+
+def make_panel() -> np.ndarray:
+    rng = np.random.default_rng(7)
+    e = rng.normal(size=(N_ROWS, N_OBS)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, y.shape[1]):
+        y[:, i] = 0.6 * y[:, i - 1] + e[:, i]
+    return y
+
+
+def run_fit(directory: str, mode: str, kill_after: int | None,
+            out: str | None, obs_path: str | None) -> None:
+    from spark_timeseries_tpu import obs
+    from spark_timeseries_tpu import reliability as rel
+    from spark_timeseries_tpu.models import arima
+    from spark_timeseries_tpu.reliability import faultinject as fi
+
+    hook = None
+    if kill_after is not None:
+        hook = fi.kill_after_commits(kill_after)
+    if obs_path:
+        obs.enable(obs_path)
+    panel = make_panel()
+    values = rel.HostChunkSource(panel) if mode == "host" else panel
+    res = rel.fit_chunked(
+        arima.fit, values, chunk_rows=CHUNK_ROWS, resilient=False,
+        prefetch_depth=PREFETCH_DEPTH, checkpoint_dir=directory,
+        order=(1, 0, 0), max_iters=25, _journal_commit_hook=hook,
+    )
+    if obs_path:
+        obs.disable()
+    if kill_after is not None:  # the SIGKILL should have landed mid-run
+        sys.exit(f"kill_after={kill_after} but the fit finished — the hook "
+                 "never fired")
+    if out:
+        np.savez(out, params=res.params, nll=res.neg_log_likelihood,
+                 converged=res.converged, iters=res.iters, status=res.status,
+                 meta=json.dumps({
+                     "journal": res.meta.get("journal", {}),
+                     "pipeline": res.meta.get("pipeline", {}),
+                     "source": res.meta.get("source", {}),
+                 }))
+
+
+def _child(args: list) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), *args],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=600,
+    )
+
+
+def smoke() -> None:
+    with tempfile.TemporaryDirectory() as td:
+        jdir = os.path.join(td, "journal")
+        # 1. host-resident child killed by SIGKILL after committing chunk 2
+        #    of 4 — prefetch_depth=2 means staged slices (and their pinned
+        #    pool buffers) are in flight when the kill lands
+        r = _child(["--run", "--dir", jdir, "--mode", "host",
+                    "--kill-after", "2"])
+        if r.returncode != -9:
+            sys.exit(f"expected SIGKILL (-9), got rc={r.returncode}\n"
+                     f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}")
+        manifest = json.load(open(os.path.join(jdir, "manifest.json")))
+        done = [(c["lo"], c["hi"]) for c in manifest["chunks"]
+                if c["status"] == "committed"]
+        if done != [(0, 8), (8, 16)]:
+            sys.exit(f"expected chunks (0,8),(8,16) committed, got {done}")
+        # 2. host-resident resume completes the job (telemetry on)
+        resumed_out = os.path.join(td, "resumed.npz")
+        obs_path = os.path.join(td, "events.jsonl")
+        r = _child(["--run", "--dir", jdir, "--mode", "host",
+                    "--out", resumed_out, "--obs", obs_path])
+        if r.returncode != 0:
+            sys.exit(f"resume failed rc={r.returncode}\nstderr:\n{r.stderr}")
+        # 3. in-HBM reference walk in a fresh directory
+        full_out = os.path.join(td, "full.npz")
+        r = _child(["--run", "--dir", os.path.join(td, "fresh"),
+                    "--mode", "device", "--out", full_out])
+        if r.returncode != 0:
+            sys.exit(f"reference run failed rc={r.returncode}\n{r.stderr}")
+        a, b = np.load(resumed_out), np.load(full_out)
+        for k in ("params", "nll", "converged", "iters", "status"):
+            if not np.array_equal(a[k], b[k], equal_nan=True):
+                sys.exit(f"host-resident resumed result differs from the "
+                         f"in-HBM walk on {k!r} — NOT bitwise-identical")
+        meta = json.loads(str(a["meta"]))
+        j = meta["journal"]
+        if j.get("chunks_resumed") != 2 or j.get("chunks_committed") != 4:
+            sys.exit(f"resume accounting wrong: {j}")
+        # 4. oversubscription bookkeeping: the panel is 4x the virtual
+        #    budget, and the donated-buffer peak must stay O(chunk) —
+        #    depth staged + one computing + one transient
+        pool = (meta.get("pipeline") or {}).get("staging_pool") or {}
+        panel_bytes = meta["source"]["panel_bytes"]
+        if panel_bytes < 4 * VIRTUAL_BUDGET_BYTES:
+            sys.exit(f"panel {panel_bytes}B not oversubscribed vs virtual "
+                     f"budget {VIRTUAL_BUDGET_BYTES}B")
+        bound = (PREFETCH_DEPTH + 2) * VIRTUAL_BUDGET_BYTES
+        peak = pool.get("peak_live_device_bytes")
+        if peak is None or peak > bound:
+            sys.exit(f"staged device footprint {peak}B exceeds the O(chunk) "
+                     f"bound {bound}B (panel {panel_bytes}B): donation "
+                     "broke — buffers are accumulating")
+        # 5. the staging telemetry is a journaled fact the tooling gates on
+        manifest = json.load(open(os.path.join(jdir, "manifest.json")))
+        st = (manifest.get("telemetry") or {}).get("input_staging") or {}
+        if "staging_pool" not in st:
+            sys.exit(f"manifest telemetry lacks the staging_pool block: {st}")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "obs_report.py"),
+             "--check", obs_path, "--manifest", jdir],
+            capture_output=True, text=True, timeout=120)
+        if r.returncode != 0:
+            sys.exit(f"obs_report --check failed:\n{r.stdout}\n{r.stderr}")
+        print("host-resident kill-and-resume smoke: PASS "
+              "(SIGKILL after chunk 2 with staging in flight, resumed "
+              "bitwise-identical to the in-HBM walk, panel 4x the virtual "
+              f"budget at {peak}B staged peak <= {bound}B bound, "
+              "staging-pool telemetry journaled and schema-checked)")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run", action="store_true")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--dir")
+    ap.add_argument("--mode", choices=("host", "device"), default="host")
+    ap.add_argument("--kill-after", type=int, default=None)
+    ap.add_argument("--out")
+    ap.add_argument("--obs")
+    args = ap.parse_args()
+    if args.smoke:
+        return smoke()
+    if not args.run or not args.dir:
+        ap.error("need --run --dir D or --smoke")
+    run_fit(args.dir, args.mode, args.kill_after, args.out, args.obs)
+
+
+if __name__ == "__main__":
+    main()

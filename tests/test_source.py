@@ -1,0 +1,643 @@
+"""ChunkSource / host-resident walk tests (ISSUE 7).
+
+The chunk driver walks panels that never fully reside on device: a host
+``np.ndarray`` (``HostChunkSource``) or a directory of npz shards
+(``NpzShardSource``), staged H2D chunk by chunk through a pool of
+reusable host buffers, with staged device buffers donated back to the
+allocator as the walk passes.  The contracts under test:
+
+- **bitwise identity**: a host-resident walk (serial, pipelined,
+  journaled, sharded across the forced 8-device CPU mesh) produces
+  exactly the bytes of the in-HBM walk of the same panel;
+- **edge cases rejected loudly, before compute**: mixed shard
+  dtype/shape, torn/missing INPUT shards (input data is not
+  recomputable), non-2-D panels;
+- **durability composes**: a torn JOURNAL shard downgrades to a
+  recompute THROUGH the source; an in-HBM journal cross-resumes under a
+  host-resident walk (the fingerprint is the panel's, not the
+  placement's);
+- **O(chunk) footprint**: the donated-buffer accounting bounds staged
+  device bytes by depth+2 chunks, never the panel;
+- **telemetry**: the staging-pool block lands in ``meta["pipeline"]``,
+  the manifest, the peak-memory probe, and the budget advisor.
+
+The SIGKILL-mid-stage crash (a real process death with a pinned buffer
+in flight) runs in ``tests/_hostwalk_worker.py`` — orchestrated
+unconditionally by ci.sh and here as a slow-marked subprocess test.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import zipfile
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu import obs
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.obs import memory as obs_memory
+from spark_timeseries_tpu.reliability import faultinject as fi
+
+FIELDS = ("params", "neg_log_likelihood", "converged", "iters", "status")
+KW = dict(chunk_rows=8, resilient=False, order=(1, 0, 0), max_iters=15)
+
+
+def make_panel(b=32, t=96, seed=0):
+    rng = np.random.default_rng(seed)
+    return np.cumsum(rng.normal(size=(b, t)).astype(np.float32), axis=1)
+
+
+def assert_bitwise(a, b, msg=""):
+    for f in FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+            err_msg=f"{msg}:{f}")
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return make_panel()
+
+
+@pytest.fixture(scope="module")
+def dev_result(panel):
+    return rel.fit_chunked(arima.fit, panel, **KW)
+
+
+# ---------------------------------------------------------------------------
+# bitwise identity across residencies
+# ---------------------------------------------------------------------------
+
+
+class TestBitwise:
+    def test_host_pipelined(self, panel, dev_result):
+        res = rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                              prefetch_depth=2, **KW)
+        assert_bitwise(dev_result, res, "host-pipelined")
+        pool = res.meta["pipeline"]["staging_pool"]
+        assert pool["h2d_copies"] == 4
+        assert pool["pool_hits"] + pool["pool_misses"] == 4
+        assert pool["pool_misses"] <= 3  # the pool REUSES buffers
+        assert res.meta["source"]["kind"] == "host"
+        assert res.meta["source"]["panel_bytes"] == panel.nbytes
+
+    def test_host_serial(self, panel, dev_result, tmp_path):
+        res = rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                              pipeline=False,
+                              checkpoint_dir=str(tmp_path / "j"), **KW)
+        assert_bitwise(dev_result, res, "host-serial")
+        # a serial source walk still reports its staging accounting
+        assert "staging_pool" in res.meta["pipeline"]
+
+    def test_npz_dir(self, panel, dev_result, tmp_path):
+        d = tmp_path / "shards"
+        rel.write_npz_shards(d, panel, rows_per_shard=12)  # 12, 12, 8 ragged
+        res = rel.fit_chunked(arima.fit, rel.NpzShardSource(d), **KW)
+        assert_bitwise(dev_result, res, "npz")
+        assert res.meta["source"]["kind"] == "npz_dir"
+
+    def test_npz_empty_trailing_shard(self, panel, dev_result, tmp_path):
+        d = tmp_path / "shards"
+        rel.write_npz_shards(d, panel, rows_per_shard=16)
+        np.savez(d / "part_99999.npz", values=np.zeros((0, 96), np.float32))
+        src = rel.NpzShardSource(d)
+        assert src.shape == (32, 96)  # the empty shard serves no rows
+        assert src.default_chunk_rows == 16
+        res = rel.fit_chunked(arima.fit, src, **KW)
+        assert_bitwise(dev_result, res, "npz-empty-trailing")
+
+    def test_npz_default_chunk_rows_used(self, panel, tmp_path):
+        d = tmp_path / "shards"
+        rel.write_npz_shards(d, panel, rows_per_shard=16)
+        res = rel.fit_chunked(arima.fit, rel.NpzShardSource(d),
+                              resilient=False, order=(1, 0, 0), max_iters=15)
+        assert res.meta["chunk_rows_initial"] == 16  # shard-aligned default
+
+    def test_device_source_unwraps(self, panel, dev_result):
+        import jax.numpy as jnp
+
+        res = rel.fit_chunked(arima.fit,
+                              rel.DeviceChunkSource(jnp.asarray(panel)), **KW)
+        assert_bitwise(dev_result, res, "device-source")
+        assert "source" not in res.meta  # today's path, byte-identical
+
+    def test_resilient_host_walk(self, panel):
+        y = panel.copy()
+        y[3, :10] = np.nan  # leading NaNs: sanitizer/ladder territory
+        a = rel.fit_chunked(arima.fit, y, chunk_rows=8, order=(1, 0, 0),
+                            max_iters=15)
+        b = rel.fit_chunked(arima.fit, rel.HostChunkSource(y), chunk_rows=8,
+                            order=(1, 0, 0), max_iters=15)
+        assert_bitwise(a, b, "resilient")
+
+
+# ---------------------------------------------------------------------------
+# source edge cases: rejected before compute, torn loudly at read
+# ---------------------------------------------------------------------------
+
+
+class TestSourceEdgeCases:
+    def test_mixed_dtype_rejected(self, tmp_path):
+        np.savez(tmp_path / "a.npz", values=np.ones((4, 8), np.float32))
+        np.savez(tmp_path / "b.npz", values=np.ones((4, 8), np.float64))
+        with pytest.raises(rel.SourceError, match="mixed shard layouts"):
+            rel.NpzShardSource(tmp_path)
+
+    def test_mixed_time_length_rejected(self, tmp_path):
+        np.savez(tmp_path / "a.npz", values=np.ones((4, 8), np.float32))
+        np.savez(tmp_path / "b.npz", values=np.ones((4, 9), np.float32))
+        with pytest.raises(rel.SourceError, match="mixed shard layouts"):
+            rel.NpzShardSource(tmp_path)
+
+    def test_non_2d_shard_rejected(self, tmp_path):
+        np.savez(tmp_path / "a.npz", values=np.ones((4, 8, 2), np.float32))
+        with pytest.raises(rel.SourceError, match="3-D"):
+            rel.NpzShardSource(tmp_path)
+
+    def test_multi_array_shard_needs_key(self, tmp_path):
+        np.savez(tmp_path / "a.npz", x=np.ones((4, 8), np.float32),
+                 y=np.ones((4, 8), np.float32))
+        with pytest.raises(rel.SourceError, match="key="):
+            rel.NpzShardSource(tmp_path)
+        src = rel.NpzShardSource(tmp_path, key="x")
+        assert src.shape == (4, 8)
+
+    def test_missing_key_rejected(self, tmp_path):
+        np.savez(tmp_path / "a.npz", x=np.ones((4, 8), np.float32))
+        with pytest.raises(rel.SourceError, match="no array"):
+            rel.NpzShardSource(tmp_path, key="values")
+
+    def test_torn_shard_rejected_at_construction(self, tmp_path):
+        rel.write_npz_shards(tmp_path, make_panel(8, 16), 4)
+        path = sorted(tmp_path.glob("*.npz"))[1]
+        path.write_bytes(b"torn to bits")
+        with pytest.raises(rel.SourceError, match="unreadable/torn"):
+            rel.NpzShardSource(tmp_path)
+
+    def test_torn_input_shard_fails_read_loudly(self, tmp_path):
+        """Input torn AFTER the source opened: the READ raises SourceError
+        naming the shard — input data cannot be recomputed, so this never
+        downgrades silently (unlike a torn JOURNAL shard)."""
+        rel.write_npz_shards(tmp_path, make_panel(8, 16), 4)
+        src = rel.NpzShardSource(tmp_path)
+        path = sorted(tmp_path.glob("*.npz"))[1]
+        data = bytearray(path.read_bytes())
+        data[len(data) // 2] ^= 0xFF  # corrupt the deflate stream
+        path.write_bytes(bytes(data))
+        with pytest.raises(rel.SourceError, match="part_00001"):
+            src.stage(0, 8)
+
+    def test_non_2d_host_rejected(self):
+        with pytest.raises(rel.SourceError, match="batch, time"):
+            rel.HostChunkSource(np.ones(8, np.float32))
+
+    def test_host_default_chunk_rows_bounded(self, panel, monkeypatch):
+        """A host source with no chunk_rows must NOT stage the whole
+        panel in one slice: the default chunking caps slice bytes, so an
+        oversubscribed panel walks in bounded chunks."""
+        from spark_timeseries_tpu.reliability import source as source_mod
+
+        # small panel: one chunk, same as the array path
+        assert rel.HostChunkSource(panel).default_chunk_rows == 32
+        # "large" panel (shrunken cap): chunking engages automatically
+        monkeypatch.setattr(source_mod, "_DEFAULT_SLICE_BYTES",
+                            8 * 96 * 4)  # one 8-row chunk of this panel
+        src = rel.HostChunkSource(panel)
+        assert src.default_chunk_rows == 8
+        res = rel.fit_chunked(arima.fit, src, resilient=False,
+                              order=(1, 0, 0), max_iters=15)
+        assert res.meta["chunk_rows_initial"] == 8
+        assert res.meta["chunks_run"] == 4
+
+    def test_sharded_source_rejects_multiprocess(self, panel, lane_mesh,
+                                                 monkeypatch):
+        """Host RAM is process-local: a jax.distributed sharded source
+        walk must fail loudly BEFORE touching any journal namespace."""
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        with pytest.raises(ValueError, match="single-process"):
+            rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                            mesh=lane_mesh, chunk_rows=4, resilient=False,
+                            order=(1, 0, 0), max_iters=15)
+
+    def test_stage_bounds_checked(self, panel):
+        src = rel.HostChunkSource(panel)
+        with pytest.raises(IndexError):
+            src.stage(0, 33)
+
+    def test_as_source_coercions(self, panel, tmp_path):
+        import jax.numpy as jnp
+
+        assert isinstance(rel.as_source(panel), rel.HostChunkSource)
+        assert isinstance(rel.as_source(jnp.asarray(panel)),
+                          rel.DeviceChunkSource)
+        rel.write_npz_shards(tmp_path / "d", panel, 16)
+        assert isinstance(rel.as_source(str(tmp_path / "d")),
+                          rel.NpzShardSource)
+        src = rel.HostChunkSource(panel)
+        assert rel.as_source(src) is src
+
+    def test_shape_dtype_mismatch_vs_journal(self, panel, tmp_path):
+        """A journal written for one panel must reject a source holding a
+        DIFFERENT panel — the fingerprint covers source content."""
+        d = str(tmp_path / "j")
+        rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                        checkpoint_dir=d, **KW)
+        other = make_panel(seed=99)
+        with pytest.raises(rel.StaleJournalError):
+            rel.fit_chunked(arima.fit, rel.HostChunkSource(other),
+                            checkpoint_dir=d, **KW)
+
+
+# ---------------------------------------------------------------------------
+# durability through the source
+# ---------------------------------------------------------------------------
+
+
+class TestDurability:
+    def test_torn_journal_shard_recomputes_from_source(
+            self, panel, dev_result, tmp_path):
+        d = str(tmp_path / "j")
+        rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                        checkpoint_dir=d, **KW)
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        open(os.path.join(d, m["chunks"][1]["shard"]), "wb").write(b"torn")
+        res = rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                              checkpoint_dir=d, **KW)
+        assert_bitwise(dev_result, res, "torn-journal-shard")
+        assert res.meta["journal"]["chunks_resumed"] == 3  # one recomputed
+
+    def test_crash_resume_host_resident(self, panel, dev_result, tmp_path):
+        d = str(tmp_path / "j")
+        with pytest.raises(fi.SimulatedCrash):
+            rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                            checkpoint_dir=d, prefetch_depth=2,
+                            _journal_commit_hook=fi.crash_after_commits(2),
+                            **KW)
+        res = rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                              checkpoint_dir=d, prefetch_depth=2, **KW)
+        assert_bitwise(dev_result, res, "crash-resume")
+        assert res.meta["journal"]["chunks_resumed"] == 2
+
+    def test_cross_residency_resume(self, panel, dev_result, tmp_path):
+        """An in-HBM journal resumes under a host-resident walk: the
+        fingerprint and config hash are the panel's and the fit's — the
+        placement is not durable state."""
+        d = str(tmp_path / "j")
+        with pytest.raises(fi.SimulatedCrash):
+            rel.fit_chunked(arima.fit, panel, checkpoint_dir=d,
+                            _journal_commit_hook=fi.crash_after_commits(2),
+                            **KW)
+        res = rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                              checkpoint_dir=d, **KW)
+        assert_bitwise(dev_result, res, "cross-residency")
+        assert res.meta["journal"]["chunks_resumed"] == 2
+
+    def test_npz_source_journal_resume(self, panel, dev_result, tmp_path):
+        d = str(tmp_path / "j")
+        sd = tmp_path / "shards"
+        rel.write_npz_shards(sd, panel, rows_per_shard=8)
+        with pytest.raises(fi.SimulatedCrash):
+            rel.fit_chunked(arima.fit, rel.NpzShardSource(sd),
+                            checkpoint_dir=d,
+                            _journal_commit_hook=fi.crash_after_commits(2),
+                            **KW)
+        res = rel.fit_chunked(arima.fit, rel.NpzShardSource(sd),
+                              checkpoint_dir=d, **KW)
+        assert_bitwise(dev_result, res, "npz-resume")
+
+    @pytest.mark.slow
+    def test_sigkill_mid_stage_subprocess(self):
+        """Real SIGKILL with a staged pinned buffer in flight — the full
+        orchestration ci.sh runs unconditionally."""
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "_hostwalk_worker.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+        assert "PASS" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# O(chunk) footprint + staging pool + probe
+# ---------------------------------------------------------------------------
+
+
+class TestFootprint:
+    def test_donated_buffers_bound_device_footprint(self, panel):
+        src = rel.HostChunkSource(panel)
+        res = rel.fit_chunked(arima.fit, src, prefetch_depth=1, **KW)
+        pool = res.meta["pipeline"]["staging_pool"]
+        chunk_bytes = 8 * 96 * 4
+        # depth staged + one computing + one transient handoff — never
+        # the panel (4 chunks would be panel-sized here; the bound must
+        # hold strictly below it for the walk to mean anything)
+        assert pool["peak_live_device_bytes"] <= 3 * chunk_bytes
+        assert pool["peak_live_device_bytes"] < panel.nbytes
+        assert res.converged.all() or True  # footprint is the assertion
+
+    def test_pool_reuse(self, panel):
+        src = rel.HostChunkSource(panel)
+        rel.fit_chunked(arima.fit, src, prefetch_depth=1, **KW)
+        stats = src.stats()
+        assert stats["pool_hits"] >= 2  # buffers were reused, not allocated
+        assert stats["pool_buffers"] <= 2
+        assert stats["h2d_bytes"] == panel.nbytes  # every row staged once
+
+    def test_peak_memory_reports_staging_pool(self, panel):
+        src = rel.HostChunkSource(panel)
+        src.stage(0, 8)
+        pm = obs_memory.peak_memory()
+        assert pm.staging_pool_bytes is not None
+        assert pm.staging_pool_bytes >= 8 * 96 * 4
+        assert pm.source in ("device", "host_rss")
+
+    def test_journal_entries_carry_staging_peak(self, panel, tmp_path):
+        d = str(tmp_path / "j")
+        rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                        checkpoint_dir=d, **KW)
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        assert all("peak_staging_pool_bytes" in c for c in m["chunks"])
+
+    def test_stats_delta_rebases_counters(self, panel):
+        src = rel.HostChunkSource(panel)
+        rel.fit_chunked(arima.fit, src, **KW)
+        before = src.stats()
+        res = rel.fit_chunked(arima.fit, src, **KW)
+        pool = res.meta["pipeline"]["staging_pool"]
+        assert pool["h2d_copies"] == 4  # THIS walk's copies, not lifetime
+        assert src.stats()["h2d_copies"] == before["h2d_copies"] + 4
+
+    def test_peak_live_rebased_per_walk(self, panel):
+        """A shared source's second (smaller-chunked) walk reports ITS
+        OWN donated-buffer peak, not the first walk's high-water mark —
+        the footprint bound consumers assert stays per-walk."""
+        src = rel.HostChunkSource(panel)
+        rel.fit_chunked(arima.fit, src, chunk_rows=16, resilient=False,
+                        order=(1, 0, 0), max_iters=15)  # 16-row peaks
+        res = rel.fit_chunked(arima.fit, src, **KW)  # 8-row chunks
+        pool = res.meta["pipeline"]["staging_pool"]
+        assert pool["peak_live_device_bytes"] <= 3 * 8 * 96 * 4
+
+
+# ---------------------------------------------------------------------------
+# align plan probed on host, telemetry, manifest
+# ---------------------------------------------------------------------------
+
+
+class TestAlignAndTelemetry:
+    def test_align_probe_stays_on_host(self, panel):
+        obs.enable()
+        try:
+            c0 = (obs.snapshot() or {}).get("counters", {})
+            res = rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                                  **KW)
+            c1 = (obs.snapshot() or {}).get("counters", {})
+        finally:
+            obs.disable()
+        # zero DEVICE probes: the source streams the NaN check on host
+        assert c1.get("align.host_probes", 0) == c0.get(
+            "align.host_probes", 0)
+        assert res.meta["align_mode"] == "dense"
+
+    def test_align_modes_from_source(self):
+        y = make_panel(16, 32)
+        y[2, :5] = np.nan
+        assert rel.HostChunkSource(y).align_mode() == "no-trailing"
+        y2 = y.copy()
+        y2[3, -1] = np.nan
+        assert rel.HostChunkSource(y2).align_mode() == "general"
+
+    def test_staging_lane_scoped_to_h2d_runs(self, panel, tmp_path,
+                                             capsys):
+        """The rendered staging-pool lane appears for host-resident walks
+        (stage.h2d spans) and NOT for in-HBM prefetched walks, whose
+        stage.overlap spans stay in the chronological timeline."""
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import obs_report
+
+        def render(values, name):
+            path = str(tmp_path / f"{name}.jsonl")
+            obs.enable(path)
+            try:
+                rel.fit_chunked(arima.fit, values, prefetch_depth=2, **KW)
+            finally:
+                obs.disable()
+            events, _ = obs_report.load_events(path)
+            obs_report._render(obs_report.summarize(events))
+            return capsys.readouterr().out
+
+        out_hbm = render(panel, "hbm")
+        assert "staging pool lane" not in out_hbm
+        assert "stage.overlap" in out_hbm  # still rendered, in-timeline
+        out_host = render(rel.HostChunkSource(panel), "host")
+        assert "staging pool lane" in out_host
+        assert "stage.h2d" in out_host
+
+    def test_manifest_staging_block_validates(self, panel, tmp_path):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import obs_report
+
+        d = str(tmp_path / "j")
+        obs.enable(str(tmp_path / "ev.jsonl"))
+        try:
+            rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                            prefetch_depth=2, checkpoint_dir=d, **KW)
+        finally:
+            obs.disable()
+        errors = obs_report.validate_manifest_telemetry(d)
+        assert errors == []
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        assert "staging_pool" in m["telemetry"]["input_staging"]
+        assert m["extra"]["source"]["kind"] == "host"
+
+    def test_advise_budget_host_resident(self, panel, tmp_path):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import advise_budget
+
+        d = str(tmp_path / "j")
+        obs.enable()
+        try:
+            rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                            prefetch_depth=2, checkpoint_dir=d, **KW)
+        finally:
+            obs.disable()
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        a = advise_budget.advise(m)
+        assert a["observed"]["source_kind"] == "host"
+        assert a["observed"]["panel_bytes"] == panel.nbytes
+        assert a["suggest"]["host_resident"] is True  # it ran host-resident
+        assert a["suggest"]["staging_pool_buffers"] >= 2
+
+    def test_advise_budget_host_resident_from_in_hbm_manifest(
+            self, panel, tmp_path, monkeypatch):
+        """The advice must fire where it is ACTIONABLE: an in-HBM run's
+        manifest records the panel geometry, and a tight device budget
+        flips the recommendation to host-resident."""
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import advise_budget
+
+        d = str(tmp_path / "j")
+        rel.fit_chunked(arima.fit, panel, checkpoint_dir=d, **KW)
+        m = json.load(open(os.path.join(d, "manifest.json")))
+        a = advise_budget.advise(m)
+        assert a["observed"]["panel_bytes"] == panel.nbytes  # journaled
+        monkeypatch.setattr(advise_budget, "_device_budget_bytes",
+                            lambda: panel.nbytes)  # panel > 60% of budget
+        assert advise_budget.advise(m)["suggest"]["host_resident"] is True
+        monkeypatch.setattr(advise_budget, "_device_budget_bytes",
+                            lambda: 100 * panel.nbytes)  # roomy chip
+        assert advise_budget.advise(m)["suggest"]["host_resident"] is False
+
+
+# ---------------------------------------------------------------------------
+# API surfaces: panel.fit(source=), compat fit_model(source)
+# ---------------------------------------------------------------------------
+
+
+class TestApiSurfaces:
+    def test_panel_fit_source(self, panel, dev_result):
+        import jax.numpy as jnp
+
+        from spark_timeseries_tpu import index as dtix
+        from spark_timeseries_tpu.panel import TimeSeriesPanel
+
+        p = TimeSeriesPanel(
+            dtix.uniform("2024-01-01", periods=96,
+                         frequency=dtix.DayFrequency(1)),
+            [f"s{i}" for i in range(32)], jnp.asarray(panel))
+        res = p.fit("arima", source=panel, **KW)
+        assert_bitwise(dev_result, res, "panel-source")
+
+    def test_panel_fit_source_shape_mismatch(self, panel):
+        import jax.numpy as jnp
+
+        from spark_timeseries_tpu import index as dtix
+        from spark_timeseries_tpu.panel import TimeSeriesPanel
+
+        p = TimeSeriesPanel(
+            dtix.uniform("2024-01-01", periods=96,
+                         frequency=dtix.DayFrequency(1)),
+            [f"s{i}" for i in range(32)], jnp.asarray(panel))
+        with pytest.raises(ValueError, match="does not match this panel"):
+            p.fit("arima", source=panel[:16], **KW)
+
+    def test_compat_fit_model_source(self, panel, tmp_path):
+        from spark_timeseries_tpu.compat.sparkts import ARIMA
+
+        plain = ARIMA.fit_model(1, 0, 1, panel[:8],
+                                checkpoint_dir=str(tmp_path / "a"),
+                                chunk_rows=4)
+        hosted = ARIMA.fit_model(1, 0, 1, rel.HostChunkSource(panel[:8]),
+                                 checkpoint_dir=str(tmp_path / "b"),
+                                 chunk_rows=4)
+        np.testing.assert_array_equal(np.asarray(plain.params),
+                                      np.asarray(hosted.params))
+        # a shard-directory PATH is the other documented compat spelling
+        rel.write_npz_shards(tmp_path / "sd", panel[:8], rows_per_shard=4)
+        from_dir = ARIMA.fit_model(1, 0, 1, str(tmp_path / "sd"),
+                                   checkpoint_dir=str(tmp_path / "c"),
+                                   chunk_rows=4)
+        np.testing.assert_array_equal(np.asarray(plain.params),
+                                      np.asarray(from_dir.params))
+
+
+# ---------------------------------------------------------------------------
+# sharded host-resident walk (forced 8-device CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+class TestShardedSource:
+    def test_sharded_host_walk_bitwise(self, panel, lane_mesh, tmp_path):
+        kw = dict(chunk_rows=4, resilient=False, order=(1, 0, 0),
+                  max_iters=15)
+        single = rel.fit_chunked(arima.fit, panel, **kw)
+        sharded = rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                                  mesh=lane_mesh,
+                                  checkpoint_dir=str(tmp_path / "j"), **kw)
+        assert_bitwise(single, sharded, "sharded-host")
+        assert sharded.meta["shards"]["n_shards"] == 8
+        # each lane staged ONLY its own spans: 8 chunks total, one per lane
+        pool = sharded.meta["pipeline"]["staging_pool"]
+        assert pool["h2d_copies"] == 8
+        m = json.load(open(tmp_path / "j" / "manifest.json"))
+        assert m["merged_from_shards"] == 8
+        assert m["extra"]["source"]["kind"] == "host"
+
+    def test_merge_warmer_cache_used(self, panel, lane_mesh, tmp_path,
+                                     monkeypatch):
+        """The pre-merge warmer's cache short-circuits shard-manifest
+        re-reads; the merged manifest is identical either way."""
+        from spark_timeseries_tpu.reliability import journal as journal_mod
+
+        calls = {"n": 0}
+        orig = journal_mod.MergeWarmer.stop
+
+        def counting_stop(self):
+            out = orig(self)
+            calls["n"] += 1
+            calls["cached"] = len(out)
+            return out
+
+        monkeypatch.setattr(journal_mod.MergeWarmer, "stop", counting_stop)
+        kw = dict(chunk_rows=4, resilient=False, order=(1, 0, 0),
+                  max_iters=15)
+        res = rel.fit_chunked(arima.fit, rel.HostChunkSource(panel),
+                              mesh=lane_mesh,
+                              checkpoint_dir=str(tmp_path / "j"), **kw)
+        assert calls["n"] == 1  # the warmer ran and fed the merge
+        assert calls["cached"] >= 1  # at least one lane's manifest was warm
+        assert res.meta["journal"]["merged_shards"] == 8
+
+
+class TestMergeWarmerUnit:
+    def test_cached_merge_equals_fresh(self, panel, tmp_path):
+        """merge_job_manifest(cache=) must produce the same manifest as a
+        fresh-read merge, and reject staleness through the cache path."""
+        from spark_timeseries_tpu.reliability import journal as journal_mod
+        from spark_timeseries_tpu.reliability.plan import shard_spans
+
+        d = str(tmp_path / "j")
+        kw = dict(chunk_rows=8, resilient=False, order=(1, 0, 0),
+                  max_iters=15)
+        # build real shard journals via a sharded walk on 2 lanes
+        from spark_timeseries_tpu.parallel import mesh as meshlib
+        import jax
+
+        mesh = meshlib.default_mesh(devices=jax.devices()[:2])
+        rel.fit_chunked(arima.fit, rel.HostChunkSource(panel), mesh=mesh,
+                        checkpoint_dir=d, **kw)
+        root_m = json.load(open(os.path.join(d, "manifest.json")))
+        spans = shard_spans(32, 8, 2)
+        warmer = journal_mod.MergeWarmer(d, 2, interval_s=0.01)
+        import time as _time
+
+        _time.sleep(0.1)
+        cache = warmer.stop()
+        assert len(cache) == 2
+        merged = journal_mod.merge_job_manifest(
+            d, config_hash=root_m["config_hash"],
+            panel_fingerprint=root_m["panel_fingerprint"], n_rows=32,
+            chunk_rows=8, spans=spans, cache=cache)
+        assert merged["chunks_committed"] == 4
+        # stale config through the cache path still rejected
+        with pytest.raises(journal_mod.StaleJournalError):
+            journal_mod.merge_job_manifest(
+                d, config_hash="deadbeefdeadbeef",
+                panel_fingerprint=root_m["panel_fingerprint"], n_rows=32,
+                chunk_rows=8, spans=spans, cache=cache)

@@ -29,6 +29,18 @@ tool reads one manifest and prints suggested
 - ``align_mode``      — the walk's recorded static alignment plan, so the
                         next run passes the hint and skips even the one
                         per-walk NaN-probe host sync.
+- ``host_resident``   — whether the NEXT run of this panel should walk it
+                        from host RAM / shard dir (``fit_chunked(fit_fn,
+                        as_source(...))``): recommended when the recorded
+                        panel bytes crowd the device memory budget
+                        (``memory_stats()['bytes_limit']`` when the local
+                        backend reports one), with the staging-pool
+                        telemetry (pool reuse, H2D wall, donated-buffer
+                        peak) echoed so the operator can see what the
+                        staging actually cost;
+- ``staging_pool_buffers`` — pooled host staging buffers the walk needs
+                        (prefetch_depth + 1: one per staged slice plus
+                        the one being filled);
 - ``shards``          — how many mesh lanes the next run should walk
                         (``fit_chunked(shard=True)`` / ``mesh=``): for a
                         merged sharded manifest, the lanes that actually
@@ -139,6 +151,45 @@ def advise(m: dict) -> dict:
     if staging_mean and exec_mean and exec_mean > 0:
         prefetch_depth = max(1, min(4, math.ceil(staging_mean / exec_mean)))
 
+    # -- host residency: should the panel live off-device? (ISSUE 7) ---------
+    # the manifest records what the walk read (`extra.source`: kind and
+    # panel bytes) and — for host-resident walks — the staging-pool
+    # accounting; the local device's allocator budget decides whether the
+    # NEXT run of this panel still fits in HBM next to its workspace
+    source_extra = (m.get("extra") or {}).get("source") or {}
+    pool = staging.get("staging_pool") or {}
+    # panel bytes: from the source block (host/npz walks) or the panel
+    # geometry every journaled walk records — so the advice fires for
+    # IN-HBM manifests, where "go host-resident next time" is actionable
+    panel_bytes = (source_extra.get("panel_bytes")
+                   or ((m.get("extra") or {}).get("panel") or {}).get(
+                       "bytes"))
+    budget_bytes = _device_budget_bytes()
+    host_resident = None
+    host_resident_reason = None
+    if panel_bytes and budget_bytes:
+        # the walk needs the panel AND chunk workspace resident; past
+        # ~60% of the budget the in-HBM walk is one allocation away from
+        # the OOM-backoff ladder — stage from host instead
+        host_resident = panel_bytes > 0.6 * budget_bytes
+        host_resident_reason = (
+            f"panel {panel_bytes / 1e9:.2f} GB vs device budget "
+            f"{budget_bytes / 1e9:.2f} GB")
+    elif source_extra.get("kind") in ("host", "npz_dir"):
+        host_resident = True  # it already ran host-resident and finished
+        host_resident_reason = f"ran host-resident ({source_extra['kind']})"
+    pool_ops = (pool.get("pool_hits") or 0) + (pool.get("pool_misses") or 0)
+    pool_obs = None
+    if pool:
+        pool_obs = {
+            "pool_hit_rate": (round((pool.get("pool_hits") or 0) / pool_ops,
+                                    4) if pool_ops else None),
+            "h2d_wall_s": pool.get("h2d_wall_s"),
+            "h2d_bytes": pool.get("h2d_bytes"),
+            "peak_live_device_bytes": pool.get("peak_live_device_bytes"),
+            "peak_host_bytes": pool.get("peak_host_bytes"),
+        }
+
     # -- shards: lanes for the next run's mesh walk (ISSUE 6) ----------------
     # a merged sharded manifest records which lanes actually carried work
     # and how their walls balanced; a single-device manifest still says how
@@ -200,6 +251,10 @@ def advise(m: dict) -> dict:
             "input_overlap_efficiency":
                 staging.get("input_overlap_efficiency"),
             "align_mode": align_mode,
+            "source_kind": source_extra.get("kind"),
+            "panel_bytes": panel_bytes,
+            "device_budget_bytes": budget_bytes,
+            "staging_pool": pool_obs,
             "shards": shard_obs,
         },
         "suggest": {
@@ -208,11 +263,28 @@ def advise(m: dict) -> dict:
             "job_budget_s": job_budget_s,
             "pipeline_depth": pipeline_depth,
             "prefetch_depth": prefetch_depth,
+            "staging_pool_buffers": prefetch_depth + 1,
+            "host_resident": host_resident,
+            "host_resident_reason": host_resident_reason,
             "align_mode": align_mode,
             "shards": shards_suggest,
             "chunk_rows_per_shard": chunk_rows_sharded,
         },
     }
+
+
+def _device_budget_bytes():
+    """The local device allocator's budget (``memory_stats()['bytes_limit']``)
+    when the backend reports one; None on CPU-only hosts (the advice then
+    leans on what the recorded run proved instead of a budget guess)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        return int(limit) if limit else None
+    except Exception:  # noqa: BLE001 - advisory tool, never fail on probe
+        return None
 
 
 def main():
@@ -250,6 +322,19 @@ def main():
         print(f"  input staging: mean {o['staging_wall_s_mean']}s/slice"
               + (f", overlap {o['input_overlap_efficiency']}"
                  if o["input_overlap_efficiency"] is not None else ""))
+    if o["source_kind"] is not None:
+        sz = (f", panel {o['panel_bytes'] / 1e9:.3f} GB"
+              if o["panel_bytes"] else "")
+        print(f"  chunk source: {o['source_kind']}{sz}")
+    if o["staging_pool"] is not None:
+        sp = o["staging_pool"]
+        print("  staging pool: "
+              + (f"hit rate {sp['pool_hit_rate']}"
+                 if sp["pool_hit_rate"] is not None else "no reuse data")
+              + (f", H2D wall {sp['h2d_wall_s']}s" if sp["h2d_wall_s"]
+                 is not None else "")
+              + (f", peak live device bytes {sp['peak_live_device_bytes']}"
+                 if sp["peak_live_device_bytes"] is not None else ""))
     if o["shards"] is not None:
         so = o["shards"]
         print(f"  sharded lanes: {so['lanes_with_work']}/{so['n_shards']} "
@@ -262,6 +347,10 @@ def main():
     print(f"    job_budget_s   = {s['job_budget_s']}")
     print(f"    pipeline_depth = {s['pipeline_depth']}")
     print(f"    prefetch_depth = {s['prefetch_depth']}")
+    if s["host_resident"] is not None:
+        print(f"    host_resident  = {s['host_resident']}  "
+              f"({s['host_resident_reason']}; staging_pool_buffers = "
+              f"{s['staging_pool_buffers']})")
     if s["align_mode"] is not None:
         print(f"    align_mode     = {s['align_mode']!r}")
     print(f"    shards         = {s['shards']}  (shard=True/mesh=; clamped "

@@ -130,20 +130,44 @@ def validate_manifest_telemetry(ckpt_dir: str) -> list:
                       f"{pm.get('source')!r}")
     # input-staging block (ISSUE 5): optional — serial/unprefetched walks
     # journal none — but when present it must be well-formed, since
-    # tools/advise_budget.py derives prefetch_depth from it
+    # tools/advise_budget.py derives prefetch_depth from it.  A
+    # host-resident walk (ISSUE 7) adds a staging_pool sub-block (and may
+    # journal ONLY that when the walk ran serially): pool reuse counts,
+    # H2D wall, and the donated-buffer peak must be present and sane —
+    # the oversubscribed CI smoke gates on exactly this.
     st = t.get("input_staging")
     if st is not None:
         if not isinstance(st, dict):
             errors.append(f"telemetry.input_staging not a dict: {st!r}")
         else:
             for k in ("chunks_staged", "staged_hits", "staged_misses"):
-                if not isinstance(st.get(k), int):
+                if k in st and not isinstance(st.get(k), int):
                     errors.append(f"telemetry.input_staging.{k} invalid: "
                                   f"{st.get(k)!r}")
             for k in ("staging_wall_s", "hidden_staging_s"):
-                if not isinstance(st.get(k), (int, float)):
+                if k in st and not isinstance(st.get(k), (int, float)):
                     errors.append(f"telemetry.input_staging.{k} invalid: "
                                   f"{st.get(k)!r}")
+            if not any(k in st for k in ("chunks_staged", "staging_pool")):
+                errors.append("telemetry.input_staging carries neither "
+                              "prefetch nor staging_pool accounting")
+            pool = st.get("staging_pool")
+            if pool is not None:
+                if not isinstance(pool, dict):
+                    errors.append("telemetry.input_staging.staging_pool "
+                                  f"not a dict: {pool!r}")
+                else:
+                    for k in ("pool_hits", "pool_misses", "h2d_copies",
+                              "h2d_bytes", "peak_live_device_bytes",
+                              "peak_host_bytes"):
+                        if not isinstance(pool.get(k), int) or pool[k] < 0:
+                            errors.append(
+                                f"telemetry.input_staging.staging_pool.{k} "
+                                f"invalid: {pool.get(k)!r}")
+                    if not isinstance(pool.get("h2d_wall_s"), (int, float)):
+                        errors.append(
+                            "telemetry.input_staging.staging_pool."
+                            f"h2d_wall_s invalid: {pool.get('h2d_wall_s')!r}")
     errors += validate_manifest_shards(m, path)
     return errors
 
@@ -277,6 +301,19 @@ def _render(s: dict) -> None:
             else:
                 print(f"{pad}{off:9.3f}  {indent}* {ev['name']:<22} {attrs_s}")
 
+        # host-resident walks (ISSUE 7) stage every chunk through the
+        # staging pool; those spans (stage.h2d under stage.overlap) get
+        # their own lane so the input pipeline reads as one row — the
+        # H2D wall is then visually comparable against the compute lane.
+        # Scoped to runs that actually staged H2D: an in-HBM prefetched
+        # walk also emits stage.overlap spans (device slices, no pool),
+        # and those must stay in their chronological timeline
+        staging = []
+        if any(ev.get("name") == "stage.h2d" for ev in rows):
+            staging = [ev for ev in rows
+                       if str(ev.get("name", "")).startswith("stage.")]
+            staging_ids = {id(ev) for ev in staging}
+            rows = [ev for ev in rows if id(ev) not in staging_ids]
         # sharded walks (ISSUE 6) tag every lane's spans/events with its
         # shard id: split the merged stream into ONE LANE PER SHARD so the
         # concurrent walks read as parallel rows, with the driver-level
@@ -304,6 +341,17 @@ def _render(s: dict) -> None:
             print("\ntimeline (s from start):")
             for ev in rows:
                 _row(ev)
+        if staging:
+            h2d = [ev for ev in staging if ev.get("name") == "stage.h2d"
+                   and ev["kind"] == "span"]
+            wall = sum(ev.get("wall_s", 0.0) for ev in staging
+                       if ev["kind"] == "span")
+            mb = sum((ev.get("attrs") or {}).get("bytes", 0)
+                     for ev in h2d) / 1e6
+            print(f"  staging pool lane  ({len(staging)} rows, "
+                  f"span wall {wall:.4f}s, {mb:.2f} MB H2D):")
+            for ev in staging:
+                _row(ev, pad="    ")
     m = s["metrics"]
     if m:
         print("\ncounters:")
