@@ -1709,6 +1709,121 @@ def _oversubscribed_northstar(jnp, order, quick, on_tpu):
     }
 
 
+def _auto_fit_northstar(jnp, quick, on_tpu):
+    """ISSUE 9 acceptance: batched order search throughput — fitting a
+    GRID of candidate orders per series at far less than G independent
+    full-fit campaigns.
+
+    One journaled ``models.auto.auto_fit`` over an ARIMA(1,1,1) panel and
+    a G-candidate grid, telemetry on.  Reported: **candidate-orders x
+    series/sec** (grid cells per second — the number this workload's users
+    buy), the per-order program-reuse rate from the ``compile_cache.hit``/
+    ``miss`` counters (one compiled program per order shape, reused across
+    every chunk of that order's walk), the stage-2 spend share, and —
+    from a second, ``stage2="winners"`` search over the same panel — the
+    economy-mode speedup and its selection agreement with the exact
+    search.  The exact search's selection itself is gated bitwise against
+    the exhaustive argmin in tier-1 (tests/test_auto.py); the bench
+    measures speed, not re-proves correctness.
+    """
+    import tempfile
+
+    import jax
+
+    from spark_timeseries_tpu import obs as _obs
+    from spark_timeseries_tpu.models import auto as _auto
+    from spark_timeseries_tpu.models import arima as _arima_mod
+
+    if on_tpu and not quick:
+        b, t, chunk_rows = 131_072, 1000, 32_768
+        orders = [(1, 0, 0), (0, 0, 1), (1, 0, 1), (0, 1, 1), (1, 1, 0),
+                  (1, 1, 1), (2, 1, 1), (1, 1, 2)]
+        max_iters = 60
+    elif quick:
+        b, t, chunk_rows = 256, 120, 128
+        orders = [(1, 0, 0), (0, 1, 1), (1, 1, 1)]
+        max_iters = 20
+    else:
+        b, t, chunk_rows = 1024, 200, 256
+        orders = [(1, 0, 0), (0, 0, 1), (0, 1, 1), (1, 1, 0), (1, 1, 1)]
+        max_iters = 25
+    g = len(orders)
+    panel = jnp.asarray(gen_arima_panel(b, t, seed=21))
+    panel.block_until_ready()
+
+    # warm the per-order fit programs on the chunk shape OUTSIDE the timed
+    # search (compile time is reported separately by the hit-rate metric;
+    # the timed wall measures the walk, matching every other north-star)
+    warm = panel[:chunk_rows]
+    for o in orders:
+        jax.block_until_ready(_arima_mod.fit(warm, o,
+                                             max_iters=max_iters).params)
+
+    obs_was_on = _obs.enabled()
+    if not obs_was_on:
+        _obs.enable()
+    try:
+        c0 = (_obs.snapshot() or {}).get("counters", {})
+        ckpt = tempfile.mkdtemp(prefix="auto_ns_")
+        t0 = time.perf_counter()
+        res = _auto.auto_fit(panel, orders, chunk_rows=chunk_rows,
+                             max_iters=max_iters, checkpoint_dir=ckpt)
+        wall = time.perf_counter() - t0
+        c1 = (_obs.snapshot() or {}).get("counters", {})
+        t0 = time.perf_counter()
+        res_w = _auto.auto_fit(panel, orders, chunk_rows=chunk_rows,
+                               max_iters=max_iters, stage2="winners",
+                               stage1_iters=max(6, max_iters // 4))
+        wall_w = time.perf_counter() - t0
+    finally:
+        if not obs_was_on:
+            _obs.disable()
+
+    cc_hits = c1.get("compile_cache.hit", 0) - c0.get("compile_cache.hit", 0)
+    cc_miss = (c1.get("compile_cache.miss", 0)
+               - c0.get("compile_cache.miss", 0))
+    am = res.meta["auto_fit"]
+    am_w = res_w.meta["auto_fit"]
+    agree = float(np.mean(np.asarray(res_w.order_index)
+                          == np.asarray(res.order_index)))
+    conv = float(np.sum(res.converged))
+    top = sorted(((k2, v) for k2, v in am["selection_counts"].items()
+                  if k2 != "none"), key=lambda kv: -kv[1])[:3]
+    return {
+        "series_total": b,
+        "obs_per_series": t,
+        "candidate_orders": g,
+        "chunk_rows": chunk_rows,
+        "wall_s": round(wall, 3),
+        # the acceptance number: grid cells fitted per second — G
+        # candidates per series, so the search throughput in full-fit
+        # equivalents
+        "order_series_per_sec": round(g * b / wall, 1) if wall > 0 else None,
+        "selected_series_per_sec": round(b / wall, 1) if wall > 0 else None,
+        "converged_frac": round(conv / b, 4),
+        "selection_top": dict(top),
+        "selection_none": am["selection_counts"].get("none", 0),
+        # per-order compiled-program reuse, measured (satellite 1): with
+        # C chunks per walk the steady state is (C-1)/C hits per order
+        "compile_cache_hit_rate": (round(cc_hits / (cc_hits + cc_miss), 4)
+                                   if (cc_hits + cc_miss) else None),
+        "compile_cache_hits": cc_hits,
+        "compile_cache_misses": cc_miss,
+        # stage-2 spend: zero for the exact search (the lazy split only
+        # dispatches stage 2 when stragglers remain); the winners pass
+        # reports the economy's spend share and its agreement
+        "stage2_spend_share": am["stage2_spend_share"],
+        "winners_wall_s": round(wall_w, 3),
+        "winners_speedup": round(wall / wall_w, 4) if wall_w > 0 else None,
+        "winners_stage2_spend_share": am_w["stage2_spend_share"],
+        "winners_selection_agreement": round(agree, 4),
+        "journal": {"dir": ckpt},
+        "data": "journaled exact order search (one durable walk per "
+                "candidate, on-device AICc argmin) + an unjournaled "
+                "stage2='winners' economy pass over the same panel/grid",
+    }
+
+
 def bench_arima_headline(jnp, quick, on_tpu, n_chips, platform, parity=None):
     from spark_timeseries_tpu.models import arima
 
@@ -1773,6 +1888,10 @@ def bench_arima_headline(jnp, quick, on_tpu, n_chips, platform, parity=None):
     _progress("config 3: oversubscribed north-star (host-resident walk)...")
     acct["oversubscribed_northstar"] = _oversubscribed_northstar(
         jnp, order, quick, on_tpu)
+    # ISSUE 9: auto model selection — a grid of candidate orders per
+    # series as one journaled search (candidate-orders x series/sec)
+    _progress("config 3: auto-fit north-star (batched order search)...")
+    acct["auto_fit_northstar"] = _auto_fit_northstar(jnp, quick, on_tpu)
 
     cpu_rate, n_done = cpu_rate_arima(t, 2.0 if quick else CPU_BUDGET_S)
     n_cores = os.cpu_count() or 1
@@ -1845,6 +1964,22 @@ def _telemetry_regression_gate(headline):
             **(inputs or {}),
             "oversubscribed_ratio": ov.get("host_over_hbm_throughput"),
         }
+    # auto-fit gate inputs (ISSUE 9): the order-search throughput, the
+    # per-order program-reuse rate, and the winners-economy agreement —
+    # a compile-cache keying regression or a selection drift would hide
+    # behind a flat single-fit headline
+    af = headline.get("auto_fit_northstar") or {}
+    if af.get("order_series_per_sec") is not None:
+        inputs = {
+            **(inputs or {}),
+            "auto_fit_order_series_per_sec": af.get("order_series_per_sec"),
+            "auto_fit_compile_cache_hit_rate":
+                af.get("compile_cache_hit_rate"),
+            "auto_fit_stage2_spend_share":
+                af.get("winners_stage2_spend_share"),
+            "auto_fit_winners_agreement":
+                af.get("winners_selection_agreement"),
+        }
     cur = {
         "metric": "telemetry_summary: regression-gate inputs "
                   "(compile share, commit latency, map_series cache, "
@@ -1894,6 +2029,10 @@ def _telemetry_regression_gate(headline):
         "sharded_speedup": ("rel", 0.3),
         "shard_overlap_efficiency_min": ("abs", 0.2),
         "oversubscribed_ratio": ("abs", 0.2),
+        "auto_fit_order_series_per_sec": ("rel", 0.4),
+        "auto_fit_compile_cache_hit_rate": ("abs", 0.2),
+        "auto_fit_stage2_spend_share": ("abs", 0.25),
+        "auto_fit_winners_agreement": ("abs", 0.1),
     }
     drifts, flagged = {}, []
     for k, (mode, tol) in thresholds.items():
@@ -1987,6 +2126,14 @@ def _summary_line(emitted):
                     "wall_s_host_resident", "host_over_hbm_throughput",
                     "host_bitwise_identical", "device_footprint_ok",
                     "input_overlap_efficiency")}
+            af = obj.get("auto_fit_northstar")
+            if af:
+                entry["auto_fit_northstar"] = {k: af.get(k) for k in (
+                    "series_total", "candidate_orders", "wall_s",
+                    "order_series_per_sec", "compile_cache_hit_rate",
+                    "stage2_spend_share", "winners_speedup",
+                    "winners_stage2_spend_share",
+                    "winners_selection_agreement")}
         configs[key] = entry
     line = {
         "metric": "bench_summary: all configs, tail-truncation-proof "

@@ -162,6 +162,55 @@ python tests/_sharded_worker.py --smoke
 # telemetry block journaled and validated by `obs_report --check`
 python tests/_hostwalk_worker.py --smoke
 
+# auto-fit kill-and-resume smoke (ISSUE 9): a journaled 3-order auto-fit
+# search is SIGKILLed with part of the order grid committed (order 0
+# durable, order 1 mid-walk, order 2 never started), resumed, and the
+# resumed selection must be BITWISE-identical to an uninterrupted search —
+# per-order journals replay only uncommitted chunks, the selection argmin
+# is recomputed from the full grid
+python tests/_autofit_worker.py --smoke
+
+# auto-fit tooling smoke (ISSUE 9): a short journaled order search with
+# telemetry on must leave per-order manifests carrying their grid
+# coordinate, an auto_manifest.json that passes the obs_report schema
+# gate, per-order timeline lanes in the rendered report, and enough for
+# the budget advisor to suggest orders_per_pass for the next search
+AUTO_SMOKE_DIR=$(python - <<'EOF'
+import json, os, tempfile
+import numpy as np
+from spark_timeseries_tpu import obs
+from spark_timeseries_tpu.models import auto
+
+root = tempfile.mkdtemp(prefix="auto_smoke_")
+rng = np.random.default_rng(0)
+e = rng.normal(size=(24, 120)).astype(np.float32)
+y = np.zeros_like(e)
+for t in range(1, y.shape[1]):
+    y[:, t] = 0.6 * y[:, t - 1] + e[:, t]
+obs.enable(os.path.join(root, "events.jsonl"))
+res = auto.auto_fit(y, [(1, 0, 0), (0, 0, 1)], chunk_rows=8, max_iters=20,
+                    checkpoint_dir=os.path.join(root, "search"))
+obs.disable()
+am = res.meta["auto_fit"]
+assert sum(am["selection_counts"].values()) == 24, am["selection_counts"]
+assert am["compile_cache"]["hits"] is not None
+m = json.load(open(os.path.join(root, "search", "grid_00000",
+                                "manifest.json")))
+assert m["extra"]["grid"] == {"index": 0, "total": 2}, m["extra"]
+assert m["extra"]["auto_fit"]["order"] == [1, 0, 0]
+print(root)
+EOF
+)
+python tools/obs_report.py --check "$AUTO_SMOKE_DIR/events.jsonl" \
+  --manifest "$AUTO_SMOKE_DIR/search"
+python tools/obs_report.py "$AUTO_SMOKE_DIR/events.jsonl" \
+  | grep -q "order-grid lanes" \
+  || { echo "ci.sh: obs_report did not render per-order lanes" >&2; exit 1; }
+python tools/advise_budget.py "$AUTO_SMOKE_DIR/search" \
+  | grep -q "orders_per_pass" \
+  || { echo "ci.sh: advise_budget did not suggest orders_per_pass" >&2; exit 1; }
+rm -rf "$AUTO_SMOKE_DIR"
+
 # sharded tooling smoke (ISSUE 6): a short journaled sharded walk with
 # telemetry on must produce a merged manifest whose `shards` block passes
 # the obs_report schema gate, render one timeline lane per shard, and give

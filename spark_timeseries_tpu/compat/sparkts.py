@@ -439,6 +439,70 @@ class ARIMAModel(_ModelBase):
                                                 self.has_intercept)))
 
 
+class SeasonalARIMAModel(_ModelBase):
+    """A seasonal SARIMA winner from :meth:`ARIMA.auto_fit`.
+
+    Holds the selected order, seasonal spec, and fitted parameters
+    (layout ``[c?, phi, theta, PHI, THETA]`` — ``models.arima.
+    _split_params_seasonal``).  Deliberately NOT an :class:`ARIMAModel`:
+    that class's forecast/sample/effects methods split params with the
+    non-seasonal layout and difference only ``d`` times, which would
+    silently drop the seasonal structure the criterion selected the model
+    for.  Seasonal forecasting is a ROADMAP follow-on; until it lands
+    these methods raise instead of returning wrong numbers.
+    """
+
+    def __init__(self, order, seasonal, params, has_intercept=True):
+        super().__init__(params)
+        self.order = tuple(int(v) for v in order)
+        self.seasonal = tuple(int(v) for v in seasonal)
+        self.has_intercept = has_intercept
+
+    def _meta(self) -> dict:
+        return dict(order=np.asarray(self.order),
+                    seasonal=np.asarray(self.seasonal),
+                    has_intercept=self.has_intercept)
+
+    @classmethod
+    def _from_saved(cls, params, meta):
+        return cls([int(v) for v in meta["order"]],
+                   [int(v) for v in meta["seasonal"]], params,
+                   bool(meta["has_intercept"]))
+
+    def _not_implemented(self, what: str):
+        raise NotImplementedError(
+            f"{what} is not implemented for seasonal models yet "
+            f"(order {self.order} x {self.seasonal}); the fitted "
+            "parameters and the selection criterion are available on "
+            ".params / .criterion_value")
+
+    def forecast(self, ts, n_future: int):
+        self._not_implemented("forecast")
+
+    def sample(self, n: int, seed: int = 0):
+        self._not_implemented("sample")
+
+    def add_time_dependent_effects(self, ts):
+        self._not_implemented("add_time_dependent_effects")
+
+    def remove_time_dependent_effects(self, ts):
+        self._not_implemented("remove_time_dependent_effects")
+
+    def log_likelihood_css(self, ts) -> float:
+        """Concentrated seasonal CSS log-likelihood of ``ts`` under the
+        fitted parameters (both differencings applied)."""
+        from ..models.arima import (_difference, _difference_seasonal,
+                                    sarima_neg_loglik)
+
+        P, D, Q, s = self.seasonal
+        yd = jnp.asarray(np.asarray(ts, np.float64))
+        yd = _difference(yd, self.order[1])
+        yd = _difference_seasonal(yd, D, s)
+        return -float(sarima_neg_loglik(
+            jnp.asarray(self.params, yd.dtype), yd, self.order,
+            self.seasonal, self.has_intercept))
+
+
 class ARIMA:
     @staticmethod
     def fit_model(p: int, d: int, q: int, ts, include_intercept: bool = True,
@@ -470,6 +534,64 @@ class ARIMA:
                              method=method, init_params=user_init_params,
                              align_mode=align_mode)
             return ARIMAModel(p, d, q, res.params, include_intercept)
+
+    @staticmethod
+    def auto_fit(ts, orders=None, criterion: str = "aicc",
+                 include_intercept: bool = True,
+                 checkpoint_dir: Optional[str] = None,
+                 **auto_kwargs):
+        """Batched order search (``models.auto.auto_fit``): fit a grid of
+        candidate ``(p, d, q)`` (optionally seasonal
+        ``(p, d, q, (P, D, Q, s))``) orders and select per series by
+        ``criterion`` (AICc default; AIC/BIC).
+
+        The upstream workflow — users looping ``ARIMA.fit_model`` over
+        hand-picked orders and comparing ``approx_aic`` — becomes one
+        call: the whole grid is fitted through the journaled chunk driver
+        (``checkpoint_dir=`` makes the search durable, per-order journals
+        under ``grid_00000/…``; every other ``auto_fit`` knob —
+        ``stage2``, ``chunk_rows``, ``shard``, budgets — rides through).
+
+        Returns a single model of the winning order for a ``[time]``
+        series, or a list of per-series models (``None`` where no
+        candidate produced a finite criterion) for a ``[batch, time]``
+        panel: an :class:`ARIMAModel` for non-seasonal winners, a
+        :class:`SeasonalARIMAModel` for seasonal ones (whose
+        forecast-family methods raise until seasonal forecasting lands —
+        the non-seasonal layout would silently drop the seasonal terms).
+        The underlying ``AutoFitResult`` (selection histogram, criteria,
+        per-order spend) rides on each model as ``model.auto_result`` /
+        in position via ``result.order_index``.
+        """
+        from ..models import auto as _auto
+
+        with obs.span("compat.auto_fit", model="ARIMA"):
+            a = jnp.asarray(ts)
+            single = a.ndim == 1
+            res = _auto.auto_fit(
+                jnp.atleast_2d(a), orders, criterion=criterion,
+                include_intercept=include_intercept,
+                checkpoint_dir=checkpoint_dir, **auto_kwargs)
+            models = []
+            for i, g in enumerate(np.asarray(res.order_index)):
+                if g < 0:
+                    models.append(None)
+                    continue
+                spec = res.orders[int(g)]
+                p, d, q = spec.order
+                k = spec.n_params(include_intercept)
+                if spec.seasonal is not None:
+                    m = SeasonalARIMAModel(spec.order, spec.seasonal,
+                                           res.params[i, :k],
+                                           include_intercept)
+                else:
+                    m = ARIMAModel(p, d, q, res.params[i, :k],
+                                   include_intercept)
+                    m.seasonal = None
+                m.criterion_value = float(res.criterion[i])
+                m.auto_result = res
+                models.append(m)
+            return models[0] if single else models
 
 
 class ARModel(_ModelBase):

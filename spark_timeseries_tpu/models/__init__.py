@@ -1,12 +1,17 @@
-from . import arima, autoregression, ewma, garch, holtwinters, regression_arima
+from . import (arima, auto, autoregression, ewma, garch, holtwinters,
+               regression_arima)
+from .auto import AutoFitResult, auto_fit
 from .base import FitResult
 
 __all__ = [
     "arima",
+    "auto",
     "autoregression",
     "ewma",
     "garch",
     "holtwinters",
     "regression_arima",
+    "AutoFitResult",
     "FitResult",
+    "auto_fit",
 ]

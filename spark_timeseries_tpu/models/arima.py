@@ -42,6 +42,7 @@ from .base import (FitResult, align_mode_on_host, align_right, debatch,
                    resolve_align_mode, resolve_backend)
 
 Order = Tuple[int, int, int]
+Seasonal = Tuple[int, int, int, int]  # (P, D, Q, s)
 
 # module-level so tests can monkeypatch the gate per model; the value and
 # the cap sizing live with the compaction feature (utils.optim)
@@ -85,20 +86,23 @@ def _lagged(yd, p: int):
 # ---------------------------------------------------------------------------
 
 
-def _css_errors(params, yd, order: Order, include_intercept: bool, condition: bool = True,
-                n_valid=None):
-    """One-step-ahead prediction errors of the ARMA(p,q) recursion.
+def _css_errors_poly(c, phi, theta, yd, condition: bool = True, n_valid=None):
+    """One-step-ahead prediction errors of the ARMA recursion with EXPLICIT
+    lag-coefficient vectors ``phi [p_full]`` / ``theta [q_full]`` — the one
+    scan both the plain ARMA path (:func:`_css_errors`) and the seasonal
+    expanded-polynomial path (:func:`_sarima_css_errors`) run.
 
-    ``condition=True`` zeroes errors for the first p valid steps (conditional
-    likelihood — the reference's CSS).  ``condition=False`` keeps
-    zero-padded-lag errors for every valid t, which makes the transform
-    exactly invertible (remove/add_time_dependent_effects).
+    ``condition=True`` zeroes errors for the first ``p_full`` valid steps
+    (conditional likelihood — the reference's CSS); ``condition=False``
+    keeps zero-padded-lag errors for every valid t, which makes the
+    transform exactly invertible (remove/add_time_dependent_effects).
 
     ``n_valid`` (traced scalar) marks a right-aligned valid span (see
     ``base.align_right``): errors in the zero prefix are forced to 0 so
     padded series contribute nothing there.
     """
-    p, _, q = order
+    p = phi.shape[0]
+    q = theta.shape[0]
     n = yd.shape[0]
     t_idx = jnp.arange(n)
     start = 0
@@ -108,7 +112,6 @@ def _css_errors(params, yd, order: Order, include_intercept: bool, condition: bo
         # value at yd[start-1]; zero the prefix so lags reaching below start
         # bring exactly the zeros a trimmed series would see
         yd = jnp.where(t_idx >= start, yd, 0.0)
-    c, phi, theta = _split_params(params, order, include_intercept)
     ylags = _lagged(yd, p)  # [n, p]
     zero_before = start + p if condition else start
 
@@ -123,6 +126,15 @@ def _css_errors(params, yd, order: Order, include_intercept: bool, condition: bo
     errs0 = jnp.zeros((max(q, 1),), yd.dtype)
     _, e = lax.scan(step, errs0, (yd, ylags, t_idx))
     return e
+
+
+def _css_errors(params, yd, order: Order, include_intercept: bool, condition: bool = True,
+                n_valid=None):
+    """ARMA(p,q) CSS errors from the packed parameter vector (see
+    :func:`_css_errors_poly` for the recursion's contract)."""
+    c, phi, theta = _split_params(params, order, include_intercept)
+    return _css_errors_poly(c, phi, theta, yd, condition=condition,
+                            n_valid=n_valid)
 
 
 def css_neg_loglik(params, yd, order: Order, include_intercept: bool, n_valid=None):
@@ -140,6 +152,143 @@ def css_neg_loglik(params, yd, order: Order, include_intercept: bool, n_valid=No
 def approx_aic(params, yd, order: Order, include_intercept: bool):
     k = _n_params(order, include_intercept)
     return 2.0 * css_neg_loglik(params, yd, order, include_intercept) + 2.0 * k
+
+
+# ---------------------------------------------------------------------------
+# Seasonal extension: SARIMA(p,d,q)(P,D,Q)_s through the same CSS recursion
+# ---------------------------------------------------------------------------
+#
+# The multiplicative seasonal model
+#   Phi(L^s) phi(L) (1-L)^d (1-L^s)^D y_t = c + Theta(L^s) theta(L) e_t
+# is the paper's most-missed scenario (PAPER.md section 0/L-map: upstream
+# spark-ts users pick seasonal orders as part of model selection).  Rather
+# than a second likelihood implementation, the seasonal polynomials are
+# EXPANDED into plain lag-coefficient vectors (static shapes: p+P*s AR lags,
+# q+Q*s MA lags) and run through the exact `_css_errors_poly` scan the
+# non-seasonal fit uses — one recursion, one conditioning rule, one
+# concentrated-variance likelihood.  Seasonal fits run on the portable scan
+# backend (the fused Pallas kernel's folded layout has no seasonal lag
+# structure); `auto_fit` (models.auto) is the intended high-volume caller.
+
+
+def _validate_seasonal(seasonal) -> Optional[Seasonal]:
+    """Normalize a ``(P, D, Q, s)`` seasonal spec; ``None`` (or an all-zero
+    structure) means "no seasonal terms" and returns None."""
+    if seasonal is None:
+        return None
+    try:
+        P, D, Q, s = (int(v) for v in seasonal)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"seasonal must be a (P, D, Q, s) tuple, got {seasonal!r}") from e
+    if P == 0 and D == 0 and Q == 0:
+        return None
+    if min(P, D, Q) < 0:
+        raise ValueError(f"seasonal orders must be >= 0, got {seasonal!r}")
+    if s < 2:
+        raise ValueError(
+            f"seasonal period s must be >= 2 when (P, D, Q) != 0, "
+            f"got {seasonal!r}")
+    return (P, D, Q, s)
+
+
+def _difference_seasonal(y, D: int, s: int):
+    """Order-D seasonal differencing at lag s (static shapes: drops D*s)."""
+    for _ in range(D):
+        y = y[s:] - y[:-s]
+    return y
+
+
+def _n_params_seasonal(order: Order, seasonal: Seasonal,
+                       include_intercept: bool) -> int:
+    p, _, q = order
+    P, _, Q, _ = seasonal
+    return int(include_intercept) + p + q + P + Q
+
+
+def _split_params_seasonal(params, order: Order, seasonal: Seasonal,
+                           include_intercept: bool):
+    """Layout: ``[c (if intercept), phi_1..p, theta_1..q, PHI_1..P,
+    THETA_1..Q]`` — the non-seasonal prefix matches :func:`_split_params`
+    so a caller can warm-start a seasonal fit from a plain ARMA one."""
+    p, _, q = order
+    P, _, Q, _ = seasonal
+    i = int(include_intercept)
+    c = params[0] if include_intercept else jnp.zeros((), params.dtype)
+    phi = params[i: i + p]
+    theta = params[i + p: i + p + q]
+    sphi = params[i + p + q: i + p + q + P]
+    stheta = params[i + p + q + P: i + p + q + P + Q]
+    return c, phi, theta, sphi, stheta
+
+
+def _expand_seasonal_poly(vals, svals, s: int, cross: float):
+    """Lag coefficients of the multiplicative polynomial product.
+
+    For the AR side (``cross=-1``): ``(1 - sum v_i L^i)(1 - sum w_j L^js)``
+    gives the recursion coefficients ``a`` with ``y_t = c + sum a_k y_{t-k}
+    + ...`` — ``a[:p] = v``, ``a[js-1] = w_j``, ``a[js+i-1] = -v_i w_j``.
+    For the MA side (``cross=+1``): ``(1 + sum v L)(1 + sum w L^js)`` gives
+    ``b`` with the cross terms ADDED.  All shapes static (p, P, s are
+    Python ints), so the expansion unrolls into a handful of scatter-adds
+    at trace time.
+    """
+    p = int(vals.shape[0])
+    P = int(svals.shape[0])
+    n = p + P * s
+    if n == 0:
+        return jnp.zeros((0,), vals.dtype)
+    full = jnp.zeros((n,), vals.dtype)
+    if p:
+        full = full.at[:p].add(vals)
+    for j in range(P):
+        lag = (j + 1) * s
+        full = full.at[lag - 1].add(svals[j])
+        if p:
+            full = full.at[lag: lag + p].add(cross * svals[j] * vals)
+    return full
+
+
+def _sarima_css_errors(params, yd, order: Order, seasonal: Seasonal,
+                       include_intercept: bool, condition: bool = True,
+                       n_valid=None):
+    """CSS errors of the expanded seasonal recursion (``yd`` already both
+    plain- and seasonally-differenced)."""
+    _, _, _, s = seasonal
+    c, phi, theta, sphi, stheta = _split_params_seasonal(
+        params, order, seasonal, include_intercept)
+    phi_full = _expand_seasonal_poly(phi, sphi, s, -1.0)
+    theta_full = _expand_seasonal_poly(theta, stheta, s, 1.0)
+    return _css_errors_poly(c, phi_full, theta_full, yd,
+                            condition=condition, n_valid=n_valid)
+
+
+def seasonal_lag_span(order: Order, seasonal: Optional[Seasonal]
+                      ) -> Tuple[int, int, int]:
+    """``(p_full, q_full, d_full)`` — the expanded AR/MA lag depths and the
+    total differencing the (optionally seasonal) model conditions on.
+    The criterion layer (``models.auto``) uses these to compute the same
+    effective sample size the concentrated likelihood divides by."""
+    p, d, q = order
+    if seasonal is None:
+        return p, q, d
+    P, D, Q, s = seasonal
+    return p + P * s, q + Q * s, d + D * s
+
+
+def sarima_neg_loglik(params, yd, order: Order, seasonal: Seasonal,
+                      include_intercept: bool, n_valid=None):
+    """Concentrated Gaussian CSS likelihood of the seasonal recursion —
+    same concentration rule as :func:`css_neg_loglik` with the expanded
+    AR depth ``p + P*s`` conditioned out."""
+    p_full, _, _ = seasonal_lag_span(order, seasonal)
+    nv = yd.shape[0] if n_valid is None else n_valid
+    e = _sarima_css_errors(params, yd, order, seasonal, include_intercept,
+                           n_valid=n_valid)
+    n_eff = nv - p_full
+    css = jnp.sum(e * e)
+    sigma2 = css / n_eff
+    return 0.5 * n_eff * (jnp.log(2.0 * jnp.pi * sigma2) + 1.0)
 
 
 # ---------------------------------------------------------------------------
@@ -252,6 +401,7 @@ def fit(
     order: Order,
     include_intercept: bool = True,
     *,
+    seasonal: Optional[Seasonal] = None,
     method: str = "css-lbfgs",
     init_params: Optional[jax.Array] = None,
     max_iters: int = 60,
@@ -295,6 +445,13 @@ def fit(
     flagged rows (DIVERGED under ``"dense"``, EXCLUDED with NaN params
     under ``"no-trailing"``), never as silently wrong estimates.
 
+    ``seasonal=(P, D, Q, s)`` extends the recursion with multiplicative
+    seasonal terms (SARIMA): the seasonal polynomials are expanded into
+    plain lag coefficients and run through the SAME CSS scan, with the
+    parameter layout ``[c?, phi_1..p, theta_1..q, PHI_1..P, THETA_1..Q]``.
+    Seasonal fits run on the portable scan backend only (``backend`` must
+    resolve away from pallas) and support the optimizing CSS methods.
+
     ``FitResult.status`` reports per-row ``reliability.FitStatus`` codes
     (OK / DIVERGED / EXCLUDED for a plain fit).
     """
@@ -302,6 +459,13 @@ def fit(
         raise ValueError(f"unknown method {method!r}")
     if count_evals and method == "hannan-rissanen":
         raise ValueError("count_evals requires an optimizing method")
+    seasonal = _validate_seasonal(seasonal)
+    if seasonal is not None:
+        return _fit_seasonal(
+            y, order, seasonal, include_intercept, method=method,
+            init_params=init_params, max_iters=max_iters, tol=tol,
+            backend=backend, count_evals=count_evals,
+            align_mode=align_mode)
     p, d, q = order
     yb, single = ensure_batched(y)
     k = _n_params(order, include_intercept)
@@ -560,6 +724,103 @@ def _fit_stage2_program(order, include_intercept, backend, max_iters, tol,
         res = optim.lbfgs_batched_stage2(
             fb_s, aux["res"], aux["carry"], max_iters=max_iters, tol=tol)
         return _finalize_css_fit(res, aux["ok"], aux["n_eff"])
+
+    return run
+
+
+def _fit_seasonal(
+    y,
+    order: Order,
+    seasonal: Seasonal,
+    include_intercept: bool,
+    *,
+    method: str,
+    init_params: Optional[jax.Array],
+    max_iters: int,
+    tol: Optional[float],
+    backend: str,
+    count_evals: bool,
+    align_mode: Optional[str],
+) -> FitResult:
+    """Seasonal branch of :func:`fit` (validated ``seasonal`` only)."""
+    if method == "hannan-rissanen":
+        raise ValueError(
+            "seasonal orders require an optimizing CSS method "
+            "(hannan-rissanen has no seasonal init stage)")
+    if count_evals:
+        raise ValueError(
+            "count_evals instruments the fused pallas objective; seasonal "
+            "fits run on the scan backend")
+    if backend not in ("auto", "scan"):
+        raise ValueError(
+            f"seasonal orders run on the portable scan backend (the fused "
+            f"kernel's folded layout has no seasonal lag structure); got "
+            f"backend={backend!r}")
+    p_full, q_full, d_full = seasonal_lag_span(order, seasonal)
+    yb, single = ensure_batched(y)
+    if yb.shape[1] - d_full < max(p_full + q_full + 2, 2):
+        raise ValueError(
+            f"series of length {yb.shape[1]} too short for seasonal order "
+            f"{order} x {seasonal} (needs > {d_full + p_full + q_full + 2} "
+            "observations)")
+    if tol is None:
+        tol = 1e-6 if yb.dtype == jnp.float64 else 1e-4
+    align_mode = resolve_align_mode(yb, align_mode)
+    run = _fit_sarima_program(order, seasonal, include_intercept, max_iters,
+                              float(tol), init_params is not None, align_mode)
+    if init_params is None:
+        out = run(yb)
+    else:
+        out = run(yb, jnp.asarray(init_params))
+    return debatch_fit(out, single, False)
+
+
+@jit_program
+def _fit_sarima_program(order, seasonal, include_intercept, max_iters, tol,
+                        has_init, align_mode="general"):
+    """One compiled program per (order, seasonal, ...) static config —
+    align + both differencings, the non-seasonal Hannan-Rissanen warm
+    start (seasonal terms start at 0: the optimizer owns them), the
+    identifiability gate, and the vmapped L-BFGS on the expanded-
+    polynomial CSS objective."""
+    p, d, q = order
+    P, D, Q, s = seasonal
+    k = _n_params_seasonal(order, seasonal, include_intercept)
+    p_full, q_full, d_full = seasonal_lag_span(order, seasonal)
+
+    def run(yb, init_params=None):
+        with jax.named_scope("arima.sarima_align_and_difference"):
+            ya, nv0 = maybe_align(yb, align_mode)  # ragged: NaN head/tail
+            yd = jax.vmap(
+                lambda v: _difference_seasonal(_difference(v, d), D, s))(ya)
+            nvd = nv0 - d_full  # valid length after both differencings
+        with jax.named_scope("arima.sarima_init"):
+            if has_init:
+                init = jnp.broadcast_to(init_params, (yd.shape[0], k))
+            else:
+                # short-memory (p, q) warm start on the fully differenced
+                # series; the P+Q seasonal terms start at 0 so the init is
+                # deterministic and the gate below keeps HR's long-AR order
+                # static (same nvd >= 4*(p+q+1) contract as _css_prep)
+                base = hannan_rissanen_batched(
+                    yd, (p, 0, q), include_intercept, nvd)
+                init = jnp.concatenate(
+                    [base, jnp.zeros((yd.shape[0], P + Q), yd.dtype)], axis=1)
+        ok = nvd >= p_full + q_full + max(p_full + q_full + 1, 1) + k + 2
+        if not has_init:
+            ok = ok & (nvd >= 4 * (p + q + 1))
+        # optimize the MEAN log-likelihood (same rationale as _css_prep)
+        n_eff = jnp.maximum(nvd - p_full, 1).astype(yd.dtype)
+        res = optim.batched_minimize(
+            lambda pr, data: sarima_neg_loglik(
+                pr, data[0], order, seasonal, include_intercept, data[1]
+            ) / data[2],
+            init,
+            (yd, nvd, n_eff),
+            max_iters=max_iters,
+            tol=tol,
+        )
+        return _finalize_css_fit(res, ok, n_eff)
 
     return run
 

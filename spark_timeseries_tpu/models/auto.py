@@ -1,0 +1,743 @@
+"""Auto model selection at panel scale (ISSUE 9 / ROADMAP item 4).
+
+Real users rarely know their ``(p, d, q)`` — upstream spark-ts exposes
+model selection as a first-class workflow, and seasonal order choice is
+the paper's largest still-unreproduced scenario surface.  :func:`auto_fit`
+fits a STATIC grid of candidate ARIMA (optionally seasonal SARIMA) orders
+per series, computes an information criterion per (row, order) ON DEVICE,
+and arg-selects per row — the batched rebuild of "loop statsmodels'
+``auto_arima`` over a million series".
+
+**Execution model.**  Each candidate order is one ordinary journaled chunk
+walk (``reliability.fit_chunked`` with a ``grid=(g, G)`` coordinate on its
+:class:`~..reliability.plan.ExecutionPlan`): the search therefore inherits
+EVERYTHING the driver already earns — write-ahead journaling with
+SIGKILL-resume that replays only uncommitted chunks (a kill mid-grid
+resumes with completed orders loaded from their manifests and the
+in-flight order continuing mid-walk), OOM chunk backoff, wall-clock
+budgets, pipelined commits/prefetch, mesh sharding (``shard=True``), and
+``ChunkSource`` streaming for larger-than-HBM panels.  Within each order's
+walk the lazy stage-1/stage-2 straggler split in ``utils.optim`` does the
+per-order amortization: stage 1 (the cheap lockstep sweep) runs for every
+order, and the compacted stage-2 straggler program is traced/compiled/
+dispatched ONLY when an order's rows actually need it.  One compiled
+program per (order, chunk shape) is reused across every chunk of that
+order's walk — measured by the ``compile_cache.hit``/``miss`` counters
+(``utils.compile_cache``).
+
+**Selection.**  Criteria (AICc default; AIC/BIC) are computed from each
+order's concentrated CSS likelihood and the row's valid-span length in ONE
+jitted program over the stacked ``[G, B]`` results — per-row argmin, tie
+broken toward the earlier grid entry, no host round-trip per candidate.
+Rows where no candidate produced a finite criterion come back with
+``order_index = -1`` and NaN params.  The default (``stage2="full"``)
+selection is bitwise-identical to an exhaustive per-order full-fit argmin
+on the same panel with the same chunk layout.
+
+**Stage-2 economy** (``stage2="winners"``): run every order at a small
+stage-1 iteration budget first, rank basins per row by the stage-1
+criterion, then spend the FULL budget only on each row's winning order
+(gathered into ``optim.retry_cap``-aligned sub-batches, one journaled
+refit walk per winning order).  Selection then follows the stage-1
+ranking — documented as approximate (a basin that looks worse at the
+stage-1 budget can win under full convergence) in exchange for spending
+full-fit iterations on ~1/G of the (row, order) grid.
+
+Durability artifacts: per-order journals live under
+``checkpoint_dir/grid_00000/…`` (each manifest carrying an
+``extra.auto_fit`` block) and the search writes a root
+``auto_manifest.json`` recording orders tried, per-order stage-2 spend,
+and the selection histogram — rendered/validated by
+``tools/obs_report.py`` and turned into next-run knobs by
+``tools/advise_budget.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import obs
+from ..utils import compile_cache as _compile_cache
+from ..utils import optim
+from . import arima
+from .base import jit_program
+
+__all__ = [
+    "AutoFitResult",
+    "DEFAULT_ORDERS",
+    "OrderSpec",
+    "auto_fit",
+    "criterion_matrix",
+    "normalize_orders",
+    "select_orders",
+]
+
+CRITERIA = ("aicc", "aic", "bic")
+
+# pragmatic default grid: the low-order workhorses statsmodels' stepwise
+# search visits first — differencing once covers most trending panels, and
+# anything richer is cheap to pass explicitly
+DEFAULT_ORDERS = (
+    (1, 0, 0), (0, 0, 1), (1, 0, 1),
+    (0, 1, 1), (1, 1, 0), (1, 1, 1),
+)
+
+
+class OrderSpec(NamedTuple):
+    """One candidate on the search grid: an ARIMA order plus an optional
+    multiplicative seasonal ``(P, D, Q, s)`` extension."""
+
+    order: Tuple[int, int, int]
+    seasonal: Optional[Tuple[int, int, int, int]] = None
+
+    @property
+    def label(self) -> str:
+        if self.seasonal is None:
+            return str(tuple(self.order))
+        return f"{tuple(self.order)}x{tuple(self.seasonal)}"
+
+    def n_params(self, include_intercept: bool) -> int:
+        if self.seasonal is None:
+            return arima._n_params(self.order, include_intercept)
+        return arima._n_params_seasonal(self.order, self.seasonal,
+                                        include_intercept)
+
+    def lag_span(self) -> Tuple[int, int, int]:
+        """``(p_full, q_full, d_full)`` of the (expanded) recursion."""
+        return arima.seasonal_lag_span(self.order, self.seasonal)
+
+
+def normalize_orders(orders) -> Tuple[OrderSpec, ...]:
+    """Coerce a grid spec into a validated tuple of :class:`OrderSpec`.
+
+    Accepts ``(p, d, q)`` triples, ``(p, d, q, (P, D, Q, s))`` pairs,
+    ``OrderSpec`` instances, or ``None`` (the default grid).  Duplicates
+    are rejected — a duplicate candidate can never win a strict argmin
+    and only burns a full walk.
+    """
+    if orders is None:
+        orders = DEFAULT_ORDERS
+    specs = []
+    for entry in orders:
+        if isinstance(entry, OrderSpec):
+            order, seasonal = entry.order, entry.seasonal
+        else:
+            entry = tuple(entry)
+            if len(entry) == 4 and isinstance(entry[3], (tuple, list)):
+                order, seasonal = entry[:3], tuple(entry[3])
+            elif len(entry) == 3:
+                order, seasonal = entry, None
+            else:
+                raise ValueError(
+                    f"order spec must be (p, d, q) or (p, d, q, (P, D, Q, "
+                    f"s)), got {entry!r}")
+        p, d, q = (int(v) for v in order)
+        if min(p, d, q) < 0:
+            raise ValueError(f"orders must be >= 0, got {(p, d, q)}")
+        seasonal = arima._validate_seasonal(seasonal)
+        specs.append(OrderSpec((p, d, q), seasonal))
+    if not specs:
+        raise ValueError("orders grid is empty")
+    seen = set()
+    for s in specs:
+        key = (s.order, s.seasonal)
+        if key in seen:
+            raise ValueError(f"duplicate order on the grid: {s.label}")
+        seen.add(key)
+    return tuple(specs)
+
+
+class AutoFitResult(NamedTuple):
+    """Per-row winner of the order search plus the selection record.
+
+    ``params`` is ``[B, k_max]`` with each row's tail beyond its winning
+    order's parameter count NaN-padded; ``order_index`` is the winning
+    grid position (``-1``: no candidate produced a finite criterion);
+    ``criterion`` is the winning criterion value per row, always
+    consistent with the returned ``neg_log_likelihood`` (under
+    ``stage2="winners"`` it is recomputed from the full-budget refit, so
+    it is NOT comparable with stage-1 sweep values).  ``orders`` is
+    the normalized grid and ``meta["auto_fit"]`` the search accounting
+    (per-order spend, selection histogram, stage-2 mode).
+    """
+
+    params: np.ndarray  # [B, k_max]
+    neg_log_likelihood: np.ndarray  # [B]
+    converged: np.ndarray  # [B] bool
+    iters: np.ndarray  # [B]
+    status: np.ndarray  # [B] int8 FitStatus
+    order_index: np.ndarray  # [B] int32, -1 = none eligible
+    criterion: np.ndarray  # [B] winning criterion value
+    orders: Tuple[OrderSpec, ...]
+    meta: dict
+
+
+# ---------------------------------------------------------------------------
+# criterion + selection (one jitted program over the stacked grid)
+# ---------------------------------------------------------------------------
+
+
+def _criterion_one(nll, nv, k: int, p_full: int, d_full: int,
+                   criterion: str):
+    """Per-row criterion of one order from its concentrated CSS nll and
+    the row's valid-span length ``nv`` (pre-differencing).  ``n_eff``
+    matches the likelihood's own concentration denominator
+    (``nv - d_full - p_full``); degenerate denominators and non-finite
+    likelihoods map to +inf so the row cannot select this order."""
+    n_eff = nv - float(d_full) - float(p_full)
+    kf = float(k)
+    if criterion == "bic":
+        c = 2.0 * nll + kf * jnp.log(jnp.maximum(n_eff, 1.0))
+        c = jnp.where(n_eff > 0, c, jnp.inf)
+    else:
+        c = 2.0 * nll + 2.0 * kf
+        if criterion == "aicc":
+            denom = n_eff - kf - 1.0
+            c = c + jnp.where(
+                denom > 0, 2.0 * kf * (kf + 1.0) / jnp.maximum(denom, 1.0),
+                jnp.inf)
+    return jnp.where(jnp.isfinite(c), c, jnp.inf)
+
+
+@jit_program
+def _select_program(meta: Tuple[Tuple[int, int, int], ...], criterion: str):
+    """Stacked-grid criterion + per-row argmin, one compiled program.
+
+    ``meta`` is the static per-order ``(k, p_full, d_full)`` tuple; inputs
+    are the ``[G, B, k_max]`` params stack, ``[G, B]`` nll/converged/
+    iters/status stacks, and the ``[B]`` valid-span lengths.  Ties break
+    toward the EARLIER grid entry (``jnp.argmin`` first-min), so grid
+    order is part of the selection contract.
+    """
+
+    def run(params, nll, conv, iters, status, nv0):
+        nv = nv0.astype(nll.dtype)
+        crit = jnp.stack([
+            _criterion_one(nll[g], nv, k, p_full, d_full, criterion)
+            for g, (k, p_full, d_full) in enumerate(meta)
+        ])  # [G, B]
+        best = jnp.argmin(crit, axis=0).astype(jnp.int32)
+        bestc = jnp.min(crit, axis=0)
+        has = jnp.isfinite(bestc)
+        rows = jnp.arange(nll.shape[1])
+        idx = jnp.where(has, best, 0)
+        params_sel = jnp.where(has[:, None], params[idx, rows], jnp.nan)
+        nll_sel = jnp.where(has, nll[idx, rows], jnp.nan)
+        conv_sel = conv[idx, rows] & has
+        iters_sel = jnp.where(has, iters[idx, rows], 0)
+        # a row with no eligible candidate keeps the WORST thing that
+        # happened to it anywhere on the grid (codes are severity-ordered)
+        status_sel = jnp.where(has, status[idx, rows],
+                               jnp.max(status, axis=0))
+        order_idx = jnp.where(has, best, jnp.int32(-1))
+        counts = jnp.stack(
+            [jnp.sum(order_idx == g) for g in range(len(meta))]
+            + [jnp.sum(~has)]).astype(jnp.int32)
+        crit_sel = jnp.where(has, bestc, jnp.nan)
+        return (params_sel, nll_sel, conv_sel, iters_sel, status_sel,
+                order_idx, crit_sel, crit, counts)
+
+    return run
+
+
+def criterion_matrix(specs, nll_stack, nv0, *, criterion: str = "aicc",
+                     include_intercept: bool = True):
+    """``[G, B]`` criterion values for a stacked grid of fit results —
+    the standalone spelling of the selection program's first half, shared
+    with the exhaustive-argmin reference in tests."""
+    specs = normalize_orders(specs)
+    nll_stack = jnp.asarray(nll_stack)
+    nv = jnp.asarray(nv0).astype(nll_stack.dtype)
+    rows = []
+    for spec in specs:
+        p_full, _, d_full = spec.lag_span()
+        rows.append(_criterion_one(
+            nll_stack[len(rows)], nv, spec.n_params(include_intercept),
+            p_full, d_full, criterion))
+    return jnp.stack(rows)
+
+
+def select_orders(specs, results, nv0, *, criterion: str = "aicc",
+                  include_intercept: bool = True):
+    """Run the on-device selection over per-order fit results.
+
+    ``results`` is a sequence (one per order, grid order) of objects with
+    ``params`` / ``neg_log_likelihood`` / ``converged`` / ``iters`` /
+    ``status`` arrays (``FitResult`` and ``ResilientFitResult`` both
+    qualify); ``nv0`` is the ``[B]`` per-row valid-span length
+    (:func:`panel_n_valid`).  Returns the host-side selection dict the
+    :func:`auto_fit` result is assembled from — and IS the exhaustive
+    argmin when the results are exhaustive full fits, which is exactly
+    how the bitwise acceptance test uses it.
+    """
+    specs = normalize_orders(specs)
+    if len(results) != len(specs):
+        raise ValueError(f"{len(specs)} orders but {len(results)} results")
+    if criterion not in CRITERIA:
+        raise ValueError(f"unknown criterion {criterion!r} "
+                         f"(one of {CRITERIA})")
+    kmax = max(s.n_params(include_intercept) for s in specs)
+    b = np.asarray(results[0].neg_log_likelihood).shape[0]
+    dtype = np.asarray(results[0].neg_log_likelihood).dtype
+    params = np.full((len(specs), b, kmax), np.nan, dtype)
+    nll = np.empty((len(specs), b), dtype)
+    conv = np.empty((len(specs), b), bool)
+    iters = np.empty((len(specs), b), np.int32)
+    status = np.empty((len(specs), b), np.int8)
+    for g, (spec, res) in enumerate(zip(specs, results)):
+        k = spec.n_params(include_intercept)
+        rp = np.asarray(res.params)
+        # an all-TIMEOUT walk synthesizes width-1 NaN params (the driver
+        # never learned the real k); those rows' NaN nll keeps them
+        # unselectable, so the narrow copy is purely defensive
+        w = min(k, rp.shape[1])
+        params[g, :, :w] = rp[:, :w]
+        nll[g] = np.asarray(res.neg_log_likelihood)
+        conv[g] = np.asarray(res.converged)
+        iters[g] = np.asarray(res.iters, np.int32)
+        status[g] = np.asarray(res.status, np.int8)
+    meta = []
+    for s in specs:
+        p_full, _, d_full = s.lag_span()
+        meta.append((s.n_params(include_intercept), p_full, d_full))
+    meta = tuple(meta)
+    out = _select_program(meta, criterion)(
+        jnp.asarray(params), jnp.asarray(nll), jnp.asarray(conv),
+        jnp.asarray(iters), jnp.asarray(status),
+        jnp.asarray(np.asarray(nv0, np.int32)))
+    (params_sel, nll_sel, conv_sel, iters_sel, status_sel, order_idx,
+     crit_sel, crit, counts) = (np.asarray(a) for a in out)
+    return {
+        "params": params_sel,
+        "neg_log_likelihood": nll_sel,
+        "converged": conv_sel,
+        "iters": iters_sel,
+        "status": status_sel.astype(np.int8),
+        "order_index": order_idx,
+        "criterion": crit_sel,
+        "criteria_matrix": crit,
+        "counts": counts,
+    }
+
+
+def panel_n_valid(y) -> np.ndarray:
+    """``[B] int32`` valid-span length per row: ``last_non_nan -
+    first_non_nan + 1`` (0 for all-NaN rows) — the one row property every
+    criterion on the grid shares, identical to the span
+    ``base.align_right`` fits against.  Accepts a device/host array or a
+    ``reliability.source.ChunkSource`` (streamed on the host, so an
+    oversubscribed panel never touches the device for this)."""
+    from ..reliability import source as source_mod
+
+    if isinstance(y, source_mod.ChunkSource):
+        b, t = y.shape
+        out = np.empty((b,), np.int32)
+        step = max(1, int(y.default_chunk_rows or 4096))
+        buf = np.empty((step, t), y.dtype)
+        for lo in range(0, b, step):
+            hi = min(lo + step, b)
+            y.read_rows(lo, hi, buf[: hi - lo])
+            out[lo:hi] = _nv_host(buf[: hi - lo])
+        return out
+    if isinstance(y, jax.Array) and not isinstance(y, jax.core.Tracer):
+        return np.asarray(_nv_program()(y), np.int32)
+    return _nv_host(np.asarray(y))
+
+
+def _nv_host(y: np.ndarray) -> np.ndarray:
+    valid = ~np.isnan(y)
+    any_valid = valid.any(axis=1)
+    first = valid.argmax(axis=1)
+    last = y.shape[1] - 1 - valid[:, ::-1].argmax(axis=1)
+    return np.where(any_valid, last - first + 1, 0).astype(np.int32)
+
+
+@jit_program
+def _nv_program():
+    def run(yb):
+        valid = ~jnp.isnan(yb)
+        any_valid = jnp.any(valid, axis=1)
+        first = jnp.argmax(valid, axis=1)
+        last = yb.shape[1] - 1 - jnp.argmax(valid[:, ::-1], axis=1)
+        return jnp.where(any_valid, last - first + 1, 0).astype(jnp.int32)
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# the search driver
+# ---------------------------------------------------------------------------
+
+
+def _order_fit_fn(spec: OrderSpec, include_intercept: bool, fit_kwargs: dict):
+    """The per-order fit partial handed to ``fit_chunked`` — keyword-bound
+    so the journal's config hash covers the order AND every hyperknob."""
+    kw = dict(fit_kwargs)
+    if spec.seasonal is not None:
+        kw["seasonal"] = spec.seasonal
+    return functools.partial(arima.fit, order=spec.order,
+                             include_intercept=include_intercept, **kw)
+
+
+def _grid_dir(checkpoint_dir: Optional[str], g: int,
+              stage: str = "") -> Optional[str]:
+    if checkpoint_dir is None:
+        return None
+    return os.path.join(checkpoint_dir, f"grid_{g:05d}{stage}")
+
+
+def _remaining_budget(job_budget_s: Optional[float],
+                      t0: float) -> Optional[float]:
+    """The job budget LEFT for the next order's walk: the whole search
+    shares one wall-clock allowance, so orders dispatched after it is
+    spent mark their chunks TIMEOUT without dispatch (the driver's
+    normal budget semantics) instead of running unbounded."""
+    if job_budget_s is None:
+        return None
+    return max(1e-6, job_budget_s - (time.perf_counter() - t0))
+
+
+def auto_fit(
+    y,
+    orders=None,
+    *,
+    criterion: str = "aicc",
+    include_intercept: bool = True,
+    stage2: str = "full",
+    stage1_iters: int = 12,
+    return_criteria: bool = False,
+    chunk_rows: Optional[int] = None,
+    resilient: bool = False,
+    policy: str = "impute",
+    checkpoint_dir: Optional[str] = None,
+    resume: str = "auto",
+    chunk_budget_s: Optional[float] = None,
+    job_budget_s: Optional[float] = None,
+    pipeline: bool = True,
+    pipeline_depth: int = 2,
+    prefetch_depth: int = 1,
+    align_mode: Optional[str] = None,
+    shard: bool = False,
+    mesh=None,
+    _journal_commit_hook=None,
+    **fit_kwargs,
+) -> AutoFitResult:
+    """Batched order search over ``y [B, T]`` (array or ``ChunkSource``).
+
+    Fits every candidate on ``orders`` (default :data:`DEFAULT_ORDERS`;
+    entries ``(p, d, q)`` or ``(p, d, q, (P, D, Q, s))`` for seasonal
+    SARIMA candidates) as one journaled chunk walk per order, computes
+    ``criterion`` (``"aicc"`` default, ``"aic"``/``"bic"``) per (row,
+    order) on device, and arg-selects per row.  All ``fit_chunked`` knobs
+    ride through per order (``checkpoint_dir`` fans out into per-order
+    ``grid_00000/…`` journals; ``job_budget_s`` bounds the WHOLE search);
+    remaining ``fit_kwargs`` (``max_iters``, ``backend``, ``method``,
+    ``tol``, ...) go to every order's ``models.arima.fit``.
+
+    ``stage2="full"`` (default): every order is fully fit — selection is
+    bitwise-identical to an exhaustive per-order full-fit argmin on the
+    same panel/chunk layout, and the stage-1/stage-2 economy lives inside
+    each fit (the lazy straggler split only compiles/dispatches an
+    order's stage-2 program when rows actually need it).
+    ``stage2="winners"``: sweep every order at ``stage1_iters`` first,
+    rank per row, then spend the full budget only on each row's winning
+    order — approximate selection, full-quality winning params, with the
+    stage-2 spend recorded per order in ``meta["auto_fit"]``.
+
+    Durable: SIGKILL anywhere — mid-chunk, mid-order, between orders —
+    and a re-run with the same panel/grid/config resumes from the
+    per-order journals, replaying only uncommitted chunks, with selection
+    (recomputed from the full grid) bitwise-identical to an uninterrupted
+    search.  A root ``auto_manifest.json`` records orders tried, per-order
+    spend, and the selection histogram for the tools.
+    """
+    specs = normalize_orders(orders)
+    if criterion not in CRITERIA:
+        raise ValueError(f"unknown criterion {criterion!r} "
+                         f"(one of {CRITERIA})")
+    if stage2 not in ("full", "winners"):
+        raise ValueError(f"stage2 must be 'full' or 'winners', got "
+                         f"{stage2!r}")
+    if stage2 == "winners" and int(stage1_iters) < 1:
+        raise ValueError("stage1_iters must be >= 1")
+    from ..reliability import fit_chunked
+    from ..reliability import source as source_mod
+
+    if isinstance(y, source_mod.ChunkSource):
+        values = y
+        b = int(y.shape[0])
+    else:
+        values = jnp.asarray(y)
+        if values.ndim != 2:
+            raise ValueError(
+                f"auto_fit expects [batch, time], got {values.shape}")
+        b = int(values.shape[0])
+    nv0 = panel_n_valid(values)
+    g_total = len(specs)
+    t0 = time.perf_counter()
+    cc0 = _compile_cache.program_cache_stats()
+    tele = obs.enabled()
+
+    walk_knobs = dict(
+        chunk_rows=chunk_rows, resilient=resilient, policy=policy,
+        resume=resume, chunk_budget_s=chunk_budget_s,
+        pipeline=pipeline, pipeline_depth=pipeline_depth,
+        prefetch_depth=prefetch_depth, align_mode=align_mode,
+        shard=shard, mesh=mesh, _journal_commit_hook=_journal_commit_hook,
+    )
+
+    def _walk(spec, g, ckpt, *, stage_tag, max_iters_override=None,
+              vals=None):
+        """One order's walk — the full panel by default, or a gathered
+        sub-panel (``vals``, the winners refit).  EVERY walk inherits the
+        caller's knobs (resilient/policy/align_mode/budgets/pipeline/
+        shard) so a stage-2 refit fits its rows under the same contract
+        the stage-1 sweep did; the align hint stays valid on any row
+        subset (it is a row-wise property of the panel)."""
+        kw = dict(fit_kwargs)
+        if max_iters_override is not None:
+            kw["max_iters"] = max_iters_override
+        fit_fn = _order_fit_fn(spec, include_intercept, kw)
+        extra = {"auto_fit": {
+            "grid_index": g, "grid_total": g_total,
+            "order": list(spec.order),
+            "seasonal": (list(spec.seasonal) if spec.seasonal is not None
+                         else None),
+            "criterion": criterion, "stage": stage_tag,
+        }}
+        with obs.span("auto_fit.order", grid=g, order=spec.label,
+                      stage=stage_tag):
+            t_g = time.perf_counter()
+            res = fit_chunked(
+                fit_fn, values if vals is None else vals,
+                checkpoint_dir=ckpt, grid=(g, g_total),
+                job_budget_s=_remaining_budget(job_budget_s, t0),
+                journal_extra=extra, **walk_knobs)
+            wall = time.perf_counter() - t_g
+        return res, wall
+
+    order_meta = []
+    if stage2 == "full":
+        results = []
+        for g, spec in enumerate(specs):
+            s2_0 = (obs.snapshot() or {}).get("counters", {}) if tele else {}
+            res, wall = _walk(spec, g, _grid_dir(checkpoint_dir, g),
+                              stage_tag="full")
+            s2_1 = (obs.snapshot() or {}).get("counters", {}) if tele else {}
+            results.append(res)
+            order_meta.append({
+                "grid_index": g,
+                "order": list(spec.order),
+                "seasonal": (list(spec.seasonal)
+                             if spec.seasonal is not None else None),
+                "label": spec.label,
+                "k": spec.n_params(include_intercept),
+                "wall_s": round(wall, 4),
+                "chunks_run": res.meta.get("chunks_run"),
+                "rows_fit": b,
+                "stage2_traces": (
+                    s2_1.get("optim.stage2_compact_traces", 0)
+                    - s2_0.get("optim.stage2_compact_traces", 0))
+                if tele else None,
+                "timeouts": res.meta.get("timeouts", 0),
+            })
+        sel = select_orders(specs, results, nv0, criterion=criterion,
+                            include_intercept=include_intercept)
+        stage1_wall = sum(m["wall_s"] for m in order_meta)
+        stage2_wall = 0.0
+    else:
+        sel, order_meta, stage1_wall, stage2_wall = _winners_search(
+            specs, values, nv0, b, criterion, include_intercept,
+            stage1_iters, checkpoint_dir, _walk)
+
+    counts = sel["counts"]
+    for m in order_meta:
+        m["selected_rows"] = int(counts[m["grid_index"]])
+    selection_counts = {specs[g].label: int(counts[g])
+                        for g in range(g_total)}
+    selection_counts["none"] = int(counts[g_total])
+    cc1 = _compile_cache.program_cache_stats()
+    cc_hits = cc1["hits"] - cc0["hits"]
+    cc_misses = cc1["misses"] - cc0["misses"]
+    total_wall = time.perf_counter() - t0
+    auto_meta = {
+        "criterion": criterion,
+        "stage2": stage2,
+        "stage1_iters": stage1_iters if stage2 == "winners" else None,
+        "n_rows": b,
+        "orders": order_meta,
+        "selection_counts": selection_counts,
+        "wall_s": round(total_wall, 4),
+        "stage1_wall_s": round(stage1_wall, 4),
+        "stage2_wall_s": round(stage2_wall, 4),
+        "stage2_spend_share": (
+            round(stage2_wall / max(stage1_wall + stage2_wall, 1e-9), 4)),
+        "compile_cache": {
+            "hits": cc_hits, "misses": cc_misses,
+            "hit_rate": (round(cc_hits / (cc_hits + cc_misses), 4)
+                         if (cc_hits + cc_misses) else None)},
+    }
+    meta = {"auto_fit": auto_meta}
+    if return_criteria:
+        meta["criteria_matrix"] = sel["criteria_matrix"]
+    if checkpoint_dir is not None:
+        # the dirs THIS search used, derived from its own plan (never a
+        # disk glob: a previous search in the same directory — e.g. a
+        # full run before a winners run — must not be advertised as part
+        # of this one, or the tools would read the wrong journals)
+        if stage2 == "full":
+            grid_dirs = [f"grid_{g:05d}" for g in range(g_total)]
+        else:
+            grid_dirs = [f"grid_{g:05d}_s1" for g in range(g_total)]
+            grid_dirs += [f"grid_{m['grid_index']:05d}_winners"
+                          for m in order_meta
+                          if m.get("stage2_rows")]
+        _write_auto_manifest(checkpoint_dir, auto_meta, sorted(grid_dirs))
+        meta["auto_manifest"] = os.path.join(checkpoint_dir,
+                                             "auto_manifest.json")
+    obs.counter("auto_fit.searches").inc()
+    obs.event("auto_fit.selected", orders=g_total, rows=b,
+              none=selection_counts["none"])
+    return AutoFitResult(
+        sel["params"], sel["neg_log_likelihood"], sel["converged"],
+        sel["iters"], sel["status"], sel["order_index"], sel["criterion"],
+        specs, meta)
+
+
+def _winners_search(specs, values, nv0, b, criterion, include_intercept,
+                    stage1_iters, checkpoint_dir, _walk):
+    """The ``stage2="winners"`` economy: rank on cheap stage-1 sweeps,
+    spend the full budget only on each row's winning order."""
+    g_total = len(specs)
+    order_meta = []
+    stage1_results = []
+    stage1_wall = 0.0
+    for g, spec in enumerate(specs):
+        res, wall = _walk(spec, g, _grid_dir(checkpoint_dir, g, "_s1"),
+                          stage_tag="stage1",
+                          max_iters_override=stage1_iters)
+        stage1_results.append(res)
+        stage1_wall += wall
+        order_meta.append({
+            "grid_index": g,
+            "order": list(spec.order),
+            "seasonal": (list(spec.seasonal)
+                         if spec.seasonal is not None else None),
+            "label": spec.label,
+            "k": spec.n_params(include_intercept),
+            "wall_s": round(wall, 4),
+            "chunks_run": res.meta.get("chunks_run"),
+            "rows_fit": b,
+            "stage2_traces": None,
+            "timeouts": res.meta.get("timeouts", 0),
+        })
+    sel = select_orders(specs, stage1_results, nv0, criterion=criterion,
+                        include_intercept=include_intercept)
+    # the winner refits scatter into the selection arrays: make them
+    # writable host copies (device-backed np views are read-only)
+    for key in ("params", "neg_log_likelihood", "converged", "iters",
+                "status", "criterion"):
+        sel[key] = np.array(sel[key])
+    order_idx = sel["order_index"]
+    stage2_wall = 0.0
+    # refit each winning order's rows at the FULL budget: gathered into a
+    # retry_cap-aligned sub-batch (bounded compiled shapes — the resilient
+    # ladder's contract) and scattered back over the stage-1 selection.
+    # The refit walk runs under the SAME knobs as the sweeps (resilient
+    # ladder, align hint, budgets, pipeline) via _walk, journaled under
+    # grid_{g}_winners — the sub-panel is a deterministic function of the
+    # journaled stage-1 results, so a resumed search gathers the same
+    # rows and the journal fingerprint matches.
+    for g, spec in enumerate(specs):
+        rows = np.nonzero(order_idx == g)[0]
+        if rows.size == 0:
+            order_meta[g]["stage2_rows"] = 0
+            continue
+        cap = optim.retry_cap(rows.size)
+        pad_idx = np.concatenate([rows, np.full(cap - rows.size, rows[0])])
+        sub = _gather_rows(values, pad_idx)
+        res, wall = _walk(spec, g, _grid_dir(checkpoint_dir, g, "_winners"),
+                          stage_tag="winners", vals=sub)
+        stage2_wall += wall
+        keep = np.arange(rows.size)
+        k = spec.n_params(include_intercept)
+        sel["params"][rows, :k] = np.asarray(res.params)[keep]
+        sel["params"][rows, k:] = np.nan
+        sel["neg_log_likelihood"][rows] = np.asarray(
+            res.neg_log_likelihood)[keep]
+        sel["converged"][rows] = np.asarray(res.converged)[keep]
+        sel["iters"][rows] = np.asarray(res.iters)[keep]
+        sel["status"][rows] = np.asarray(res.status)[keep]
+        # the reported criterion must match the RETURNED nll, not the
+        # truncated stage-1 sweep's — recompute it from the refit (NaN
+        # where the refit itself diverged: the row keeps its selection
+        # but carries no comparable criterion value)
+        p_full, _, d_full = spec.lag_span()
+        crit = np.asarray(_criterion_one(
+            jnp.asarray(sel["neg_log_likelihood"][rows]),
+            jnp.asarray(np.asarray(nv0)[rows].astype(
+                sel["neg_log_likelihood"].dtype)),
+            k, p_full, d_full, criterion))
+        sel["criterion"][rows] = np.where(np.isfinite(crit), crit, np.nan)
+        order_meta[g]["stage2_rows"] = int(rows.size)
+        order_meta[g]["stage2_wall_s"] = round(wall, 4)
+    return sel, order_meta, stage1_wall, stage2_wall
+
+
+def _gather_rows(values, idx: np.ndarray):
+    """Row gather tolerant of device arrays and ``ChunkSource`` panels.
+
+    A source-backed panel stays OFF the device: contiguous index runs
+    are read host-side in batches (one ``read_rows`` per run, not per
+    row) and the gathered sub-panel comes back as a
+    ``HostChunkSource`` — the winners refit then STREAMS it through the
+    staging pool like any other host-resident walk instead of
+    materializing a possibly HBM-sized sub-panel.  Device panels keep
+    the on-device gather (they are resident by definition).
+    """
+    from ..reliability import source as source_mod
+
+    if isinstance(values, source_mod.ChunkSource):
+        t = int(values.shape[1])
+        out = np.empty((idx.size, t), values.dtype)
+        pos = 0
+        # contiguous ascending runs -> one batched host read per run
+        # (the pad tail repeats idx[0], its own run)
+        run_start = 0
+        for i in range(1, idx.size + 1):
+            if i == idx.size or idx[i] != idx[i - 1] + 1:
+                lo, hi = int(idx[run_start]), int(idx[i - 1]) + 1
+                values.read_rows(lo, hi, out[pos: pos + (hi - lo)])
+                pos += hi - lo
+                run_start = i
+        return source_mod.HostChunkSource(out)
+    return jnp.asarray(values)[jnp.asarray(idx)]
+
+
+def _write_auto_manifest(checkpoint_dir: str, auto_meta: dict,
+                         grid_dirs: list) -> None:
+    """Atomically write the search-level ``auto_manifest.json`` next to
+    the per-order ``grid_*`` journals (single writer: the search driver,
+    after selection — the per-order manifests carry the durable chunk
+    state; this file is the grid-level accounting the tools read).
+    ``grid_dirs`` is the exact set of journal dirs THIS search walked."""
+    from ..reliability import journal as journal_mod
+
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    payload = {
+        "kind": "auto_fit",
+        "written_at": time.time(),
+        "auto_fit": auto_meta,
+        "grid_dirs": grid_dirs,
+    }
+    journal_mod._atomic_write_bytes(
+        os.path.join(checkpoint_dir, "auto_manifest.json"),
+        json.dumps(payload, indent=1, sort_keys=True).encode())

@@ -17,6 +17,7 @@ Conventions:
 from __future__ import annotations
 
 import functools
+import threading
 import weakref
 from typing import NamedTuple, Optional
 
@@ -35,15 +36,37 @@ def jit_program(builder):
     without this every ``fit``/``forecast`` call would re-trace and
     re-compile — the analog of the reference reusing one JVM JIT-compiled
     code path across calls.
+
+    Every lookup reports hit/miss to ``utils.compile_cache`` (obs counters
+    ``compile_cache.hit`` / ``compile_cache.miss``): per-order program
+    reuse is the auto-fit search's perf core (ISSUE 9), and the hit rate
+    makes that reuse measurable instead of assumed.
     """
     cached = functools.lru_cache(maxsize=512)(
         lambda *static: jax.jit(builder(*static))
     )
+    # lookup + hit/miss classification are one atomic step: sharded lane
+    # threads call fit concurrently, and an unsynchronized cache_info()
+    # delta would misattribute another thread's hit to this thread's
+    # miss, making the published reuse rate nondeterministic.  The lock
+    # only guards building the (cheap, uncompiled) jitted wrapper — XLA
+    # compilation happens at first dispatch, outside it.
+    lock = threading.Lock()
 
     def norm(a):  # tolerate list-valued order/shape args (lists don't hash)
         return tuple(a) if isinstance(a, list) else a
 
-    return functools.wraps(builder)(lambda *static: cached(*map(norm, static)))
+    def get(*static):
+        from ..utils import compile_cache as _cc
+
+        with lock:
+            before = cached.cache_info().hits
+            out = cached(*map(norm, static))
+            hit = cached.cache_info().hits > before
+        (_cc.note_hit if hit else _cc.note_miss)()
+        return out
+
+    return functools.wraps(builder)(get)
 
 
 def resolve_backend(backend: str, dtype, n_time: int,

@@ -459,6 +459,74 @@ class TimeSeriesPanel:
                 **fit_kwargs,
             )
 
+    def auto_fit(self, orders=None, *, criterion: str = "aicc",
+                 include_intercept: bool = True, stage2: str = "full",
+                 stage1_iters: int = 12,
+                 chunk_rows: Optional[int] = None,
+                 resilient: bool = False, policy: str = "impute",
+                 checkpoint_dir: Optional[str] = None, resume: str = "auto",
+                 chunk_budget_s: Optional[float] = None,
+                 job_budget_s: Optional[float] = None,
+                 pipeline: bool = True, pipeline_depth: int = 2,
+                 prefetch_depth: int = 1, align_mode: Optional[str] = None,
+                 shard: bool = False, mesh=None, source=None,
+                 **fit_kwargs):
+        """Batched ARIMA/SARIMA order search over every series
+        (``models.auto.auto_fit`` — ISSUE 9 / ROADMAP item 4).
+
+        Fits a static grid of candidate orders per series (``orders``:
+        ``(p, d, q)`` triples, optionally with a seasonal
+        ``(P, D, Q, s)`` fourth element; default
+        ``models.auto.DEFAULT_ORDERS``), computes ``criterion`` (AICc
+        default) per (row, order) on device, and arg-selects per row.
+        Every candidate rides the SAME durable chunk driver as
+        :meth:`fit` — per-order write-ahead journals under
+        ``checkpoint_dir/grid_00000/…`` (SIGKILL anywhere mid-grid and a
+        re-run resumes, replaying only uncommitted chunks, with selection
+        bitwise-identical to an uninterrupted search), OOM backoff,
+        budgets (``job_budget_s`` bounds the WHOLE search), pipelined
+        commits/prefetch, mesh sharding (``shard=True``), and
+        ``source=`` streaming for larger-than-HBM panels (same contract
+        as :meth:`fit`).
+
+        ``stage2="full"`` (default) fully fits every order — selection is
+        bitwise-identical to an exhaustive per-order full-fit argmin;
+        ``stage2="winners"`` sweeps every order at ``stage1_iters``
+        first and spends the full budget only on each row's winning
+        order (approximate selection, ~1/G of the full-fit spend).
+
+        Returns a ``models.auto.AutoFitResult`` whose rows align with
+        ``self.keys``: ``order_index`` is each series' winning grid
+        position and ``meta["auto_fit"]`` the search accounting (orders
+        tried, per-order stage-2 spend, selection histogram).
+        """
+        from .models import auto as _auto
+
+        if source is not None:
+            from .reliability import source as source_mod
+
+            src = source_mod.as_source(source)
+            if tuple(src.shape) != (int(self.n_series), int(self.n_time)):
+                raise ValueError(
+                    f"source shape {src.shape} does not match this panel "
+                    f"({self.n_series} series x {self.n_time} obs); the "
+                    "source must hold the panel's own values")
+            values = src
+        else:
+            values = self.series_values()
+        with obs.span("panel.auto_fit", n_series=self.n_series,
+                      orders=len(_auto.normalize_orders(orders))):
+            return _auto.auto_fit(
+                values, orders, criterion=criterion,
+                include_intercept=include_intercept, stage2=stage2,
+                stage1_iters=stage1_iters, chunk_rows=chunk_rows,
+                resilient=resilient, policy=policy,
+                checkpoint_dir=checkpoint_dir, resume=resume,
+                chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
+                pipeline=pipeline, pipeline_depth=pipeline_depth,
+                prefetch_depth=prefetch_depth, align_mode=align_mode,
+                shard=shard, mesh=mesh, **fit_kwargs)
+
     def lags(self, max_lag: int, include_original: bool = True,
              lagged_key: Callable[[object, int], object] = None) -> "TimeSeriesPanel":
         """Panel of lagged copies of every series — the upstream

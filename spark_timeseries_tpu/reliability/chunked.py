@@ -114,6 +114,7 @@ def fit_chunked(
     mesh=None,
     shard: bool = False,
     process_index: Optional[int] = None,
+    grid: Optional[tuple] = None,
     journal_extra: Optional[dict] = None,
     _journal_commit_hook=None,
     **fit_kwargs,
@@ -248,6 +249,16 @@ def fit_chunked(
     backoff and timeout event, ``degraded=True`` whenever a backoff or
     timeout happened, and — when journaled — the journal accounting
     (``meta["journal"]``: run id, chunks committed/resumed/timeout).
+
+    **Grid coordinate** (``grid=(index, total)``): an auto-fit order
+    search (``models.auto``) runs one ordinary walk per candidate order;
+    the coordinate places this walk's plan on that grid — chunk
+    spans/events/telemetry rows carry a ``grid`` tag (one
+    ``tools/obs_report.py`` timeline lane per order), the manifest
+    records ``extra.grid``, and ``meta["grid"]`` echoes it.  Like the
+    pipeline/shard knobs it is NOT part of the journal config hash: the
+    order itself rides in the hashed fit kwargs; the coordinate only
+    labels where in the search the work happened.
 
     **Telemetry** (``obs.enable()``): each chunk dispatch runs under an
     ``obs.span("chunk")`` whose first dispatch per (fit, shape, dtype) is
@@ -422,6 +433,20 @@ def fit_chunked(
                                      else model_base.align_mode_on_host(yb))}
     plan_mode = fit_kwargs.get("align_mode") if fit_takes_align else None
 
+    # -- grid coordinate (ISSUE 9) -------------------------------------------
+    # an auto-fit order search (models.auto) runs one ordinary walk per
+    # candidate order; grid=(index, total) places this walk on that grid so
+    # its telemetry rows/events are per-order lanes and the journal records
+    # where in the search the chunks belong.  NOT config-hashed (the order
+    # itself rides in fit_kwargs, which is) — purely a label.
+    if grid is not None:
+        gi, gn = (int(grid[0]), int(grid[1]))
+        if not (0 <= gi < gn):
+            raise ValueError(f"grid index {gi} out of range for total {gn}")
+        grid = (gi, gn)
+        journal_extra = {**(journal_extra or {}),
+                         "grid": {"index": gi, "total": gn}}
+
     # -- journal(s) ----------------------------------------------------------
     if src is not None:
         # the source spelling rides in the manifest `extra` (NOT the config
@@ -564,6 +589,7 @@ def fit_chunked(
         lanes=lane_specs,
         process_index=int(process_index or 0),
         n_shards=len(spans) if sharded else 1,
+        grid=grid,
     )
     runners = [
         LaneRunner(plan, spec, fit_fn, fit_kwargs, vals,
@@ -689,6 +715,8 @@ def fit_chunked(
             "lanes_run": len(results),
             "devices": [str(spec.device) for spec in lane_specs],
         }
+    if grid is not None:
+        meta["grid"] = {"index": grid[0], "total": grid[1]}
     if journals is not None and not sharded:
         meta["journal"] = journals[0].accounting()
     if plan_mode is not None:

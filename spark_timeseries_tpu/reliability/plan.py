@@ -133,6 +133,14 @@ class ExecutionPlan(NamedTuple):
     # lane (or none) of a genuinely sharded walk, and its telemetry/events
     # must still carry shard tags so the merged timeline stays per-lane
     n_shards: int = 1
+    # GRID coordinate (ISSUE 9): an auto-fit order search runs one ordinary
+    # walk per candidate order; ``(grid_index, grid_total)`` places this
+    # walk's plan on that grid so its chunk spans/events/telemetry carry a
+    # ``grid`` tag (tools/obs_report.py renders one timeline lane per
+    # order).  Like the shard/pipeline knobs it is deliberately EXCLUDED
+    # from the journal config hash — the order itself rides in fit_kwargs,
+    # which IS hashed; the coordinate only labels where work happened.
+    grid: Optional[Tuple[int, int]] = None
 
     @property
     def sharded(self) -> bool:
@@ -277,8 +285,11 @@ class LaneRunner:
         self.fit_key = fit_key
         # obs attrs tagged with the shard id ONLY for sharded plans: the
         # single-lane walk's spans/events/meta stay byte-identical to the
-        # pre-plan driver
+        # pre-plan driver.  A grid-placed plan (auto-fit order search)
+        # additionally tags every span/event with its order's grid index
         self.tag = {"shard": spec.shard_id} if plan.sharded else {}
+        if plan.grid is not None:
+            self.tag = {**self.tag, "grid": int(plan.grid[0])}
         # source-backed lanes (ISSUE 7): `values` is a SourceLane over a
         # host-resident ChunkSource — every chunk, including a whole-span
         # one, must be STAGED (there is no resident device array to hand

@@ -23,17 +23,79 @@ Deliberately OPT-IN: a shared default directory would let one user's cache
 poison another's benchmark numbers (first-run compile time is a published
 measurement), and stale caches across jax upgrades are evicted by jax's
 own key, not by us.
+
+This module also owns the PROGRAM-reuse counters (``compile_cache.hit`` /
+``compile_cache.miss`` in the obs registry, fed by ``models.base.
+jit_program``): the auto-fit order search (ISSUE 9) promises one compiled
+program per order shape reused across chunks, and the hit rate is how
+that promise is measured (``bench.py`` ``telemetry_summary``).
 """
 
 from __future__ import annotations
 
 import os
+import threading as _threading
 from typing import Optional
 
-__all__ = ["enable_compile_cache", "enable_from_env"]
+__all__ = ["enable_compile_cache", "enable_from_env", "note_hit",
+           "note_miss", "program_cache_stats"]
 
 _ENV_VAR = "STSTPU_COMPILE_CACHE"
 _enabled_dir: Optional[str] = None
+
+# -- program-reuse accounting (ISSUE 9 satellite) ----------------------------
+#
+# The auto-fit order search compiles ONE program per (order, chunk shape)
+# and reuses it across every chunk of that order's walk — the whole perf
+# argument for riding the grid through the chunk driver.  These counters
+# make that reuse a MEASURED number instead of a belief: `models.base.
+# jit_program` (the per-static-config program cache every model fit goes
+# through) reports each lookup here, the obs registry carries them as
+# `compile_cache.hit` / `compile_cache.miss`, and `bench.py` surfaces the
+# hit rate in its `telemetry_summary` regression-gate line.  Process-local
+# mirrors ride along so the rate is readable even with the obs plane off
+# (the obs counters stay authoritative for per-run deltas).
+
+_hits = 0
+_misses = 0
+# concurrent lane threads (sharded walks) report through here; the obs
+# counters carry their own locks, but these process-local mirrors would
+# otherwise lose increments to the non-atomic load/add/store
+_stats_lock = _threading.Lock()
+
+
+def note_hit() -> None:
+    """Record a program-cache hit (an already-built jitted program reused)."""
+    global _hits
+    with _stats_lock:
+        _hits += 1
+    from .. import obs
+
+    obs.counter("compile_cache.hit").inc()
+
+
+def note_miss() -> None:
+    """Record a program-cache miss (a new program built — trace + compile
+    will be paid at its first dispatch)."""
+    global _misses
+    with _stats_lock:
+        _misses += 1
+    from .. import obs
+
+    obs.counter("compile_cache.miss").inc()
+
+
+def program_cache_stats() -> dict:
+    """Process-lifetime program-cache accounting: ``{hits, misses,
+    hit_rate}`` (hit_rate None before the first lookup)."""
+    with _stats_lock:
+        hits, misses = _hits, _misses
+    total = hits + misses
+    return {
+        "hits": hits,
+        "misses": misses,
+        "hit_rate": round(hits / total, 4) if total else None,
+    }
 
 
 def enable_compile_cache(cache_dir: str) -> Optional[str]:

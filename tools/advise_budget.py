@@ -54,6 +54,13 @@ tool reads one manifest and prints suggested
                         per-shard wall balance printed so a straggler
                         lane is visible.
 
+Pointed at an **auto-fit search root** (ISSUE 9: ``auto_manifest.json`` +
+per-order ``grid_*`` journals) the advisor switches to grid-level advice —
+``orders_per_pass`` (prune candidates that never won a row) and the
+per-order ``chunk_rows`` (>= 2 chunks per order so each order's compiled
+program is reused), from the recorded stage-1 vs stage-2 wall balance and
+selection histogram (see :func:`advise_auto`).
+
     python tools/advise_budget.py CHECKPOINT_DIR [--json]
 
 Suggestions only apply to a run with the SAME config hash and panel (both
@@ -66,6 +73,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 
 from inspect_journal import load_manifest  # same directory
@@ -273,6 +281,122 @@ def advise(m: dict) -> dict:
     }
 
 
+def advise_auto(root: str) -> dict:
+    """Auto-fit search advice (ISSUE 9): read the grid-level
+    ``auto_manifest.json`` plus one per-order journal and suggest
+
+    - ``orders_per_pass`` — how many candidate orders the NEXT search of
+      this panel should sweep before pruning: the orders that actually won
+      rows (+1 exploration slot, never below 2) — a candidate that never
+      wins spends a full stage-1 sweep with zero stage-2 payoff, and the
+      recorded selection histogram is the evidence;
+    - ``chunk_rows_grid`` — the per-order walk's chunk size: the sustained
+      (post-OOM-backoff) size from the per-order journals, resized so
+      every order walks >= 2 chunks (program reuse across chunks is the
+      point of the per-order compile cache, and a one-chunk walk has
+      nothing to overlap its commits under);
+
+    alongside the observed stage-1 vs stage-2 wall balance (the
+    ``stage2="winners"`` economy is worth switching to when stage-2 spend
+    is a small share of a full search, and worth widening the grid under
+    when it already dominates).
+    """
+    path = os.path.join(root, "auto_manifest.json") if os.path.isdir(root) \
+        else root
+    try:
+        with open(path, "rb") as f:
+            m = json.loads(f.read().decode())
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        sys.exit(f"advise_budget: auto manifest {path} unreadable ({e})")
+    a = m.get("auto_fit") or {}
+    orders = a.get("orders") or []
+    counts = a.get("selection_counts") or {}
+    winners = [o for o in orders if (o.get("selected_rows") or 0) > 0]
+    g_total = max(len(orders), 1)
+    orders_per_pass = max(2, min(g_total, len(winners) + 1))
+    n_rows = int(a.get("n_rows") or 0)
+
+    # per-order chunk advice: reuse the ordinary advisor on the first
+    # per-order journal that has committed chunks (all orders share the
+    # panel and the chunk layout, so one manifest speaks for the grid)
+    chunk_rows_grid = None
+    per_order = None
+    base = root if os.path.isdir(root) else os.path.dirname(path)
+    for d in sorted(m.get("grid_dirs") or []):
+        sub = os.path.join(base, d, "manifest.json")
+        if not os.path.exists(sub):
+            continue
+        per_order = advise(load_manifest(sub))
+        if "error" not in per_order:
+            sustained = per_order["suggest"]["chunk_rows"]
+            # >= 2 chunks per order so the compiled program is REUSED
+            # within the walk and commits/staging have a next chunk
+            chunk_rows_grid = max(1, min(sustained,
+                                         -(-n_rows // 2) if n_rows else
+                                         sustained))
+            break
+
+    stage1_wall = a.get("stage1_wall_s")
+    stage2_wall = a.get("stage2_wall_s")
+    per_order_wall = (round(stage1_wall / g_total, 4)
+                      if isinstance(stage1_wall, (int, float)) and g_total
+                      else None)
+    cc = a.get("compile_cache") or {}
+    return {
+        "auto_fit": True,
+        "observed": {
+            "criterion": a.get("criterion"),
+            "stage2_mode": a.get("stage2"),
+            "n_rows": n_rows,
+            "orders_tried": len(orders),
+            "orders_with_wins": len(winners),
+            "selection_counts": counts,
+            "stage1_wall_s": stage1_wall,
+            "stage2_wall_s": stage2_wall,
+            "stage2_spend_share": a.get("stage2_spend_share"),
+            "stage1_wall_s_per_order": per_order_wall,
+            "compile_cache_hit_rate": cc.get("hit_rate"),
+        },
+        "suggest": {
+            "orders_per_pass": orders_per_pass,
+            "orders_kept": [o.get("label") or str(tuple(o.get("order")))
+                            for o in winners],
+            "chunk_rows_grid": chunk_rows_grid,
+            "per_order": (per_order or {}).get("suggest"),
+        },
+    }
+
+
+def _render_auto(root: str, a: dict) -> None:
+    o, s = a["observed"], a["suggest"]
+    print(f"auto-fit search {root}")
+    print(f"  criterion {o['criterion']}  stage2 {o['stage2_mode']}  "
+          f"{o['n_rows']} rows x {o['orders_tried']} candidate orders")
+    print(f"  observed: {o['orders_with_wins']} orders won rows; "
+          f"stage-1 wall {o['stage1_wall_s']}s "
+          f"({o['stage1_wall_s_per_order']}s/order), "
+          f"stage-2 wall {o['stage2_wall_s']}s "
+          f"(spend share {o['stage2_spend_share']})")
+    if o["compile_cache_hit_rate"] is not None:
+        print(f"  compile cache: program hit rate "
+              f"{o['compile_cache_hit_rate']}")
+    print("  selection:", ", ".join(f"{k}={v}"
+                                    for k, v in o["selection_counts"].items()))
+    print("  suggest for the next search of this panel/grid:")
+    print(f"    orders_per_pass = {s['orders_per_pass']}  "
+          f"(winners {s['orders_kept']} + 1 exploration slot)")
+    if s["chunk_rows_grid"] is not None:
+        print(f"    chunk_rows (per-order grid walk) = "
+              f"{s['chunk_rows_grid']}  (>= 2 chunks/order so each "
+              "order's compiled program is reused)")
+    if s["per_order"]:
+        p = s["per_order"]
+        print(f"    per-order walk knobs: chunk_budget_s = "
+              f"{p.get('chunk_budget_s')}, pipeline_depth = "
+              f"{p.get('pipeline_depth')}, prefetch_depth = "
+              f"{p.get('prefetch_depth')}")
+
+
 def _device_budget_bytes():
     """The local device allocator's budget (``memory_stats()['bytes_limit']``)
     when the backend reports one; None on CPU-only hosts (the advice then
@@ -293,6 +417,17 @@ def main():
     ap.add_argument("--json", action="store_true",
                     help="machine-readable advice instead of the table")
     args = ap.parse_args()
+    # an auto-fit search root (ISSUE 9) has no root manifest.json — the
+    # grid-level auto_manifest.json plus per-order journals stand in
+    if os.path.isdir(args.path) and \
+            os.path.exists(os.path.join(args.path, "auto_manifest.json")) \
+            and not os.path.exists(os.path.join(args.path, "manifest.json")):
+        a = advise_auto(args.path)
+        if args.json:
+            print(json.dumps(a, indent=1, sort_keys=True))
+        else:
+            _render_auto(args.path, a)
+        return
     m = load_manifest(args.path)
     a = advise(m)
     if args.json:

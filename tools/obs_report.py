@@ -22,6 +22,13 @@ and shard-tagged chunk entries/telemetry rows; ``--check --manifest``
 validates that block (contiguous spans, in-range shard ids, shard-rooted
 npz paths), and the rendered timeline splits into ONE LANE PER SHARD so
 the eight concurrent walks read as eight rows, not one interleaved blur.
+
+Auto-fit searches (ISSUE 9): every per-order walk tags its spans/events
+with a ``grid`` coordinate, and the timeline splits into ONE LANE PER
+ORDER; ``--check --manifest`` pointed at the search root validates the
+``auto_manifest.json`` block (orders, stage-2 spend, selection counts)
+and recurses into every per-order journal, and a per-order manifest's
+``extra.auto_fit`` block is checked for grid coherence.
 """
 
 from __future__ import annotations
@@ -95,10 +102,19 @@ def validate_events(events, errors) -> list:
 
 
 def validate_manifest_telemetry(ckpt_dir: str) -> list:
-    """Validate the journal manifest's embedded ``telemetry`` block."""
+    """Validate the journal manifest's embedded ``telemetry`` block.
+
+    An auto-fit search root (ISSUE 9: ``auto_manifest.json`` + per-order
+    ``grid_*`` journals, no root ``manifest.json``) dispatches to
+    :func:`validate_auto_manifest` instead, which checks the grid-level
+    block and recurses into every per-order journal.
+    """
     errors = []
     path = ckpt_dir
     if os.path.isdir(path):
+        if (os.path.exists(os.path.join(path, "auto_manifest.json"))
+                and not os.path.exists(os.path.join(path, "manifest.json"))):
+            return validate_auto_manifest(path)
         path = os.path.join(path, "manifest.json")
     try:
         with open(path, "rb") as f:
@@ -169,6 +185,104 @@ def validate_manifest_telemetry(ckpt_dir: str) -> list:
                             "telemetry.input_staging.staging_pool."
                             f"h2d_wall_s invalid: {pool.get('h2d_wall_s')!r}")
     errors += validate_manifest_shards(m, path)
+    errors += validate_manifest_auto_extra(m, path)
+    return errors
+
+
+def validate_manifest_auto_extra(m: dict, path: str) -> list:
+    """Validate a per-order journal manifest's ``extra.auto_fit`` block
+    (ISSUE 9).  Manifests without the block (non-auto walks) pass
+    untouched; a walk that claims a grid position must carry a coherent
+    one — the budget advisor and the search resume both read it.
+    """
+    a = (m.get("extra") or {}).get("auto_fit")
+    if a is None:
+        return []
+    errors = []
+    if not isinstance(a, dict):
+        return [f"manifest {path}: extra.auto_fit is not an object: {a!r}"]
+    gi, gn = a.get("grid_index"), a.get("grid_total")
+    if not isinstance(gi, int) or not isinstance(gn, int) or not (
+            0 <= gi < gn):
+        errors.append(f"extra.auto_fit grid position invalid: index "
+                      f"{gi!r} of {gn!r}")
+    order = a.get("order")
+    if not (isinstance(order, list) and len(order) == 3
+            and all(isinstance(v, int) and v >= 0 for v in order)):
+        errors.append(f"extra.auto_fit.order invalid: {order!r}")
+    seasonal = a.get("seasonal")
+    if seasonal is not None and not (
+            isinstance(seasonal, list) and len(seasonal) == 4
+            and all(isinstance(v, int) for v in seasonal)):
+        errors.append(f"extra.auto_fit.seasonal invalid: {seasonal!r}")
+    if a.get("stage") not in ("full", "stage1", "winners"):
+        errors.append(f"extra.auto_fit.stage invalid: {a.get('stage')!r}")
+    grid = (m.get("extra") or {}).get("grid") or {}
+    if isinstance(gi, int) and grid.get("index") != gi:
+        errors.append(f"extra.grid.index {grid.get('index')!r} disagrees "
+                      f"with extra.auto_fit.grid_index {gi}")
+    return errors
+
+
+def validate_auto_manifest(root: str) -> list:
+    """Validate an auto-fit search root (``auto_manifest.json``): the
+    grid-level telemetry block — orders tried, per-order stage-2 spend,
+    selection counts — plus every per-order journal found on disk."""
+    path = root
+    if os.path.isdir(path):
+        path = os.path.join(path, "auto_manifest.json")
+    try:
+        with open(path, "rb") as f:
+            m = json.loads(f.read().decode())
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        return [f"auto manifest {path}: unreadable ({e})"]
+    a = m.get("auto_fit")
+    if not isinstance(a, dict):
+        return [f"auto manifest {path}: no auto_fit block"]
+    errors = []
+    orders = a.get("orders")
+    if not isinstance(orders, list) or not orders:
+        errors.append("auto_fit.orders missing/empty")
+        orders = []
+    for i, o in enumerate(orders):
+        if not isinstance(o, dict):
+            errors.append(f"auto_fit.orders[{i}] is not an object: {o!r}")
+            continue
+        if o.get("grid_index") != i:
+            errors.append(f"auto_fit.orders[{i}].grid_index is "
+                          f"{o.get('grid_index')!r}")
+        od = o.get("order")
+        if not (isinstance(od, list) and len(od) == 3
+                and all(isinstance(v, int) and v >= 0 for v in od)):
+            errors.append(f"auto_fit.orders[{i}].order invalid: {od!r}")
+        if not isinstance(o.get("selected_rows"), int) or \
+                o["selected_rows"] < 0:
+            errors.append(f"auto_fit.orders[{i}].selected_rows invalid: "
+                          f"{o.get('selected_rows')!r}")
+        if not isinstance(o.get("wall_s"), (int, float)):
+            errors.append(f"auto_fit.orders[{i}].wall_s invalid: "
+                          f"{o.get('wall_s')!r}")
+    sc = a.get("selection_counts")
+    if not isinstance(sc, dict) or not sc or not all(
+            isinstance(v, int) and v >= 0 for v in sc.values()):
+        errors.append(f"auto_fit.selection_counts missing/invalid: {sc!r}")
+    elif isinstance(a.get("n_rows"), int) and \
+            sum(sc.values()) != a["n_rows"]:
+        errors.append(f"auto_fit.selection_counts sum "
+                      f"{sum(sc.values())} != n_rows {a['n_rows']}")
+    for key in ("stage1_wall_s", "stage2_wall_s", "stage2_spend_share"):
+        if not isinstance(a.get(key), (int, float)):
+            errors.append(f"auto_fit.{key} invalid: {a.get(key)!r}")
+    if a.get("criterion") not in ("aicc", "aic", "bic"):
+        errors.append(f"auto_fit.criterion invalid: {a.get('criterion')!r}")
+    # recurse into every per-order journal the search left on disk: each
+    # is an ordinary chunk-walk manifest and must pass the same gate
+    if os.path.isdir(root):
+        for d in sorted(m.get("grid_dirs") or []):
+            sub = os.path.join(root, d)
+            if os.path.exists(os.path.join(sub, "manifest.json")):
+                errors += [f"{d}: {e}"
+                           for e in validate_manifest_telemetry(sub)]
     return errors
 
 
@@ -338,9 +452,42 @@ def _render(s: dict) -> None:
                 for ev in drv:
                     _row(ev, pad="    ")
         else:
-            print("\ntimeline (s from start):")
-            for ev in rows:
-                _row(ev)
+            # auto-fit order search (ISSUE 9): every per-order walk tags
+            # its spans/events with its grid index — split the stream into
+            # ONE LANE PER ORDER so the G candidate walks read as G rows
+            # (the sharded-lane treatment, keyed on the grid), with the
+            # search-level rows (selection, panel spans) kept separate
+            grids = sorted({(ev.get("attrs") or {}).get("grid")
+                            for ev in rows
+                            if (ev.get("attrs") or {}).get("grid")
+                            is not None})
+            if grids:
+                drv = [ev for ev in rows
+                       if (ev.get("attrs") or {}).get("grid") is None]
+                print(f"\ntimeline (s from start; {len(grids)} order-grid "
+                      "lanes):")
+                for gid in grids:
+                    mine = [ev for ev in rows
+                            if (ev.get("attrs") or {}).get("grid") == gid]
+                    wall = sum(ev.get("wall_s", 0.0) for ev in mine
+                               if ev["kind"] == "span")
+                    label = next(
+                        ((ev.get("attrs") or {}).get("order")
+                         for ev in mine
+                         if (ev.get("attrs") or {}).get("order")), None)
+                    print(f"  lane grid={gid}"
+                          + (f" order={label}" if label else "")
+                          + f"  ({len(mine)} rows, span wall {wall:.4f}s):")
+                    for ev in mine:
+                        _row(ev, pad="    ")
+                if drv:
+                    print("  search driver:")
+                    for ev in drv:
+                        _row(ev, pad="    ")
+            else:
+                print("\ntimeline (s from start):")
+                for ev in rows:
+                    _row(ev)
         if staging:
             h2d = [ev for ev in staging if ev.get("name") == "stage.h2d"
                    and ev["kind"] == "span"]
