@@ -162,19 +162,21 @@ python tests/_sharded_worker.py --smoke
 # telemetry block journaled and validated by `obs_report --check`
 python tests/_hostwalk_worker.py --smoke
 
-# auto-fit kill-and-resume smoke (ISSUE 9): a journaled 3-order auto-fit
-# search is SIGKILLed with part of the order grid committed (order 0
-# durable, order 1 mid-walk, order 2 never started), resumed, and the
-# resumed selection must be BITWISE-identical to an uninterrupted search —
-# per-order journals replay only uncommitted chunks, the selection argmin
-# is recomputed from the full grid
+# auto-fit kill-and-resume smoke (ISSUE 9/10): a journaled FUSED 3-order
+# search (two d=0 orders batched into ONE group walk, then a d=1
+# singleton) is SIGKILLed MID-GROUP — the fused walk torn with both
+# orders' packed results partially durable — resumed, and the resumed
+# selection must be BITWISE-identical to an uninterrupted fused search:
+# per-group journals replay only uncommitted chunks, the demuxed
+# selection argmin is recomputed from the full grid
 python tests/_autofit_worker.py --smoke
 
-# auto-fit tooling smoke (ISSUE 9): a short journaled order search with
-# telemetry on must leave per-order manifests carrying their grid
-# coordinate, an auto_manifest.json that passes the obs_report schema
-# gate, per-order timeline lanes in the rendered report, and enough for
-# the budget advisor to suggest orders_per_pass for the next search
+# auto-fit tooling smoke (ISSUE 9/10): a short journaled FUSED order
+# search with telemetry on must leave a group manifest carrying its grid
+# coordinate + fusion membership, an auto_manifest.json that passes the
+# obs_report schema gate, order-grid timeline lanes in the rendered
+# report, and enough for the budget advisor to suggest orders_per_pass
+# and the fusion width for the next search
 AUTO_SMOKE_DIR=$(python - <<'EOF'
 import json, os, tempfile
 import numpy as np
@@ -194,10 +196,14 @@ obs.disable()
 am = res.meta["auto_fit"]
 assert sum(am["selection_counts"].values()) == 24, am["selection_counts"]
 assert am["compile_cache"]["hits"] is not None
+assert am["diff_cache_hits"] == 1, am  # both orders share the d=0 prep
+assert [g["orders"] for g in am["fusion_groups"]] == [[0, 1]], am
 m = json.load(open(os.path.join(root, "search", "grid_00000",
                                 "manifest.json")))
-assert m["extra"]["grid"] == {"index": 0, "total": 2}, m["extra"]
-assert m["extra"]["auto_fit"]["order"] == [1, 0, 0]
+assert m["extra"]["grid"] == {"index": 0, "total": 2,
+                              "fused": [0, 1]}, m["extra"]
+assert m["extra"]["auto_fit"]["fused_orders"] == [0, 1]
+assert m["extra"]["auto_fit"]["orders"] == [[1, 0, 0], [0, 0, 1]]
 print(root)
 EOF
 )
@@ -209,6 +215,9 @@ python tools/obs_report.py "$AUTO_SMOKE_DIR/events.jsonl" \
 python tools/advise_budget.py "$AUTO_SMOKE_DIR/search" \
   | grep -q "orders_per_pass" \
   || { echo "ci.sh: advise_budget did not suggest orders_per_pass" >&2; exit 1; }
+python tools/advise_budget.py "$AUTO_SMOKE_DIR/search" \
+  | grep -q "fuse " \
+  || { echo "ci.sh: advise_budget did not suggest a fusion width" >&2; exit 1; }
 rm -rf "$AUTO_SMOKE_DIR"
 
 # sharded tooling smoke (ISSUE 6): a short journaled sharded walk with

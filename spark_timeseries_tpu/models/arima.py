@@ -86,11 +86,13 @@ def _lagged(yd, p: int):
 # ---------------------------------------------------------------------------
 
 
-def _css_errors_poly(c, phi, theta, yd, condition: bool = True, n_valid=None):
+def _css_errors_poly(c, phi, theta, yd, condition: bool = True, n_valid=None,
+                     condition_lags=None):
     """One-step-ahead prediction errors of the ARMA recursion with EXPLICIT
     lag-coefficient vectors ``phi [p_full]`` / ``theta [q_full]`` — the one
-    scan both the plain ARMA path (:func:`_css_errors`) and the seasonal
-    expanded-polynomial path (:func:`_sarima_css_errors`) run.
+    scan the plain ARMA path (:func:`_css_errors`), the seasonal
+    expanded-polynomial path (:func:`_sarima_css_errors`), and the fused
+    multi-order grid fit (:func:`fit_grid`) all run.
 
     ``condition=True`` zeroes errors for the first ``p_full`` valid steps
     (conditional likelihood — the reference's CSS); ``condition=False``
@@ -100,6 +102,13 @@ def _css_errors_poly(c, phi, theta, yd, condition: bool = True, n_valid=None):
     ``n_valid`` (traced scalar) marks a right-aligned valid span (see
     ``base.align_right``): errors in the zero prefix are forced to 0 so
     padded series contribute nothing there.
+
+    ``condition_lags`` overrides the conditioning depth: the fused grid
+    fit zero-pads every order's coefficient vectors to the grid maximum
+    (``phi.shape[0]`` is then the GRID's depth, not this order's), but
+    the likelihood must still condition out exactly this order's
+    ``p_full`` steps — the padded slots multiply by exact 0.0 and change
+    nothing else.
     """
     p = phi.shape[0]
     q = theta.shape[0]
@@ -113,7 +122,8 @@ def _css_errors_poly(c, phi, theta, yd, condition: bool = True, n_valid=None):
         # bring exactly the zeros a trimmed series would see
         yd = jnp.where(t_idx >= start, yd, 0.0)
     ylags = _lagged(yd, p)  # [n, p]
-    zero_before = start + p if condition else start
+    cond_p = p if condition_lags is None else condition_lags
+    zero_before = start + cond_p if condition else start
 
     def step(errs, inp):
         yt, yl, t = inp
@@ -821,6 +831,451 @@ def _fit_sarima_program(order, seasonal, include_intercept, max_iters, tol,
             tol=tol,
         )
         return _finalize_css_fit(res, ok, n_eff)
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# Fused multi-order grid fit (ISSUE 10): K same-d orders, ONE program
+# ---------------------------------------------------------------------------
+#
+# The auto-fit order search (models.auto) runs one chunk walk per candidate
+# order, so a G-order search stages/prefetches/journals every chunk G times.
+# fit_grid makes the candidate grid a BATCH dimension instead of a loop: K
+# orders that share the plain differencing order d are fitted by ONE
+# compiled program — every order's AR/MA lag-coefficient vectors are
+# expanded (_expand_seasonal_poly) and zero-padded to the grid's max
+# (p+P*s, q+Q*s), the CSS objective runs as a [K]-leading-axis vmap of the
+# one _css_errors_poly scan (conditioning depth stays per-order via
+# condition_lags), and one lockstep batched L-BFGS optimizes the flattened
+# [K*B] problem.  Orders whose FULL differencing signature (d, D, s)
+# matches share one differenced panel through a per-trace cache (the
+# shared-prep half of the tentpole); variants are embedded right-aligned
+# into the group's common length so every order sees one static shape.
+#
+# The K per-order results are PACKED into the params matrix — per row,
+# per order: [params(k_max), nll, converged, iters, status] — so a fused
+# chunk rides the journal/commit/resume machinery of fit_chunked
+# unchanged (one npz shard per chunk carries the whole fusion group) and
+# models.auto demuxes per-order results after the walk.  Scan backend
+# only: the fused Pallas kernel's folded layout is per-(p, q) static.
+
+GRID_PACK_COLS = 5  # nll, eligible, converged, iters, status per order
+
+
+def _grid_spec_info(order: Order, seasonal: Optional[Seasonal],
+                    include_intercept: bool) -> dict:
+    p, d, q = order
+    seasonal = _validate_seasonal(seasonal)
+    if seasonal is None:
+        k = _n_params(order, include_intercept)
+        P = D = Q = 0
+        s = 0
+    else:
+        P, D, Q, s = seasonal
+        k = _n_params_seasonal(order, seasonal, include_intercept)
+    p_full, q_full, d_full = seasonal_lag_span(order, seasonal)
+    return dict(order=(p, d, q), seasonal=seasonal, k=k, P=P, D=D, Q=Q, s=s,
+                p_full=p_full, q_full=q_full, d_full=d_full)
+
+
+def grid_pack_width(specs, include_intercept: bool = True) -> int:
+    """Packed-row width of a :func:`fit_grid` result for ``specs``."""
+    infos = [_grid_spec_info(tuple(o), sea, include_intercept)
+             for o, sea in specs]
+    k_max = max(i["k"] for i in infos)
+    return len(infos) * (k_max + GRID_PACK_COLS)
+
+
+def grid_diff_cache_keys(specs) -> int:
+    """Distinct differencing signatures ``(d, D, s)`` a fused group of
+    ``specs`` needs — the group differences the panel once per key, so
+    ``len(specs) - grid_diff_cache_keys(specs)`` orders hit the shared-prep
+    cache instead of re-differencing."""
+    keys = set()
+    for order, seasonal in specs:
+        seasonal = _validate_seasonal(seasonal)
+        d = int(order[1])
+        if seasonal is None or seasonal[1] == 0:
+            keys.add((d, 0, 0))
+        else:
+            keys.add((d, int(seasonal[1]), int(seasonal[3])))
+    return len(keys)
+
+
+def _grid_coef_maps(infos, include_intercept: bool, k_max: int, p_max: int,
+                    q_max: int):
+    """Per-order packed-params -> expanded-lag-coefficient maps, as
+    CONSTANTS: ``phi_full = lin_phi[g] @ P + P^T quad_phi[g] P`` (and the
+    theta analog, cross ``+1``), ``c = lin_c[g] @ P``.
+
+    The multiplicative seasonal expansion (:func:`_expand_seasonal_poly`)
+    is linear in the own-lag and seasonal coefficients plus BILINEAR
+    cross terms — so per order it is exactly a (linear, quadratic-form)
+    pair of 0/±1 constant tensors.  That makes the fused grid objective a
+    uniform per-cell computation gatherable by CELL index, which is what
+    lets straggler compaction run on the flattened ``[K*B]`` problem
+    (the static-unrolled main objective cannot be gathered across mixed
+    orders)."""
+    K = len(infos)
+    lin_c = np.zeros((K, k_max), np.float32)
+    lin_phi = np.zeros((K, max(p_max, 1), k_max), np.float32)
+    quad_phi = np.zeros((K, max(p_max, 1), k_max, k_max), np.float32)
+    lin_th = np.zeros((K, max(q_max, 1), k_max), np.float32)
+    quad_th = np.zeros((K, max(q_max, 1), k_max, k_max), np.float32)
+    i0 = int(include_intercept)
+    for g, info in enumerate(infos):
+        p, _, q = info["order"]
+        P, Q, s = info["P"], info["Q"], info["s"]
+        if include_intercept:
+            lin_c[g, 0] = 1.0
+        for i in range(p):
+            lin_phi[g, i, i0 + i] = 1.0
+        for j in range(q):
+            lin_th[g, j, i0 + p + j] = 1.0
+        for j in range(P):  # seasonal AR: lag (j+1)s - 1, cross = -1
+            lag = (j + 1) * s
+            lin_phi[g, lag - 1, i0 + p + q + j] += 1.0
+            for i in range(p):
+                quad_phi[g, lag + i, i0 + p + q + j, i0 + i] += -1.0
+        for j in range(Q):  # seasonal MA: cross = +1
+            lag = (j + 1) * s
+            lin_th[g, lag - 1, i0 + p + q + P + j] += 1.0
+            for i in range(q):
+                quad_th[g, lag + i, i0 + p + q + P + j,
+                        i0 + p + i] += 1.0
+    return lin_c, lin_phi, quad_phi, lin_th, quad_th
+
+
+def fit_grid(
+    y,
+    specs,
+    include_intercept: bool = True,
+    *,
+    method: str = "css-lbfgs",
+    max_iters: int = 60,
+    tol: Optional[float] = None,
+    backend: str = "auto",
+    align_mode: Optional[str] = None,
+) -> FitResult:
+    """Fit a fused grid of K same-``d`` (S)ARIMA candidates in ONE program.
+
+    ``specs`` is a sequence of ``(order, seasonal_or_None)`` pairs that all
+    share the plain differencing order ``d`` (seasonal ``(D, s)`` may vary
+    — each distinct ``(d, D, s)`` signature differences the panel once
+    through the shared-prep cache).  Returns a :class:`FitResult` whose
+    ``params`` matrix packs the K per-order results per row — ALL-FINITE
+    by construction, with per-order eligibility as its own column
+    (layout: :data:`GRID_PACK_COLS`; width :func:`grid_pack_width`) —
+    and whose row-level nll/converged/iters/status summarize the row's
+    BEST outcome across the grid (min nll / any-converged / max iters /
+    min-severity status): a resilient caller therefore retries only rows
+    with NO usable candidate, and an all-excluded row keeps the
+    retry-cannot-help shield.  ``models.auto`` demuxes the pack into
+    per-order results.
+
+    Scan backend only (``backend`` must resolve away from pallas); the
+    optimizing CSS methods only.  Numerics match the per-order scan fits
+    up to f32 fusion differences (zero-padded coefficient slots and the
+    shared lockstep loop) — selection built on top is tested to agree
+    with the per-order search; ``fuse=1`` in ``auto_fit`` remains the
+    bitwise per-order path.
+    """
+    if method not in ("css-lbfgs", "css-cgd", "css-bobyqa"):
+        raise ValueError(
+            f"fit_grid requires an optimizing CSS method, got {method!r}")
+    if backend not in ("auto", "scan"):
+        raise ValueError(
+            f"fit_grid runs on the portable scan backend (the fused pallas "
+            f"kernel's folded layout is per-order static); got "
+            f"backend={backend!r}")
+    specs = tuple((tuple(int(v) for v in o),
+                   _validate_seasonal(sea)) for o, sea in specs)
+    if not specs:
+        raise ValueError("fit_grid needs at least one order spec")
+    d0 = specs[0][0][1]
+    if any(o[1] != d0 for o, _ in specs):
+        raise ValueError(
+            f"fit_grid fuses same-d orders only (shared differencing); got "
+            f"d values {sorted({o[1] for o, _ in specs})}")
+    yb, single = ensure_batched(y)
+    if tol is None:
+        tol = 1e-6 if yb.dtype == jnp.float64 else 1e-4
+    align_mode = resolve_align_mode(yb, align_mode)
+    run = _grid_fit_program(specs, include_intercept, max_iters, float(tol),
+                            align_mode)
+    return debatch_fit(run(yb), single, False)
+
+
+@jit_program
+def _grid_fit_program(specs, include_intercept, max_iters, tol,
+                      align_mode="general"):
+    """One compiled program per fused grid: shared align + per-(d, D, s)
+    differencing, per-order Hannan-Rissanen warm starts, the [K]-axis
+    vmapped padded-polynomial CSS objective, and one lockstep batched
+    L-BFGS over the flattened ``[K*B]`` problem."""
+    from .. import obs as _obs
+
+    infos = [_grid_spec_info(o, sea, include_intercept) for o, sea in specs]
+    K = len(infos)
+    d = infos[0]["order"][1]
+    k_max = max(i["k"] for i in infos)
+    p_max = max(i["p_full"] for i in infos)
+    q_max = max(i["q_full"] for i in infos)
+    i0 = int(include_intercept)
+    lin_c, lin_phi, quad_phi, lin_th, quad_th = _grid_coef_maps(
+        infos, include_intercept, k_max, p_max, q_max)
+    any_seasonal = any(i["seasonal"] is not None for i in infos)
+    # distinct differencing signatures -> trace-time shared-prep accounting
+    # (mirrors grid_diff_cache_keys; the obs counter records the saved
+    # differencings once per compile, like optim.stage2_compact_traces)
+    n_keys = grid_diff_cache_keys(tuple((i["order"], i["seasonal"])
+                                        for i in infos))
+    if K > n_keys:
+        _obs.counter("auto_fit.diff_cache_hits").add(K - n_keys)
+
+    def run(yb):
+        bsz, t_len = yb.shape
+        with jax.named_scope("arima.grid_align"):
+            ya, nv0 = maybe_align(yb, align_mode)  # ragged: NaN head/tail
+        n = t_len - d
+        # shared-prep cache (the tentpole's second half): ONE differencing
+        # per (d, D, s) signature across the fusion group; seasonal
+        # variants embed right-aligned into the group's common length n
+        # (the scan's n_valid masking zeroes the pad, so the embedded
+        # recursion sees the bytes a per-order fit of length n - D*s would)
+        cache = {}
+
+        def differenced(D, s):
+            key = (D, s) if D else (0, 0)
+            if key in cache:
+                return cache[key]
+            with jax.named_scope("arima.grid_difference"):
+                yd = jax.vmap(lambda v: _difference(v, d))(ya)
+                if D:
+                    yd = jax.vmap(
+                        lambda v: _difference_seasonal(v, D, s))(yd)
+                    yd = jnp.pad(yd, ((0, 0), (n - yd.shape[1], 0)))
+            cache[key] = yd
+            return yd
+
+        inits, oks, n_effs, nvds, yds = [], [], [], [], []
+        for info in infos:
+            p, _, q = info["order"]
+            yd = differenced(info["D"], info["s"])
+            nvd = nv0 - info["d_full"]
+            with jax.named_scope("arima.grid_init"):
+                # non-seasonal HR warm start on the (fully) differenced
+                # panel; seasonal terms start at 0 (same contract as
+                # _fit_sarima_program).  Inside the ok region the
+                # embedding cannot change HR's static long-AR order m
+                # (the nvd >= 4*(p+q+1) gate pins m = p+q+1 either way).
+                base = hannan_rissanen_batched(
+                    yd, (p, 0, q), include_intercept, nvd)
+                if info["P"] + info["Q"]:
+                    base = jnp.concatenate(
+                        [base, jnp.zeros((bsz, info["P"] + info["Q"]),
+                                         yd.dtype)], axis=1)
+            # zero-pad to k_max: the objective never reads the pad, so its
+            # gradient (and therefore its trajectory) stays exactly 0
+            init = jnp.pad(base, ((0, 0), (0, k_max - info["k"])))
+            pf, qf, k = info["p_full"], info["q_full"], info["k"]
+            ok = nvd >= pf + qf + max(pf + qf + 1, 1) + k + 2
+            ok = ok & (nvd >= 4 * (p + q + 1))
+            # optimize the MEAN log-likelihood (same rationale as _css_prep)
+            n_eff = jnp.maximum(nvd - pf, 1).astype(yd.dtype)
+            inits.append(init)
+            oks.append(ok)
+            n_effs.append(n_eff)
+            nvds.append(nvd)
+            yds.append(yd)
+
+        def row_nll(c, phi_f, theta_f, ydr, nvr, cond_p, ner):
+            e = _css_errors_poly(c, phi_f, theta_f, ydr, n_valid=nvr,
+                                 condition_lags=cond_p)
+            css = jnp.sum(e * e)
+            sigma2 = css / ner
+            return 0.5 * ner * (jnp.log(2.0 * jnp.pi * sigma2) + 1.0)
+
+        # over rows; the panel (ydr) is per row, the conditioning depth is
+        # shared by the order
+        nll_rows = jax.vmap(row_nll, in_axes=(0, 0, 0, 0, 0, None, 0))
+        # over the leading [K] order axis of one diff-signature's stack;
+        # the shared differenced panel broadcasts instead of tiling K x B
+        nll_grid = jax.vmap(nll_rows, in_axes=(0, 0, 0, None, 0, 0, 0))
+
+        def fb(p_flat):
+            pk = p_flat.reshape(K, bsz, k_max)
+            cs, phis, thetas = [], [], []
+            for g, info in enumerate(infos):
+                p, _, q = info["order"]
+                pg = pk[g]
+                c = (pg[:, 0] if include_intercept
+                     else jnp.zeros((bsz,), pg.dtype))
+                phi = pg[:, i0: i0 + p]
+                theta = pg[:, i0 + p: i0 + p + q]
+                if info["seasonal"] is not None:
+                    P, Q, s = info["P"], info["Q"], info["s"]
+                    sphi = pg[:, i0 + p + q: i0 + p + q + P]
+                    stheta = pg[:, i0 + p + q + P: i0 + p + q + P + Q]
+                    phi = jax.vmap(
+                        lambda a, b: _expand_seasonal_poly(a, b, s, -1.0)
+                    )(phi, sphi)
+                    theta = jax.vmap(
+                        lambda a, b: _expand_seasonal_poly(a, b, s, 1.0)
+                    )(theta, stheta)
+                phi = jnp.pad(phi, ((0, 0), (0, p_max - phi.shape[1])))
+                theta = jnp.pad(theta, ((0, 0), (0, q_max - theta.shape[1])))
+                cs.append(c)
+                phis.append(phi)
+                thetas.append(theta)
+            # one vmapped objective per diff signature: the [K_sig] stack
+            # shares its differenced panel via broadcast (in_axes=None)
+            out = [None] * K
+            by_sig: dict = {}
+            for g, info in enumerate(infos):
+                sig = ((info["D"], info["s"]) if info["D"] else (0, 0))
+                by_sig.setdefault(sig, []).append(g)
+            for sig, gs in by_sig.items():
+                nll_sig = nll_grid(
+                    jnp.stack([cs[g] for g in gs]),
+                    jnp.stack([phis[g] for g in gs]),
+                    jnp.stack([thetas[g] for g in gs]),
+                    yds[gs[0]],
+                    jnp.stack([nvds[g] for g in gs]),
+                    jnp.asarray([infos[g]["p_full"] for g in gs]),
+                    jnp.stack([n_effs[g] for g in gs]),
+                )  # [K_sig, B]
+                for j, g in enumerate(gs):
+                    out[g] = nll_sig[j] / n_effs[g]
+            return jnp.concatenate(out)  # [K*B]
+
+        # straggler compaction over the flattened [K*B] CELL grid: the
+        # lockstep loop runs to the slowest (order, row) cell while every
+        # pass evaluates all K*B cells — with per-order convergence rates
+        # this skewed (an HR-init order can converge in 0 iterations while
+        # a neighbor runs 16), the tail would cost more than the fusion
+        # saves.  Once at most `cap` cells remain, they are gathered into
+        # one small uniform problem whose objective reconstructs each
+        # cell's expanded coefficients from the per-order (linear,
+        # quadratic) constant maps (_grid_coef_maps) — gatherable by cell
+        # index, which the static-unrolled main objective is not.
+        # Single-signature groups only: a mixed-signature gather would
+        # need per-cell panel selection; those groups stay lockstep.
+        cells = K * bsz
+        straggler_fun = None
+        cap = None
+        if n_keys == 1 and cells >= 512:
+            # cap at cells/4 (128-aligned): the cross-ORDER skew makes the
+            # tail fat (a whole order can sit converged while another
+            # runs), so exiting the full-width lockstep earlier buys more
+            # than the compacted problem's extra quarter-width costs
+            cap = -(-max(128, cells // 4) // 128) * 128
+            if cap >= cells:
+                cap = None
+        if cap is not None:
+            lc_a = jnp.asarray(lin_c)
+            lphi_a = jnp.asarray(lin_phi)
+            lth_a = jnp.asarray(lin_th)
+            qphi_a = jnp.asarray(quad_phi) if any_seasonal else None
+            qth_a = jnp.asarray(quad_th) if any_seasonal else None
+            yd0 = yds[0]
+            nvd_all = jnp.concatenate(nvds)
+            ne_all = jnp.concatenate(n_effs)
+            cp_all = jnp.concatenate([
+                jnp.full((bsz,), info["p_full"], jnp.int32)
+                for info in infos])
+
+            def straggler_fun(idxc):
+                gcell = idxc // bsz
+                rcell = idxc % bsz
+                lc_s = lc_a[gcell]
+                lphi_s = lphi_a[gcell]
+                lth_s = lth_a[gcell]
+                qphi_s = qphi_a[gcell] if any_seasonal else None
+                qth_s = qth_a[gcell] if any_seasonal else None
+                yd_s = yd0[rcell]
+                nvd_s = nvd_all[idxc]
+                ne_s = ne_all[idxc]
+                cp_s = cp_all[idxc]
+                cell_nll = jax.vmap(row_nll)
+
+                def fb_s(p_sub):
+                    c = jnp.einsum("ck,ck->c", lc_s, p_sub)
+                    phi = jnp.einsum("cpk,ck->cp", lphi_s, p_sub)
+                    th = jnp.einsum("cqk,ck->cq", lth_s, p_sub)
+                    if any_seasonal:
+                        phi = phi + jnp.einsum("cpkl,ck,cl->cp", qphi_s,
+                                               p_sub, p_sub)
+                        th = th + jnp.einsum("cqkl,ck,cl->cq", qth_s,
+                                             p_sub, p_sub)
+                    return cell_nll(c, phi, th, yd_s, nvd_s, cp_s,
+                                    ne_s) / ne_s
+
+                return fb_s
+
+        with jax.named_scope("arima.grid_lbfgs"):
+            res = optim.minimize_lbfgs_batched(
+                fb, jnp.concatenate(inits), max_iters=max_iters, tol=tol,
+                straggler_fun=straggler_fun, straggler_cap=cap)
+
+        xk = res.x.reshape(K, bsz, k_max)
+        fk = res.f.reshape(K, bsz)
+        convk = res.converged.reshape(K, bsz)
+        itk = res.iters.reshape(K, bsz)
+        blocks, nlls, convs, statuses = [], [], [], []
+        for g, info in enumerate(infos):
+            ok = oks[g]
+            colmask = jnp.arange(k_max) < info["k"]
+            params_g = jnp.where(ok[:, None] & colmask[None, :], xk[g],
+                                 jnp.nan)
+            nll_g = jnp.where(ok, fk[g] * n_effs[g], jnp.nan)
+            conv_g = convk[g] & ok
+            # status judges THIS order's own parameter columns: the
+            # k_max padding is NaN by the pack convention, and letting
+            # derive_status's finiteness check read it would flag every
+            # narrower order on the grid DIVERGED
+            status_g = derive_status(
+                ok, convk[g], jnp.where(colmask[None, :], params_g, 0.0))
+            # the PACK must be all-finite: the resilient runner's
+            # failed-row mask requires finite(params).all(axis=-1) per
+            # ROW, and the pack IS the row — NaN slots (excluded orders,
+            # k_g padding) would mark every row failed and feed the
+            # whole panel through the retry ladder.  Eligibility rides
+            # as its own column; _demux_fused restores the per-order NaN
+            # conventions from it and the status column.
+            elig_g = ok & jnp.isfinite(nll_g)
+            dt = params_g.dtype
+            blocks += [jnp.where(jnp.isfinite(params_g), params_g, 0.0),
+                       jnp.where(elig_g, nll_g, 0.0)[:, None],
+                       elig_g.astype(dt)[:, None],
+                       conv_g.astype(dt)[:, None],
+                       itk[g].astype(dt)[:, None],
+                       status_g.astype(dt)[:, None]]
+            nlls.append(jnp.where(elig_g, nll_g, jnp.nan))
+            convs.append(conv_g)
+            statuses.append(status_g)
+        wide = jnp.concatenate(blocks, axis=1)  # [B, K*(k_max+5)]
+        nll_all = jnp.stack(nlls)
+        best = jnp.min(jnp.where(jnp.isnan(nll_all), jnp.inf, nll_all),
+                       axis=0)
+        row_nll_out = jnp.where(jnp.isfinite(best), best, jnp.nan)
+        # row-level summaries feed the DRIVER's accounting and the
+        # resilient runner's per-ROW decisions — the per-order truth
+        # lives in the pack.  A row's summary is its BEST outcome across
+        # the grid: converged = ANY order usable (the ladder retries
+        # rows with NO usable candidate — a single stubborn order must
+        # not send the row through the ladder, and an exhausted ladder
+        # must not wipe the orders that DID fit; per-candidate rescue is
+        # fuse=1's contract), status = min severity (EXCLUDED only when
+        # EVERY order structurally refused the row, which is when the
+        # runner's retry-cannot-help shield is actually true).
+        return FitResult(
+            wide, row_nll_out,
+            jnp.any(jnp.stack(convs), axis=0),
+            jnp.max(itk, axis=0),
+            jnp.min(jnp.stack(statuses), axis=0),
+        )
 
     return run
 

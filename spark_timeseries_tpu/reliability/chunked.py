@@ -250,15 +250,19 @@ def fit_chunked(
     timeout happened, and — when journaled — the journal accounting
     (``meta["journal"]``: run id, chunks committed/resumed/timeout).
 
-    **Grid coordinate** (``grid=(index, total)``): an auto-fit order
-    search (``models.auto``) runs one ordinary walk per candidate order;
-    the coordinate places this walk's plan on that grid — chunk
+    **Grid coordinate** (``grid=(index, total)`` or
+    ``(index, total, members)``): an auto-fit order search
+    (``models.auto``) runs one ordinary walk per candidate order — or,
+    fused, one walk per same-``d`` fusion group, whose member grid
+    indices ride in ``members`` (leading with the walk's own index); the
+    coordinate places this walk's plan on that grid — chunk
     spans/events/telemetry rows carry a ``grid`` tag (one
-    ``tools/obs_report.py`` timeline lane per order), the manifest
-    records ``extra.grid``, and ``meta["grid"]`` echoes it.  Like the
-    pipeline/shard knobs it is NOT part of the journal config hash: the
-    order itself rides in the hashed fit kwargs; the coordinate only
-    labels where in the search the work happened.
+    ``tools/obs_report.py`` timeline lane per walk), the manifest
+    records ``extra.grid`` (with ``fused`` for a group walk), and
+    ``meta["grid"]`` echoes it.  Like the pipeline/shard knobs it is NOT
+    part of the journal config hash: the orders themselves ride in the
+    hashed fit kwargs; the coordinate only labels where in the search
+    the work happened.
 
     **Telemetry** (``obs.enable()``): each chunk dispatch runs under an
     ``obs.span("chunk")`` whose first dispatch per (fit, shape, dtype) is
@@ -433,19 +437,34 @@ def fit_chunked(
                                      else model_base.align_mode_on_host(yb))}
     plan_mode = fit_kwargs.get("align_mode") if fit_takes_align else None
 
-    # -- grid coordinate (ISSUE 9) -------------------------------------------
+    # -- grid coordinate (ISSUE 9 / 10) --------------------------------------
     # an auto-fit order search (models.auto) runs one ordinary walk per
-    # candidate order; grid=(index, total) places this walk on that grid so
-    # its telemetry rows/events are per-order lanes and the journal records
-    # where in the search the chunks belong.  NOT config-hashed (the order
-    # itself rides in fit_kwargs, which is) — purely a label.
+    # candidate order — or, fused (ISSUE 10), one walk per fusion GROUP of
+    # same-d orders; grid=(index, total) or (index, total, members) places
+    # this walk on that grid so its telemetry rows/events are per-walk
+    # lanes and the journal records where in the search the chunks belong
+    # (a fused walk's chunks carry the whole group in extra.grid.fused).
+    # NOT config-hashed (the orders themselves ride in fit_kwargs, which
+    # is) — purely a label.
     if grid is not None:
         gi, gn = (int(grid[0]), int(grid[1]))
         if not (0 <= gi < gn):
             raise ValueError(f"grid index {gi} out of range for total {gn}")
+        members = None
+        if len(grid) > 2 and grid[2] is not None:
+            members = [int(m) for m in grid[2]]
+            if any(not (0 <= m < gn) for m in members) or members[0] != gi:
+                raise ValueError(
+                    f"grid members {members} must sit in [0, {gn}) and "
+                    f"lead with the walk's own index {gi}")
         grid = (gi, gn)
-        journal_extra = {**(journal_extra or {}),
-                         "grid": {"index": gi, "total": gn}}
+        grid_members = members
+        gx = {"index": gi, "total": gn}
+        if members is not None:
+            gx["fused"] = members
+        journal_extra = {**(journal_extra or {}), "grid": gx}
+    else:
+        grid_members = None
 
     # -- journal(s) ----------------------------------------------------------
     if src is not None:
@@ -717,6 +736,8 @@ def fit_chunked(
         }
     if grid is not None:
         meta["grid"] = {"index": grid[0], "total": grid[1]}
+        if grid_members is not None:
+            meta["grid"]["fused"] = grid_members
     if journals is not None and not sharded:
         meta["journal"] = journals[0].accounting()
     if plan_mode is not None:

@@ -241,7 +241,7 @@ def resilient_fit(
         # the optimizer's straggler compaction: out-of-range pad rows are
         # copies of a real row whose results are dropped on the scatter)
         cap = optim.retry_cap(idx.size)
-        pad_idx = np.concatenate([idx, np.full(cap - idx.size, idx[0])])
+        pad_idx = optim.gather_pad_indices(idx, cap)
         y_sub = y_clean[jnp.asarray(pad_idx)]
         kw = {**fit_kwargs, **rung.kwargs}
         if supports_init and rung.perturb:

@@ -60,6 +60,28 @@ def retry_cap(n: int, align: int = 8) -> int:
     return cap
 
 
+def gather_pad_indices(rows, cap: int):
+    """Pad a host-side row-index gather to ``cap`` slots by repeating the
+    first index.
+
+    The convention every bounded-shape subset dispatch shares — the
+    resilient retry ladder's failed-row buckets and the auto-fit winners
+    stage-2 basin refits (``models.auto``): the padded tail recomputes a
+    real row (its results are dropped on scatter), so the compiled
+    program's shape is the :func:`retry_cap` bucket, never one shape per
+    subset size.
+    """
+    import numpy as _np
+
+    rows = _np.asarray(rows)
+    if rows.size == 0:
+        raise ValueError("gather_pad_indices needs at least one row")
+    if int(cap) < rows.size:
+        raise ValueError(f"cap {cap} smaller than the {rows.size}-row gather")
+    return _np.concatenate(
+        [rows, _np.full(int(cap) - rows.size, rows[0], rows.dtype)])
+
+
 class LBFGSResult(NamedTuple):
     x: jax.Array  # [d] solution
     f: jax.Array  # [] final objective

@@ -1,21 +1,25 @@
-"""Subprocess worker for the auto-fit kill-and-resume smoke (ISSUE 9).
+"""Subprocess worker for the auto-fit kill-and-resume smoke (ISSUE 9/10).
 
-Runs a journaled 3-order auto-fit search over a deterministic AR(1) panel,
-optionally SIGKILLing itself after N durable chunk commits — which, with 3
-chunks per order, lands the kill MID-GRID (order 0's walk committed, order
-1's walk partially committed, order 2 never started).  A resumed search
-must replay only the uncommitted chunks and produce a selection
-bitwise-identical to an uninterrupted search: the acceptance smoke both
-``ci.sh`` and the slow-marked ``tests/test_auto.py`` subprocess test run.
+Runs a journaled FUSED 3-order auto-fit search over a deterministic AR(1)
+panel, optionally SIGKILLing itself after N durable chunk commits.  The
+grid's two d=0 orders fuse into ONE group walk (``grid_00000``, 3 chunks
+carrying both orders per chunk) followed by the d=1 singleton
+(``grid_00002``, 3 chunks) — so a kill after 2 commits lands MID-GROUP
+(the fused walk torn with per-order results for BOTH orders partially
+durable) and a kill after 4 lands mid-grid (fused group fully committed,
+singleton torn).  A resumed search must replay only the uncommitted
+chunks and produce a selection bitwise-identical to an uninterrupted
+fused search: the acceptance smoke both ``ci.sh`` and the slow-marked
+``tests/test_auto.py`` subprocess test run.
 
 Modes:
     --run --dir D [--kill-after N] [--out F]
         one journaled auto_fit; with --kill-after the process dies
         mid-run (exit by SIGKILL), else the selection is saved to F.
     --smoke
-        full orchestration: kill a child after 4 commits (mid-grid),
-        verify which order journals exist, resume, compare bitwise
-        against an uninterrupted search, validate the auto manifest with
+        full orchestration: kill a child after 2 commits (MID-GROUP),
+        verify the torn fused journal, resume, compare bitwise against
+        an uninterrupted fused search, validate the auto manifest with
         tools/obs_report.py, and print PASS.
 """
 
@@ -85,30 +89,33 @@ def _child(args: list) -> subprocess.CompletedProcess:
 def smoke() -> None:
     with tempfile.TemporaryDirectory() as td:
         jdir = os.path.join(td, "search")
-        # 1. child SIGKILLed after 4 chunk commits: order 0's 3-chunk walk
-        # is fully durable, order 1 died with 1 of 3 chunks committed,
-        # order 2 never started — a kill MID-GRID
-        r = _child(["--run", "--dir", jdir, "--kill-after", "4"])
+        # 1. child SIGKILLed after 2 chunk commits: the kill lands
+        # MID-GROUP — the fused {order 0, order 1} walk has 2 of its 3
+        # chunks durable (each chunk carrying BOTH orders' results), the
+        # d=1 singleton never started
+        r = _child(["--run", "--dir", jdir, "--kill-after", "2"])
         if r.returncode != -9:
             sys.exit(f"expected SIGKILL (-9), got rc={r.returncode}\n"
                      f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}")
         g0 = json.load(open(os.path.join(jdir, "grid_00000",
                                          "manifest.json")))
         done0 = [c for c in g0["chunks"] if c["status"] == "committed"]
-        if len(done0) != 3:
-            sys.exit(f"order 0 should have 3 committed chunks, got "
+        if len(done0) != 2:
+            sys.exit(f"fused group should have 2 committed chunks, got "
                      f"{len(done0)}")
-        g1 = json.load(open(os.path.join(jdir, "grid_00001",
-                                         "manifest.json")))
-        done1 = [c for c in g1["chunks"] if c["status"] == "committed"]
-        if len(done1) != 1:
-            sys.exit(f"order 1 should have exactly 1 committed chunk, got "
-                     f"{len(done1)}")
+        if g0["extra"]["auto_fit"].get("fused_orders") != [0, 1]:
+            sys.exit(f"fused journal should carry its group: "
+                     f"{g0['extra']['auto_fit']!r}")
+        if g0["extra"]["grid"].get("fused") != [0, 1]:
+            sys.exit(f"extra.grid should carry the fusion group: "
+                     f"{g0['extra']['grid']!r}")
+        if os.path.exists(os.path.join(jdir, "grid_00001")):
+            sys.exit("no per-order journal should exist for a fused order")
         if os.path.exists(os.path.join(jdir, "grid_00002")):
-            sys.exit("order 2's journal should not exist yet")
+            sys.exit("the d=1 singleton's journal should not exist yet")
         if os.path.exists(os.path.join(jdir, "auto_manifest.json")):
             sys.exit("auto manifest should only be written after selection")
-        # 2. resume completes the search from the per-order journals
+        # 2. resume completes the search from the per-group journals
         resumed_out = os.path.join(td, "resumed.npz")
         r = _child(["--run", "--dir", jdir, "--out", resumed_out])
         if r.returncode != 0:
@@ -123,31 +130,42 @@ def smoke() -> None:
         for k in FIELDS:
             if not np.array_equal(a[k], b[k], equal_nan=True):
                 sys.exit(f"resumed search differs from uninterrupted on "
-                         f"{k!r} — mid-grid resume is NOT bitwise-identical")
+                         f"{k!r} — mid-group resume is NOT "
+                         "bitwise-identical")
         if json.loads(str(a["counts"])) != json.loads(str(b["counts"])):
             sys.exit("selection histograms differ")
-        # 4. resumed journals: order 0 fully resumed, order 1 partially
+        # 4. resumed journals: the fused group replayed ONLY its missing
+        # chunk (3 committed now), the singleton ran fresh
         g0 = json.load(open(os.path.join(jdir, "grid_00000",
                                          "manifest.json")))
         if len([c for c in g0["chunks"] if c["status"] == "committed"]) != 3:
-            sys.exit("order 0 manifest should still show 3 chunks")
+            sys.exit("fused group manifest should show 3 chunks")
+        g2 = json.load(open(os.path.join(jdir, "grid_00002",
+                                         "manifest.json")))
+        if len([c for c in g2["chunks"] if c["status"] == "committed"]) != 3:
+            sys.exit("singleton manifest should show 3 chunks")
         man = json.load(open(os.path.join(jdir, "auto_manifest.json")))
         if len(man["auto_fit"]["orders"]) != 3:
             sys.exit("auto manifest should record all 3 orders")
+        if [g["orders"] for g in man["auto_fit"]["fusion_groups"]] != \
+                [[0, 1], [2]]:
+            sys.exit(f"auto manifest fusion groups wrong: "
+                     f"{man['auto_fit']['fusion_groups']!r}")
         # 5. the tools gate the resumed search's manifests
         sys.path.insert(0, os.path.join(ROOT, "tools"))
         import obs_report
 
         errs = obs_report.validate_auto_manifest(jdir)
-        # per-order journals were written WITHOUT obs enabled in this
+        # per-group journals were written WITHOUT obs enabled in this
         # smoke, so drop the telemetry-block errors the recursion adds
         errs = [e for e in errs if "no telemetry block" not in e]
         if errs:
             sys.exit(f"auto manifest failed validation: {errs}")
         print("auto-fit kill-and-resume smoke: PASS "
-              "(SIGKILL mid-grid after 4 commits, resumed search "
-              "bitwise-identical to uninterrupted, selection histogram "
-              "stable, manifests validate)")
+              "(SIGKILL mid-GROUP after 2 commits — fused walk torn with "
+              "both orders' results partial — resumed search "
+              "bitwise-identical to uninterrupted fused run, selection "
+              "histogram stable, manifests validate)")
 
 
 def main() -> None:

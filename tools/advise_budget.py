@@ -55,10 +55,13 @@ tool reads one manifest and prints suggested
                         lane is visible.
 
 Pointed at an **auto-fit search root** (ISSUE 9: ``auto_manifest.json`` +
-per-order ``grid_*`` journals) the advisor switches to grid-level advice —
-``orders_per_pass`` (prune candidates that never won a row) and the
-per-order ``chunk_rows`` (>= 2 chunks per order so each order's compiled
-program is reused), from the recorded stage-1 vs stage-2 wall balance and
+per-order/per-group ``grid_*`` journals) the advisor switches to
+grid-level advice — ``orders_per_pass`` (prune candidates that never won
+a row), the fusion width ``fuse`` (ISSUE 10: how many same-d orders
+should share one fused walk, capped by HBM headroom, with the per-order
+wall balance and compile-cache hit rate as evidence), and the per-order
+``chunk_rows`` (>= 2 chunks per order so each order's compiled program
+is reused), from the recorded stage-1 vs stage-2 wall balance and
 selection histogram (see :func:`advise_auto`).
 
     python tools/advise_budget.py CHECKPOINT_DIR [--json]
@@ -342,6 +345,47 @@ def advise_auto(root: str) -> dict:
                       if isinstance(stage1_wall, (int, float)) and g_total
                       else None)
     cc = a.get("compile_cache") or {}
+
+    # -- fusion width K (ISSUE 10): how many same-d orders should share
+    # one walk next time.  The ceiling is the largest same-d cohort on
+    # the grid (fusion never crosses d); HBM headroom caps it — the
+    # fused program holds the chunk panel plus K orders' optimizer state
+    # and up to K differenced variants, so past ~half the device budget
+    # the group would meet the OOM-backoff ladder instead of amortizing
+    # the walk.  Per-order wall balance and the compile-cache hit rate
+    # are echoed as the evidence: balanced walls mean no straggler order
+    # gates the fused lockstep, and a LOW hit rate means the per-order
+    # walks were paying compiles fusion would amortize.
+    by_d: dict = {}
+    for o in orders:
+        od = o.get("order") or [0, 0, 0]
+        by_d[od[1]] = by_d.get(od[1], 0) + 1
+    max_same_d = max(by_d.values()) if by_d else 1
+    walls = [o.get("wall_s") for o in orders
+             if isinstance(o.get("wall_s"), (int, float))]
+    wall_balance = None
+    if walls and sum(walls) > 0:
+        wall_balance = round(max(walls) / (sum(walls) / len(walls)), 4)
+    budget_bytes = _device_budget_bytes()
+    fuse_mem_cap = None
+    po_obs = (per_order or {}).get("observed") or {}
+    panel_bytes = po_obs.get("panel_bytes")
+    if budget_bytes and panel_bytes and n_rows and chunk_rows_grid:
+        chunk_bytes = panel_bytes * chunk_rows_grid / n_rows
+        if chunk_bytes > 0:
+            fuse_mem_cap = max(1, int(0.5 * budget_bytes / chunk_bytes) - 2)
+    fuse_suggest = max_same_d
+    if fuse_mem_cap is not None:
+        fuse_suggest = max(1, min(fuse_suggest, fuse_mem_cap))
+    fuse_reason = (f"largest same-d cohort {max_same_d}"
+                   + (f", HBM headroom caps at {fuse_mem_cap}"
+                      if fuse_mem_cap is not None
+                      and fuse_mem_cap < max_same_d else "")
+                   + (f"; per-order wall balance {wall_balance}"
+                      if wall_balance is not None else "")
+                   + (f"; compile-cache hit rate {cc.get('hit_rate')}"
+                      if cc.get("hit_rate") is not None else ""))
+
     return {
         "auto_fit": True,
         "observed": {
@@ -356,12 +400,19 @@ def advise_auto(root: str) -> dict:
             "stage2_spend_share": a.get("stage2_spend_share"),
             "stage1_wall_s_per_order": per_order_wall,
             "compile_cache_hit_rate": cc.get("hit_rate"),
+            "fuse_used": a.get("fuse"),
+            "fusion_groups": len(a.get("fusion_groups") or []) or None,
+            "diff_cache_hits": a.get("diff_cache_hits"),
+            "max_same_d_orders": max_same_d,
+            "order_wall_balance": wall_balance,
         },
         "suggest": {
             "orders_per_pass": orders_per_pass,
             "orders_kept": [o.get("label") or str(tuple(o.get("order")))
                             for o in winners],
             "chunk_rows_grid": chunk_rows_grid,
+            "fuse": fuse_suggest,
+            "fuse_reason": fuse_reason,
             "per_order": (per_order or {}).get("suggest"),
         },
     }
@@ -382,9 +433,14 @@ def _render_auto(root: str, a: dict) -> None:
               f"{o['compile_cache_hit_rate']}")
     print("  selection:", ", ".join(f"{k}={v}"
                                     for k, v in o["selection_counts"].items()))
+    if o.get("diff_cache_hits") is not None:
+        print(f"  fusion: fuse={o.get('fuse_used')!r} over "
+              f"{o.get('fusion_groups')} group(s); shared-prep cache "
+              f"saved {o['diff_cache_hits']} differencing(s)")
     print("  suggest for the next search of this panel/grid:")
     print(f"    orders_per_pass = {s['orders_per_pass']}  "
           f"(winners {s['orders_kept']} + 1 exploration slot)")
+    print(f"    fuse            = {s['fuse']}  ({s['fuse_reason']})")
     if s["chunk_rows_grid"] is not None:
         print(f"    chunk_rows (per-order grid walk) = "
               f"{s['chunk_rows_grid']}  (>= 2 chunks/order so each "

@@ -206,21 +206,53 @@ def validate_manifest_auto_extra(m: dict, path: str) -> list:
             0 <= gi < gn):
         errors.append(f"extra.auto_fit grid position invalid: index "
                       f"{gi!r} of {gn!r}")
-    order = a.get("order")
-    if not (isinstance(order, list) and len(order) == 3
-            and all(isinstance(v, int) and v >= 0 for v in order)):
-        errors.append(f"extra.auto_fit.order invalid: {order!r}")
-    seasonal = a.get("seasonal")
-    if seasonal is not None and not (
-            isinstance(seasonal, list) and len(seasonal) == 4
-            and all(isinstance(v, int) for v in seasonal)):
-        errors.append(f"extra.auto_fit.seasonal invalid: {seasonal!r}")
+
+    def _order_ok(od):
+        return (isinstance(od, list) and len(od) == 3
+                and all(isinstance(v, int) and v >= 0 for v in od))
+
+    fused = a.get("fused_orders")
+    if fused is not None:
+        # a fused group walk (ISSUE 10): the chunks carry K same-d orders
+        if not (isinstance(fused, list) and fused
+                and all(isinstance(v, int) for v in fused)):
+            errors.append(f"extra.auto_fit.fused_orders invalid: {fused!r}")
+        else:
+            if isinstance(gn, int) and not all(0 <= v < gn for v in fused):
+                errors.append(f"extra.auto_fit.fused_orders {fused} out of "
+                              f"range for grid_total {gn}")
+            if isinstance(gi, int) and fused[0] != gi:
+                errors.append(f"extra.auto_fit.fused_orders must lead with "
+                              f"grid_index {gi}, got {fused}")
+        ods = a.get("orders")
+        if not (isinstance(ods, list) and ods
+                and all(_order_ok(od) for od in ods)):
+            errors.append(f"extra.auto_fit.orders invalid for fused walk: "
+                          f"{ods!r}")
+        elif len({od[1] for od in ods}) != 1:
+            errors.append(f"extra.auto_fit.orders mix d values in one "
+                          f"fused group: {ods!r}")
+        elif isinstance(fused, list) and len(ods) != len(fused):
+            errors.append(f"extra.auto_fit.orders count {len(ods)} != "
+                          f"fused_orders count {len(fused)}")
+    else:
+        order = a.get("order")
+        if not _order_ok(order):
+            errors.append(f"extra.auto_fit.order invalid: {order!r}")
+        seasonal = a.get("seasonal")
+        if seasonal is not None and not (
+                isinstance(seasonal, list) and len(seasonal) == 4
+                and all(isinstance(v, int) for v in seasonal)):
+            errors.append(f"extra.auto_fit.seasonal invalid: {seasonal!r}")
     if a.get("stage") not in ("full", "stage1", "winners"):
         errors.append(f"extra.auto_fit.stage invalid: {a.get('stage')!r}")
     grid = (m.get("extra") or {}).get("grid") or {}
     if isinstance(gi, int) and grid.get("index") != gi:
         errors.append(f"extra.grid.index {grid.get('index')!r} disagrees "
                       f"with extra.auto_fit.grid_index {gi}")
+    if fused is not None and grid.get("fused") != fused:
+        errors.append(f"extra.grid.fused {grid.get('fused')!r} disagrees "
+                      f"with extra.auto_fit.fused_orders {fused!r}")
     return errors
 
 
@@ -275,6 +307,26 @@ def validate_auto_manifest(root: str) -> list:
             errors.append(f"auto_fit.{key} invalid: {a.get(key)!r}")
     if a.get("criterion") not in ("aicc", "aic", "bic"):
         errors.append(f"auto_fit.criterion invalid: {a.get('criterion')!r}")
+    # fusion accounting (ISSUE 10): when present, the groups must
+    # partition the grid exactly once — the resume path and the budget
+    # advisor both read the group membership
+    fg = a.get("fusion_groups")
+    if fg is not None:
+        if not (isinstance(fg, list) and fg
+                and all(isinstance(e, dict) and isinstance(e.get("dir"), str)
+                        and isinstance(e.get("orders"), list)
+                        for e in fg)):
+            errors.append(f"auto_fit.fusion_groups invalid: {fg!r}")
+        else:
+            seen = [g for e in fg for g in e["orders"]]
+            if sorted(seen) != list(range(len(orders))):
+                errors.append(
+                    f"auto_fit.fusion_groups {seen} do not partition the "
+                    f"{len(orders)}-order grid exactly once")
+        if not (isinstance(a.get("diff_cache_hits"), int)
+                and a["diff_cache_hits"] >= 0):
+            errors.append(f"auto_fit.diff_cache_hits invalid: "
+                          f"{a.get('diff_cache_hits')!r}")
     # recurse into every per-order journal the search left on disk: each
     # is an ordinary chunk-walk manifest and must pass the same gate
     if os.path.isdir(root):
