@@ -1408,6 +1408,16 @@ def _sharded_northstar(jnp, order, quick, on_tpu):
     a straggler lane is a journaled fact), and
     ``sharded_bitwise_identical`` — sharding must not change a byte.
 
+    DEGRADED mode (ISSUE 11): a third walk of the same panel with lane 1
+    killed mid-job (permanent — its retries fail, the elastic supervisor
+    quarantines it and rebalances its chunks onto the survivors).
+    Reported: ``degraded_speedup`` (vs the single device — the bar is
+    > 1x: losing a lane degrades the mesh win, never erases it),
+    ``rebalance_overhead`` (degraded wall over healthy sharded wall − 1),
+    and ``degraded_bitwise_identical`` — both wired into the directional
+    telemetry regression gate, with an absolute ``degraded_speedup_floor``
+    at 1.0.
+
     On TPU full runs this is the literal 1M x 1k north-star spread over
     all chips; elsewhere a small AR panel proves the scaling on however
     many local (or forced virtual CPU) devices exist.  Every lane device
@@ -1488,6 +1498,20 @@ def _sharded_northstar(jnp, order, quick, on_tpu):
     # telemetry rides BOTH walks (same instrumentation overhead on each
     # side of the speedup); for the sharded walk it also lands the
     # per-shard overlap in the merged manifest
+    from spark_timeseries_tpu.reliability import faultinject as _fi
+
+    def _run_degraded(ckpt):
+        # ISSUE 11 acceptance: kill one lane mid-job (permanently — its
+        # retries fail too, so it is QUARANTINED) and let the elastic
+        # supervisor rebalance its chunks onto the survivors.  The fit is
+        # the same compiled program; only lane 1's dispatches die.
+        dead_fit = _fi.lane_kill(arima.fit, 1, after_chunks=1)
+        t0 = time.perf_counter()
+        r = _rel.fit_chunked(dead_fit, panel, chunk_rows=chunk_rows,
+                             resilient=False, order=order,
+                             checkpoint_dir=ckpt, shard=True, mesh=mesh)
+        return r, time.perf_counter() - t0
+
     obs_was_on = _obs.enabled()
     if not obs_was_on:
         _obs.enable()
@@ -1496,17 +1520,21 @@ def _sharded_northstar(jnp, order, quick, on_tpu):
             prefix="sharded_ns_single_"))
         ckpt_sharded = tempfile.mkdtemp(prefix="sharded_ns_mesh_")
         r_sharded, wall_sharded = _run(True, ckpt_sharded)
+        r_degraded, wall_degraded = _run_degraded(tempfile.mkdtemp(
+            prefix="sharded_ns_degraded_"))
     finally:
         if not obs_was_on:
             _obs.disable()
 
-    def _field_eq(f):
-        a = np.asarray(getattr(r_sharded, f))
+    def _field_eq(r, f):
+        a = np.asarray(getattr(r, f))
         b = np.asarray(getattr(r_single, f))
         return np.array_equal(a, b, equal_nan=a.dtype.kind == "f")
 
-    bitwise_ok = all(_field_eq(f) for f in (
-        "params", "neg_log_likelihood", "converged", "iters", "status"))
+    fields = ("params", "neg_log_likelihood", "converged", "iters", "status")
+    bitwise_ok = all(_field_eq(r_sharded, f) for f in fields)
+    degraded_bitwise_ok = all(_field_eq(r_degraded, f) for f in fields)
+    el = (r_degraded.meta.get("shards") or {}).get("elastic") or {}
 
     pipe = r_sharded.meta.get("pipeline") or {}
     per_shard = pipe.get("shards") or []
@@ -1529,6 +1557,22 @@ def _sharded_northstar(jnp, order, quick, on_tpu):
             round(conv / wall_sharded, 1) if wall_sharded > 0 else None,
         "converged_frac": round(conv / total, 4),
         "sharded_bitwise_identical": bitwise_ok,
+        # degraded mode (ISSUE 11): 1 of n_lanes lanes killed mid-job and
+        # quarantined; survivors rebalance its chunks.  The bar: losing a
+        # lane must DEGRADE the mesh win, never erase it (> 1x vs the
+        # single device), and the rebalance itself must stay cheap
+        "wall_s_degraded": round(wall_degraded, 3),
+        "degraded_speedup": (round(wall_single / wall_degraded, 4)
+                             if wall_degraded > 0 else None),
+        "rebalance_overhead": (round(wall_degraded / wall_sharded - 1.0, 4)
+                               if wall_sharded > 0 else None),
+        "degraded_bitwise_identical": degraded_bitwise_ok,
+        "degraded_gate_ok": (wall_degraded > 0
+                             and wall_single / wall_degraded > 1.0
+                             and degraded_bitwise_ok),
+        "quarantined_lanes": [q.get("shard_id")
+                              for q in el.get("quarantined") or []],
+        "degraded_steals": el.get("steals"),
         "overlap_efficiency": pipe.get("overlap_efficiency"),
         "input_overlap_efficiency": pipe.get("input_overlap_efficiency"),
         "per_shard_overlap_efficiency": shard_ov,
@@ -1539,10 +1583,11 @@ def _sharded_northstar(jnp, order, quick, on_tpu):
             "merged_shards": j.get("merged_shards"),
             "chunks_resumed": j.get("chunks_resumed"),
         },
-        "data": "same panel walked twice, both journaled: single-device "
-                "vs series-sharded mesh (one lane per device, shard 0 "
-                "merging ONE job manifest); per-shard overlap journaled "
-                "in the manifest telemetry",
+        "data": "same panel walked three times, all journaled: "
+                "single-device vs series-sharded mesh vs DEGRADED mesh "
+                "(lane 1 killed mid-job, quarantined, chunks rebalanced "
+                "onto survivors); per-shard overlap journaled in the "
+                "manifest telemetry",
     }
 
 
@@ -2000,6 +2045,11 @@ def _telemetry_regression_gate(headline):
             "sharded_speedup": sh.get("sharded_speedup"),
             "shard_overlap_efficiency_min":
                 sh.get("shard_overlap_efficiency_min"),
+            # ISSUE 11: the elastic walk's degraded-mode numbers — losing
+            # a lane must keep beating the single device, and the
+            # quarantine/rebalance machinery must stay cheap
+            "degraded_speedup": sh.get("degraded_speedup"),
+            "rebalance_overhead": sh.get("rebalance_overhead"),
         }
     # host-resident-walk gate inputs (ISSUE 7): the H2D overlap can rot
     # (prefetcher regression, staging pool thrash) while the in-HBM
@@ -2086,6 +2136,10 @@ def _telemetry_regression_gate(headline):
         "input_overlap_efficiency": ("abs", 0.15, "higher"),
         "sharded_speedup": ("rel", 0.3, "higher"),
         "shard_overlap_efficiency_min": ("abs", 0.2, "higher"),
+        "degraded_speedup": ("rel", 0.4, "higher"),
+        # absolute drift: the overhead hovers near 0 (and can be negative)
+        # where a relative band is all timing noise
+        "rebalance_overhead": ("abs", 0.5, "lower"),
         "oversubscribed_ratio": ("abs", 0.2, "higher"),
         "auto_fit_order_series_per_sec": ("rel", 0.4, "higher"),
         "auto_fit_compile_cache_hit_rate": ("abs", 0.2, "higher"),
@@ -2123,6 +2177,16 @@ def _telemetry_regression_gate(headline):
             "tolerance": 0.0, "mode": "abs", "direction": "higher",
             "flagged": True}
         flagged.append("auto_fit_winners_speedup_floor")
+    # ABSOLUTE floor (ISSUE 11): losing 1 of n lanes must DEGRADE the mesh
+    # win, never erase it — a degraded walk slower than the single device
+    # means quarantine/rebalance is broken, regardless of the previous run
+    ds = inputs.get("degraded_speedup")
+    if ds is not None and ds < 1.0:
+        drifts["degraded_speedup_floor"] = {
+            "prev": 1.0, "cur": ds, "drift": round(1.0 - ds, 4),
+            "tolerance": 0.0, "mode": "abs", "direction": "higher",
+            "flagged": True}
+        flagged.append("degraded_speedup_floor")
     if not drifts:
         # the prior summary carried none of the tracked keys (e.g. a
         # --quick run): comparing NOTHING must not read as a green gate
@@ -2194,7 +2258,10 @@ def _summary_line(emitted):
                     "wall_s_single_device", "sharded_speedup",
                     "sharded_converged_series_per_sec",
                     "shard_overlap_efficiency_min",
-                    "sharded_bitwise_identical")}
+                    "sharded_bitwise_identical",
+                    "wall_s_degraded", "degraded_speedup",
+                    "rebalance_overhead", "degraded_bitwise_identical",
+                    "degraded_gate_ok")}
             elif sn:
                 entry["sharded_northstar"] = sn
             ov = obj.get("oversubscribed_northstar")

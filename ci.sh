@@ -153,6 +153,18 @@ rm -rf "$OBS_SMOKE_DIR"
 # manifest (written by shard/process 0) accounting for every chunk
 python tests/_sharded_worker.py --smoke
 
+# elastic lane smoke (ISSUE 11): a journaled sharded walk with ONE LANE
+# KILLED mid-job must complete on the surviving lanes — the dead lane
+# retried, quarantined, its uncommitted chunks re-staged and recomputed
+# by survivors, its committed shards adopted — bitwise-identical to the
+# uninterrupted single-device walk, with the quarantine + owner-tagged
+# reassignment journaled in the merged manifest; then the SAME degraded
+# job is SIGKILLed mid-rebalance and resumed with the lane healthy:
+# quarantine must compose with crash-resume (the resume re-admits the
+# quarantined device and replays only truly-uncommitted work), again
+# bitwise vs the single-device walk
+python tests/_sharded_worker.py --elastic-smoke
+
 # host-resident kill-and-resume smoke (ISSUE 7): a journaled walk over a
 # panel that lives in HOST RAM — 4x oversubscribed against a virtual
 # one-chunk device budget, each chunk staged H2D through the pinned-style

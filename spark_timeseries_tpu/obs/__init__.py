@@ -43,6 +43,16 @@ counter), ``TimeSeriesPanel.fit`` / ``map_series``, the compat
 time-sharded ``ops.seqparallel`` ``sp_*_fit`` entry points (``sp_fit``
 spans with compile/execute first-dispatch tagging), and
 ``parallel.mesh.shard_series``.
+
+Elastic lane supervision (ISSUE 11, ``reliability.plan.LaneSupervisor``)
+reports its whole lifecycle here: a per-lane health gauge
+``lane.state.<shard>`` (``active`` / ``idle`` / ``retrying`` /
+``quarantined`` / ``done`` / ``stopped``), counters ``lane.retry`` /
+``lane.quarantine`` / ``lane.steal`` / ``lane.rebalance`` (spans moved
+between lanes), and shard-tagged events ``lane.retry`` /
+``lane.quarantine`` / ``lane.steal`` that ``tools/obs_report.py`` renders
+inside each lane's timeline row (with a degraded-run total in the
+header).
 """
 
 from . import core, memory, metrics, recorder

@@ -401,7 +401,12 @@ class TimeSeriesPanel:
         lane per series-axis device, bitwise-identical to the
         single-device walk on the same panel, with shard/process 0
         merging the per-shard journals into one job manifest (see
-        ``reliability.fit_chunked`` sharded execution).  Note this is the
+        ``reliability.fit_chunked`` sharded execution).  Sharded walks
+        are ELASTIC: a failing lane is retried then quarantined (its
+        chunks adopted/recomputed by survivors) and idle lanes steal from
+        stragglers — pass ``lane_retries=`` / ``rebalance_threshold=``
+        through ``fit_kwargs`` to tune the containment (see
+        ``reliability.fit_chunked`` elastic lanes).  Note this is the
         chunk DRIVER's mesh knob, independent of the panel's own
         ``mesh``-attached SPMD fit path.
 

@@ -37,6 +37,17 @@ bitwise-identical to it.  Under ``jax.distributed`` build the global
 panel with :func:`distribute_panel`
 (``jax.make_array_from_process_local_data``); each process then runs the
 lanes of its own addressable shards.
+
+**Elastic lanes** (ISSUE 11): the per-lane placement above is the
+STARTING layout, not ownership.  A single-process sharded walk may move
+chunks between lanes mid-job — a quarantined lane's uncommitted chunks
+and a straggler's stolen tail are re-staged to the computing lane's
+device on demand (``reliability.plan.RestagedPanel`` wraps the driver's
+resident panel in a ``device_put``-per-chunk view; source-backed lanes
+re-stage through ``SourceLane`` exactly as at startup).  Under
+``jax.distributed`` rows of another process are not addressable here, so
+multi-host walks keep the static layout — re-staging across hosts is the
+open ROADMAP item 5 follow-on.
 """
 
 from __future__ import annotations

@@ -19,7 +19,12 @@ same guarantees at row granularity:
   scheduler that owns one prefetch → compute → commit pipeline; the
   serial, pipelined, and mesh-sharded walks are all the same plan with
   one-vs-many lanes (``fit_chunked(shard=True)`` runs one lane per mesh
-  device, bitwise-identical to the single-device walk).
+  device, bitwise-identical to the single-device walk).  Sharded walks
+  are ELASTIC (:class:`~.plan.LaneSupervisor` + :class:`~.plan.WorkQueue`):
+  a failing lane is retried then quarantined — survivors adopt its
+  committed chunks and recompute the rest — and idle lanes steal
+  grid-aligned spans from stragglers, still bitwise-identical to the
+  uninterrupted single-device walk.
 - :mod:`.committer` — :class:`ChunkCommitter`: the pipelined driver's
   bounded background commit thread — journal commits and host I/O overlap
   the next chunk's device compute while preserving the journal's
@@ -50,11 +55,12 @@ from . import (chunked, committer, faultinject, journal, plan, prefetcher,
                runner, sanitize, source, status, watchdog)
 from .chunked import OOMBackoffExceeded, fit_chunked, is_resource_exhausted
 from .committer import ChunkCommitter, CommitterStats
-from .plan import ExecutionPlan, LaneRunner, LaneSpec, shard_spans
+from .plan import (ExecutionPlan, LaneRunner, LaneSpec, LaneSupervisor,
+                   RestagedPanel, WorkQueue, shard_spans)
 from .prefetcher import ChunkPrefetcher, PrefetchStats
 from .journal import (ChunkJournal, JournalError, MergeWarmer,
-                      StaleJournalError, TornManifestError, config_hash,
-                      merge_job_manifest, panel_fingerprint)
+                      ShardJournalView, StaleJournalError, TornManifestError,
+                      config_hash, merge_job_manifest, panel_fingerprint)
 from .source import (ChunkSource, DeviceChunkSource, HostChunkSource,
                      NpzShardSource, SourceError, StagingPool, as_source,
                      write_npz_shards)
@@ -86,7 +92,11 @@ __all__ = [
     "JournalError",
     "LaneRunner",
     "LaneSpec",
+    "LaneSupervisor",
     "OOMBackoffExceeded",
+    "RestagedPanel",
+    "ShardJournalView",
+    "WorkQueue",
     "ResilientFitResult",
     "RetryRung",
     "SanitizeReport",

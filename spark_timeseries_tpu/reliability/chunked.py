@@ -113,6 +113,9 @@ def fit_chunked(
     align_mode: Optional[str] = None,
     mesh=None,
     shard: bool = False,
+    lane_retries: int = 1,
+    lane_retry_backoff_s: float = 0.1,
+    rebalance_threshold: float = 4.0,
     process_index: Optional[int] = None,
     grid: Optional[tuple] = None,
     journal_extra: Optional[dict] = None,
@@ -249,6 +252,28 @@ def fit_chunked(
     backoff and timeout event, ``degraded=True`` whenever a backoff or
     timeout happened, and — when journaled — the journal accounting
     (``meta["journal"]``: run id, chunks committed/resumed/timeout).
+
+    **Elastic lanes** (ISSUE 11, single-process sharded walks): lane
+    failures no longer fail the job.  Lanes pull grid-aligned spans from
+    a shared work queue (seeded with the static partition, so a healthy
+    walk is layout-identical to PR 6); a lane whose walk raises is
+    retried up to ``lane_retries`` times with exponential backoff
+    (``lane_retry_backoff_s``), then QUARANTINED — its device leaves the
+    active set, its uncommitted chunks are re-staged to survivors'
+    devices and recomputed, and chunks it already committed are ADOPTED
+    from its journal namespace (chunk entries carry an ``owner`` lane
+    tag; the merged manifest reconciles reassigned chunks and records a
+    ``rebalance`` block).  Idle lanes STEAL the grid-aligned tail of a
+    straggler's remaining span once its projected finish exceeds
+    ``rebalance_threshold`` mean chunk walls.  Results stay
+    bitwise-identical to the uninterrupted single-device walk regardless
+    of which lane computed which chunk; SIGKILL-resume composes (a
+    resumed job re-admits previously quarantined devices and replays
+    only truly-uncommitted work); a job that loses ALL lanes still fails
+    with the original error.  ``meta["shards"]["elastic"]`` records
+    quarantines/steals/retries.  Under ``jax.distributed`` (host RAM is
+    process-local, so a process cannot re-stage another process's rows)
+    the static fail-fast layout is kept.
 
     **Grid coordinate** (``grid=(index, total)`` or
     ``(index, total, members)``): an auto-fit order search
@@ -589,6 +614,15 @@ def fit_chunked(
     # -- the plan, then its lanes -------------------------------------------
     lane_specs = tuple(LaneSpec(sid, slo, shi, dev)
                        for (sid, slo, shi, dev, _vals) in lanes)
+    # elastic supervision (ISSUE 11) applies to SINGLE-PROCESS multi-lane
+    # walks: under jax.distributed a process cannot re-stage another
+    # process's rows (they are not addressable here), so multi-host jobs
+    # keep the static fail-fast layout
+    try:
+        _n_procs = jax.process_count()
+    except Exception:  # noqa: BLE001 - no backend yet: single process
+        _n_procs = 1
+    elastic = sharded and len(lane_specs) > 1 and _n_procs <= 1
     plan = ExecutionPlan(
         n_rows=b,
         chunk_rows=chunk0,
@@ -609,14 +643,27 @@ def fit_chunked(
         process_index=int(process_index or 0),
         n_shards=len(spans) if sharded else 1,
         grid=grid,
+        elastic=elastic,
+        lane_retries=int(lane_retries),
+        lane_retry_backoff_s=float(lane_retry_backoff_s),
+        rebalance_threshold=float(rebalance_threshold),
     )
+    # journal handles: an elastic lane READS committed state across every
+    # shard namespace (adopting a quarantined/stolen-from lane's durable
+    # chunks) and WRITES only its own; static walks keep the direct handle
+    lane_journals = None
+    if journals is not None:
+        lane_journals = (
+            [journal_mod.ShardJournalView(j, journals) for j in journals]
+            if elastic else list(journals))
     runners = [
         LaneRunner(plan, spec, fit_fn, fit_kwargs, vals,
-                   journal=journals[i] if journals is not None else None,
+                   journal=(lane_journals[i] if lane_journals is not None
+                            else None),
                    deadline=deadline, tele=tele, fit_key=fit_key)
         for i, (spec, (_sid, _lo, _hi, _dev, vals))
         in enumerate(zip(lane_specs, lanes))
-    ]
+    ] if not elastic else None
     # overlap the root-manifest merge with the last lanes' tails (ISSUE 7
     # satellite, PR-6 follow-on): while slower lanes finish, shard/process 0
     # already READS and parses the shard manifests the committed lanes have
@@ -624,11 +671,30 @@ def fit_chunked(
     # that changed since.  Read-only by construction: the root manifest's
     # single writer is still merge_job_manifest, after the lanes join.
     warmer = None
-    if (journals is not None and sharded and len(runners) > 1
+    if (journals is not None and sharded and len(lane_specs) > 1
             and int(process_index or 0) == 0):
         warmer = journal_mod.MergeWarmer(checkpoint_dir, len(spans))
+    elastic_meta = None
     try:
-        if len(runners) == 1:
+        if elastic:
+            # elastic supervision (ISSUE 11): lanes pull spans from the
+            # shared work queue, failures quarantine instead of failing
+            # the job, idle lanes steal from stragglers, and reassigned
+            # spans are re-staged to the computing lane's device
+            def _restage(rlo, rhi, device):
+                if src is not None:
+                    return source_mod.SourceLane(src, base=rlo,
+                                                 device=device)
+                return plan_mod.RestagedPanel(yb, device=device, base=rlo)
+
+            supervisor = plan_mod.LaneSupervisor(
+                plan, fit_fn, fit_kwargs,
+                [(spec, vals) for spec, (_s, _l, _h, _d, vals)
+                 in zip(lane_specs, lanes)],
+                journals=lane_journals, deadline=deadline, tele=tele,
+                fit_key=fit_key, restage=_restage)
+            results, elastic_meta = supervisor.run()
+        elif len(runners) == 1:
             results = [runners[0].run()]
         else:
             results = [None] * len(runners)
@@ -669,7 +735,11 @@ def fit_chunked(
         raise
 
     # -- merge lanes ---------------------------------------------------------
+    # results arrive one per WALKED SPAN (an elastic lane can walk several);
+    # spans are disjoint and each result's pieces ascend, so the sort by
+    # span lo yields globally ascending pieces either way
     pieces = [p for r in results for p in r.pieces]
+    pieces.sort(key=lambda p: p[0])
     oom_events, timeout_events = [], []
     for r in results:
         tag = {"shard": r.spec.shard_id} if sharded else {}
@@ -731,9 +801,11 @@ def fit_chunked(
         meta["shards"] = {
             "n_shards": len(spans),
             "spans": [[int(slo), int(shi)] for slo, shi in spans],
-            "lanes_run": len(results),
+            "lanes_run": len({r.spec.shard_id for r in results}),
             "devices": [str(spec.device) for spec in lane_specs],
         }
+        if elastic_meta is not None:
+            meta["shards"]["elastic"] = elastic_meta
     if grid is not None:
         meta["grid"] = {"index": grid[0], "total": grid[1]}
         if grid_members is not None:
@@ -825,6 +897,7 @@ def fit_chunked(
                 telemetry=telemetry,
                 extra=journal_extra,
                 cache=warmer.stop() if warmer is not None else None,
+                rebalance=elastic_meta,
             )
         else:
             _distributed_barrier()
@@ -907,26 +980,32 @@ def _pipeline_meta(results, sharded: bool) -> Optional[dict]:
     pipe_meta["end_to_end_overlap_efficiency"] = (
         round(total_hidden / total_wall, 4) if total_wall > 0 else None)
     if sharded:
+        # per-shard accumulation: an ELASTIC lane (ISSUE 11) walks several
+        # spans — one LaneResult each — and its commit/staging accounting
+        # must sum into ONE row per shard, not overwrite
         by_shard: dict = {}
         for sid, s, _d in pipes:
-            by_shard.setdefault(sid, {"shard": sid})
-            by_shard[sid].update({
-                "commits_background": s.commits,
-                "commit_wall_s": round(s.commit_wall_s, 6),
-                "hidden_commit_s": round(s.hidden_s, 6),
-                "overlap_efficiency": (
-                    round(s.hidden_s / s.commit_wall_s, 4)
-                    if s.commit_wall_s > 0 else None),
+            e = by_shard.setdefault(sid, {"shard": sid})
+            cw = e.get("commit_wall_s", 0.0) + s.commit_wall_s
+            hc = e.get("hidden_commit_s", 0.0) + s.hidden_s
+            e.update({
+                "commits_background": e.get("commits_background", 0)
+                + s.commits,
+                "commit_wall_s": round(cw, 6),
+                "hidden_commit_s": round(hc, 6),
+                "overlap_efficiency": (round(hc / cw, 4) if cw > 0
+                                       else None),
             })
         for sid, s, _d in pfs:
-            by_shard.setdefault(sid, {"shard": sid})
-            by_shard[sid].update({
-                "chunks_staged": s.staged,
-                "staging_wall_s": round(s.staging_wall_s, 6),
-                "hidden_staging_s": round(s.hidden_s, 6),
-                "input_overlap_efficiency": (
-                    round(s.hidden_s / s.staging_wall_s, 4)
-                    if s.staging_wall_s > 0 else None),
+            e = by_shard.setdefault(sid, {"shard": sid})
+            sw = e.get("staging_wall_s", 0.0) + s.staging_wall_s
+            hs = e.get("hidden_staging_s", 0.0) + s.hidden_s
+            e.update({
+                "chunks_staged": e.get("chunks_staged", 0) + s.staged,
+                "staging_wall_s": round(sw, 6),
+                "hidden_staging_s": round(hs, 6),
+                "input_overlap_efficiency": (round(hs / sw, 4) if sw > 0
+                                             else None),
             })
         pipe_meta["shards"] = [by_shard[sid] for sid in sorted(by_shard)]
     return pipe_meta

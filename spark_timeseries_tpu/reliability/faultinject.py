@@ -26,6 +26,19 @@ process / raise mid-run after N durable chunk commits (between or mid
 commit, selectable), simulating preemption exactly where it hurts; and
 :func:`tear_file` truncates a manifest or shard to a prefix, simulating a
 torn write on a non-atomic filesystem.
+
+**Lane faults** (ISSUE 11 — the elastic sharded walk's quarantine and
+rebalance paths must be exercisable without real hardware failures):
+:func:`lane_kill` raises a :class:`SimulatedLaneFailure` on every fit
+call a designated lane dispatches (after an optional warm-up chunk
+count), simulating a dead device; :func:`slow_lane` delays one lane's
+every fit call, simulating a straggler chip the rebalancer must steal
+from; and :func:`lane_oom_storm` makes one lane's every allocation fail
+``RESOURCE_EXHAUSTED`` so its backoff ladder exhausts and the lane is
+quarantined.  All three key on :func:`~.watchdog.current_lane` — the
+thread-local lane tag the :class:`~.plan.LaneRunner` sets around each
+chunk dispatch — so the SAME wrapped fit behaves normally on every
+other lane, deterministically.
 """
 
 from __future__ import annotations
@@ -34,14 +47,16 @@ import functools
 import os
 import signal
 import time
-from typing import Callable
+from typing import Callable, Optional
 
 import numpy as np
 
 from .status import STATUS_DTYPE, FitStatus
+from .watchdog import current_lane
 
 __all__ = [
     "SimulatedCrash",
+    "SimulatedLaneFailure",
     "SimulatedResourceExhausted",
     "inject_nan_rows",
     "inject_inf_rows",
@@ -54,6 +69,9 @@ __all__ = [
     "hanging_fit",
     "kill_after_commits",
     "crash_after_commits",
+    "lane_kill",
+    "lane_oom_storm",
+    "slow_lane",
     "tear_file",
 ]
 
@@ -299,6 +317,84 @@ def crash_after_commits(n: int, *, mid_commit: bool = False) -> Callable:
                 f"simulated process death after {n} {event} events")
 
     return hook
+
+
+# ---------------------------------------------------------------------------
+# lane faults (ISSUE 11: elastic sharded walk — quarantine and rebalance)
+# ---------------------------------------------------------------------------
+
+
+class SimulatedLaneFailure(RuntimeError):
+    """Stands in for a dead lane device: an exception no backoff ladder can
+    absorb (not RESOURCE_EXHAUSTED, not a watchdog timeout), so the elastic
+    supervisor's retry → quarantine path is the only recovery."""
+
+    def __init__(self, shard_id: int):
+        super().__init__(
+            f"lane shard={shard_id} failed "
+            "(simulated by reliability.faultinject.lane_kill)")
+        self.shard_id = int(shard_id)
+
+
+def lane_kill(fit_fn: Callable, shard_id: int, after_chunks: int = 0,
+              n_failures: Optional[int] = None) -> Callable:
+    """Wrap ``fit_fn`` so lane ``shard_id``'s fit calls raise
+    :class:`SimulatedLaneFailure` after ``after_chunks`` successful calls.
+
+    ``n_failures=None`` (default) is a PERMANENT death — every later call
+    on that lane fails too, so the supervisor's retries burn out and the
+    lane is quarantined, its span reassigned to survivors.  An integer
+    makes the fault TRANSIENT (the lane recovers after that many
+    failures), exercising the retry-without-quarantine path.  Calls from
+    other lanes (or outside any lane) pass through untouched.
+    """
+    state = {"ok": 0, "failed": 0}
+
+    @functools.wraps(fit_fn)
+    def wrapped(yb, **kwargs):
+        if current_lane() == int(shard_id):
+            if state["ok"] >= int(after_chunks) and (
+                    n_failures is None or state["failed"] < int(n_failures)):
+                state["failed"] += 1
+                raise SimulatedLaneFailure(int(shard_id))
+            state["ok"] += 1
+        return fit_fn(yb, **kwargs)
+
+    return wrapped
+
+
+def slow_lane(fit_fn: Callable, shard_id: int, delay_s: float) -> Callable:
+    """Wrap ``fit_fn`` so lane ``shard_id`` stalls ``delay_s`` before every
+    fit call — a deterministic straggler chip.  The elastic walk's idle
+    survivors should STEAL the straggler's unstarted chunks once its
+    projected finish blows the rebalance threshold; the fault follows the
+    LANE, so stolen chunks run at full speed on their new lane."""
+
+    @functools.wraps(fit_fn)
+    def wrapped(yb, **kwargs):
+        if current_lane() == int(shard_id):
+            time.sleep(float(delay_s))
+        return fit_fn(yb, **kwargs)
+
+    return wrapped
+
+
+def lane_oom_storm(fit_fn: Callable, shard_id: int) -> Callable:
+    """Wrap ``fit_fn`` so every fit call on lane ``shard_id`` raises a
+    simulated ``RESOURCE_EXHAUSTED`` — an allocator storm no chunk halving
+    survives.  The lane's OOM backoff ladder exhausts
+    (``OOMBackoffExceeded``), its retries re-exhaust, and the elastic
+    supervisor quarantines it; survivors recompute its chunks at their own
+    (healthy) chunk size."""
+
+    @functools.wraps(fit_fn)
+    def wrapped(yb, **kwargs):
+        if current_lane() == int(shard_id):
+            raise SimulatedResourceExhausted(
+                int(np.prod(np.asarray(yb.shape))) * 4)
+        return fit_fn(yb, **kwargs)
+
+    return wrapped
 
 
 def tear_file(path: str, keep_frac: float = 0.5) -> None:

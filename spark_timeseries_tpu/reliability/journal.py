@@ -74,6 +74,7 @@ __all__ = [
     "JournalError",
     "LoadedChunk",
     "MergeWarmer",
+    "ShardJournalView",
     "StaleJournalError",
     "TornManifestError",
     "config_hash",
@@ -415,6 +416,23 @@ class ChunkJournal:
                       if e["status"] == "committed" and e["lo"] > int(lo)]
         return min(starts) if starts else None
 
+    def committed_crossing(self, pos: int) -> Optional[int]:
+        """``hi`` of the once-committed chunk that strictly contains row
+        ``pos`` (``lo < pos < hi``), or None.  The elastic steal path
+        (ISSUE 11) must never split a span inside such a chunk — a
+        previous run's OOM backoff can leave off-grid boundaries — or
+        thief and victim would both compute its rows.  ``shard-lost``
+        entries (a committed chunk whose npz tore) count too: the walk
+        recomputes them as FORCED boundaries pinned to the recorded
+        ``[lo, hi)``, dispatching past any narrower steal split."""
+        pos = int(pos)
+        with self._mu:
+            for e in self._manifest["chunks"]:
+                if e["status"] in ("committed", "shard-lost") \
+                        and e["lo"] < pos < e["hi"]:
+                    return int(e["hi"])
+        return None
+
     def load_chunk(self, entry: dict) -> Optional[LoadedChunk]:
         """Rehydrate a committed chunk; ``None`` (recompute) when the shard
         is missing or unreadable — a shard torn by a crash downgrades to a
@@ -426,17 +444,20 @@ class ChunkJournal:
                                      ("params", "nll", "converged", "iters",
                                       "status")}, entry)
         except (OSError, ValueError, KeyError, zipfile.BadZipFile):
-            entry["status"] = "shard-lost"
-            self._write_manifest()
-            self._by_lo.pop(entry["lo"], None)
+            with self._mu:
+                entry["status"] = "shard-lost"
+                self._write_manifest()
+                self._by_lo.pop(entry["lo"], None)
             return None
         if piece.params.shape[0] != entry["hi"] - entry["lo"]:
-            entry["status"] = "shard-lost"
-            self._write_manifest()
-            self._by_lo.pop(entry["lo"], None)
+            with self._mu:
+                entry["status"] = "shard-lost"
+                self._write_manifest()
+                self._by_lo.pop(entry["lo"], None)
             return None
-        self.resumed_entries += 1  # resumed = actually rehydrated, not
-        obs.counter("journal.chunks_resumed").inc()  # (torn shards recompute)
+        with self._mu:  # elastic lanes may ADOPT from a peer namespace
+            self.resumed_entries += 1  # concurrently (ISSUE 11); resumed =
+        obs.counter("journal.chunks_resumed").inc()  # actually rehydrated
         return piece
 
     def _record(self, entry: dict) -> None:
@@ -562,6 +583,71 @@ def check_root_manifest(directory: str, *, config_hash: str,
             "checkpoint_dir or remove the stale journal explicitly.")
 
 
+class ShardJournalView:
+    """One elastic lane's journal handle: WRITE to its own shard namespace,
+    READ committed state across EVERY namespace of the job (ISSUE 11).
+
+    Under elastic reassignment a chunk's durable shard can live in any
+    lane's namespace — the lane that COMPUTED it (tagged ``owner`` in its
+    manifest entry), which after a quarantine, a steal, or a resumed
+    rebalanced job need not be the lane whose nominal span contains it.
+    The walk's resume/skip logic (``committed`` / ``load_chunk`` /
+    ``next_committed_lo`` / ``committed_crossing``) therefore consults the
+    lane's own journal first, then every peer namespace, ADOPTING foreign
+    commits instead of recomputing them — "resume replays only
+    truly-uncommitted work".  Writes (``commit_chunk`` / ``mark_timeout``)
+    go exclusively to the lane's own namespace, so the journal's
+    single-writer-per-namespace protocol is untouched; a loaded entry is
+    always rehydrated (and, on a torn shard, downgraded) by the journal
+    that OWNS it, so its manifest bookkeeping stays correct.
+    """
+
+    def __init__(self, own: ChunkJournal, peers):
+        self.own = own
+        self.peers = [p for p in peers if p is not own]
+        # lo -> journal holding the committed entry last returned for it;
+        # load_chunk must dispatch to that journal (paths are
+        # namespace-relative, and a torn-shard downgrade must hit the
+        # owning manifest).  One view per lane; the rare concurrent writer
+        # is a watchdog-abandoned worker re-probing the same lo, which
+        # writes the same value.
+        self._found_in: dict = {}
+
+    def committed(self, lo: int):
+        e = self.own.committed(lo)
+        if e is not None:
+            self._found_in[int(lo)] = self.own
+            return e
+        for j in self.peers:
+            e = j.committed(lo)
+            if e is not None:
+                self._found_in[int(lo)] = j
+                return e
+        return None
+
+    def load_chunk(self, entry: dict):
+        j = self._found_in.get(int(entry["lo"]), self.own)
+        return j.load_chunk(entry)
+
+    def next_committed_lo(self, lo: int):
+        cands = [j.next_committed_lo(lo) for j in (self.own, *self.peers)]
+        cands = [c for c in cands if c is not None]
+        return min(cands) if cands else None
+
+    def committed_crossing(self, pos: int):
+        for j in (self.own, *self.peers):
+            x = j.committed_crossing(pos)
+            if x is not None:
+                return x
+        return None
+
+    def commit_chunk(self, *args, **kwargs):
+        return self.own.commit_chunk(*args, **kwargs)
+
+    def mark_timeout(self, *args, **kwargs):
+        return self.own.mark_timeout(*args, **kwargs)
+
+
 class MergeWarmer:
     """Overlap the sharded root-manifest merge with the last lanes' tails.
 
@@ -638,6 +724,7 @@ def merge_job_manifest(
     telemetry: Optional[dict] = None,
     extra: Optional[dict] = None,
     cache: Optional[dict] = None,
+    rebalance: Optional[dict] = None,
 ) -> dict:
     """Fold the shard-namespace manifests of a sharded walk into the ONE
     job-level ``manifest.json`` at the journal root, and return the merged
@@ -667,6 +754,19 @@ def merge_job_manifest(
     unchanged since the warmer saw them — the merge I/O then overlapped
     the last lanes' tails instead of following them.  Validation runs on
     the cached parse exactly as on a fresh read.
+
+    **Elastic reconciliation** (ISSUE 11): a quarantined or stolen-from
+    lane's chunks are committed by SURVIVORS into the survivors'
+    namespaces, each entry tagged with its computing ``owner`` lane.  The
+    merge reconciles by row range: per chunk ``lo`` a ``committed`` entry
+    wins over a stale ``TIMEOUT``/pending duplicate from another
+    namespace, every entry keeps its namespace-rooted npz path plus its
+    ``owner`` tag, each ``shards[*]`` entry records its ``owner`` identity
+    and how many of its committed chunks were reassigned in from other
+    lanes' nominal spans, and the driver's quarantine/steal record lands
+    as a top-level ``rebalance`` block (``tools/obs_report.py --check``
+    validates all three; ``tools/advise_budget.py`` turns them into
+    ``lane_retries``/``rebalance_threshold`` advice).
     """
     root = os.path.abspath(directory)
     # the root manifest is another job's write-ahead record until proven
@@ -742,7 +842,35 @@ def merge_job_manifest(
                                   if e["status"] == "TIMEOUT"),
             "resumes": len(m.get("resumes") or []),
         })
-    chunks.sort(key=lambda e: e["lo"])
+    # elastic reconciliation: one entry per chunk lo.  A chunk marked
+    # TIMEOUT (or left pending) by one lane and later COMMITTED by another
+    # must merge as committed — the committed shard is the durable truth,
+    # and a duplicate entry would double-count its rows
+    by_lo: dict = {}
+    for e in chunks:
+        cur = by_lo.get(e["lo"])
+        if cur is None or (e["status"] == "committed"
+                           and cur["status"] != "committed"):
+            by_lo[e["lo"]] = e
+    chunks = sorted(by_lo.values(), key=lambda e: e["lo"])
+    # per-shard accounting is recomputed from the RECONCILED entries: a
+    # TIMEOUT mark another lane later resolved as committed must not
+    # linger in its namespace's totals (post-mortems and advise_budget
+    # would report a timeout no chunk in the final result has).  Plus the
+    # owner accounting: entries in this namespace whose rows fall OUTSIDE
+    # its nominal span were reassigned in (a quarantine hand-off or a
+    # steal) — a journaled fact read from the manifest alone
+    for s in shards:
+        sid, (slo, shi) = s["shard_id"], (s["lo"], s["hi"])
+        mine = [e for e in chunks if e.get("shard_id") == sid]
+        s["chunks_committed"] = sum(1 for e in mine
+                                    if e["status"] == "committed")
+        s["chunks_timeout"] = sum(1 for e in mine
+                                  if e["status"] == "TIMEOUT")
+        s["owner"] = sid
+        s["chunks_reassigned_in"] = sum(
+            1 for e in mine if e["status"] == "committed"
+            and not (slo <= e["lo"] and e["hi"] <= shi))
     manifest = {
         "journal_version": JOURNAL_VERSION,
         "run_id": run_id or uuid.uuid4().hex[:12],
@@ -760,6 +888,12 @@ def merge_job_manifest(
         "chunks": chunks,
         "shards": shards,
     }
+    if rebalance is not None:
+        manifest["rebalance"] = {
+            **rebalance,
+            "reassigned_chunks": sum(s["chunks_reassigned_in"]
+                                     for s in shards),
+        }
     if telemetry is not None:
         manifest["telemetry"] = telemetry
     _atomic_write_bytes(
@@ -777,6 +911,8 @@ def merge_job_manifest(
         "chunks_committed": sum(s["chunks_committed"] for s in shards),
         "chunks_timeout": sum(s["chunks_timeout"] for s in shards),
         "shards": shards,
+        **({"rebalance": manifest["rebalance"]}
+           if rebalance is not None else {}),
     }
 
 

@@ -39,10 +39,34 @@ walk (the merged entries keep their shard-relative paths).  The reverse
 is not adoption: a sharded plan's lanes journal into fresh shard
 namespaces, so chunks a root/serial manifest already committed are
 recomputed (identical bytes, just repeated work), never spliced.
+
+**Elastic lanes** (ISSUE 11).  Through PR 9 a sharded walk inverted the
+reference's resilience promise: one lane hitting an unrecoverable fit
+exception, an exhausted OOM-backoff ladder, or a dead device failed the
+ENTIRE job, and a straggler lane paced every healthy device.  The
+:class:`LaneSupervisor` restores the Spark contract at lane granularity:
+lanes PULL grid-aligned spans from a shared lock-protected
+:class:`WorkQueue` instead of owning a static partition; a lane whose
+walk raises is retried up to ``lane_retries`` times with backoff, then
+**quarantined** — its device leaves the active set, its *uncommitted*
+chunks are re-enqueued and recomputed by survivors (committed shards are
+ADOPTED from the dead lane's journal namespace via the cross-namespace
+:class:`~.journal.ShardJournalView`, so only truly-uncommitted work
+replays), and each idle survivor re-stages reassigned chunks to its own
+device (:class:`RestagedPanel` / ``SourceLane``, O(chunk) either way).
+Stragglers rebalance the same way: an idle lane STEALS the grid-aligned
+tail of the slowest lane's remaining span when that lane's projected
+finish exceeds ``rebalance_threshold`` mean chunk walls.  Every steal
+boundary stays on the single-device chunk grid (and never splits a
+committed chunk), so the walk's results remain bitwise-identical to the
+uninterrupted single-device walk regardless of which lane computed which
+chunk; a job that loses ALL lanes still fails with the original error.
 """
 
 from __future__ import annotations
 
+import math
+import threading
 import time
 from typing import Callable, NamedTuple, Optional, Sequence, Tuple
 
@@ -62,7 +86,10 @@ __all__ = [
     "ExecutionPlan",
     "LaneRunner",
     "LaneSpec",
+    "LaneSupervisor",
     "OOMBackoffExceeded",
+    "RestagedPanel",
+    "WorkQueue",
     "is_resource_exhausted",
     "shard_spans",
 ]
@@ -141,6 +168,16 @@ class ExecutionPlan(NamedTuple):
     # from the journal config hash — the order itself rides in fit_kwargs,
     # which IS hashed; the coordinate only labels where work happened.
     grid: Optional[Tuple[int, int]] = None
+    # ELASTIC knobs (ISSUE 11) — like every other plan knob they move work
+    # between lanes without changing a byte, so none are config-hashed.
+    # ``elastic`` is resolved by the driver: True for single-process
+    # multi-lane walks (under jax.distributed a process cannot re-stage
+    # another process's rows, so those keep the fail-fast static layout).
+    elastic: bool = False
+    lane_retries: int = 1  # failed-lane retries before quarantine
+    lane_retry_backoff_s: float = 0.1  # first retry's backoff (doubles)
+    rebalance_threshold: float = 4.0  # steal when a lane's projected
+    # remaining wall exceeds this many mean chunk walls
 
     @property
     def sharded(self) -> bool:
@@ -239,6 +276,31 @@ class _LaneView:
         return self.arr[s.start - self.base:s.stop - self.base]
 
 
+class RestagedPanel:
+    """Device-staging view over the driver's resident panel, for a lane
+    walking a REASSIGNED span (quarantine hand-off or a straggler steal —
+    ISSUE 11): the lane's device never held those rows, so each chunk's
+    slice is staged to it on demand — ``device_put(panel[lo:hi], device)``,
+    the same bytes the original lane's resident slice held, at O(chunk)
+    device footprint (the SourceLane pattern, for in-HBM panels).
+
+    Local coordinates: row 0 is global row ``base`` (the reassigned span's
+    lo), matching the lane-array convention ``LaneRunner`` slices with.
+    """
+
+    __slots__ = ("arr", "device", "base")
+
+    def __init__(self, arr, device=None, base: int = 0):
+        self.arr = arr
+        self.device = device
+        self.base = int(base)
+
+    def __getitem__(self, s: slice):
+        vals = self.arr[s.start + self.base:s.stop + self.base]
+        return (jax.device_put(vals, self.device)
+                if self.device is not None else jax.numpy.asarray(vals))
+
+
 class LaneResult(NamedTuple):
     """Everything one lane hands back to the driver for merging."""
 
@@ -290,12 +352,31 @@ class LaneRunner:
         self.tag = {"shard": spec.shard_id} if plan.sharded else {}
         if plan.grid is not None:
             self.tag = {**self.tag, "grid": int(plan.grid[0])}
+        # sharded journal entries — commits AND timeout marks — record the
+        # lane that produced them (ISSUE 11): under elastic reassignment
+        # either kind can land in a namespace whose nominal span does not
+        # contain it, and the merge/validators reconcile by this tag.
+        # Single-device manifests stay byte-identical (no tag).
+        self._owner = {"owner": spec.shard_id} if plan.sharded else {}
         # source-backed lanes (ISSUE 7): `values` is a SourceLane over a
         # host-resident ChunkSource — every chunk, including a whole-span
         # one, must be STAGED (there is no resident device array to hand
         # through), and the staged buffer is donated back to the allocator
-        # the moment the chunk's fit drops it
-        self._from_source = isinstance(values, source_mod.SourceLane)
+        # the moment the chunk's fit drops it.  RestagedPanel (ISSUE 11)
+        # is the in-HBM twin for reassigned spans: same rule.
+        self._from_source = isinstance(
+            values, (source_mod.SourceLane, RestagedPanel))
+        # elastic-steal state (ISSUE 11): the span's END is mutable — an
+        # idle lane may steal the grid-aligned tail of the remaining span
+        # (try_steal, called from ANOTHER thread) — so every read of the
+        # span end and every dispatch-boundary decision happens under one
+        # lock, and nothing at/before _busy_hi can ever be stolen
+        self._mu = threading.Lock()
+        self._hi = spec.hi
+        self._busy_hi = spec.lo
+        self._steal_closed = False
+        self._rows_done = 0  # rows COMPUTED by this runner (not resumed)
+        self._t0: Optional[float] = None
 
         span_rows = spec.hi - spec.lo
         self.chunk = max(1, min(plan.chunk_rows, span_rows))
@@ -329,6 +410,86 @@ class LaneRunner:
     def _slice(self, lo: int, hi: int):
         base = self.spec.lo
         return self.values[lo - base:hi - base]
+
+    # -- elastic span (ISSUE 11) ---------------------------------------------
+
+    @property
+    def hi(self) -> int:
+        """The span's CURRENT end — shrinks when an idle lane steals the
+        tail (``try_steal``)."""
+        with self._mu:
+            return self._hi
+
+    def progress(self) -> dict:
+        """Live walk telemetry for the supervisor's rebalance decision."""
+        with self._mu:
+            return {
+                "rows_done": self._rows_done,
+                "rows_remaining": max(0, self._hi - self._busy_hi),
+                "elapsed_s": (time.perf_counter() - self._t0
+                              if self._t0 is not None else 0.0),
+            }
+
+    def try_steal(self) -> Optional[Tuple[int, int]]:
+        """Give up the grid-aligned tail of this lane's remaining span to
+        an idle lane; returns the stolen ``(lo, hi)`` or None.
+
+        The split lands on the single-device chunk grid (multiples of the
+        plan's ``chunk_rows`` — the invariant the bitwise contract rests
+        on), strictly beyond everything this lane has dispatched or
+        resumed (``_busy_hi``), keeps the victim at least half the
+        remaining whole chunks, and never lands strictly inside a chunk
+        some namespace already committed (a previous run's OOM backoff
+        can leave off-grid committed boundaries; splitting one would make
+        thief and victim double-compute its rows).  Staged slices are
+        invalidated — every prediction past the split is now wrong.
+        """
+        chunk0 = max(1, int(self.plan.chunk_rows))
+        with self._mu:
+            if self._steal_closed:
+                return None
+            hi = self._hi
+            base = max(self._busy_hi, self.spec.lo)
+            g0 = -(-base // chunk0) * chunk0
+            if g0 >= hi:
+                return None
+            n_rem = -(-(hi - g0) // chunk0)  # whole grid chunks left
+            if n_rem < 2:
+                return None
+            split = g0 + ((n_rem + 1) // 2) * chunk0  # victim keeps ceil
+            if self.journal is not None:
+                for _ in range(n_rem):
+                    x = self.journal.committed_crossing(split)
+                    if x is None:
+                        break
+                    split = int(x)
+            if split <= base or split >= hi:
+                return None
+            self._hi = split
+        if self.prefetcher is not None:
+            # staged predictions past the split belong to the thief now;
+            # dropping ALL staged slices is conservative but safe (a kept
+            # span degrades to an inline slice — a miss, never a wrong one)
+            self.prefetcher.invalidate()
+        return split, hi
+
+    def close_steals(self) -> int:
+        """Atomically close the span to further steals and return its
+        FINAL end.  The supervisor calls this the moment a runner's walk
+        fails: the retry/quarantine hand-off re-walks ``[lo, hi)``, and a
+        steal landing between the failure and that hand-off would make
+        the stolen tail both the thief's work and the retry's — duplicate
+        rows in the assembled result.  Steals that completed before the
+        close already shrank ``_hi``, so the returned end excludes them.
+        """
+        with self._mu:
+            self._steal_closed = True
+            return self._hi
+
+    def _note_busy(self, row: int) -> None:
+        with self._mu:
+            if row > self._busy_hi:
+                self._busy_hi = row
 
     # -- backoff / rollback --------------------------------------------------
 
@@ -382,7 +543,8 @@ class LaneRunner:
         ``lo``).  Returns None at the lane end or when the next span is
         already committed (the resume path loads it from its shard — no
         device slice needed)."""
-        if nlo >= self.spec.hi:
+        span_hi = self.hi
+        if nlo >= span_hi:
             return None
         journal = self.journal
         if journal is not None and journal.committed(nlo) is not None:
@@ -390,7 +552,7 @@ class LaneRunner:
         forced = self.lost_boundaries.get(nlo)
         if forced:
             return nlo, forced[0]
-        nhi = min(nlo + cur_chunk, self.spec.hi)
+        nhi = min(nlo + cur_chunk, span_hi)
         if journal is not None:
             nxt = journal.next_committed_lo(nlo)
             if nxt is not None and nxt < nhi:
@@ -410,8 +572,15 @@ class LaneRunner:
     # -- the walk ------------------------------------------------------------
 
     def run(self) -> LaneResult:
+        self._t0 = time.perf_counter()
         try:
-            self._walk()
+            # sharded lanes tag their thread (and, via the watchdog, their
+            # budgeted workers) with the shard id: lane-targeted fault
+            # injection and per-lane accounting key on it.  Single-lane
+            # walks stay untagged — byte-identical to the pre-plan driver.
+            with watchdog_mod.lane_context(
+                    self.spec.shard_id if self.plan.sharded else None):
+                self._walk()
         except BaseException:
             if self.committer is not None:
                 # the walk is failing: stop the worker without letting a
@@ -442,7 +611,7 @@ class LaneRunner:
                 if err is not None:
                     lo, self.chunk = self._rollback(err)
                     continue
-            if lo >= spec.hi:
+            if lo >= self.hi:
                 # final drain: a commit of one of the last chunks may still
                 # fail (or OOM at fetch) — that must surface (or roll the
                 # walk back) BEFORE assembly reads the pieces
@@ -456,6 +625,7 @@ class LaneRunner:
                 if entry is not None:
                     piece = journal.load_chunk(entry)
                     if piece is not None:
+                        self._note_busy(int(entry["hi"]))  # not stealable
                         self.pieces.append((lo, int(entry["hi"]), piece))
                         if tele:
                             self.tele_chunks.append(
@@ -472,16 +642,23 @@ class LaneRunner:
                         int(entry["hi"]),
                         int(entry.get("chunk_rows_after", self.chunk)))
             forced = self.lost_boundaries.get(lo)
-            hi = forced[0] if forced else min(lo + self.chunk, spec.hi)
-            if journal is not None and not forced:
-                # keep the walk on the committed grid: after an OOM backoff
-                # whose halving does not divide the original chunk size, a
-                # free-running hi would sail past the next committed chunk's
-                # lo, orphaning it (never matched again) and double-counting
-                # its rows in the manifest — clamp to the boundary instead
-                nxt = journal.next_committed_lo(lo)
-                if nxt is not None and nxt < hi:
-                    hi = nxt
+            # the chunk boundary is decided and PUBLISHED (as _busy_hi)
+            # under the span lock, so a concurrent try_steal can never
+            # split inside a chunk this iteration is about to dispatch
+            with self._mu:
+                hi = forced[0] if forced else min(lo + self.chunk, self._hi)
+                if journal is not None and not forced:
+                    # keep the walk on the committed grid: after an OOM
+                    # backoff whose halving does not divide the original
+                    # chunk size, a free-running hi would sail past the next
+                    # committed chunk's lo, orphaning it (never matched
+                    # again) and double-counting its rows in the manifest —
+                    # clamp to the boundary instead
+                    nxt = journal.next_committed_lo(lo)
+                    if nxt is not None and nxt < hi:
+                        hi = nxt
+                if hi > self._busy_hi:
+                    self._busy_hi = hi
             if deadline.exceeded():
                 err = self._drain_for_journal_write()
                 if err is not None:
@@ -504,7 +681,8 @@ class LaneRunner:
                 if journal is not None:
                     journal.mark_timeout(lo, hi, scope="job",
                                          budget_s=deadline.budget_s,
-                                         chunk_rows_after=self.chunk)
+                                         chunk_rows_after=self.chunk,
+                                         **self._owner)
                 lo = hi
                 continue
 
@@ -601,7 +779,8 @@ class LaneRunner:
                 if journal is not None:
                     journal.mark_timeout(lo, hi, scope="chunk",
                                          budget_s=plan.chunk_budget_s,
-                                         chunk_rows_after=self.chunk)
+                                         chunk_rows_after=self.chunk,
+                                         **self._owner)
                 lo = hi
                 continue
             except Exception as e:  # noqa: BLE001 - filtered just below
@@ -639,6 +818,7 @@ class LaneRunner:
                                          **self.tag, **_span_times(sp)})
             if journal is not None:
                 wall_s = round(time.perf_counter() - t0, 4)
+                owner = self._owner
                 if self.committer is not None and not forced:
                     # background commit: the fetch + shard + manifest update
                     # overlap the next chunk's dispatch/compute.  chunk_rows
@@ -646,7 +826,8 @@ class LaneRunner:
                     # recorded backoff state matches the serial walk exactly
                     try:
                         self.committer.submit(lo, hi, piece, wall_s=wall_s,
-                                              chunk_rows_after=self.chunk)
+                                              chunk_rows_after=self.chunk,
+                                              **owner)
                     except BaseException as se:
                         err = self.committer.take_error()
                         # only the worker's OWN re-raised error enters the
@@ -679,6 +860,395 @@ class LaneRunner:
                         # the job's whole footprint (obs.memory)
                         **({"peak_staging_pool_bytes": pm.staging_pool_bytes}
                            if pm.staging_pool_bytes is not None else {}),
+                        **owner,
                     )
             self.pieces.append((lo, hi, piece))
+            with self._mu:
+                self._rows_done += hi - lo
             lo = hi
+
+
+# ---------------------------------------------------------------------------
+# elastic lane scheduling (ISSUE 11): work queue, supervision, rebalance
+# ---------------------------------------------------------------------------
+
+
+class WorkQueue:
+    """Lock-protected queue of chunk-grid spans the elastic lanes pull.
+
+    Seeded with the static shard partition, each span PREFERRED by its
+    nominal lane — so a healthy walk pulls exactly the spans the static
+    layout would have assigned and stays namespace- and byte-identical to
+    it.  A quarantined lane's span re-enters unpreferred and is picked up
+    by whichever survivor goes idle first.  ``cond`` is the one condition
+    variable the whole supervisor synchronizes on (push, pull, lane
+    completion, fatal errors): lanes never take it while holding a
+    runner/journal lock, so the lock order cond → runner → journal is
+    acyclic.
+    """
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self._spans: list = []  # (lo, hi, preferred_sid-or-None)
+
+    def push(self, lo: int, hi: int, preferred: Optional[int] = None) -> None:
+        with self.cond:
+            self._push_locked(lo, hi, preferred)
+            self.cond.notify_all()
+
+    def _push_locked(self, lo: int, hi: int,
+                     preferred: Optional[int] = None) -> None:
+        self._spans.append((int(lo), int(hi), preferred))
+        self._spans.sort(key=lambda s: s[0])
+
+    def _pull_locked(self, sid: int) -> Optional[Tuple[int, int]]:
+        """Lowest-lo span preferred by ``sid``, else lowest-lo UNPREFERRED
+        span.  A span preferred by ANOTHER lane is never poached: its lane
+        is alive and will pull it (at thread-startup a fast lane could
+        otherwise grab a peer's span before that peer's thread is even
+        scheduled — work the peer's device should do, and the surface
+        lane-targeted fault injection and per-lane accounting key on);
+        quarantine strips the dead lane's preference first
+        (:meth:`release_preference`), so nothing is ever stranded."""
+        pick = None
+        for i, (_lo, _hi, pref) in enumerate(self._spans):
+            if pref == sid:
+                pick = i
+                break
+            if pref is None and pick is None:
+                pick = i
+        if pick is None:
+            return None
+        lo, hi, _ = self._spans.pop(pick)
+        return lo, hi
+
+    def _release_preference_locked(self, sid: int) -> None:
+        self._spans = [(lo, hi, None if pref == sid else pref)
+                       for lo, hi, pref in self._spans]
+
+    def pending(self) -> list:
+        with self.cond:
+            return [(lo, hi) for lo, hi, _ in self._spans]
+
+
+class LaneSupervisor:
+    """Elastic scheduler for a multi-lane sharded walk (ISSUE 11).
+
+    One supervisor thread per lane device, each looping pull → walk →
+    pull over the shared :class:`WorkQueue`.  Failure containment per the
+    module docstring: an ``Exception`` escaping a lane's walk (fit bug,
+    exhausted OOM ladder, dead device) is retried up to
+    ``plan.lane_retries`` times with exponential backoff, then the lane is
+    QUARANTINED — its span re-enqueued for survivors, who re-stage the
+    rows to their own devices (``restage``) and adopt whatever chunks the
+    dead lane already committed (the per-lane journal handle is a
+    cross-namespace :class:`~.journal.ShardJournalView`).  A
+    ``BaseException`` (KeyboardInterrupt, the fault harness's
+    ``SimulatedCrash`` standing in for SIGKILL) is FATAL: no quarantine,
+    no reassignment — it re-raises from :meth:`run` exactly as the static
+    layout would, so crash-resume semantics are unchanged.  Idle lanes
+    STEAL from stragglers via ``LaneRunner.try_steal`` once the victim's
+    projected remaining wall exceeds ``plan.rebalance_threshold`` mean
+    chunk walls.  If every lane is quarantined with work remaining, the
+    FIRST lane's original error re-raises — a job that loses all lanes
+    still fails loudly.
+    """
+
+    def __init__(self, plan: ExecutionPlan, fit_fn: Callable,
+                 fit_kwargs: dict, lanes: Sequence[tuple], *,
+                 journals: Optional[Sequence] = None, deadline=None,
+                 tele: bool = False, fit_key=None,
+                 restage: Optional[Callable] = None):
+        self.plan = plan
+        self.fit_fn = fit_fn
+        self.fit_kwargs = fit_kwargs
+        self.lanes = list(lanes)  # [(LaneSpec, values), ...]
+        self.journals = list(journals) if journals is not None else None
+        self.deadline = deadline or watchdog_mod.Deadline(plan.job_budget_s)
+        self.tele = tele
+        self.fit_key = fit_key
+        self.restage = restage
+
+        self.queue = WorkQueue()
+        self.results: list = []
+        self._active: dict = {}  # sid -> live LaneRunner (steal victims)
+        self._busy: set = set()  # sids mid-span (walking or retry backoff)
+        self._journal_by_sid = {}
+        if self.journals is not None:
+            for (spec, _v), j in zip(self.lanes, self.journals):
+                self._journal_by_sid[spec.shard_id] = j
+        self._lane_mean_wall: dict = {}  # sid -> mean computed-chunk wall
+        self._global_walls: list = []  # (n_chunks, wall_s) per finished span
+        self._quarantined: list = []
+        self._errors: list = []
+        self._fatal: Optional[BaseException] = None
+        self._steals = 0
+        self._retries = 0
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _state(self, sid: int, state: str) -> None:
+        obs.gauge(f"lane.state.{sid}").set(state)
+
+    def _mean_chunk_wall(self, sid: int) -> Optional[float]:
+        ref = self._lane_mean_wall.get(sid)
+        if ref:
+            return ref
+        n = sum(c for c, _w in self._global_walls)
+        w = sum(w for _c, w in self._global_walls)
+        return (w / n) if n else None
+
+    def _pick_victim_locked(self, thief_sid: int):
+        """The active lane worth stealing from, or None.  Called under the
+        queue cond; reads each runner's live progress (runner lock)."""
+        ref = self._mean_chunk_wall(thief_sid)
+        if ref is None:
+            return None  # no completed chunk anywhere yet: too early
+        best, best_proj = None, 0.0
+        for vsid, runner in self._active.items():
+            if vsid == thief_sid:
+                continue
+            p = runner.progress()
+            if p["rows_remaining"] <= 0:
+                continue
+            if p["rows_done"] > 0:
+                proj = p["rows_remaining"] * p["elapsed_s"] / p["rows_done"]
+            elif p["elapsed_s"] > 2.0 * ref:
+                proj = math.inf  # no chunk done yet and already overdue
+            else:
+                continue
+            if proj > best_proj:
+                best, best_proj = runner, proj
+        if best is None:
+            return None
+        if best_proj <= self.plan.rebalance_threshold * ref:
+            return None
+        return best
+
+    def _next_work(self, sid: int):
+        """Block until there is a span for this lane: from the queue, or
+        stolen from a straggler.  None = no work will ever come (all spans
+        done, or a fatal error is propagating)."""
+        cond = self.queue.cond
+        while True:
+            with cond:
+                if self._fatal is not None:
+                    return None
+                span = self.queue._pull_locked(sid)
+                if span is not None:
+                    self._busy.add(sid)
+                    return span
+                if not self._busy and not self.queue._spans:
+                    cond.notify_all()  # release peers blocked in wait()
+                    return None
+                victim = self._pick_victim_locked(sid)
+            if victim is not None:
+                stolen = victim.try_steal()
+                if stolen is not None:
+                    with cond:
+                        self._busy.add(sid)
+                        self._steals += 1
+                    obs.counter("lane.steal").inc()
+                    obs.counter("lane.rebalance").inc()
+                    obs.event("lane.steal", shard=sid,
+                              victim=victim.spec.shard_id,
+                              lo=stolen[0], hi=stolen[1])
+                    return stolen
+            with cond:
+                # spans preferred by not-yet-started peers also park us
+                # here: their own lanes will pull them (or a quarantine
+                # will release them to everyone)
+                if self._fatal is None and (self._busy
+                                            or self.queue._spans):
+                    cond.wait(timeout=0.05)
+
+    def _values_for(self, spec0: LaneSpec, values0, lo: int, hi: int):
+        """The values a lane walks for span ``[lo, hi)``: its own resident
+        array when that IS its nominal span, else a re-staged O(chunk)
+        view onto the driver's panel/source."""
+        if (lo, hi) == (spec0.lo, spec0.hi):
+            return values0
+        if self.restage is None:
+            raise RuntimeError(
+                "elastic reassignment needs a restage callback")
+        return self.restage(lo, hi, spec0.device)
+
+    def _quarantine(self, sid: int, e: Exception, attempts: int,
+                    lo: int, hi: int) -> None:
+        cause = f"{type(e).__name__}: {e}"[:200]
+        rec = {"shard_id": int(sid), "cause": cause,
+               "retries": int(attempts - 1), "span": [int(lo), int(hi)]}
+        with self.queue.cond:
+            self._quarantined.append(rec)
+            self._errors.append(e)
+            self._busy.discard(sid)
+            self._push_remainder_locked(sid, lo, hi)
+            # any span still reserved for this lane is up for grabs now
+            self.queue._release_preference_locked(sid)
+            self.queue.cond.notify_all()
+        obs.counter("lane.quarantine").inc()
+        obs.counter("lane.rebalance").inc()
+        obs.event("lane.quarantine", shard=sid, cause=cause,
+                  retries=attempts - 1, lo=lo, hi=hi)
+        self._state(sid, "quarantined")
+
+    def _push_remainder_locked(self, sid: int, lo: int, hi: int) -> None:
+        self.queue._push_locked(lo, hi, preferred=None)
+
+    # -- the lane loop ------------------------------------------------------
+
+    def _drive(self, idx: int) -> None:
+        plan = self.plan
+        spec0, values0 = self.lanes[idx]
+        sid = spec0.shard_id
+        cond = self.queue.cond
+        jour = self._journal_by_sid.get(sid)
+        self._state(sid, "active")
+        while True:
+            work = self._next_work(sid)
+            if work is None:
+                self._state(sid,
+                            "done" if self._fatal is None else "stopped")
+                return
+            lo, hi = work
+            try:
+                vals = self._values_for(spec0, values0, lo, hi)
+            except Exception as e:  # noqa: BLE001 - a restage failure is a
+                # lane failure (the device may be gone): quarantine, do not
+                # kill the job
+                self._quarantine(sid, e, 1, lo, hi)
+                return
+            failures = 0
+            span_hi = hi
+            while True:  # attempt loop over this span
+                spec = LaneSpec(sid, lo, span_hi, spec0.device)
+                if span_hi != hi and not isinstance(
+                        vals, (source_mod.SourceLane, RestagedPanel)):
+                    # a steal landed during a failed attempt: shrink the
+                    # resident values to the kept span so the whole-span
+                    # hand-through can never pass extra rows to the fit
+                    vals = vals[:span_hi - lo]
+                    hi = span_hi
+                runner = LaneRunner(plan, spec, self.fit_fn,
+                                    self.fit_kwargs, vals, journal=jour,
+                                    deadline=self.deadline, tele=self.tele,
+                                    fit_key=self.fit_key)
+                with cond:
+                    self._active[sid] = runner
+                self._state(sid, "active")
+                t0 = time.perf_counter()
+                try:
+                    result = runner.run()
+                except Exception as e:  # noqa: BLE001 - lane containment
+                    with cond:
+                        self._active.pop(sid, None)
+                    # close the failed span to steals BEFORE reading its
+                    # end: a thief holding this runner could otherwise
+                    # still shrink it after we decide what to retry/
+                    # re-enqueue, and the stolen tail would be walked by
+                    # both sides (duplicate rows in assembly)
+                    span_hi = runner.close_steals()
+                    failures += 1
+                    if failures <= plan.lane_retries:
+                        self._retries += 1
+                        self._state(sid, "retrying")
+                        obs.counter("lane.retry").inc()
+                        obs.event("lane.retry", shard=sid, attempt=failures,
+                                  lo=lo, hi=span_hi,
+                                  error=f"{type(e).__name__}: {e}"[:160])
+                        time.sleep(plan.lane_retry_backoff_s
+                                   * (2 ** (failures - 1)))
+                        continue
+                    self._quarantine(sid, e, failures, lo, span_hi)
+                    return
+                except BaseException as e:  # crash/interrupt: fatal, no
+                    # containment — resume semantics must match the static
+                    # layout (the journal, not a survivor, is the recovery)
+                    with cond:
+                        self._active.pop(sid, None)
+                        self._busy.discard(sid)
+                        if self._fatal is None:
+                            self._fatal = e
+                        cond.notify_all()
+                    raise
+                break
+            span_wall = time.perf_counter() - t0
+            n_comp = 0
+            for _plo, _phi, p in result.pieces:
+                if isinstance(p, _TimeoutChunk):
+                    continue
+                pm = getattr(p, "meta", None)
+                if isinstance(pm, dict) and pm.get("resumed_from_journal"):
+                    continue
+                n_comp += 1
+            with cond:
+                self._active.pop(sid, None)
+                self._busy.discard(sid)
+                self.results.append(result)
+                if n_comp:
+                    self._global_walls.append((n_comp, span_wall))
+                    prev = self._lane_mean_wall.get(sid)
+                    mean = span_wall / n_comp
+                    self._lane_mean_wall[sid] = (
+                        mean if prev is None else 0.5 * (prev + mean))
+                cond.notify_all()
+            self._state(sid, "idle")
+
+    # -- entry point --------------------------------------------------------
+
+    def run(self) -> Tuple[list, dict]:
+        """Run the elastic walk; returns ``(results, elastic_meta)``.
+
+        Raises the fatal error (crash/interrupt) unchanged, or — when
+        every lane was quarantined with spans still unprocessed — the
+        FIRST quarantined lane's original error.
+        """
+        for spec, _vals in self.lanes:
+            self.queue.push(spec.lo, spec.hi, preferred=spec.shard_id)
+        threads = [
+            threading.Thread(target=self._drive_safe, args=(i,), daemon=True,
+                             name=f"chunk-lane-{spec.shard_id}")
+            for i, (spec, _v) in enumerate(self.lanes)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        if self._fatal is not None:
+            raise self._fatal
+        undone = self.queue.pending()
+        if undone:
+            # every lane is gone and work remains: the job is lost — fail
+            # with the FIRST lane's original error (invariant 3 of the
+            # tentpole), the quarantine record riding its __notes__-free
+            # message via the exception chain below
+            first = self._errors[0] if self._errors else RuntimeError(
+                f"elastic walk stalled with spans pending: {undone}")
+            raise first
+        self.results.sort(key=lambda r: r.spec.lo)
+        return self.results, self.elastic_meta()
+
+    def _drive_safe(self, idx: int) -> None:
+        sid = self.lanes[idx][0].shard_id
+        try:
+            self._drive(idx)
+        except BaseException as e:  # noqa: BLE001 - re-raised after join
+            # ANY error escaping the lane loop — including supervisor-level
+            # failures outside the runner.run() handlers (LaneRunner
+            # construction, the retry-path values slice) — is recorded as
+            # fatal and the lane's busy state released, so peers stop
+            # polling and the job FAILS LOUDLY instead of hanging with a
+            # silently dead lane still marked busy
+            with self.queue.cond:
+                self._active.pop(sid, None)
+                self._busy.discard(sid)
+                if self._fatal is None:
+                    self._fatal = e
+                self.queue.cond.notify_all()
+
+    def elastic_meta(self) -> dict:
+        return {
+            "quarantined": list(self._quarantined),
+            "steals": int(self._steals),
+            "lane_retries_used": int(self._retries),
+            "reassigned_spans": len(self._quarantined) + int(self._steals),
+        }

@@ -35,12 +35,16 @@ three-stage overlap: **stage N+1 ∥ compute N ∥ commit N−1**.
 **Prediction, not speculation**: the driver schedules exactly the spans
 the walk will visit next (up to ``depth`` consecutive ones, with
 committed-grid clamping, torn-shard forced boundaries, and the current
-chunk size all applied by the driver before scheduling).  When the walk deviates anyway — an OOM backoff halves the
-chunk size, a committer rollback rewinds the walk — the driver
-**invalidates** the staged slices; a ``take`` that finds no matching span
-simply slices inline (a recorded miss), so a stale prediction can cost at
-most the work it saved, never correctness: the staged buffer either IS
-``panel[lo:hi]`` for the requested span or it is not used.
+chunk size all applied by the driver before scheduling).  When the walk
+deviates anyway — an OOM backoff halves the chunk size, a committer
+rollback rewinds the walk, or an idle elastic lane STEALS the tail of
+this lane's span (``plan.LaneRunner.try_steal``, ISSUE 11 — every staged
+prediction past the split now belongs to the thief) — the driver (or the
+thief) **invalidates** the staged slices; a ``take`` that finds no
+matching span simply slices inline (a recorded miss), so a stale
+prediction can cost at most the work it saved, never correctness: the
+staged buffer either IS ``panel[lo:hi]`` for the requested span or it is
+not used.
 
 **Bounded depth** (``prefetch_depth``, default 1): at most ``depth``
 staged-but-untaken slices exist at any time, bounding the extra device

@@ -27,13 +27,42 @@ the TIMEOUT/pending chunks.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Callable, Optional
 
 from .. import obs
 
-__all__ = ["Deadline", "DeadlineExceeded", "call_with_deadline"]
+__all__ = ["Deadline", "DeadlineExceeded", "call_with_deadline",
+           "current_lane", "lane_context"]
+
+# -- lane identity (ISSUE 11) -----------------------------------------------
+# The elastic sharded walk needs to know, from INSIDE a fit call, which lane
+# dispatched it: the deterministic lane-targeted faults
+# (reliability.faultinject.lane_kill / slow_lane / lane_oom_storm) key on it,
+# and it keeps working across the thread hop call_with_deadline performs for
+# budgeted chunks.  Thread-local by design — concurrent lanes each see their
+# own id; code outside any lane sees None.
+_lane_ctx = threading.local()
+
+
+def current_lane() -> Optional[int]:
+    """Shard id of the lane whose walk is executing on THIS thread (set by
+    ``plan.LaneRunner`` around every chunk dispatch, and propagated into
+    the watchdog worker thread for budgeted chunks); None outside a lane."""
+    return getattr(_lane_ctx, "shard_id", None)
+
+
+@contextlib.contextmanager
+def lane_context(shard_id: Optional[int]):
+    """Tag the current thread as running lane ``shard_id`` (None: untag)."""
+    prev = getattr(_lane_ctx, "shard_id", None)
+    _lane_ctx.shard_id = shard_id
+    try:
+        yield
+    finally:
+        _lane_ctx.shard_id = prev
 
 
 class DeadlineExceeded(RuntimeError):
@@ -74,7 +103,7 @@ class Deadline:
 
 
 def call_with_deadline(fn: Callable, budget_s: Optional[float] = None,
-                       *, label: str = ""):
+                       *, label: str = "", lane: Optional[int] = None):
     """Run ``fn()`` with at most ``budget_s`` seconds of wall clock.
 
     ``budget_s=None`` calls ``fn`` inline (zero overhead).  Otherwise ``fn``
@@ -85,15 +114,24 @@ def call_with_deadline(fn: Callable, budget_s: Optional[float] = None,
     :class:`DeadlineExceeded` and ABANDONS the worker — the computation is
     not cancelled (XLA dispatch cannot be interrupted from Python), its
     eventual result is discarded, and the thread dies with the process.
+
+    ``lane=`` propagates the calling lane's identity into the worker
+    thread (:func:`current_lane`), so lane-targeted fault injection and
+    per-lane accounting survive the thread hop; ``None`` inherits the
+    caller's lane tag.
     """
+    if lane is None:
+        lane = current_lane()
     if budget_s is None:
-        return fn()
+        with lane_context(lane):
+            return fn()
     box: dict = {}
     done = threading.Event()
 
     def worker():
         try:
-            box["result"] = fn()
+            with lane_context(lane):
+                box["result"] = fn()
         except BaseException as e:  # noqa: BLE001 - re-raised in the caller
             box["error"] = e
         finally:

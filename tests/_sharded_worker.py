@@ -11,10 +11,12 @@ single-device walk of the same panel, with exactly ONE merged job
 manifest at the journal root.
 
 Modes:
-    --run --dir D [--kill-after N] [--single] [--out F]
+    --run --dir D [--kill-after N] [--single] [--lane-kill S] [--out F]
         one journaled walk (sharded unless --single); with --kill-after
         the process dies mid-job (exit by SIGKILL), else the assembled
-        result is saved to F.
+        result is saved to F.  --lane-kill S permanently fails lane S's
+        fit calls after its first chunk (ISSUE 11): the elastic
+        supervisor must retry, quarantine it, and finish on survivors.
     --smoke
         full orchestration (used by ci.sh and tests/test_sharded.py):
         SIGKILL a sharded walk after 5 commits, verify it died with only
@@ -22,6 +24,17 @@ Modes:
         against an uninterrupted sharded run AND a single-device run,
         and assert the resumed journal holds exactly one merged root
         manifest accounting for every chunk.
+    --elastic-smoke
+        elastic orchestration (ISSUE 11, used by ci.sh and
+        tests/test_elastic.py): (1) a sharded walk with lane 2 killed
+        mid-job completes on the survivors, bitwise-identical to the
+        uninterrupted single-device walk, with the quarantine and the
+        reassigned chunks recorded in the merged manifest; (2) the SAME
+        degraded job is then SIGKILLed mid-rebalance and resumed with
+        the lane healthy again — the resume re-admits the previously
+        quarantined device, adopts every durable chunk regardless of
+        which lane's namespace holds it, and is again bitwise-identical
+        to the single-device walk.
 """
 
 from __future__ import annotations
@@ -60,7 +73,7 @@ def make_panel() -> np.ndarray:
 
 
 def run_fit(directory: str, kill_after: int | None, single: bool,
-            out: str | None) -> None:
+            out: str | None, lane_kill: int | None = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -71,8 +84,14 @@ def run_fit(directory: str, kill_after: int | None, single: bool,
     hook = None
     if kill_after is not None:
         hook = fi.kill_after_commits(kill_after)
+    fit_fn = arima.fit
+    if lane_kill is not None:
+        # permanent lane death after its first chunk: the retries fail
+        # too, so the elastic supervisor must quarantine the lane and
+        # finish the job on the survivors (ISSUE 11)
+        fit_fn = fi.lane_kill(arima.fit, lane_kill, after_chunks=1)
     res = rel.fit_chunked(
-        arima.fit, make_panel(), chunk_rows=CHUNK_ROWS, resilient=False,
+        fit_fn, make_panel(), chunk_rows=CHUNK_ROWS, resilient=False,
         checkpoint_dir=directory, order=(1, 0, 0), max_iters=25,
         shard=not single, _journal_commit_hook=hook,
     )
@@ -80,9 +99,11 @@ def run_fit(directory: str, kill_after: int | None, single: bool,
         sys.exit(f"kill_after={kill_after} but the fit finished — the hook "
                  "never fired")
     if out:
+        elastic = (res.meta.get("shards") or {}).get("elastic") or {}
         np.savez(out, params=res.params, nll=res.neg_log_likelihood,
                  converged=res.converged, iters=res.iters, status=res.status,
-                 journal=json.dumps(res.meta.get("journal", {})))
+                 journal=json.dumps(res.meta.get("journal", {})),
+                 elastic=json.dumps(elastic))
 
 
 def _child(args: list) -> subprocess.CompletedProcess:
@@ -170,20 +191,111 @@ def smoke() -> None:
               "single-device walks, one merged manifest)")
 
 
+def elastic_smoke() -> None:
+    with tempfile.TemporaryDirectory() as td:
+        n_chunks = N_ROWS // CHUNK_ROWS
+        # 0. the identity bar: uninterrupted single-device walk
+        single_out = os.path.join(td, "single.npz")
+        r = _child(["--run", "--dir", os.path.join(td, "single"), "--single",
+                    "--out", single_out])
+        if r.returncode != 0:
+            sys.exit(f"single-device run failed rc={r.returncode}\n{r.stderr}")
+        ref = np.load(single_out)
+
+        # 1. lane 2 dies mid-job: the job must COMPLETE on survivors,
+        # bitwise vs the single-device walk, quarantine journaled
+        jdir = os.path.join(td, "degraded")
+        deg_out = os.path.join(td, "degraded.npz")
+        r = _child(["--run", "--dir", jdir, "--lane-kill", "2",
+                    "--out", deg_out])
+        if r.returncode != 0:
+            sys.exit(f"lane-killed job should survive on the other 7 lanes, "
+                     f"got rc={r.returncode}\nstderr:\n{r.stderr}")
+        a = np.load(deg_out)
+        for k in ("params", "nll", "converged", "iters", "status"):
+            if not np.array_equal(a[k], ref[k], equal_nan=True):
+                sys.exit(f"degraded result differs from single-device on "
+                         f"{k!r} — NOT bitwise-identical")
+        el = json.loads(str(a["elastic"]))
+        if [q["shard_id"] for q in el.get("quarantined", [])] != [2]:
+            sys.exit(f"expected lane 2 quarantined, got {el}")
+        m = json.load(open(os.path.join(jdir, "manifest.json")))
+        rb = m.get("rebalance") or {}
+        if [q["shard_id"] for q in rb.get("quarantined", [])] != [2]:
+            sys.exit(f"merged manifest's rebalance block wrong: {rb}")
+        done = sum(1 for c in m["chunks"] if c["status"] == "committed")
+        if done != n_chunks:
+            sys.exit(f"degraded job committed {done}/{n_chunks} chunks")
+        if not all(isinstance(c.get("owner"), int) for c in m["chunks"]):
+            sys.exit("merged chunk entries are missing owner tags")
+        if rb.get("reassigned_chunks", 0) < 1:
+            sys.exit(f"expected reassigned chunks in the manifest: {rb}")
+
+        # 2. the SAME degraded job, SIGKILLed mid-rebalance, then resumed
+        # with lane 2 healthy: quarantine must compose with crash-resume,
+        # and the resume must re-admit the quarantined device and adopt
+        # chunks from every namespace
+        jdir2 = os.path.join(td, "killed")
+        r = _child(["--run", "--dir", jdir2, "--lane-kill", "2",
+                    "--kill-after", "6"])
+        if r.returncode != -9:
+            sys.exit(f"expected SIGKILL (-9), got rc={r.returncode}\n"
+                     f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}")
+        if os.path.exists(os.path.join(jdir2, "manifest.json")):
+            sys.exit("killed mid-job but a root manifest exists")
+        committed0 = sum(
+            sum(1 for c in json.load(open(mp))["chunks"]
+                if c["status"] == "committed")
+            for mp in glob.glob(os.path.join(jdir2, "shard_*",
+                                             "manifest.shard_*.json")))
+        if committed0 < 6:
+            sys.exit(f"expected >= 6 durable chunks at the kill, "
+                     f"found {committed0}")
+        resumed_out = os.path.join(td, "resumed.npz")
+        r = _child(["--run", "--dir", jdir2, "--out", resumed_out])
+        if r.returncode != 0:
+            sys.exit(f"resume failed rc={r.returncode}\nstderr:\n{r.stderr}")
+        a = np.load(resumed_out)
+        for k in ("params", "nll", "converged", "iters", "status"):
+            if not np.array_equal(a[k], ref[k], equal_nan=True):
+                sys.exit(f"resumed rebalanced result differs from "
+                         f"single-device on {k!r} — NOT bitwise-identical")
+        el = json.loads(str(a["elastic"]))
+        if el.get("quarantined"):
+            sys.exit(f"healthy resume must re-admit the quarantined lane, "
+                     f"got {el}")
+        j = json.loads(str(a["journal"]))
+        if j.get("chunks_resumed", 0) < committed0:
+            sys.exit(f"resume replayed fewer chunks than were durable at "
+                     f"the kill ({committed0}): {j}")
+        if j.get("chunks_committed") != n_chunks or j.get("merged_shards") != 8:
+            sys.exit(f"merged accounting wrong: {j}")
+        print("elastic lane smoke: PASS "
+              f"(lane 2 quarantined mid-job, survivors finished all "
+              f"{n_chunks} chunks bitwise-identical to the single-device "
+              f"walk with {rb.get('reassigned_chunks')} reassigned; the "
+              f"SIGKILLed degraded job resumed bitwise with "
+              f"{j.get('chunks_resumed')} durable chunks adopted)")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--run", action="store_true")
     ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--elastic-smoke", action="store_true")
     ap.add_argument("--dir")
     ap.add_argument("--kill-after", type=int, default=None)
+    ap.add_argument("--lane-kill", type=int, default=None)
     ap.add_argument("--single", action="store_true")
     ap.add_argument("--out")
     args = ap.parse_args()
     if args.smoke:
         return smoke()
+    if args.elastic_smoke:
+        return elastic_smoke()
     if not args.run or not args.dir:
-        ap.error("need --run --dir D or --smoke")
-    run_fit(args.dir, args.kill_after, args.single, args.out)
+        ap.error("need --run --dir D, --smoke, or --elastic-smoke")
+    run_fit(args.dir, args.kill_after, args.single, args.out, args.lane_kill)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,485 @@
+"""Elastic-lane tests (ISSUE 11, tier-1 CPU, 8 forced devices).
+
+The acceptance bar: a sharded walk SURVIVES sick lanes.  A lane whose walk
+raises (dead device, allocator storm that exhausts the OOM ladder, fit
+exception) is retried then QUARANTINED — its device leaves the active set,
+its committed shards are adopted from its journal namespace, and its
+uncommitted chunks are re-staged and recomputed by the surviving lanes; a
+straggler lane's unstarted chunks are STOLEN by idle survivors once its
+projected finish blows the rebalance threshold.  In every case the result
+is BITWISE-IDENTICAL to the uninterrupted single-device walk — it must
+not matter which lane computed which chunk.  Quarantine composes with
+SIGKILL-resume (a resumed job re-admits previously quarantined devices
+and replays only truly-uncommitted work), and a job that loses ALL lanes
+still fails with the original error.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu import obs
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.models import arima, ewma
+from spark_timeseries_tpu.reliability import faultinject as fi
+from spark_timeseries_tpu.reliability import plan as plan_mod
+from spark_timeseries_tpu.reliability import watchdog as watchdog_mod
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ar_panel(b=64, t=96, seed=7, phi=0.6):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(b, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, t):
+        y[:, i] = phi * y[:, i - 1] + e[:, i]
+    return y
+
+
+def _assert_bitwise(a, b):
+    for f in ("params", "neg_log_likelihood", "converged", "iters", "status"):
+        np.testing.assert_array_equal(np.asarray(getattr(a, f)),
+                                      np.asarray(getattr(b, f)),
+                                      err_msg=f"field {f!r} differs")
+
+
+def _fit(y, d=None, fit_fn=ewma.fit, **kw):
+    kw.setdefault("chunk_rows", 2)
+    kw.setdefault("resilient", False)
+    return rel.fit_chunked(fit_fn, y, checkpoint_dir=d, **kw)
+
+
+def _manifest(d):
+    return json.load(open(os.path.join(d, "manifest.json")))
+
+
+# ---------------------------------------------------------------------------
+# quarantine: lane failures are contained, the job survives, bytes agree
+# ---------------------------------------------------------------------------
+
+
+class TestQuarantine:
+    def test_lane_kill_bitwise_and_quarantined(self, lane_mesh):
+        """A permanently dead lane is retried, quarantined, and its chunks
+        recomputed by survivors — result bitwise vs single-device."""
+        y = _ar_panel()
+        single = _fit(y)
+        killed = _fit(y, fit_fn=fi.lane_kill(ewma.fit, 3, after_chunks=1),
+                      shard=True)
+        _assert_bitwise(killed, single)
+        el = killed.meta["shards"]["elastic"]
+        assert [q["shard_id"] for q in el["quarantined"]] == [3]
+        assert el["quarantined"][0]["retries"] == 1  # the default budget
+        assert "SimulatedLaneFailure" in el["quarantined"][0]["cause"]
+        assert el["lane_retries_used"] == 1
+        # the dead lane no longer counts among the lanes that produced work
+        assert killed.meta["shards"]["n_shards"] == 8
+
+    def test_lane_kill_at_first_chunk(self, lane_mesh):
+        """A lane that never commits anything: its WHOLE span reassigns."""
+        y = _ar_panel(b=32)
+        single = _fit(y)
+        killed = _fit(y, fit_fn=fi.lane_kill(ewma.fit, 0, after_chunks=0),
+                      shard=True, lane_retries=0)
+        _assert_bitwise(killed, single)
+        el = killed.meta["shards"]["elastic"]
+        assert el["quarantined"][0]["shard_id"] == 0
+        assert el["quarantined"][0]["retries"] == 0
+        assert el["quarantined"][0]["span"] == [0, 4]
+
+    def test_oom_storm_quarantine(self, lane_mesh):
+        """An allocator storm exhausts the lane's backoff ladder; the
+        OOMBackoffExceeded is contained as a quarantine, not a job
+        failure, and survivors recompute at their own healthy size."""
+        y = _ar_panel()
+        single = _fit(y)
+        storm = _fit(y, fit_fn=fi.lane_oom_storm(ewma.fit, 1), shard=True,
+                     min_chunk_rows=1)
+        _assert_bitwise(storm, single)
+        el = storm.meta["shards"]["elastic"]
+        assert [q["shard_id"] for q in el["quarantined"]] == [1]
+        # the quarantine cause proves the ladder burned to the floor before
+        # the lane was given up (the failed attempts' own oom_events are
+        # discarded with their pieces — only surviving walks report meta)
+        assert "OOMBackoffExceeded" in el["quarantined"][0]["cause"]
+        assert "RESOURCE_EXHAUSTED" in el["quarantined"][0]["cause"]
+
+    def test_transient_failure_retried_not_quarantined(self, lane_mesh):
+        """A lane that fails once then recovers is rescued by the retry
+        budget — no quarantine, no reassignment."""
+        y = _ar_panel(b=32)
+        single = _fit(y)
+        flaky = _fit(y, fit_fn=fi.lane_kill(ewma.fit, 4, after_chunks=0,
+                                            n_failures=1),
+                     shard=True, lane_retries=1, lane_retry_backoff_s=0.01)
+        _assert_bitwise(flaky, single)
+        el = flaky.meta["shards"]["elastic"]
+        assert el["quarantined"] == []
+        assert el["lane_retries_used"] == 1
+
+    def test_all_lanes_lost_surfaces_original_error(self, lane_mesh):
+        """Every lane dying leaves no survivors: the job fails with the
+        ORIGINAL error, never a hang or a silent partial result."""
+
+        def bad_fit(yb, **kw):
+            raise ValueError("deterministic fit bug: every lane dies")
+
+        y = _ar_panel(b=32)
+        with pytest.raises(ValueError, match="deterministic fit bug"):
+            _fit(y, fit_fn=bad_fit, shard=True, lane_retries=0)
+
+    def test_unjournaled_elastic_walk(self, lane_mesh):
+        """Quarantine and reassignment need no journal: an unjournaled
+        degraded walk recomputes the dead lane's span and stays bitwise."""
+        y = _ar_panel(b=32)
+        single = _fit(y)
+        killed = _fit(y, fit_fn=fi.lane_kill(ewma.fit, 7, after_chunks=0),
+                      shard=True)
+        _assert_bitwise(killed, single)
+        assert killed.meta["shards"]["elastic"]["quarantined"]
+
+
+# ---------------------------------------------------------------------------
+# rebalancing: work-queue pulls, straggler steals, healthy-run neutrality
+# ---------------------------------------------------------------------------
+
+
+class TestRebalance:
+    def test_straggler_steal_bitwise(self, lane_mesh):
+        """Idle lanes steal the straggler's unstarted chunks; the job
+        finishes faster than the straggler would alone, and the bytes do
+        not care which lane computed what."""
+        y = _ar_panel()  # 4 chunks per lane: room to steal
+        single = _fit(y)
+        slow = _fit(y, fit_fn=fi.slow_lane(ewma.fit, 5, 0.4), shard=True,
+                    rebalance_threshold=2.0)
+        _assert_bitwise(slow, single)
+        el = slow.meta["shards"]["elastic"]
+        assert el["steals"] >= 1
+        assert el["quarantined"] == []  # slow is not dead
+
+    def test_healthy_run_is_static_layout(self, lane_mesh):
+        """With 2 chunks per lane a steal is structurally impossible
+        (never >= 2 unstarted chunks behind the walk) and a healthy run's
+        elastic accounting is all zeros — the work queue reproduces the
+        static partition exactly."""
+        y = _ar_panel(b=32)
+        res = _fit(y, shard=True)
+        el = res.meta["shards"]["elastic"]
+        assert el == {"quarantined": [], "steals": 0,
+                      "lane_retries_used": 0, "reassigned_spans": 0}
+        assert res.meta["shards"]["lanes_run"] == 8
+
+    def test_healthy_journaled_manifest_owner_tags(self, lane_mesh,
+                                                   tmp_path):
+        """Even a healthy elastic walk journals owner tags and a zeroed
+        rebalance block — the schema the tools validate is always there."""
+        y = _ar_panel(b=32)
+        d = str(tmp_path / "j")
+        _fit(y, d, shard=True)
+        m = _manifest(d)
+        assert all(c.get("owner") == c["shard_id"] for c in m["chunks"])
+        assert m["rebalance"]["quarantined"] == []
+        assert m["rebalance"]["reassigned_chunks"] == 0
+        assert all(s["owner"] == s["shard_id"] and
+                   s["chunks_reassigned_in"] == 0 for s in m["shards"])
+
+    def test_timeout_entries_carry_owner_tag(self, lane_mesh, tmp_path):
+        """Review hardening: TIMEOUT marks are journal entries too — under
+        reassignment they can land outside their namespace's nominal span,
+        so they need the owner tag exactly like commits (obs_report would
+        otherwise flag a legitimate degraded manifest)."""
+        y = _ar_panel(b=32)
+        d = str(tmp_path / "j")
+        res = _fit(y, d, shard=True, job_budget_s=0.0)
+        assert res.meta["status_counts"]["TIMEOUT"] == 32
+        m = _manifest(d)
+        assert m["chunks"] and all(
+            c["status"] == "TIMEOUT" and c.get("owner") == c["shard_id"]
+            for c in m["chunks"])
+        # and the per-shard totals reflect the reconciled entries
+        assert all(s["chunks_timeout"] == 2 and s["chunks_committed"] == 0
+                   for s in m["shards"])
+
+    def test_work_queue_preference_is_strict(self):
+        q = plan_mod.WorkQueue()
+        q.push(0, 8, preferred=0)
+        q.push(8, 16, preferred=1)
+        q.push(16, 24, preferred=None)
+        assert q._pull_locked(1) == (8, 16)  # own span first
+        assert q._pull_locked(1) == (16, 24)  # then unpreferred
+        # lane 0's span is reserved while lane 0 is alive — never poached
+        assert q._pull_locked(1) is None
+        assert q.pending() == [(0, 8)]
+        # quarantine releases the dead lane's reservation to everyone
+        q._release_preference_locked(0)
+        assert q._pull_locked(1) == (0, 8)
+        assert q.pending() == []
+
+    def test_try_steal_grid_aligned(self):
+        """The steal boundary lands on the chunk grid, beyond everything
+        dispatched, and leaves the victim at least half the chunks."""
+        plan = plan_mod.ExecutionPlan(
+            n_rows=32, chunk_rows=4, min_chunk_rows=1, max_backoffs=8,
+            resilient=False, policy="impute", ladder=None,
+            checkpoint_dir=None, resume="auto", chunk_budget_s=None,
+            job_budget_s=None, pipeline=False, pipeline_depth=2,
+            prefetch_depth=0, align_mode=None,
+            lanes=(plan_mod.LaneSpec(0, 0, 32),), process_index=0,
+            n_shards=2, elastic=True)
+        runner = plan_mod.LaneRunner(plan, plan.lanes[0], ewma.fit, {},
+                                     jnp.asarray(_ar_panel(b=32)))
+        # nothing dispatched yet: 8 chunks remain, victim keeps 4
+        assert runner.try_steal() == (16, 32)
+        assert runner.hi == 16
+        # 4 chunks remain: victim keeps 2, thief takes 2
+        assert runner.try_steal() == (8, 16)
+        # 2 chunks remain -> 1/1 split is allowed, then nothing
+        assert runner.try_steal() == (4, 8)
+        assert runner.try_steal() is None
+
+    def test_close_steals_blocks_late_thieves(self):
+        """Review hardening: once a runner's walk fails, the supervisor
+        closes its span to steals BEFORE deciding what to retry — a thief
+        landing after the close would otherwise walk a tail the retry
+        also walks (duplicate rows in assembly)."""
+        plan = plan_mod.ExecutionPlan(
+            n_rows=32, chunk_rows=4, min_chunk_rows=1, max_backoffs=8,
+            resilient=False, policy="impute", ladder=None,
+            checkpoint_dir=None, resume="auto", chunk_budget_s=None,
+            job_budget_s=None, pipeline=False, pipeline_depth=2,
+            prefetch_depth=0, align_mode=None,
+            lanes=(plan_mod.LaneSpec(0, 0, 32),), process_index=0,
+            n_shards=2, elastic=True)
+        runner = plan_mod.LaneRunner(plan, plan.lanes[0], ewma.fit, {},
+                                     jnp.asarray(_ar_panel(b=32)))
+        assert runner.try_steal() == (16, 32)  # steals work before close
+        assert runner.close_steals() == 16  # the end EXCLUDES prior steals
+        assert runner.try_steal() is None  # and nothing after the close
+
+    def test_committed_crossing_counts_shard_lost(self, tmp_path):
+        """Review hardening: a torn (shard-lost) chunk is recomputed at
+        its RECORDED off-grid boundaries — a steal split inside it would
+        make thief and victim both compute the overlap, so the crossing
+        probe must see shard-lost entries too."""
+        j = rel.ChunkJournal(str(tmp_path / "j"), config_hash="c",
+                             panel_fingerprint="p", n_rows=16, chunk_rows=4)
+        entry = j.commit_chunk(2, 10, {
+            "params": np.zeros((8, 1), np.float32),
+            "nll": np.zeros(8, np.float32),
+            "converged": np.ones(8, bool),
+            "iters": np.zeros(8, np.int32),
+            "status": np.zeros(8, np.int8)})
+        assert j.committed_crossing(6) == 10
+        fi.tear_file(os.path.join(j.dir, entry["shard"]), keep_frac=0.3)
+        assert j.load_chunk(entry) is None  # downgraded to shard-lost
+        assert j.committed_crossing(6) == 10  # still a forbidden split
+
+    def test_supervisor_level_error_fails_loudly(self, lane_mesh,
+                                                 monkeypatch):
+        """Review hardening: an error OUTSIDE the runner's walk (e.g.
+        LaneRunner construction dying) must fail the job loudly — never
+        leave the lane silently dead while peers poll forever."""
+
+        def boom(self, *a, **k):
+            raise RuntimeError("lane runner construction failed")
+
+        monkeypatch.setattr(plan_mod.LaneRunner, "__init__", boom)
+        with pytest.raises(RuntimeError, match="construction failed"):
+            _fit(_ar_panel(b=32), shard=True)
+
+    def test_lane_faults_only_fire_on_their_lane(self):
+        """The lane-targeted faults key on the thread-local lane tag."""
+        calls = {"n": 0}
+
+        def fit(yb, **kw):
+            calls["n"] += 1
+            return ewma.fit(yb)
+
+        y = jnp.asarray(_ar_panel(b=4))
+        wrapped = fi.lane_kill(fit, 3, after_chunks=0)
+        wrapped(y)  # outside any lane: passes through
+        with watchdog_mod.lane_context(2):
+            wrapped(y)  # another lane: passes through
+        with watchdog_mod.lane_context(3):
+            with pytest.raises(fi.SimulatedLaneFailure):
+                wrapped(y)
+        assert calls["n"] == 2
+
+
+# ---------------------------------------------------------------------------
+# durability: quarantine composes with crash/SIGKILL-resume
+# ---------------------------------------------------------------------------
+
+
+class TestElasticResume:
+    def test_quarantine_composes_with_crash_resume(self, lane_mesh,
+                                                   tmp_path):
+        """A degraded (lane-killed, rebalancing) job crashes mid-flight;
+        the resume — lane healthy again — re-admits the device, adopts
+        every durable chunk from WHICHEVER namespace holds it, and ends
+        bitwise-identical to the single-device walk."""
+        y = _ar_panel()
+        single = _fit(y)
+        d = str(tmp_path / "j")
+        with pytest.raises(fi.SimulatedCrash):
+            _fit(y, d, fit_fn=fi.lane_kill(ewma.fit, 2, after_chunks=0),
+                 shard=True,
+                 _journal_commit_hook=fi.crash_after_commits(6))
+        assert not os.path.exists(os.path.join(d, "manifest.json"))
+        committed = sum(
+            sum(1 for c in json.load(open(mp))["chunks"]
+                if c["status"] == "committed")
+            for mp in glob.glob(os.path.join(d, "shard_*",
+                                             "manifest.shard_*.json")))
+        assert committed >= 6
+        res = _fit(y, d, shard=True)
+        _assert_bitwise(res, single)
+        el = res.meta["shards"]["elastic"]
+        assert el["quarantined"] == []  # the device is re-admitted
+        assert res.meta["journal"]["chunks_resumed"] >= committed
+        assert res.meta["journal"]["chunks_committed"] == 32
+
+    def test_completed_degraded_job_resumes_all_from_journal(self, lane_mesh,
+                                                             tmp_path):
+        """After a COMPLETED degraded job (reassigned chunks live in
+        survivor namespaces), a fresh sharded run of the same job adopts
+        every chunk cross-namespace — zero recomputes, zero quarantines."""
+        y = _ar_panel(b=32)
+        single = _fit(y)
+        d = str(tmp_path / "j")
+        first = _fit(y, d, fit_fn=fi.lane_kill(ewma.fit, 2, after_chunks=0),
+                     shard=True)
+        _assert_bitwise(first, single)
+        again = _fit(y, d, shard=True)
+        _assert_bitwise(again, single)
+        el = again.meta["shards"]["elastic"]
+        assert el["quarantined"] == []
+        assert again.meta["journal"]["chunks_resumed"] == 16
+
+    def test_steal_composes_with_crash_resume(self, lane_mesh, tmp_path):
+        """Crash a REBALANCING (straggler-steal) job mid-flight; the
+        resume replays only uncommitted work and stays bitwise."""
+        y = _ar_panel()
+        single = _fit(y)
+        d = str(tmp_path / "j")
+        with pytest.raises(fi.SimulatedCrash):
+            _fit(y, d, fit_fn=fi.slow_lane(ewma.fit, 5, 0.25), shard=True,
+                 rebalance_threshold=2.0,
+                 _journal_commit_hook=fi.crash_after_commits(10))
+        res = _fit(y, d, shard=True)
+        _assert_bitwise(res, single)
+        assert res.meta["journal"]["chunks_committed"] == 32
+
+    def test_degraded_manifest_validates_and_advises(self, lane_mesh,
+                                                     tmp_path):
+        """The merged manifest of a degraded run passes the obs_report
+        schema gate (owner tags, rebalance block, per-shard reassignment
+        counts) and gives advise_budget its elastic evidence."""
+        y = _ar_panel(b=32)
+        d = str(tmp_path / "j")
+        ev = str(tmp_path / "ev.jsonl")
+        obs.enable(ev)
+        try:
+            res = _fit(y, d, fit_fn=fi.lane_kill(ewma.fit, 1,
+                                                 after_chunks=1),
+                       shard=True)
+        finally:
+            obs.disable()
+        el = res.meta["shards"]["elastic"]
+        assert el["quarantined"]
+        m = _manifest(d)
+        assert m["rebalance"]["reassigned_chunks"] >= 1
+        reassigned = [c for c in m["chunks"]
+                      if c["status"] == "committed"
+                      and c["shard_id"] != c["lo"] // 4]
+        assert reassigned and all(c["owner"] == c["shard_id"]
+                                  for c in reassigned)
+        r = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools", "obs_report.py"),
+             "--check", ev, "--manifest", d],
+            capture_output=True, text=True, cwd=_ROOT)
+        assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+        r = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools",
+                                          "advise_budget.py"), d],
+            capture_output=True, text=True, cwd=_ROOT)
+        assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+        assert "lane_retries" in r.stdout
+        assert "rebalance_threshold" in r.stdout
+        assert "quarantined shard 1" in r.stdout
+
+    def test_quarantine_events_and_gauges(self, lane_mesh, tmp_path):
+        """The obs plane records the lane lifecycle: state gauge lands on
+        'quarantined', and the quarantine/rebalance counters move."""
+        y = _ar_panel(b=32)
+        obs.enable(str(tmp_path / "ev.jsonl"))
+        try:
+            c0 = (obs.snapshot() or {}).get("counters", {})
+            _fit(y, fit_fn=fi.lane_kill(ewma.fit, 6, after_chunks=0),
+                 shard=True)
+            snap = obs.snapshot()
+        finally:
+            obs.disable()
+        counters, gauges = snap["counters"], snap["gauges"]
+        assert counters.get("lane.quarantine", 0) - c0.get(
+            "lane.quarantine", 0) == 1
+        assert counters.get("lane.rebalance", 0) > c0.get(
+            "lane.rebalance", 0)
+        assert counters.get("lane.retry", 0) - c0.get("lane.retry", 0) == 1
+        assert gauges.get("lane.state.6") == "quarantined"
+        assert gauges.get("lane.state.0") == "done"
+
+
+# ---------------------------------------------------------------------------
+# the ci.sh elastic smoke (real SIGKILL, subprocess) — tier-2 here, ci.sh
+# runs it unconditionally
+# ---------------------------------------------------------------------------
+
+
+class TestElasticSmoke:
+    @pytest.mark.slow
+    def test_elastic_smoke_subprocess(self):
+        worker = os.path.join(_ROOT, "tests", "_sharded_worker.py")
+        r = subprocess.run([sys.executable, worker, "--elastic-smoke"],
+                           cwd=_ROOT,
+                           env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                           capture_output=True, text=True, timeout=900)
+        assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+        assert "PASS" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# resilient + arima surfaces: containment is fit-agnostic
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def test_resilient_elastic_quarantine(self, lane_mesh):
+        """The resilient runner (sanitize + ladder) rides inside the lane;
+        a lane failure under it quarantines the same way."""
+        y = _ar_panel(b=32)
+        single = rel.fit_chunked(ewma.fit, y, chunk_rows=2)
+        killed = rel.fit_chunked(fi.lane_kill(ewma.fit, 5, after_chunks=0),
+                                 y, chunk_rows=2, shard=True)
+        _assert_bitwise(killed, single)
+        assert killed.meta["shards"]["elastic"]["quarantined"]
+
+    def test_arima_elastic_bitwise(self, lane_mesh):
+        y = _ar_panel(b=32)
+        kw = dict(chunk_rows=4, resilient=False, order=(1, 0, 0),
+                  max_iters=15)
+        single = rel.fit_chunked(arima.fit, y, **kw)
+        killed = rel.fit_chunked(fi.lane_kill(arima.fit, 3, after_chunks=0),
+                                 y, shard=True, **kw)
+        _assert_bitwise(killed, single)
+        assert killed.meta["shards"]["elastic"]["quarantined"]

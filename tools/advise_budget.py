@@ -54,6 +54,15 @@ tool reads one manifest and prints suggested
                         per-shard wall balance printed so a straggler
                         lane is visible.
 
+- ``lane_retries`` / ``rebalance_threshold`` — the elastic-lane knobs
+                        (ISSUE 11), read from the merged manifest's
+                        ``rebalance`` block and the per-lane wall
+                        imbalance: transient quarantine causes (allocator
+                        storms, deadline blips) earn a lane one more
+                        retry, a straggler-paced job gets a lower steal
+                        threshold, and the steal counts and quarantine
+                        causes are printed as the evidence.
+
 Pointed at an **auto-fit search root** (ISSUE 9: ``auto_manifest.json`` +
 per-order/per-group ``grid_*`` journals) the advisor switches to
 grid-level advice — ``orders_per_pass`` (prune candidates that never won
@@ -230,6 +239,7 @@ def advise(m: dict) -> dict:
         }
         shards_suggest = max(1, len(worked))
     else:
+        balance = None
         # unsharded run: each chunk can become a lane (the coarsest useful
         # split); the runtime mesh clamps this to its device count
         shards_suggest = max(1, -(-n_rows // max(1, chunk_rows)))
@@ -239,6 +249,45 @@ def advise(m: dict) -> dict:
     rows_per_shard = -(-n_rows // shards_suggest)
     chunk_rows_sharded = max(1, min(chunk_rows, -(-rows_per_shard // 2))) \
         if shards_suggest > 1 else chunk_rows
+
+    # -- elastic lanes: lane_retries + rebalance_threshold (ISSUE 11) --------
+    # the merged manifest's `rebalance` block records what the supervisor
+    # actually did — quarantine causes, steals, spans reassigned — and the
+    # per-lane wall imbalance says whether the threshold let a straggler
+    # pace the job.  Transient-looking causes (allocator storms, deadline
+    # blips) earn the lane one more retry; deterministic failures make
+    # extra retries wasted wall.
+    rb = m.get("rebalance") or {}
+    quarantined = rb.get("quarantined") or []
+    transient_markers = ("RESOURCE_EXHAUSTED", "Out of memory",
+                         "DeadlineExceeded", "OOMBackoffExceeded")
+    transient = [q for q in quarantined
+                 if any(t in (q.get("cause") or "") for t in transient_markers)]
+    lane_retries = 1  # the driver default
+    if quarantined:
+        lane_retries = 2 if transient else 1
+    steals = rb.get("steals") or 0
+    rebalance_threshold = 4.0  # the driver default
+    if balance is not None:
+        if balance > 2.0:
+            # a straggler paced the job and stealing never (or barely)
+            # engaged: hand work off sooner next run
+            rebalance_threshold = 1.5 if steals else 2.0
+        elif steals and balance <= 1.2:
+            # stealing engaged and the walls came out level: keep it
+            rebalance_threshold = 4.0
+    rebalance_obs = None
+    if rb or quarantined:
+        rebalance_obs = {
+            "steals": steals,
+            "reassigned_chunks": rb.get("reassigned_chunks"),
+            "lane_retries_used": rb.get("lane_retries_used"),
+            "quarantine_causes": [
+                {"shard_id": q.get("shard_id"),
+                 "retries": q.get("retries"),
+                 "cause": (q.get("cause") or "")[:120]}
+                for q in quarantined],
+        }
 
     return {
         "config_hash": m.get("config_hash"),
@@ -267,6 +316,7 @@ def advise(m: dict) -> dict:
             "device_budget_bytes": budget_bytes,
             "staging_pool": pool_obs,
             "shards": shard_obs,
+            "rebalance": rebalance_obs,
         },
         "suggest": {
             "chunk_rows": chunk_rows,
@@ -280,6 +330,8 @@ def advise(m: dict) -> dict:
             "align_mode": align_mode,
             "shards": shards_suggest,
             "chunk_rows_per_shard": chunk_rows_sharded,
+            "lane_retries": lane_retries,
+            "rebalance_threshold": rebalance_threshold,
         },
     }
 
@@ -532,6 +584,14 @@ def main():
               "carried work"
               + (f"; wall balance max/mean {so['shard_wall_balance']}"
                  if so["shard_wall_balance"] is not None else ""))
+    if o.get("rebalance") is not None:
+        ro = o["rebalance"]
+        print(f"  elastic: {ro['steals']} steals, "
+              f"{ro['reassigned_chunks']} chunks reassigned, "
+              f"{ro['lane_retries_used']} lane retries used")
+        for q in ro["quarantine_causes"]:
+            print(f"    quarantined shard {q['shard_id']} after "
+                  f"{q['retries']} retries: {q['cause']}")
     print("  suggest for the next run of this config hash:")
     print(f"    chunk_rows     = {s['chunk_rows']}")
     print(f"    chunk_budget_s = {s['chunk_budget_s']}")
@@ -549,6 +609,11 @@ def main():
     if s["shards"] > 1:
         print(f"    chunk_rows (per-shard walk) = {s['chunk_rows_per_shard']}"
               "  (>= 2 chunks per lane so commits/staging overlap)")
+        print(f"    lane_retries   = {s['lane_retries']}  (failed-lane "
+              "retries before quarantine)")
+        print(f"    rebalance_threshold = {s['rebalance_threshold']}  "
+              "(steal from a lane once its projected remaining wall "
+              "exceeds this many mean chunk walls)")
 
 
 if __name__ == "__main__":

@@ -347,14 +347,23 @@ def validate_manifest_shards(m: dict, path: str) -> list:
     row range sits inside their shard's span and whose npz path is rooted
     in that shard's namespace, and (when telemetry rode along) shard tags
     on the merged timeline rows.
+
+    Elastic walks (ISSUE 11): a chunk REASSIGNED by quarantine or a steal
+    legitimately sits outside its committing namespace's nominal span —
+    allowed iff the entry carries its ``owner`` lane tag; the per-shard
+    ``owner``/``chunks_reassigned_in`` fields and the top-level
+    ``rebalance`` block (quarantine causes, steal counts, reassigned
+    total — which must agree with the per-shard counts) are validated
+    when present.
     """
     shards = m.get("shards")
     if shards is None and not m.get("merged_from_shards"):
         return []
     errors = []
+    errors += _validate_rebalance(m)
     if not isinstance(shards, list) or not shards:
-        return [f"manifest {path}: merged_from_shards set but shards "
-                "block missing/empty"]
+        return errors + [f"manifest {path}: merged_from_shards set but "
+                         "shards block missing/empty"]
     if m.get("merged_from_shards") != len(shards):
         errors.append(f"shards block has {len(shards)} entries but "
                       f"merged_from_shards={m.get('merged_from_shards')}")
@@ -378,6 +387,16 @@ def validate_manifest_shards(m: dict, path: str) -> list:
                 errors.append(f"shards[{i}].{k} invalid: {s.get(k)!r}")
         if not isinstance(s.get("dir"), str):
             errors.append(f"shards[{i}].dir invalid: {s.get('dir')!r}")
+        # elastic merges (ISSUE 11) stamp each namespace with its owner
+        # lane and how many committed chunks were reassigned in
+        if "owner" in s and s["owner"] != s.get("shard_id"):
+            errors.append(f"shards[{i}].owner {s['owner']!r} != shard_id "
+                          f"{s.get('shard_id')!r}")
+        if "chunks_reassigned_in" in s and (
+                not isinstance(s["chunks_reassigned_in"], int)
+                or s["chunks_reassigned_in"] < 0):
+            errors.append(f"shards[{i}].chunks_reassigned_in invalid: "
+                          f"{s['chunks_reassigned_in']!r}")
     n_rows = m.get("n_rows")
     if isinstance(n_rows, int) and prev_hi and prev_hi != n_rows:
         errors.append(f"shard spans cover [0, {prev_hi}) but n_rows is "
@@ -401,8 +420,20 @@ def validate_manifest_shards(m: dict, path: str) -> list:
                           "the shards block")
             continue
         if not (span[0] <= c.get("lo", -1) and c.get("hi", 1 << 60) <= span[1]):
-            errors.append(f"chunk [{c.get('lo')}, {c.get('hi')}) outside "
-                          f"its shard {sid} span {span}")
+            # a chunk outside its committing namespace's nominal span is
+            # only legitimate when elastically REASSIGNED — the owner tag
+            # says which lane computed it (ISSUE 11)
+            if not isinstance(c.get("owner"), int):
+                errors.append(f"chunk [{c.get('lo')}, {c.get('hi')}) "
+                              f"outside its shard {sid} span {span} and "
+                              "not owner-tagged (no elastic reassignment "
+                              "can explain it)")
+            elif c["owner"] != sid:
+                errors.append(f"chunk {c.get('lo')}: owner {c['owner']} "
+                              f"disagrees with committing namespace {sid}")
+        elif isinstance(c.get("owner"), int) and c["owner"] != sid:
+            errors.append(f"chunk {c.get('lo')}: owner {c['owner']} "
+                          f"disagrees with committing namespace {sid}")
         d = next((s.get("dir") for s in shards
                   if isinstance(s, dict) and s.get("shard_id") == sid), None)
         if "shard" in c and isinstance(d, str) and \
@@ -414,6 +445,59 @@ def validate_manifest_shards(m: dict, path: str) -> list:
         if sid is not None and sid not in spans:
             errors.append(f"telemetry chunk {row.get('lo')}: shard tag "
                           f"{sid!r} not in the shards block")
+    # the rebalance block's reassigned total must agree with what the
+    # chunk entries actually show — a drifting count means the merge's
+    # reconciliation and the supervisor's record no longer describe the
+    # same job
+    rb = m.get("rebalance")
+    if isinstance(rb, dict) and isinstance(rb.get("reassigned_chunks"), int):
+        observed = sum(
+            1 for c in m.get("chunks", [])
+            if c.get("status") == "committed"
+            and c.get("shard_id") in spans
+            and not (spans[c["shard_id"]][0] <= c.get("lo", -1)
+                     and c.get("hi", 1 << 60) <= spans[c["shard_id"]][1]))
+        if observed != rb["reassigned_chunks"]:
+            errors.append(f"rebalance.reassigned_chunks "
+                          f"{rb['reassigned_chunks']} != {observed} "
+                          "owner-tagged chunks outside their namespace "
+                          "span")
+    return errors
+
+
+def _validate_rebalance(m: dict) -> list:
+    """Validate a merged manifest's elastic ``rebalance`` block (ISSUE 11);
+    absent (static/pre-elastic walks, multi-host jobs) passes untouched."""
+    rb = m.get("rebalance")
+    if rb is None:
+        return []
+    if not isinstance(rb, dict):
+        return [f"rebalance block is not an object: {rb!r}"]
+    errors = []
+    for k in ("steals", "lane_retries_used", "reassigned_chunks",
+              "reassigned_spans"):
+        if not isinstance(rb.get(k), int) or rb[k] < 0:
+            errors.append(f"rebalance.{k} invalid: {rb.get(k)!r}")
+    q = rb.get("quarantined")
+    if not isinstance(q, list):
+        errors.append(f"rebalance.quarantined invalid: {q!r}")
+        return errors
+    n_shards = m.get("merged_from_shards")
+    for i, rec in enumerate(q):
+        if not isinstance(rec, dict):
+            errors.append(f"rebalance.quarantined[{i}] not an object: "
+                          f"{rec!r}")
+            continue
+        sid = rec.get("shard_id")
+        if not isinstance(sid, int) or (
+                isinstance(n_shards, int) and not 0 <= sid < n_shards):
+            errors.append(f"rebalance.quarantined[{i}].shard_id invalid: "
+                          f"{sid!r}")
+        if not isinstance(rec.get("cause"), str) or not rec["cause"]:
+            errors.append(f"rebalance.quarantined[{i}].cause missing")
+        if not isinstance(rec.get("retries"), int) or rec["retries"] < 0:
+            errors.append(f"rebalance.quarantined[{i}].retries invalid: "
+                          f"{rec.get('retries')!r}")
     return errors
 
 
@@ -489,7 +573,21 @@ def _render(s: dict) -> None:
         if lanes:
             drv = [ev for ev in rows
                    if (ev.get("attrs") or {}).get("shard") is None]
-            print(f"\ntimeline (s from start; {len(lanes)} sharded lanes):")
+            # elastic lane events (ISSUE 11) are shard-tagged, so each
+            # quarantine/steal/retry already renders INSIDE its lane's row
+            # below; the header totals make a degraded run visible at a
+            # glance
+            elastic_names = ("lane.quarantine", "lane.steal", "lane.retry")
+            reb = [ev for ev in rows if ev["kind"] == "event"
+                   and ev.get("name") in elastic_names]
+            header = f"\ntimeline (s from start; {len(lanes)} sharded lanes"
+            if reb:
+                counts = {n: sum(1 for ev in reb if ev["name"] == n)
+                          for n in elastic_names}
+                header += (f"; elastic: {counts['lane.quarantine']} "
+                           f"quarantined, {counts['lane.steal']} steals, "
+                           f"{counts['lane.retry']} retries")
+            print(header + "):")
             for sid in lanes:
                 mine = [ev for ev in rows
                         if (ev.get("attrs") or {}).get("shard") == sid]
