@@ -1915,6 +1915,143 @@ def _auto_fit_northstar(jnp, quick, on_tpu):
     }
 
 
+def _serving_northstar(jnp, quick, on_tpu):
+    """ISSUE 12 acceptance: the resident serving loop under load.
+
+    Drives a :class:`serving.FitServer` with a concurrent multi-tenant
+    request storm and reports what a service owner buys: sustained
+    **request throughput and p50/p99 request latency** (client-measured,
+    submit -> demuxed result), the **batching amplification** (the same
+    storm against a coalescing-disabled server — how much the
+    micro-batched walk beats per-request walks), and the **overload
+    contract** at 2x queue capacity: the server SHEDS with explicit
+    rejections and answers everything else — zero OOMs, zero hangs,
+    conservation of requests (floor-gated ``serving_gate_ok``; the
+    bitwise batched==solo and crash-recovery contracts are tier-1 tests,
+    not re-proved here).  Both servers journal every batch (the serving
+    path IS the durable path) and run compile-warmed via a scratch
+    warm-up request, so the measured walls are steady-state serving, not
+    first-compile.
+    """
+    import tempfile
+    import threading
+
+    from spark_timeseries_tpu import serving
+
+    if on_tpu and not quick:
+        n_reqs, rows, t_len, iters = 32, 8192, 1000, 60
+    elif quick:
+        n_reqs, rows, t_len, iters = 6, 16, 120, 15
+    else:
+        n_reqs, rows, t_len, iters = 16, 64, 200, 25
+    kw = dict(order=(1, 1, 1), max_iters=iters)
+    panel = gen_arima_panel(n_reqs * rows, t_len, seed=33)
+    panels = [np.ascontiguousarray(panel[i * rows:(i + 1) * rows])
+              for i in range(n_reqs)]
+
+    def _drive(srv, reqs, timeout=1800.0):
+        lat = [None] * len(reqs)
+        errs = [None] * len(reqs)
+
+        def one(i):
+            t0 = time.perf_counter()
+            try:
+                tk = srv.submit(f"tenant-{i}", reqs[i], "arima", **kw)
+                tk.result(timeout=timeout)
+                lat[i] = time.perf_counter() - t0
+            except Exception as e:  # noqa: BLE001 - per-request record
+                errs[i] = e
+
+        ts = [threading.Thread(target=one, args=(i,), daemon=True)
+              for i in range(len(reqs))]
+        t0 = time.perf_counter()
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(timeout=timeout)
+        return time.perf_counter() - t0, lat, errs
+
+    def _mk(root, **over):
+        cfg = dict(cell_rows=rows, batch_window_s=0.01,
+                   max_batch_rows=max(rows * 8, rows), autotune=False,
+                   max_queue_rows=n_reqs * rows * 4,
+                   max_queue_requests=4 * n_reqs + 8)
+        cfg.update(over)
+        return serving.FitServer(root, **cfg)
+
+    # warm-up: one batch through a scratch server compiles the cell
+    # program + journal path for every later server (process-wide caches)
+    with _mk(tempfile.mkdtemp(prefix="srvns_warm_")) as warm:
+        warm.submit("warm", panels[0], "arima", **kw).result(timeout=1800)
+
+    # 1. sustained storm, coalescing ON
+    with _mk(tempfile.mkdtemp(prefix="srvns_b_")) as srv:
+        wall_b, lat_b, errs_b = _drive(srv, panels)
+        batched_counters = srv.health()["counters"]
+    # 2. the same storm, coalescing OFF (every batch = one request)
+    with _mk(tempfile.mkdtemp(prefix="srvns_s_"), batch_window_s=0.0,
+             max_batch_rows=rows) as srv:
+        wall_s, _lat_s, errs_s = _drive(srv, panels)
+        solo_batches = srv.health()["counters"]["batches_run"]
+    # 3. 2x overload: the queue holds half the storm's rows — the rest
+    #    must shed with explicit rejections, never an OOM or a hang
+    storm = panels + panels  # 2x the sustained load
+    with _mk(tempfile.mkdtemp(prefix="srvns_o_"),
+             max_queue_rows=max(rows, (n_reqs * rows) // 2),
+             batch_window_s=0.0) as srv:
+        wall_o, lat_o, errs_o = _drive(srv, storm)
+        over_counters = srv.health()["counters"]
+    served_o = sum(1 for e in lat_o if e is not None)
+    rejected_o = sum(1 for e in errs_o
+                     if isinstance(e, serving.RejectedError))
+    other_errs = [e for e in errs_o
+                  if e is not None
+                  and not isinstance(e, serving.RejectedError)]
+    conserved = served_o + rejected_o == len(storm)
+    shed_rate = rejected_o / len(storm)
+    lats = sorted(v for v in lat_b if v is not None)
+    ok_b = not any(errs_b) and not any(errs_s) and len(lats) == n_reqs
+    gate_ok = bool(ok_b and conserved and rejected_o > 0
+                   and not other_errs)
+    return {
+        "requests": n_reqs,
+        "rows_per_request": rows,
+        "obs_per_series": t_len,
+        "cell_rows": rows,
+        "wall_s": round(wall_b, 3),
+        "rows_per_sec": (round(n_reqs * rows / wall_b, 1)
+                         if wall_b > 0 else None),
+        "requests_per_sec": (round(n_reqs / wall_b, 2)
+                             if wall_b > 0 else None),
+        "p50_request_latency_s": (round(float(np.percentile(lats, 50)), 4)
+                                  if lats else None),
+        "p99_request_latency_s": (round(float(np.percentile(lats, 99)), 4)
+                                  if lats else None),
+        "batches_run": batched_counters["batches_run"],
+        "solo_wall_s": round(wall_s, 3),
+        "solo_batches": solo_batches,
+        # >1: the coalescing walk beats one-walk-per-request on the same
+        # storm (fewer walks, shared staging pool, reused programs)
+        "batch_amplification": (round(wall_s / wall_b, 4)
+                                if wall_b > 0 else None),
+        "overload_submitted": len(storm),
+        "overload_served": served_o,
+        "overload_rejected": rejected_o,
+        "overload_shed_rate": round(shed_rate, 4),
+        "overload_conserved": conserved,
+        "overload_other_errors": [repr(e)[:120] for e in other_errs[:3]],
+        "overload_wall_s": round(wall_o, 3),
+        # the floor gate: overload degrades to explicit shedding with
+        # every other request answered — never an OOM, never a hang
+        "serving_gate_ok": gate_ok,
+        "data": "resident FitServer; concurrent storm of "
+                f"{n_reqs} tenant requests x {rows} rows (journaled "
+                "micro-batched walks, warm staging pool/compile cache) "
+                "vs the same storm with coalescing disabled, + a 2x "
+                "overload storm against a half-sized admission queue",
+    }
+
+
 def bench_arima_headline(jnp, quick, on_tpu, n_chips, platform, parity=None):
     from spark_timeseries_tpu.models import arima
 
@@ -1983,6 +2120,10 @@ def bench_arima_headline(jnp, quick, on_tpu, n_chips, platform, parity=None):
     # series as one journaled search (candidate-orders x series/sec)
     _progress("config 3: auto-fit north-star (batched order search)...")
     acct["auto_fit_northstar"] = _auto_fit_northstar(jnp, quick, on_tpu)
+    # ISSUE 12: the resident serving loop — multi-tenant request storm
+    # throughput/latency, batching amplification, 2x-overload shedding
+    _progress("config 3: serving north-star (resident fit server)...")
+    acct["serving_northstar"] = _serving_northstar(jnp, quick, on_tpu)
 
     cpu_rate, n_done = cpu_rate_arima(t, 2.0 if quick else CPU_BUDGET_S)
     n_cores = os.cpu_count() or 1
@@ -2083,6 +2224,19 @@ def _telemetry_regression_gate(headline):
             "auto_fit_diff_cache_hits": af.get("diff_cache_hits"),
             "auto_fit_winners_speedup": af.get("winners_speedup"),
         }
+    # serving gate inputs (ISSUE 12): sustained throughput, tail latency,
+    # the batching win, and the overload contract — a serving regression
+    # (coalescing silently off, shedding broken) hides behind every
+    # one-shot headline
+    sv = headline.get("serving_northstar") or {}
+    if sv.get("rows_per_sec") is not None:
+        inputs = {
+            **(inputs or {}),
+            "serving_rows_per_sec": sv.get("rows_per_sec"),
+            "serving_p99_latency_s": sv.get("p99_request_latency_s"),
+            "serving_batch_amplification": sv.get("batch_amplification"),
+            "serving_gate_ok": 1.0 if sv.get("serving_gate_ok") else 0.0,
+        }
     cur = {
         "metric": "telemetry_summary: regression-gate inputs "
                   "(compile share, commit latency, map_series cache, "
@@ -2148,6 +2302,9 @@ def _telemetry_regression_gate(headline):
         "auto_fit_fused_speedup": ("rel", 0.4, "higher"),
         "auto_fit_diff_cache_hits": ("rel", 0.5, "higher"),
         "auto_fit_winners_speedup": ("rel", 0.5, "higher"),
+        "serving_rows_per_sec": ("rel", 0.5, "higher"),
+        "serving_p99_latency_s": ("rel", 1.0, "lower"),
+        "serving_batch_amplification": ("rel", 0.4, "higher"),
     }
     drifts, flagged = {}, []
     for k, (mode, tol, direction) in thresholds.items():
@@ -2187,6 +2344,16 @@ def _telemetry_regression_gate(headline):
             "tolerance": 0.0, "mode": "abs", "direction": "higher",
             "flagged": True}
         flagged.append("degraded_speedup_floor")
+    # ABSOLUTE floor (ISSUE 12): overload must degrade to explicit
+    # shedding with conservation — a server that OOMs, hangs, or loses
+    # requests under 2x load is broken regardless of the previous run
+    sg = inputs.get("serving_gate_ok")
+    if sg is not None and sg < 1.0:
+        drifts["serving_overload_floor"] = {
+            "prev": 1.0, "cur": sg, "drift": 1.0,
+            "tolerance": 0.0, "mode": "abs", "direction": "higher",
+            "flagged": True}
+        flagged.append("serving_overload_floor")
     if not drifts:
         # the prior summary carried none of the tracked keys (e.g. a
         # --quick run): comparing NOTHING must not read as a green gate
@@ -2282,6 +2449,13 @@ def _summary_line(emitted):
                     "winners_gate_ok",
                     "winners_stage2_spend_share",
                     "winners_selection_agreement")}
+            sv = obj.get("serving_northstar")
+            if sv:
+                entry["serving_northstar"] = {k: sv.get(k) for k in (
+                    "requests", "rows_per_request", "rows_per_sec",
+                    "p50_request_latency_s", "p99_request_latency_s",
+                    "batch_amplification", "overload_shed_rate",
+                    "overload_conserved", "serving_gate_ok")}
         configs[key] = entry
     line = {
         "metric": "bench_summary: all configs, tail-truncation-proof "

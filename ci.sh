@@ -165,6 +165,58 @@ python tests/_sharded_worker.py --smoke
 # bitwise vs the single-device walk
 python tests/_sharded_worker.py --elastic-smoke
 
+# serving kill-and-restart smoke (ISSUE 12): a resident FitServer under a
+# request storm — several tenants micro-batched into shared chunked walks,
+# one tenant injected slow — is SIGKILLed MID-COMMIT after 2 durable chunk
+# commits, restarted on the same root, and must re-answer EVERY admitted
+# request bitwise-identically to an uninterrupted server (in-flight batch
+# journals resumed, only uncommitted chunks replayed; unbatched requests
+# re-enqueued), with the Prometheus textfile it streamed mid-run still
+# valid (atomic writes: a scraper never sees a torn file)
+python tests/_serving_worker.py --smoke
+
+# serving tooling smoke (ISSUE 12): a short server run with telemetry on
+# must leave (a) a prom textfile that passes the obs_report --prom gate —
+# exposition syntax + every registry metric present under its mapped name,
+# so a renamed counter cannot silently vanish from dashboards — and (b) a
+# server.json + per-batch journals the budget advisor's serving mode turns
+# into next-life knobs (cell_rows, pipeline depth, overload evidence)
+SERVING_SMOKE_DIR=$(python - <<'EOF'
+import os, tempfile
+import numpy as np
+from spark_timeseries_tpu import obs, serving
+
+root = tempfile.mkdtemp(prefix="serving_smoke_")
+rng = np.random.default_rng(0)
+e = rng.normal(size=(24, 96)).astype(np.float32)
+y = np.zeros_like(e)
+for t in range(1, y.shape[1]):
+    y[:, t] = 0.6 * y[:, t - 1] + e[:, t]
+obs.enable(os.path.join(root, "events.jsonl"))
+srv = serving.FitServer(root, cell_rows=8, batch_window_s=0.05,
+                        prom_path=os.path.join(root, "fits.prom"),
+                        prom_interval_s=0.0)
+# submit BEFORE start(): the three requests deterministically share the
+# first batch instead of racing the coalescing window on a loaded box
+ts = [srv.submit(f"tenant{i}", y[8*i:8*(i+1)], "arima",
+                 order=(1, 0, 0), max_iters=15) for i in range(3)]
+srv.start()
+rs = [t.result(timeout=600) for t in ts]
+srv.stop()
+obs.disable()
+assert rs[0].meta["batch_members"] == 3, rs[0].meta  # coalesced into ONE walk
+h = srv.health()
+assert h["counters"]["completed"] == 3 and h["state"] == "stopped", h
+print(root)
+EOF
+)
+python tools/obs_report.py --check "$SERVING_SMOKE_DIR/events.jsonl" \
+  --prom "$SERVING_SMOKE_DIR/fits.prom"
+python tools/advise_budget.py "$SERVING_SMOKE_DIR" \
+  | grep -q "cell_rows" \
+  || { echo "ci.sh: advise_budget --serving did not suggest cell_rows" >&2; exit 1; }
+rm -rf "$SERVING_SMOKE_DIR"
+
 # host-resident kill-and-resume smoke (ISSUE 7): a journaled walk over a
 # panel that lives in HOST RAM — 4x oversubscribed against a virtual
 # one-chunk device budget, each chunk staged H2D through the pinned-style

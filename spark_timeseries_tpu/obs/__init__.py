@@ -25,6 +25,12 @@ and leaves fit results bitwise-identical to the uninstrumented code:
   dumps the tail for post-mortems.
 - :mod:`.memory` — peak-memory probe: device ``memory_stats()`` with a
   host peak-RSS fallback, so the reading is never null on CPU.
+- :mod:`.promsink` — streaming Prometheus-textfile sink (ISSUE 12): the
+  registry snapshot (+ caller gauges) rendered to the node-exporter
+  textfile-collector format with atomic replace, so a RESIDENT serving
+  process (``serving.FitServer(prom_path=...)``) is scrapeable mid-run;
+  ``validate_textfile`` is the ``obs_report --check --prom`` gate that
+  keeps renamed metrics from silently vanishing off dashboards.
 
 Usage::
 
@@ -55,13 +61,14 @@ inside each lane's timeline row (with a degraded-run total in the
 header).
 """
 
-from . import core, memory, metrics, recorder
+from . import core, memory, metrics, promsink, recorder
 from .core import (NULL_SPAN, Span, counter, disable, dump_failure,
                    dump_on_failure, emit_metrics, enable, enable_from_env,
                    enabled, event, first_dispatch, gauge, histogram,
                    last_crash_dump, snapshot, span, summary)
 from .memory import PeakMemory, peak_memory
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .promsink import PromTextfileSink
 from .recorder import SCHEMA_VERSION, FlightRecorder
 
 __all__ = [
@@ -72,6 +79,7 @@ __all__ = [
     "MetricsRegistry",
     "NULL_SPAN",
     "PeakMemory",
+    "PromTextfileSink",
     "SCHEMA_VERSION",
     "Span",
     "core",
@@ -91,6 +99,7 @@ __all__ = [
     "memory",
     "metrics",
     "peak_memory",
+    "promsink",
     "recorder",
     "snapshot",
     "span",

@@ -39,6 +39,16 @@ quarantined.  All three key on :func:`~.watchdog.current_lane` — the
 thread-local lane tag the :class:`~.plan.LaneRunner` sets around each
 chunk dispatch — so the SAME wrapped fit behaves normally on every
 other lane, deterministically.
+
+**Request faults** (ISSUE 12 — the resident fit server's admission,
+deadline, shedding, and crash-recovery paths): :func:`request_storm`
+burst-admits a list of submissions from a thread pool (driving the
+bounded queue into shedding); :func:`server_kill` SIGKILLs the serving
+process after N durable chunk commits across its batch walks;
+:func:`slow_tenant` makes any micro-batch carrying one tenant's rows
+straggle, keyed on the thread-local request tag
+(:func:`~.watchdog.current_request`) exactly like the lane faults key on
+the lane tag.
 """
 
 from __future__ import annotations
@@ -52,7 +62,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from .status import STATUS_DTYPE, FitStatus
-from .watchdog import current_lane
+from .watchdog import current_lane, current_request
 
 __all__ = [
     "SimulatedCrash",
@@ -71,7 +81,10 @@ __all__ = [
     "crash_after_commits",
     "lane_kill",
     "lane_oom_storm",
+    "request_storm",
+    "server_kill",
     "slow_lane",
+    "slow_tenant",
     "tear_file",
 ]
 
@@ -392,6 +405,91 @@ def lane_oom_storm(fit_fn: Callable, shard_id: int) -> Callable:
         if current_lane() == int(shard_id):
             raise SimulatedResourceExhausted(
                 int(np.prod(np.asarray(yb.shape))) * 4)
+        return fit_fn(yb, **kwargs)
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# request faults (ISSUE 12: the resident fit server's admission, deadline,
+# shedding, and crash-recovery paths must be exercisable in tier-1 CPU tests)
+# ---------------------------------------------------------------------------
+
+
+def request_storm(submit: Callable, calls, threads: int = 8,
+                  timeout_s: float = 120.0) -> tuple:
+    """Burst-admit ``calls`` concurrently — the admission-control load
+    test.  ``submit`` is typically ``server.submit``; each element of
+    ``calls`` is ``(args_tuple, kwargs_dict)`` and is fired from a pool
+    of ``threads`` worker threads as fast as they can go.
+
+    Returns ``(results, errors)``, both lists aligned with ``calls``:
+    ``results[i]`` is the submit's return value (a ticket) or None,
+    ``errors[i]`` the exception it raised (``RejectedError`` under
+    overload — the storm is exactly how shedding is driven) or None.
+    Deterministic in coverage, deliberately NOT in interleaving: the
+    invariant under test is conservation (every call is answered or
+    explicitly rejected; none hang, none OOM), not ordering.
+    """
+    import queue as queue_mod
+    import threading
+
+    calls = list(calls)
+    results: list = [None] * len(calls)
+    errors: list = [None] * len(calls)
+    work: "queue_mod.Queue" = queue_mod.Queue()
+    for i, c in enumerate(calls):
+        work.put((i, c))
+
+    def _worker():
+        while True:
+            try:
+                i, (args, kwargs) = work.get_nowait()
+            except queue_mod.Empty:
+                return
+            try:
+                results[i] = submit(*args, **(kwargs or {}))
+            except BaseException as e:  # noqa: BLE001 - reported per call
+                errors[i] = e
+
+    ts = [threading.Thread(target=_worker, daemon=True,
+                           name=f"request-storm-{k}")
+          for k in range(max(1, int(threads)))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=timeout_s)
+    return results, errors
+
+
+def server_kill(n_commits: int, *, mid_commit: bool = False) -> Callable:
+    """SIGKILL stand-in for a dying fit SERVER: a journal commit hook that
+    kills the process after ``n_commits`` durable chunk commits COUNTED
+    ACROSS every batch walk the server runs (pass as
+    ``FitServer(_commit_hook=...)`` in a subprocess).  With
+    ``mid_commit=True`` the kill lands inside a commit (shard written,
+    manifest not yet updated) — the torn-batch window restart recovery
+    must replay.  Same contract as :func:`kill_after_commits`; the
+    serving spelling exists so the serving tests read as what they
+    simulate."""
+    return kill_after_commits(n_commits, mid_commit=mid_commit)
+
+
+def slow_tenant(fit_fn: Callable, tenant: str, delay_s: float) -> Callable:
+    """Wrap ``fit_fn`` so any serving batch carrying ``tenant``'s rows
+    straggles ``delay_s`` per fit call — one tenant's pathological panel
+    slowing the micro-batch it rides in.  Keys on the thread-local
+    request tag (:func:`~.watchdog.current_request`, the serving twin of
+    the PR 10 lane tags), so the SAME registered fit behaves normally for
+    every other batch, deterministically; with a chunk/job budget armed
+    the watchdog TIMEOUTs the straggling batch instead of hanging the
+    server."""
+
+    @functools.wraps(fit_fn)
+    def wrapped(yb, **kwargs):
+        tags = current_request() or ()
+        if tenant in tags:
+            time.sleep(float(delay_s))
         return fit_fn(yb, **kwargs)
 
     return wrapped

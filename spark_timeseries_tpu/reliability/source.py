@@ -200,7 +200,8 @@ class ChunkSource:
 
     kind = "abstract"
 
-    def __init__(self, shape: Tuple[int, int], dtype):
+    def __init__(self, shape: Tuple[int, int], dtype,
+                 pool: Optional[StagingPool] = None):
         b, t = int(shape[0]), int(shape[1])
         if b <= 0 or t <= 0:
             raise SourceError(f"chunk source must be non-empty 2-D, "
@@ -210,7 +211,18 @@ class ChunkSource:
         self.dtype = np.dtype(dtype)
         self.nbytes = b * t * self.dtype.itemsize
         self.default_chunk_rows: Optional[int] = None
-        self._pool = StagingPool(t, self.dtype)
+        if pool is not None:
+            # a caller-owned pool shared across sources (ISSUE 12: the
+            # resident fit server keeps ONE process-level pool warm across
+            # requests, so buffer reuse spans panels, not just chunks) —
+            # geometry must match or the leased views would be wrong-shaped
+            if pool.n_cols != t or pool.dtype != self.dtype:
+                raise SourceError(
+                    f"shared staging pool is [*, {pool.n_cols}] "
+                    f"{pool.dtype}, panel needs [*, {t}] {self.dtype}")
+            self._pool = pool
+        else:
+            self._pool = StagingPool(t, self.dtype)
         self._mu = threading.Lock()
         self._align_mode: Optional[str] = None
         self._fingerprint: Optional[str] = None
@@ -434,12 +446,12 @@ class HostChunkSource(ChunkSource):
 
     kind = "host"
 
-    def __init__(self, values):
+    def __init__(self, values, pool: Optional[StagingPool] = None):
         arr = np.asarray(values)
         if arr.ndim != 2:
             raise SourceError(f"expected [batch, time], got {arr.shape}")
         self._arr = arr
-        super().__init__(arr.shape, arr.dtype)
+        super().__init__(arr.shape, arr.dtype, pool=pool)
         row_bytes = max(1, self.shape[1] * self.dtype.itemsize)
         self.default_chunk_rows = max(
             1, min(self.shape[0], _DEFAULT_SLICE_BYTES // row_bytes))

@@ -35,7 +35,8 @@ from typing import Callable, Optional
 from .. import obs
 
 __all__ = ["Deadline", "DeadlineExceeded", "call_with_deadline",
-           "current_lane", "lane_context"]
+           "current_lane", "current_request", "lane_context",
+           "request_context"]
 
 # -- lane identity (ISSUE 11) -----------------------------------------------
 # The elastic sharded walk needs to know, from INSIDE a fit call, which lane
@@ -63,6 +64,34 @@ def lane_context(shard_id: Optional[int]):
         yield
     finally:
         _lane_ctx.shard_id = prev
+
+
+# -- request identity (ISSUE 12) ---------------------------------------------
+# The serving layer's twin of the lane tag: a FitServer batch walk serves
+# several tenants' requests in ONE fit program, and the request-level fault
+# injectors (reliability.faultinject.slow_tenant / server_kill targeting)
+# need to know, from inside a fit call, WHOSE work is on this thread.  The
+# tag is the tuple of tenant ids riding the active micro-batch (or a single
+# request's tenant for a solo run), propagated across the watchdog's worker
+# thread hop exactly like the lane tag.
+
+
+def current_request() -> Optional[tuple]:
+    """Tenant tags of the serving request/batch executing on THIS thread
+    (set by ``serving.FitServer`` around each batch walk); None outside."""
+    return getattr(_lane_ctx, "request_tags", None)
+
+
+@contextlib.contextmanager
+def request_context(tags):
+    """Tag the current thread as serving ``tags`` (a tuple of tenant ids;
+    None: untag)."""
+    prev = getattr(_lane_ctx, "request_tags", None)
+    _lane_ctx.request_tags = tuple(tags) if tags is not None else None
+    try:
+        yield
+    finally:
+        _lane_ctx.request_tags = prev
 
 
 class DeadlineExceeded(RuntimeError):
@@ -122,6 +151,7 @@ def call_with_deadline(fn: Callable, budget_s: Optional[float] = None,
     """
     if lane is None:
         lane = current_lane()
+    req = current_request()  # serving request tag survives the hop too
     if budget_s is None:
         with lane_context(lane):
             return fn()
@@ -130,7 +160,7 @@ def call_with_deadline(fn: Callable, budget_s: Optional[float] = None,
 
     def worker():
         try:
-            with lane_context(lane):
+            with lane_context(lane), request_context(req):
                 box["result"] = fn()
         except BaseException as e:  # noqa: BLE001 - re-raised in the caller
             box["error"] = e

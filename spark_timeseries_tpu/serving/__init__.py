@@ -1,0 +1,60 @@
+"""Resident serving loop (ISSUE 12 / ROADMAP item 1).
+
+Everything below this package was one-shot — ``panel.fit`` built a plan,
+walked it, and exited.  :class:`FitServer` is the long-lived caller the
+journal, watchdog, elastic-lane, and obs planes were built for: a daemon
+that admits concurrent tenant fit requests under bounded queues and
+per-tenant quotas, coalesces compatible panels into micro-batched chunked
+walks (demuxed per tenant, bitwise-identical to solo fits), enforces
+per-request deadlines through the watchdog, sheds lowest-priority work
+under overload with explicit retry-after rejections, quarantines failing
+batches, keeps one process-level staging pool and the compile cache warm
+across requests, journals every batch so a SIGKILLed server resumes
+in-flight work bitwise on restart, and streams its health and metrics
+through the Prometheus-textfile sink (``obs.promsink``).
+
+Quickstart::
+
+    from spark_timeseries_tpu import serving
+
+    with serving.FitServer("/srv/fits", max_batch_rows=8192,
+                           prom_path="/metrics/fits.prom") as srv:
+        ticket = srv.submit("tenant-a", y, "arima", order=(1, 1, 1),
+                            deadline_s=30.0)
+        res = ticket.result()          # TenantFitResult, rows == y rows
+        res.status                     # per-row FitStatus, TIMEOUT capped
+
+- :mod:`.session` — requests, tickets, results, the error vocabulary
+  (:class:`RejectedError` with ``retry_after_s`` is the backpressure
+  signal).
+- :mod:`.admission` — the bounded queue, priority shedding, tenant
+  quotas.
+- :mod:`.batcher` — micro-batch packing/demux and the durable batch
+  membership records recovery replays.
+- :mod:`.server` — the :class:`FitServer` daemon itself.
+"""
+
+from . import admission, batcher, server, session
+from .admission import AdmissionQueue, TenantQuota
+from .batcher import MicroBatch, batch_key
+from .server import FitServer
+from .session import (CancelledError, FitRequest, FitTicket, RejectedError,
+                      ServerClosedError, TenantFitResult)
+
+__all__ = [
+    "AdmissionQueue",
+    "CancelledError",
+    "FitRequest",
+    "FitServer",
+    "FitTicket",
+    "MicroBatch",
+    "RejectedError",
+    "ServerClosedError",
+    "TenantFitResult",
+    "TenantQuota",
+    "admission",
+    "batch_key",
+    "batcher",
+    "server",
+    "session",
+]

@@ -1,0 +1,932 @@
+"""The resident fit server: a long-lived serving loop over the chunk driver.
+
+ROADMAP item 1 (ISSUE 12): every caller surface before this PR was
+one-shot — build a plan, walk it, exit — but a production service holds
+state BETWEEN requests.  :class:`FitServer` is that state:
+
+- **admission** (:mod:`.admission`): caller threads ``submit()`` tenant
+  panels; a bounded queue + per-tenant quotas keep memory finite, and
+  overload sheds lowest-priority work with explicit
+  :class:`~.session.RejectedError` (retry-after backpressure) — never an
+  OOM, never an unbounded queue.
+- **micro-batching** (:mod:`.batcher`): compatible requests coalesce into
+  ONE chunked walk (tenants packed on the row axis the way PR 9 packed
+  candidate orders), demuxed per tenant afterwards — bitwise-identical to
+  fitting each tenant alone.
+- **deadlines**: a request's ``deadline_s`` bounds its wall clock —
+  expired-in-queue requests answer all-TIMEOUT rows immediately, and a
+  dispatched batch runs under ``job_budget_s`` = the earliest member
+  deadline, riding the chunk driver's watchdog (TIMEOUT rows, never a
+  hang).
+- **graceful degradation**: a batch walk that raises quarantines only
+  that batch — its members re-run SOLO so one poisoned tenant panel
+  cannot take down its co-batched neighbors (the serving rung of the
+  PR 10 quarantine ladder; sharded walks additionally quarantine failing
+  LANES inside the walk) — and the server keeps serving.
+- **crash recovery**: requests are durable at admission (write-ahead npz
+  under ``<root>/requests/``), batch membership is durable before each
+  walk (``<root>/batches/<id>/members.json``), and every batch walk
+  journals under its batch directory.  A SIGKILLed server restarted on
+  the same root re-forms the in-flight batches from their membership
+  records, RESUMES their journals (replaying only uncommitted chunks —
+  results bitwise-identical to an uninterrupted run), re-answers
+  completed requests from ``<root>/results/``, and re-enqueues the rest.
+- **warmth**: ONE process-level staging-pool family
+  (``reliability.source.StagingPool``) is shared across every request's
+  walk, and the per-program compile cache
+  (``utils.compile_cache.program_cache_stats``) spans requests — repeat
+  fits of a shape skip straight to execute, and both hit rates are
+  exposed (and asserted to climb in the tests).
+- **observability**: health/readiness state (``health()``), obs-plane
+  gauges/counters, and a streaming Prometheus-textfile sink
+  (``obs.promsink``) rewritten after every batch so the server is
+  scrapeable MID-run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Dict, Optional, Union
+
+import numpy as np
+
+from .. import obs
+from ..reliability import fit_chunked
+from ..reliability import source as source_mod
+from ..reliability import watchdog as watchdog_mod
+from ..reliability.faultinject import SimulatedCrash
+from ..reliability.status import FitStatus
+from ..utils import compile_cache
+from . import batcher
+from .admission import AdmissionQueue, TenantQuota
+from .session import (CancelledError, FitRequest, FitTicket, RejectedError,
+                      ServerClosedError, TenantFitResult)
+
+__all__ = ["FitServer"]
+
+
+def _align_mode_host(values: np.ndarray) -> str:
+    """The panel's static align mode, probed host-side at admission (the
+    same vocabulary as ``models.base.align_mode_on_host``).  Part of the
+    batch key: same-mode panels concatenate to the same mode, so a
+    micro-batched walk runs the exact program each solo walk would."""
+    nan_last = bool(np.isnan(values[:, -1]).any())
+    if nan_last:
+        return "general"
+    return "no-trailing" if bool(np.isnan(values).any()) else "dense"
+
+
+def _load_online_advisor() -> Optional[Callable]:
+    """``tools/advise_budget.py``'s knob inference, imported by file path
+    (ISSUE 12: run ONLINE between batches instead of post-mortem).  The
+    tools directory is a repo-checkout artifact, not a package — absence
+    degrades to no adaptation, never to a serving failure."""
+    try:
+        import importlib.util
+        import sys
+
+        tools_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "tools")
+        path = os.path.join(tools_dir, "advise_budget.py")
+        if not os.path.exists(path):
+            return None
+        if tools_dir not in sys.path:  # advise_budget imports a sibling
+            sys.path.append(tools_dir)
+        spec = importlib.util.spec_from_file_location(
+            "_ststpu_online_advise", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.advise
+    except Exception:  # noqa: BLE001 - advisory only
+        return None
+
+
+class FitServer:
+    """A long-lived in-process fit daemon (see module docstring).
+
+    ``root`` is the server-owned checkpoint root — requests, batch
+    journals, and results live under it, and a restarted server on the
+    same root recovers everything in flight.  ``models`` extends the
+    built-in model registry (name -> fit callable); requests reference
+    models BY NAME so they stay durable/re-resolvable across restarts.
+
+    Thread model: ``submit()`` is safe from any thread; ONE serve-loop
+    thread forms and walks batches (the walk itself pipelines
+    stage/compute/commit internally, and ``shard=True`` in
+    ``walk_kwargs`` adds elastic mesh lanes).
+    """
+
+    def __init__(self, root: str, *,
+                 models: Optional[Dict[str, Callable]] = None,
+                 batch_window_s: float = 0.01,
+                 max_batch_rows: int = 4096,
+                 max_queue_rows: int = 65_536,
+                 max_queue_requests: int = 1024,
+                 max_inflight_per_tenant: Optional[int] = None,
+                 max_rows_per_tenant: Optional[int] = None,
+                 max_rows_per_request: Optional[int] = None,
+                 cell_rows: int = 256,
+                 pipeline_depth: int = 2,
+                 prefetch_depth: int = 1,
+                 chunk_budget_s: Optional[float] = None,
+                 default_deadline_s: Optional[float] = None,
+                 resilient: bool = False,
+                 policy: str = "impute",
+                 autotune: bool = True,
+                 prom_path: Optional[str] = None,
+                 prom_interval_s: float = 2.0,
+                 degraded_window_s: float = 5.0,
+                 walk_kwargs: Optional[dict] = None,
+                 compile_cache_dir: Optional[str] = None,
+                 _commit_hook: Optional[Callable] = None):
+        self.root = os.path.abspath(root)
+        self._requests_dir = os.path.join(self.root, "requests")
+        self._results_dir = os.path.join(self.root, "results")
+        self._batches_dir = os.path.join(self.root, "batches")
+        for d in (self._requests_dir, self._results_dir, self._batches_dir):
+            os.makedirs(d, exist_ok=True)
+        self._models = dict(models or {})
+        self.batch_window_s = float(batch_window_s)
+        self.max_batch_rows = int(max_batch_rows)
+        self.chunk_budget_s = chunk_budget_s
+        self.default_deadline_s = default_deadline_s
+        self.resilient = bool(resilient)
+        self.policy = str(policy)
+        self.autotune = bool(autotune)
+        self.degraded_window_s = float(degraded_window_s)
+        self.walk_kwargs = dict(walk_kwargs or {})
+        self._commit_hook = _commit_hook
+        self.queue = AdmissionQueue(max_queue_rows=max_queue_rows,
+                                    max_queue_requests=max_queue_requests)
+        self.quota = TenantQuota(
+            max_inflight_per_tenant=max_inflight_per_tenant,
+            max_rows_per_tenant=max_rows_per_tenant,
+            max_rows_per_request=max_rows_per_request)
+        # adaptive walk knobs: seeded from config, then advise_budget's
+        # inference updates them ONLINE after each journaled batch; a
+        # restart reloads the last adaptation so warmup is not re-paid.
+        # cell_rows is both the batcher's padding quantum and the batch
+        # walk's chunk size — one request per chunk cell is what keeps
+        # micro-batched results bitwise-identical to solo fits.
+        self._knobs = {"cell_rows": max(1, min(int(cell_rows),
+                                               self.max_batch_rows)),
+                       "pipeline_depth": int(pipeline_depth),
+                       "prefetch_depth": int(prefetch_depth)}
+        self._knobs_path = os.path.join(self.root, "knobs.json")
+        if self.autotune and os.path.exists(self._knobs_path):
+            try:
+                with open(self._knobs_path) as f:
+                    saved = json.load(f)
+                self._knobs.update({k: saved[k] for k in self._knobs
+                                    if saved.get(k) is not None})
+            except (OSError, json.JSONDecodeError, KeyError):
+                pass
+        self._advise = _load_online_advisor() if self.autotune else None
+        # ONE process-level staging-pool family shared across requests
+        # (keyed by panel geometry — a pool's buffers are [*, T] dtype)
+        self._pools: Dict[tuple, source_mod.StagingPool] = {}
+        self._pools_lock = threading.Lock()
+        if compile_cache_dir:
+            compile_cache.enable_compile_cache(compile_cache_dir)
+        # prom sink (obs.promsink): rewritten after every batch + idle tick
+        self._prom = None
+        self._prom_interval_s = float(prom_interval_s)
+        self._prom_last = 0.0
+        if prom_path:
+            from ..obs.promsink import PromTextfileSink
+
+            self._prom = PromTextfileSink(prom_path)
+        self._state = "starting"
+        self._state_lock = threading.Lock()
+        self._degraded_until = 0.0
+        self._ready = threading.Event()
+        self._stop = threading.Event()
+        self._drain = True
+        self._thread: Optional[threading.Thread] = None
+        self._crash_error: Optional[BaseException] = None
+        self._seq_lock = threading.Lock()
+        self._seq = self._next_seq_floor()
+        self._batch_seq = 0
+        self._live: Dict[str, FitRequest] = {}  # req_id -> admitted request
+        self._live_lock = threading.Lock()
+        self.counters = {
+            "admitted": 0, "completed": 0, "rejected": 0, "shed": 0,
+            "cancelled": 0, "timeout_requests": 0, "deadline_expired": 0,
+            "batches_run": 0, "batch_failures": 0, "solo_retries": 0,
+            "rows_fitted": 0, "recovered_requests": 0,
+            "recovered_batches": 0, "autotune_updates": 0,
+        }
+        self._counters_lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, wait_ready: bool = True,
+              timeout_s: float = 300.0) -> "FitServer":
+        """Start the serve loop (recovery first, then steady state).
+        ``wait_ready=True`` blocks until recovery finished and the server
+        reports ready."""
+        if self._thread is not None:
+            raise RuntimeError("FitServer.start() called twice")
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="fit-server")
+        self._thread.start()
+        if wait_ready and not self._ready.wait(timeout=timeout_s):
+            raise TimeoutError("FitServer recovery did not finish in "
+                               f"{timeout_s}s")
+        if self._crash_error is not None:
+            raise ServerClosedError(
+                f"server crashed during startup: {self._crash_error!r}")
+        return self
+
+    def stop(self, drain: bool = True, timeout_s: float = 300.0) -> None:
+        """Stop serving.  ``drain=True`` answers everything already
+        queued first; ``drain=False`` abandons the queue (requests stay
+        durable for the next start on this root)."""
+        self._drain = drain
+        self._set_state("draining" if drain else "stopping")
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout_s)
+        # ALWAYS close the queue, drained or not: a submit() racing the
+        # state check can land an offer after the serve loop exits, and
+        # an enqueued-but-never-served ticket would hang its caller —
+        # reject it explicitly (the durable request record survives for
+        # the next start on this root)
+        for req in self.queue.close():
+            req.ticket._reject(ServerClosedError(
+                "server stopped before serving this request; it is "
+                "durable — restart the server on the same root"))
+        self._set_state("stopped")
+        self._write_server_state()
+        self._write_prom(force=True)
+
+    def __enter__(self) -> "FitServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- admission (caller threads) ------------------------------------------
+
+    def submit(self, tenant: str, values, model: Union[str, Callable] = "arima",
+               *, priority: int = 0, deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None, **fit_kwargs) -> FitTicket:
+        """Admit one tenant panel fit; returns a :class:`FitTicket`.
+
+        ``values`` is a host ``[rows, T]`` array (copied to the durable
+        request record).  ``model`` must be a registry NAME (built-in
+        model module or a name passed via ``models=`` at construction) so
+        the request survives a restart.  ``deadline_s`` bounds the
+        request's wall clock from NOW (default: the server's
+        ``default_deadline_s``); ``priority`` (higher = keep longer under
+        overload) drives shedding.  ``request_id`` makes the submit
+        idempotent: re-submitting a completed id returns its stored
+        result instantly.
+
+        Raises :class:`RejectedError` (queue full / quota — carries
+        ``retry_after_s``) or :class:`ServerClosedError`.
+        """
+        if self._state in ("draining", "stopping", "stopped", "crashed"):
+            raise ServerClosedError(f"server is {self._state}")
+        if callable(model):
+            name = next((k for k, v in self._models.items() if v is model),
+                        None)
+            if name is None:
+                raise TypeError(
+                    "model callables must be registered by name "
+                    "(FitServer(models={'name': fn})) so requests stay "
+                    "durable across restarts")
+            model = name
+        self._resolve_model(model)  # unknown model fails at the door
+        arr = np.ascontiguousarray(np.asarray(values))
+        if arr.ndim != 2 or arr.shape[0] < 1 or arr.shape[1] < 1:
+            raise ValueError(f"expected a non-empty [rows, T] panel, "
+                             f"got {arr.shape}")
+        if request_id is not None:
+            prior = self._try_stored(request_id)
+            if prior is not None:
+                return prior
+            with self._live_lock:
+                dup = request_id in self._live
+            if dup:
+                self._count_rejected()
+                raise RejectedError(
+                    f"request {request_id!r} is already in flight; poll "
+                    "its ticket or result_for()", retry_after_s=0.5)
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        try:
+            self.quota.try_acquire(tenant, arr.shape[0])
+        except RejectedError:
+            self._count_rejected()
+            raise
+        try:
+            with self._seq_lock:
+                self._seq += 1
+                seq = self._seq
+            req_id = request_id or f"r{seq:08d}-{uuid.uuid4().hex[:8]}"
+            req = FitRequest(
+                req_id, seq, tenant, arr, model, fit_kwargs,
+                priority=priority, deadline_s=deadline_s,
+                align_mode=_align_mode_host(arr),
+                resilient=self.resilient, policy=self.policy)
+            req.ticket._canceller = self._cancel
+            # write-ahead: the request is durable BEFORE the caller holds
+            # a ticket for it — a crash after this line re-answers it
+            req.save(self._request_path(req_id))
+            # live BEFORE the queue sees it: the moment offer() returns,
+            # the serve loop (or a shedding offer on another thread) may
+            # complete the request and call _forget — registering after
+            # the fact would leak a stale entry (and its panel) forever
+            with self._live_lock:
+                self._live[req.req_id] = req
+            try:
+                self.queue.offer(req, on_shed=self._on_shed)
+            except RejectedError:
+                with self._live_lock:
+                    self._live.pop(req.req_id, None)
+                self._remove_request_file(req_id)
+                raise
+        except RejectedError:
+            self.quota.release(tenant, arr.shape[0])
+            self._count_rejected()
+            raise
+        with self._counters_lock:
+            self.counters["admitted"] += 1
+        obs.counter("server.admitted").inc()
+        return req.ticket
+
+    def _count_rejected(self) -> None:
+        """Every refusal — queue, quota, duplicate — is load evidence:
+        it must show in the counters and flip the degraded signal, or a
+        saturated server reads as healthy."""
+        with self._counters_lock:
+            self.counters["rejected"] += 1
+        self._note_degraded()
+        obs.counter("server.rejected").inc()
+
+    def _cancel(self, req_id: str) -> bool:
+        req = self.queue.cancel(req_id)
+        if req is None:
+            return False
+        self._forget(req)
+        self._remove_request_file(req_id)
+        with self._counters_lock:
+            self.counters["cancelled"] += 1
+        obs.counter("server.cancelled").inc()
+        return True
+
+    def _on_shed(self, req: FitRequest) -> None:
+        """Queue eviction callback: refund the quota and durable record."""
+        self._forget(req)
+        self._remove_request_file(req.req_id)
+        with self._counters_lock:
+            self.counters["shed"] += 1
+        self._note_degraded()
+        obs.counter("server.shed").inc()
+        obs.event("server.shed", req_id=req.req_id, tenant=req.tenant,
+                  priority=req.priority)
+
+    def _try_stored(self, request_id: str) -> Optional[FitTicket]:
+        path = os.path.join(self._results_dir, f"{request_id}.npz")
+        if not os.path.exists(path):
+            return None
+        t = FitTicket(request_id)
+        t._resolve(self._load_result(path))
+        return t
+
+    # -- results / durable paths ---------------------------------------------
+
+    def _request_path(self, req_id: str) -> str:
+        return os.path.join(self._requests_dir, f"{req_id}.npz")
+
+    def _remove_request_file(self, req_id: str) -> None:
+        try:
+            os.remove(self._request_path(req_id))
+        except OSError:
+            pass
+
+    def _store_result(self, req_id: str, res: TenantFitResult) -> None:
+        path = os.path.join(self._results_dir, f"{req_id}.npz")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, params=res.params, nll=res.neg_log_likelihood,
+                     converged=res.converged, iters=res.iters,
+                     status=res.status,
+                     meta=np.frombuffer(
+                         json.dumps(res.meta, default=repr).encode(),
+                         dtype=np.uint8))
+        os.replace(tmp, path)
+
+    def _load_result(self, path: str) -> TenantFitResult:
+        with np.load(path) as z:
+            return TenantFitResult(
+                params=np.array(z["params"]),
+                neg_log_likelihood=np.array(z["nll"]),
+                converged=np.array(z["converged"]),
+                iters=np.array(z["iters"]),
+                status=np.array(z["status"]),
+                meta=json.loads(bytes(z["meta"].tobytes()).decode()))
+
+    def result_for(self, req_id: str) -> TenantFitResult:
+        """Load a completed request's stored result — how a client
+        re-attaches after a server restart re-answered its request."""
+        path = os.path.join(self._results_dir, f"{req_id}.npz")
+        if not os.path.exists(path):
+            raise KeyError(f"no stored result for request {req_id!r}")
+        return self._load_result(path)
+
+    # -- the serve loop ------------------------------------------------------
+
+    def _serve(self) -> None:
+        try:
+            self._recover()
+            self._set_state("ready")
+            self._ready.set()
+            while True:
+                if self._stop.is_set() and not self._drain:
+                    break
+                cell = self._knobs["cell_rows"]
+                members = self.queue.take_batch(
+                    batcher.batch_key, self.max_batch_rows,
+                    window_s=self.batch_window_s, timeout_s=0.25,
+                    # the PADDED size is what the walk stages and fits:
+                    # max_batch_rows must bound the packed panel, not
+                    # just the payload
+                    rows_fn=lambda r: -(-r.rows // cell) * cell)
+                if not members:
+                    if self._stop.is_set():
+                        break  # drained
+                    self._idle_tick()
+                    continue
+                self._run_members(members)
+        except BaseException as e:  # noqa: BLE001 - crash path below
+            self._crash_error = e
+            self._set_state("crashed")
+            self._ready.set()
+            # pending tickets must not hang forever on a dead loop: the
+            # durable state re-answers them on the next start
+            with self._live_lock:
+                live = list(self._live.values())
+            for req in live:
+                req.ticket._reject(ServerClosedError(
+                    f"server crashed ({type(e).__name__}); the request is "
+                    "durable — restart the server on this root to "
+                    "re-answer it"))
+            if not isinstance(e, (SimulatedCrash, KeyboardInterrupt)):
+                obs.event("server.crash", error=repr(e)[:300])
+                raise
+
+    def _run_members(self, members) -> None:
+        # deadline triage: a request that expired while queued answers
+        # all-TIMEOUT rows NOW — it never costs a dispatch
+        ready = []
+        for req in members:
+            if req.ticket.done():  # cancelled while the batch formed
+                self._forget(req)
+                continue
+            if req.expired():
+                self._finalize(req, batcher.timeout_result(
+                    req, "deadline expired while queued"))
+                with self._counters_lock:
+                    self.counters["deadline_expired"] += 1
+                obs.counter("server.deadline_expired").inc()
+                continue
+            ready.append(req)
+        if not ready:
+            return
+        self._batch_seq += 1
+        knobs = dict(self._knobs)
+        batch = batcher.pack(ready, self._batch_seq,
+                             cell_rows=knobs["cell_rows"])
+        batch.save_members(self.root, knobs)
+        t0 = time.perf_counter()
+        try:
+            res = self._execute_batch(batch, knobs)
+        except Exception as e:  # noqa: BLE001 - batch quarantine below
+            self._quarantine_batch(batch, e)
+            return
+        wall = time.perf_counter() - t0
+        self._deliver(batch, res)
+        self.queue.record_drain(batch.rows, wall)
+        self._after_batch(batch, wall)
+
+    def _execute_batch(self, batch: "batcher.MicroBatch", knobs: dict):
+        fit_fn = self._resolve_model(batch.members[0].model)
+        head = batch.members[0]
+        from ..reliability.runner import _accepted_kwargs
+
+        # the explicit align hint is what makes batched == solo bitwise
+        # (same compiled program family either way); a registry fit that
+        # does not take the hint simply runs its own per-chunk plan
+        align = (head.align_mode
+                 if "align_mode" in _accepted_kwargs(
+                     fit_fn, {"align_mode": None}) else None)
+        src = source_mod.HostChunkSource(
+            batch.values, pool=self._pool_for(batch.values.shape[1],
+                                              batch.values.dtype))
+        ckpt = os.path.join(batch.dir(self.root), "journal")
+        job_budget = batch.job_budget_s()
+        with watchdog_mod.request_context(batch.tenants):
+            with obs.span("server.batch", batch_id=batch.batch_id,
+                          members=len(batch.members), rows=batch.rows):
+                return fit_chunked(
+                    fit_fn, src,
+                    chunk_rows=batch.cell_rows,
+                    resilient=head.resilient,
+                    policy=head.policy,
+                    checkpoint_dir=ckpt,
+                    chunk_budget_s=self.chunk_budget_s,
+                    job_budget_s=job_budget,
+                    pipeline_depth=int(knobs.get("pipeline_depth") or 2),
+                    prefetch_depth=int(knobs.get("prefetch_depth") or 1),
+                    align_mode=align,
+                    _journal_commit_hook=self._commit_hook,
+                    **{**self.walk_kwargs, **head.fit_kwargs})
+
+    def _deliver(self, batch: "batcher.MicroBatch", res) -> None:
+        # counters BEFORE tickets resolve: a caller that reads health()
+        # the moment its result() unblocks must see this batch counted
+        with self._counters_lock:
+            self.counters["batches_run"] += 1
+            self.counters["rows_fitted"] += batch.rows
+        obs.counter("server.batches").inc()
+        obs.counter("server.rows_fitted").add(batch.rows)
+        obs.histogram("server.batch_members").observe(len(batch.members))
+        for req, tres in zip(batch.members, batch.demux(res)):
+            self._finalize(req, tres)
+        batch.mark_complete(self.root)
+
+    def _quarantine_batch(self, batch: "batcher.MicroBatch",
+                          error: Exception) -> None:
+        """A failed batch walk takes down ONLY this batch: members re-run
+        solo so a poisoned tenant panel is isolated to its own request
+        (the serving rung of the PR 10 quarantine ladder); a solo failure
+        lands on that request's ticket alone.  The server keeps serving
+        either way."""
+        with self._counters_lock:
+            self.counters["batch_failures"] += 1
+        self._note_degraded()
+        obs.counter("server.batch_failures").inc()
+        obs.event("server.batch_quarantined", batch_id=batch.batch_id,
+                  members=len(batch.members), error=repr(error)[:200])
+        if len(batch.members) == 1:
+            req = batch.members[0]
+            self._forget(req)
+            req.ticket._reject(error)
+            return
+        for req in batch.members:
+            if req.ticket.done():
+                self._forget(req)
+                continue
+            with self._counters_lock:
+                self.counters["solo_retries"] += 1
+            self._batch_seq += 1
+            knobs = dict(self._knobs)
+            solo = batcher.pack([req], self._batch_seq,
+                                cell_rows=knobs["cell_rows"])
+            solo.save_members(self.root, knobs)
+            try:
+                res = self._execute_batch(solo, knobs)
+            except Exception as e:  # noqa: BLE001 - per-request terminal
+                self._forget(req)
+                req.ticket._reject(e)
+                continue
+            self._deliver(solo, res)
+
+    def _finalize(self, req: FitRequest, tres: TenantFitResult) -> None:
+        self._store_result(req.req_id, tres)
+        self._remove_request_file(req.req_id)
+        self._forget(req)
+        with self._counters_lock:
+            self.counters["completed"] += 1
+            if int((tres.status == FitStatus.TIMEOUT).sum()):
+                self.counters["timeout_requests"] += 1
+        obs.counter("server.completed").inc()
+        req.ticket._resolve(tres)  # last: the caller may read health() now
+
+    def _forget(self, req: FitRequest) -> None:
+        with self._live_lock:
+            self._live.pop(req.req_id, None)
+        self.quota.release(req.tenant, req.rows)
+
+    # -- recovery (restart on a used root) -----------------------------------
+
+    def _recover(self) -> None:
+        """Re-answer everything a dead server left in flight: re-form
+        recorded batches (their journals resume bitwise), then re-enqueue
+        admitted-but-unbatched requests."""
+        pending: Dict[str, FitRequest] = {}
+        for fn in sorted(os.listdir(self._requests_dir)):
+            if not fn.endswith(".npz"):
+                continue
+            path = os.path.join(self._requests_dir, fn)
+            try:
+                req = FitRequest.load(path)
+            except Exception:  # noqa: BLE001 - torn request record
+                obs.event("server.recovery_torn_request", path=path)
+                continue
+            if os.path.exists(os.path.join(self._results_dir,
+                                           f"{req.req_id}.npz")):
+                self._remove_request_file(req.req_id)
+                continue
+            with self._live_lock:
+                live = req.req_id in self._live
+            if live:
+                # already admitted to THIS instance (submitted before
+                # start()): the queue owns it — recovery is for the
+                # previous process's orphans only
+                continue
+            # recovery voids deadlines: the original clock died with the
+            # original process, and the re-answer contract is bitwise
+            # identity with an uninterrupted run, not latency
+            req.deadline_s = None
+            req.ticket._canceller = self._cancel
+            pending[req.req_id] = req
+        records = []
+        if os.path.isdir(self._batches_dir):
+            for bid in sorted(os.listdir(self._batches_dir)):
+                d = os.path.join(self._batches_dir, bid)
+                mpath = os.path.join(d, batcher.MEMBERS_FILE)
+                if not os.path.exists(mpath) or os.path.exists(
+                        os.path.join(d, batcher.COMPLETE_FILE)):
+                    continue
+                try:
+                    with open(mpath) as f:
+                        rec = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue
+                ids = [m["req_id"] for m in rec.get("members", [])]
+                if not ids or not all(i in pending for i in ids):
+                    # some members already answered (results written
+                    # before the crash finished the batch) or records
+                    # torn: the remaining members re-enqueue below
+                    continue
+                records.append((rec.get("seq", 0), ids,
+                                rec.get("knobs", {}),
+                                int(rec.get("cell_rows", 1))))
+        # a crash during batch quarantine leaves OVERLAPPING records (the
+        # failed batch plus its solo re-runs name the same request);
+        # replay in seq order and skip any record with a member an
+        # earlier record already took, or this replay would execute the
+        # same request twice and double-release its quota
+        handled: set = set()
+        for seq, ids, knobs, cell in sorted(records):
+            if any(i in handled for i in ids):
+                continue
+            handled.update(ids)
+            members = [pending[i] for i in ids]
+            self._batch_seq = max(self._batch_seq, int(seq))
+            batch = batcher.MicroBatch(members, int(seq), cell_rows=cell)
+            # force=True (like the unbatched path below): _finalize/
+            # _quarantine release per member, so every replayed member
+            # must be acquired or the tenant ledger skews negative
+            for m in members:
+                self.quota.try_acquire(m.tenant, m.rows, force=True)
+            with self._counters_lock:
+                self.counters["recovered_batches"] += 1
+                self.counters["recovered_requests"] += len(members)
+            obs.event("server.recover_batch", batch_id=batch.batch_id,
+                      members=len(members))
+            try:
+                res = self._execute_batch(batch, knobs or dict(self._knobs))
+            except Exception as e:  # noqa: BLE001 - quarantine, as live
+                self._quarantine_batch(batch, e)
+                continue
+            self._deliver(batch, res)
+        for req in sorted(pending.values(), key=lambda r: r.seq):
+            if req.req_id in handled:
+                continue
+            # force=True: the dead server already admitted this work, so
+            # recovery never refuses it — and the acquire stays symmetric
+            # with the release in _forget (an unbalanced ledger would
+            # corrupt the tenant's quota for the server's lifetime)
+            self.quota.try_acquire(req.tenant, req.rows, force=True)
+            with self._counters_lock:
+                self.counters["recovered_requests"] += 1
+            with self._live_lock:
+                self._live[req.req_id] = req
+            try:
+                self.queue.offer(req, on_shed=self._on_shed)
+            except RejectedError as e:
+                with self._live_lock:
+                    self._live.pop(req.req_id, None)
+                self.quota.release(req.tenant, req.rows)
+                req.ticket._reject(e)
+                continue
+
+    def _next_seq_floor(self) -> int:
+        """Request sequence numbers survive restarts (monotonic ids)."""
+        floor = 0
+        try:
+            for fn in os.listdir(self._requests_dir):
+                if fn.startswith("r") and "-" in fn:
+                    try:
+                        floor = max(floor, int(fn[1:].split("-", 1)[0]))
+                    except ValueError:
+                        pass
+        except OSError:
+            pass
+        return floor
+
+    # -- adaptation / warmth -------------------------------------------------
+
+    def _pool_for(self, n_cols: int, dtype) -> source_mod.StagingPool:
+        key = (int(n_cols), str(np.dtype(dtype)))
+        with self._pools_lock:
+            pool = self._pools.get(key)
+            if pool is None:
+                pool = source_mod.StagingPool(n_cols, dtype)
+                self._pools[key] = pool
+            return pool
+
+    def _after_batch(self, batch: "batcher.MicroBatch", wall: float) -> None:
+        self._autotune_from(os.path.join(batch.dir(self.root), "journal"))
+        self._write_server_state()
+        self._write_prom()
+
+    def _autotune_from(self, ckpt: str) -> None:
+        """ISSUE 12: ``tools/advise_budget.py``'s knob inference, run
+        online — the finished batch's manifest suggests the NEXT batch's
+        ``chunk_rows``/``pipeline_depth`` instead of waiting for a
+        post-mortem."""
+        if self._advise is None:
+            return
+        try:
+            with open(os.path.join(ckpt, "manifest.json")) as f:
+                m = json.load(f)
+            a = self._advise(m)
+            s = a.get("suggest") or {}
+        except Exception:  # noqa: BLE001 - advisory only
+            return
+        changed = False
+        cr = s.get("chunk_rows")
+        if cr:
+            # the suggested chunk size becomes the NEXT batches' cell (the
+            # sustained-size logic only ever shrinks it, e.g. after OOM
+            # backoff); results are bitwise-stable per cell setting
+            cr = max(1, min(int(cr), self.max_batch_rows))
+            if cr != self._knobs["cell_rows"]:
+                self._knobs["cell_rows"] = cr
+                changed = True
+        pd = s.get("pipeline_depth")
+        if pd:
+            pd = max(1, min(int(pd), 8))
+            if pd != self._knobs["pipeline_depth"]:
+                self._knobs["pipeline_depth"] = pd
+                changed = True
+        pf = s.get("prefetch_depth")
+        if pf:
+            pf = max(0, min(int(pf), 4))
+            if pf != self._knobs["prefetch_depth"]:
+                self._knobs["prefetch_depth"] = pf
+                changed = True
+        if changed:
+            with self._counters_lock:
+                self.counters["autotune_updates"] += 1
+            obs.counter("server.autotune_updates").inc()
+            obs.event("server.autotune", **self._knobs)
+            try:
+                tmp = self._knobs_path + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(self._knobs, f)
+                os.replace(tmp, self._knobs_path)
+            except OSError:
+                pass
+
+    def _resolve_model(self, model: str) -> Callable:
+        fn = self._models.get(model)
+        if fn is not None:
+            return fn
+        from .. import models as _models
+
+        mod = getattr(_models, model, None)
+        if mod is None or not hasattr(mod, "fit"):
+            raise ValueError(f"unknown model {model!r} (not in the server "
+                             "registry or the bundled model set)")
+        return mod.fit
+
+    # -- health / observability ----------------------------------------------
+
+    def _set_state(self, state: str) -> None:
+        with self._state_lock:
+            if self._state == "crashed":
+                return  # terminal: stop()/__exit__ must not mask a crash
+            if self._state == "stopped" and state != "stopped":
+                return
+            self._state = state
+
+    def _note_degraded(self) -> None:
+        self._degraded_until = time.monotonic() + self.degraded_window_s
+
+    def state(self) -> str:
+        """Lifecycle/health state: ``starting`` → ``ready`` (``degraded``
+        while shedding/rejecting/failing recently or the queue is near its
+        bound) → ``draining``/``stopping`` → ``stopped``; ``crashed``
+        terminal on a serve-loop crash."""
+        with self._state_lock:
+            s = self._state
+        if s == "ready":
+            depth = self.queue.depth()
+            if (time.monotonic() < self._degraded_until
+                    or depth["rows"] > 0.8 * depth["max_rows"]):
+                return "degraded"
+        return s
+
+    def ready(self) -> bool:
+        return self.state() in ("ready", "degraded")
+
+    def health(self) -> dict:
+        """Readiness + load + warmth in one scrape-able dict (also
+        exported through the Prometheus sink)."""
+        depth = self.queue.depth()
+        with self._counters_lock:
+            counters = dict(self.counters)
+        with self._pools_lock:
+            pools = {f"{t}x{dt}": p.stats()
+                     for (t, dt), p in self._pools.items()}
+        with self._live_lock:
+            inflight = len(self._live)
+        return {
+            "state": self.state(),
+            "ready": self.ready(),
+            "degraded": self.state() == "degraded",
+            "queue": depth,
+            "inflight_requests": inflight,
+            "tenants": self.quota.snapshot(),
+            "counters": counters,
+            "knobs": dict(self._knobs),
+            "staging_pools": pools,
+            "compile_cache": compile_cache.program_cache_stats(),
+            "root": self.root,
+        }
+
+    def _numeric_health(self) -> dict:
+        """Flat numeric gauges for the prom sink / obs plane."""
+        h = self.health()
+        out = {
+            "server_ready": 1.0 if h["ready"] else 0.0,
+            "server_degraded": 1.0 if h["degraded"] else 0.0,
+            "server_queue_rows": float(h["queue"]["rows"]),
+            "server_queue_requests": float(h["queue"]["requests"]),
+            "server_inflight_requests": float(h["inflight_requests"]),
+        }
+        for k, v in h["counters"].items():
+            out[f"server_{k}_total"] = float(v)
+        pool_hits = sum(p["pool_hits"] for p in h["staging_pools"].values())
+        pool_miss = sum(p["pool_misses"]
+                        for p in h["staging_pools"].values())
+        out["server_staging_pool_hits_total"] = float(pool_hits)
+        out["server_staging_pool_misses_total"] = float(pool_miss)
+        cc = h["compile_cache"]
+        out["server_compile_cache_hits_total"] = float(cc["hits"])
+        out["server_compile_cache_misses_total"] = float(cc["misses"])
+        return out
+
+    def _idle_tick(self) -> None:
+        self._write_prom()
+
+    def _write_prom(self, force: bool = False) -> None:
+        if self._prom is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._prom_last < self._prom_interval_s:
+            return
+        self._prom_last = now
+        nm = self._numeric_health()
+        # registry first: the sink snapshot then carries the fresh values
+        # and its renderer dedupes the extra copies by family name
+        obs.gauge("server.queue_rows").set(nm["server_queue_rows"])
+        obs.gauge("server.inflight_requests").set(
+            nm["server_inflight_requests"])
+        obs.gauge("server.degraded").set(nm["server_degraded"])
+        try:
+            self._prom.write(extra=nm)
+        except Exception:  # noqa: BLE001 - the sink must never stop serving
+            pass
+
+    def _write_server_state(self) -> None:
+        """``<root>/server.json``: the serving-level record the budget
+        advisor's ``--serving`` mode reads (shed/reject counts, knobs,
+        state) — atomic, best-effort."""
+        try:
+            path = os.path.join(self.root, "server.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({
+                    "state": self.state(),
+                    "counters": dict(self.counters),
+                    "queue": self.queue.depth(),
+                    "knobs": dict(self._knobs),
+                    "max_batch_rows": self.max_batch_rows,
+                    "batch_window_s": self.batch_window_s,
+                }, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            pass

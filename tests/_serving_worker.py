@@ -1,0 +1,227 @@
+"""Subprocess worker for the serving kill-and-restart tests (ISSUE 12).
+
+Runs a resident :class:`serving.FitServer` under a request storm — several
+tenants, one injected slow (``faultinject.slow_tenant``), deterministic
+request ids — optionally SIGKILLing itself mid-batch after N durable chunk
+commits (``faultinject.server_kill``): real process death with staged
+batches, journals, and queued requests in flight.  A restarted worker on
+the same root re-answers EVERY admitted request from recovery
+(in-flight batch journals resumed bitwise, unbatched requests re-enqueued)
+and writes the demuxed results; the ``--smoke`` orchestration compares
+them bitwise against an uninterrupted server on a fresh root and validates
+the Prometheus-textfile sink the server streamed mid-run.
+
+Modes:
+    --run --root R [--kill-commits N] [--out F]
+        serve the standard request set; with --kill-commits the process
+        dies by SIGKILL mid-batch, else all results are saved to F.
+    --recover --root R --out F
+        restart on a used root, wait for recovery to re-answer every
+        request id, save the results.
+    --smoke
+        full orchestration (used by ci.sh): storm + slow tenant, SIGKILL
+        after 2 commits, verify durable state, recover, compare bitwise
+        vs an uninterrupted run, check the prom textfile, print PASS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+T = 96
+CELL = 8
+N_REQS = 5
+SLOW_TENANT = "t2"
+FIELDS = ("params", "neg_log_likelihood", "converged", "iters", "status")
+
+
+def make_panels():
+    rng = np.random.default_rng(11)
+    e = rng.normal(size=(N_REQS * CELL, T)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, T):
+        y[:, i] = 0.6 * y[:, i - 1] + e[:, i]
+    return [y[i * CELL:(i + 1) * CELL] for i in range(N_REQS)]
+
+
+def build_server(root: str, kill_commits: int | None):
+    from spark_timeseries_tpu import serving
+    from spark_timeseries_tpu.models import arima
+    from spark_timeseries_tpu.reliability import faultinject as fi
+
+    hook = (fi.server_kill(kill_commits, mid_commit=True)
+            if kill_commits is not None else None)
+    return serving.FitServer(
+        root,
+        models={"stormmodel": fi.slow_tenant(arima.fit, SLOW_TENANT, 0.15)},
+        cell_rows=CELL, batch_window_s=0.05, autotune=False,
+        prom_path=os.path.join(root, "fits.prom"),
+        prom_interval_s=0.0,
+        _commit_hook=hook,
+    )
+
+
+def save_results(path: str, results: dict) -> None:
+    arrays = {}
+    for rid, res in results.items():
+        for f in FIELDS:
+            arrays[f"{rid}__{f}"] = np.asarray(getattr(res, f))
+        arrays[f"{rid}__resumed"] = np.asarray(
+            (res.meta.get("journal") or {}).get("chunks_resumed") or 0)
+    np.savez(path, **arrays)
+
+
+def run(root: str, kill_commits: int | None, out: str | None) -> None:
+    from spark_timeseries_tpu.reliability import faultinject as fi
+
+    srv = build_server(root, kill_commits)
+    srv.start()
+    panels = make_panels()
+    calls = [((f"t{i}", panels[i], "stormmodel"),
+              dict(order=(1, 0, 0), max_iters=15, request_id=f"req-{i}"))
+             for i in range(N_REQS)]
+    tickets, errors = fi.request_storm(srv.submit, calls, threads=4)
+    bad = [e for e in errors if e is not None]
+    if bad:  # the queue is sized for the storm: nothing should shed here
+        sys.exit(f"unexpected admission errors: {bad!r}")
+    results = {}
+    for i, tk in enumerate(tickets):
+        results[f"req-{i}"] = tk.result(timeout=600)
+    if kill_commits is not None:
+        sys.exit(f"kill_commits={kill_commits} but the server finished — "
+                 "the hook never fired")
+    srv.stop()
+    if out:
+        save_results(out, results)
+
+
+def recover(root: str, out: str) -> None:
+    import time
+
+    srv = build_server(root, None)
+    srv.start()
+    results = {}
+    deadline = time.monotonic() + 600
+    while len(results) < N_REQS and time.monotonic() < deadline:
+        for i in range(N_REQS):
+            rid = f"req-{i}"
+            if rid in results:
+                continue
+            try:
+                results[rid] = srv.result_for(rid)
+            except KeyError:
+                pass
+        time.sleep(0.05)
+    srv.stop()
+    if len(results) < N_REQS:
+        sys.exit(f"recovery answered only {sorted(results)} of {N_REQS}")
+    c = srv.health()["counters"]
+    print(f"recovered: {json.dumps({k: v for k, v in c.items() if v})}")
+    save_results(out, results)
+
+
+def _child(args: list) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), *args],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=900,
+    )
+
+
+def smoke() -> None:
+    from spark_timeseries_tpu.obs import promsink
+
+    with tempfile.TemporaryDirectory() as td:
+        root = os.path.join(td, "server")
+        # 1. the serving child dies by SIGKILL mid-batch (after 2 durable
+        #    chunk commits, the second torn mid-commit) under a request
+        #    storm with tenant t2 injected slow
+        r = _child(["--run", "--root", root, "--kill-commits", "2"])
+        if r.returncode != -9:
+            sys.exit(f"expected SIGKILL (-9), got rc={r.returncode}\n"
+                     f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}")
+        # durable state left behind: request records and >=1 batch journal
+        reqs = [f for f in os.listdir(os.path.join(root, "requests"))
+                if f.endswith(".npz")]
+        batches = os.listdir(os.path.join(root, "batches"))
+        if not reqs or not batches:
+            sys.exit(f"no durable state after kill: requests={reqs} "
+                     f"batches={batches}")
+        committed = 0
+        for b in batches:
+            mp = os.path.join(root, "batches", b, "journal", "manifest.json")
+            if os.path.exists(mp):
+                m = json.load(open(mp))
+                committed += sum(1 for c in m["chunks"]
+                                 if c["status"] == "committed")
+        # 2. a restarted server on the same root re-answers everything
+        rec_out = os.path.join(td, "recovered.npz")
+        r = _child(["--recover", "--root", root, "--out", rec_out])
+        if r.returncode != 0:
+            sys.exit(f"recovery failed rc={r.returncode}\nstdout:\n"
+                     f"{r.stdout}\nstderr:\n{r.stderr}")
+        # 3. uninterrupted reference on a fresh root
+        ref_out = os.path.join(td, "reference.npz")
+        r = _child(["--run", "--root", os.path.join(td, "fresh"),
+                    "--out", ref_out])
+        if r.returncode != 0:
+            sys.exit(f"reference run failed rc={r.returncode}\n{r.stderr}")
+        a, b = np.load(rec_out), np.load(ref_out)
+        for i in range(N_REQS):
+            for f in FIELDS:
+                k = f"req-{i}__{f}"
+                if not np.array_equal(a[k], b[k], equal_nan=True):
+                    sys.exit(f"recovered {k} differs from the uninterrupted "
+                             "run — restart re-answer is NOT bitwise")
+        resumed = sum(int(a[f"req-{i}__resumed"]) for i in range(N_REQS))
+        if committed and not resumed:
+            sys.exit(f"{committed} chunks were durable at the kill but the "
+                     "recovery resumed none — it recomputed instead of "
+                     "replaying")
+        # 4. the prom textfile the killed server streamed mid-run is
+        #    valid (atomic writes: never torn), and the restarted server's
+        #    final write parses too
+        errs = promsink.validate_textfile(os.path.join(root, "fits.prom"))
+        if errs:
+            sys.exit(f"prom textfile invalid after kill+restart: {errs}")
+        print("serving kill-and-restart smoke: PASS "
+              f"(SIGKILL mid-commit after 2 commits, {len(reqs)} requests "
+              f"durable, {committed} chunks committed pre-kill, "
+              f"{resumed} resumed on restart, all {N_REQS} re-answered "
+              "bitwise, prom textfile valid)")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run", action="store_true")
+    ap.add_argument("--recover", action="store_true")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--root")
+    ap.add_argument("--kill-commits", type=int, default=None)
+    ap.add_argument("--out")
+    args = ap.parse_args()
+    if args.smoke:
+        return smoke()
+    if args.recover:
+        if not args.root or not args.out:
+            ap.error("--recover needs --root and --out")
+        return recover(args.root, args.out)
+    if not args.run or not args.root:
+        ap.error("need --run --root R, --recover, or --smoke")
+    run(args.root, args.kill_commits, args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -73,7 +73,18 @@ wall balance and compile-cache hit rate as evidence), and the per-order
 is reused), from the recorded stage-1 vs stage-2 wall balance and
 selection histogram (see :func:`advise_auto`).
 
-    python tools/advise_budget.py CHECKPOINT_DIR [--json]
+Pointed at a **serving root** (ISSUE 12: a ``serving.FitServer``
+checkpoint root — ``server.json`` plus one journal per micro-batch under
+``batches/<id>/journal``; auto-detected, or force with ``--serving``) the
+advisor aggregates the per-batch advice into serving knobs — the
+sustained ``cell_rows``, worst-batch ``pipeline_depth``/
+``prefetch_depth``/``chunk_budget_s``, the ``max_batch_rows`` coalescing
+cap — and reads the server's own shed/reject counters as the overload
+evidence (see :func:`advise_serving`).  The same :func:`advise` inference
+runs ONLINE inside the server between batches (``FitServer(autotune=
+True)``); this mode is the post-mortem view of what it learned.
+
+    python tools/advise_budget.py CHECKPOINT_DIR [--json] [--serving]
 
 Suggestions only apply to a run with the SAME config hash and panel (both
 printed): a different model/order/chunk layout re-derives everything.
@@ -336,6 +347,141 @@ def advise(m: dict) -> dict:
     }
 
 
+def advise_serving(root: str) -> dict:
+    """Serving-mode advice (ISSUE 12): a :class:`serving.FitServer`
+    checkpoint root — ``server.json`` + one journal per micro-batch under
+    ``batches/<id>/journal`` — instead of one walk's manifest.
+
+    Runs the per-manifest :func:`advise` over every batch journal and
+    aggregates: the **cell** size batches actually sustained (the
+    server's ``cell_rows`` knob — also what its own online adaptation
+    applies between batches), ``pipeline_depth``/``prefetch_depth`` at
+    the across-batch max (sized for the worst batch), a
+    ``chunk_budget_s`` over the slowest observed chunk, plus
+    serving-level knobs from the server's own record: shed/reject counts
+    argue for more queue or more capacity, and the observed batch-size
+    distribution argues the ``max_batch_rows``/``batch_window_s``
+    coalescing trade.
+    """
+    sj_path = os.path.join(root, "server.json")
+    try:
+        with open(sj_path) as f:
+            server = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return {"error": f"not a serving root ({e})"}
+    per_batch = []
+    batches_dir = os.path.join(root, "batches")
+    n_manifests = 0
+    if os.path.isdir(batches_dir):
+        for bid in sorted(os.listdir(batches_dir)):
+            mp = os.path.join(batches_dir, bid, "journal", "manifest.json")
+            if not os.path.exists(mp):
+                continue
+            n_manifests += 1
+            try:
+                a = advise(load_manifest(mp))
+            except SystemExit:
+                continue
+            if "error" not in a:
+                per_batch.append(a)
+    counters = server.get("counters") or {}
+    knobs = server.get("knobs") or {}
+    if not per_batch:
+        return {"error": "no committed batch journals to learn from",
+                "serving": {"server_state": server.get("state"),
+                            "counters": counters}}
+
+    def _vals(path):
+        out = []
+        for a in per_batch:
+            v = a
+            for k in path:
+                v = (v or {}).get(k)
+            if v is not None:
+                out.append(v)
+        return out
+
+    cells = _vals(("suggest", "chunk_rows"))
+    batch_rows = _vals(("observed", "chunks_committed"))
+    chunk_walls = _vals(("observed", "chunk_wall_s_max"))
+    rows_per_batch = []
+    for a in per_batch:
+        o = a["observed"]
+        rows_per_batch.append(o["chunk_rows_sustained"]
+                              * max(1, o["chunks_committed"]))
+    shed = counters.get("shed", 0)
+    rejected = counters.get("rejected", 0)
+    admitted = max(1, counters.get("admitted", 0))
+    pressure = (shed + rejected) / (admitted + shed + rejected)
+    q = server.get("queue") or {}
+    suggest = {
+        "cell_rows": int(_percentile(sorted(cells), 0.5)) if cells else
+        knobs.get("cell_rows"),
+        "pipeline_depth": max(_vals(("suggest", "pipeline_depth")) or [2]),
+        "prefetch_depth": max(_vals(("suggest", "prefetch_depth")) or [1]),
+        "chunk_budget_s": (max(_vals(("suggest", "chunk_budget_s")) or [0])
+                           or None),
+        # coalescing: if batches run well under the cap, a longer window
+        # would pack more; if they saturate it, the cap is the lever
+        "max_batch_rows": max(server.get("max_batch_rows") or 0,
+                              int(1.5 * max(rows_per_batch))
+                              if rows_per_batch else 0) or None,
+        # backpressure: sustained shedding means the queue is the
+        # bottleneck surface — either raise it (more RAM) or add capacity
+        "raise_queue_or_capacity": pressure > 0.05,
+    }
+    return {
+        "serving": {
+            "server_state": server.get("state"),
+            "batches_advised": len(per_batch),
+            "batch_manifests": n_manifests,
+            "counters": counters,
+            "queue": q,
+            "knobs_in_effect": knobs,
+            "shed_plus_reject_rate": round(pressure, 4),
+            "rows_per_batch_p90": (int(_percentile(sorted(rows_per_batch),
+                                                   0.9))
+                                   if rows_per_batch else None),
+            "chunk_wall_s_max": (round(max(chunk_walls), 4)
+                                 if chunk_walls else None),
+            "batches_with_commits": len(batch_rows),
+        },
+        "suggest": suggest,
+    }
+
+
+def _render_serving(root: str, a: dict) -> None:
+    s, o = a["suggest"], a["serving"]
+    print(f"serving root {root}")
+    c = o["counters"]
+    print(f"  server: state {o['server_state']}, "
+          f"{o['batches_advised']} batch journals advised "
+          f"(of {o['batch_manifests']})")
+    print(f"  traffic: {c.get('admitted', 0)} admitted / "
+          f"{c.get('completed', 0)} completed / {c.get('shed', 0)} shed / "
+          f"{c.get('rejected', 0)} rejected "
+          f"(shed+reject rate {o['shed_plus_reject_rate']})")
+    if c.get("batch_failures"):
+        print(f"  degradation: {c['batch_failures']} batch failures, "
+              f"{c.get('solo_retries', 0)} solo retries, "
+              f"{c.get('timeout_requests', 0)} requests with TIMEOUT rows")
+    if o["rows_per_batch_p90"] is not None:
+        print(f"  batches: p90 {o['rows_per_batch_p90']} rows"
+              + (f"; slowest chunk {o['chunk_wall_s_max']}s"
+                 if o["chunk_wall_s_max"] is not None else ""))
+    print("  suggest for this server's next life:")
+    print(f"    cell_rows      = {s['cell_rows']}")
+    print(f"    pipeline_depth = {s['pipeline_depth']}")
+    print(f"    prefetch_depth = {s['prefetch_depth']}")
+    if s["chunk_budget_s"]:
+        print(f"    chunk_budget_s = {s['chunk_budget_s']}")
+    if s["max_batch_rows"]:
+        print(f"    max_batch_rows = {s['max_batch_rows']}")
+    if s["raise_queue_or_capacity"]:
+        print("    overload: sustained shedding — raise max_queue_rows "
+              "(more RAM) or add serving capacity")
+
+
 def advise_auto(root: str) -> dict:
     """Auto-fit search advice (ISSUE 9): read the grid-level
     ``auto_manifest.json`` plus one per-order journal and suggest
@@ -524,7 +670,24 @@ def main():
     ap.add_argument("path", help="journal directory or manifest path")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable advice instead of the table")
+    ap.add_argument("--serving", action="store_true",
+                    help="treat PATH as a serving.FitServer checkpoint "
+                         "root (server.json + per-batch journals); "
+                         "auto-detected when server.json is present")
     args = ap.parse_args()
+    # a serving root (ISSUE 12) is a server.json plus one journal per
+    # micro-batch under batches/<id>/journal
+    if args.serving or (
+            os.path.isdir(args.path)
+            and os.path.exists(os.path.join(args.path, "server.json"))):
+        a = advise_serving(args.path)
+        if args.json:
+            print(json.dumps(a, indent=1, sort_keys=True))
+            return
+        if "error" in a:
+            sys.exit(f"advise_budget: {a['error']}")
+        _render_serving(args.path, a)
+        return
     # an auto-fit search root (ISSUE 9) has no root manifest.json — the
     # grid-level auto_manifest.json plus per-order journals stand in
     if os.path.isdir(args.path) and \

@@ -501,6 +501,33 @@ def _validate_rebalance(m: dict) -> list:
     return errors
 
 
+def validate_prom_sink(prom_path: str, events) -> list:
+    """Validate a Prometheus-textfile sink output (ISSUE 12 satellite).
+
+    Delegates to ``obs.promsink.validate_textfile`` — exposition-format
+    syntax plus, when the event stream carries a final ``metrics``
+    snapshot, the registry cross-check: every counter/gauge/histogram in
+    the snapshot must appear in the textfile under its mapped name (a
+    rename/drop fails here instead of silently emptying a dashboard).
+    The serving gauges the sink adds on top of the registry are allowed —
+    the contract is "nothing vanishes", not "nothing extra".
+    """
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        from spark_timeseries_tpu.obs import promsink
+    except Exception as e:  # noqa: BLE001 - tooling must degrade loudly
+        return [f"cannot import obs.promsink to validate {prom_path}: {e}"]
+    snapshot = None
+    for _, ev in events:
+        if ev.get("kind") == "metrics":
+            snapshot = {k: ev.get(k) for k in ("counters", "gauges",
+                                               "histograms")}
+    return [f"prom {prom_path}: {e}"
+            for e in promsink.validate_textfile(prom_path,
+                                                snapshot=snapshot)]
+
+
 def summarize(events) -> dict:
     """Timeline + final metrics snapshot of the LATEST run in the stream.
 
@@ -680,6 +707,12 @@ def main():
     ap.add_argument("--manifest", default=None, metavar="CKPT_DIR",
                     help="with --check: also validate the journal "
                          "manifest's embedded telemetry block")
+    ap.add_argument("--prom", default=None, metavar="PROM_FILE",
+                    help="with --check: validate a Prometheus-textfile "
+                         "sink output (obs.promsink) — exposition syntax "
+                         "plus name/label agreement with the event "
+                         "stream's final metrics snapshot, so a renamed "
+                         "counter cannot silently vanish from dashboards")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable summary instead of the report")
     args = ap.parse_args()
@@ -689,12 +722,16 @@ def main():
         errors = validate_events(events, errors)
         if args.manifest:
             errors += validate_manifest_telemetry(args.manifest)
+        if args.prom:
+            errors += validate_prom_sink(args.prom, events)
         if errors:
             for e in errors:
                 print(f"obs_report: FAIL {e}", file=sys.stderr)
             sys.exit(1)
         n = len(events)
         extra = f" + manifest {args.manifest}" if args.manifest else ""
+        if args.prom:
+            extra += f" + prom textfile {args.prom}"
         print(f"obs_report: OK — {n} events valid{extra}")
         return
     if errors:
