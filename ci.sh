@@ -10,6 +10,24 @@ export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_ci_cache}"
 
+# invariant linter (ISSUE 13): the codebase's cross-cutting contracts —
+# host-sync-free hot paths, config-hash knob coverage, journal write
+# ownership, lock-map discipline, obs inertness, nondeterminism bans —
+# are machine-checked BEFORE any test runs.  The self-test runs first and
+# seeds a violation of every contract into each checker: a linter whose
+# checkers silently stopped matching would otherwise pass vacuously and
+# CI would go green on a broken guard.  Then the real lint runs against
+# the committed (EMPTY) baseline: any NEW finding fails CI with the
+# machine-readable report on stderr.
+python -m tools.lint --self-test
+python -m tools.lint --json > /tmp/ci_lint.json || {
+  echo "ci.sh: ststpu-lint found NEW contract violations" >&2
+  cat /tmp/ci_lint.json >&2
+  echo "ci.sh: run 'python -m tools.lint --explain <rule>' for the" >&2
+  echo "       contract text and the inline-waiver syntax" >&2
+  exit 1
+}
+
 # -rs surfaces every skip with its reason: the 2-process jax.distributed
 # smoke test skips on a chronically slow host, and that must be VISIBLE in
 # CI output, not silently folded into the pass count (VERDICT r3 weak #4)
@@ -164,6 +182,17 @@ python tests/_sharded_worker.py --smoke
 # quarantined device and replays only truly-uncommitted work), again
 # bitwise vs the single-device walk
 python tests/_sharded_worker.py --elastic-smoke
+
+# lock-discipline runtime smoke (ISSUE 13): the declared _protected_by_
+# maps — the same ones the static lock-map checker verifies lexically —
+# are enforced DYNAMICALLY on a real workload: every registered
+# concurrency class is instrumented with owner-tracking lock proxies,
+# then (1) a seeded off-lock mutation must be CAUGHT (the tracker cannot
+# pass vacuously), (2) a journaled pipelined+sharded+elastic walk with a
+# fault-injected straggler lane (steals cross-thread) and (3) a resident
+# FitServer under a request_storm burst must both complete with ZERO
+# violations — while staying bitwise-identical to the uninstrumented run
+python tests/_lockdiscipline_worker.py --smoke
 
 # serving kill-and-restart smoke (ISSUE 12): a resident FitServer under a
 # request storm — several tenants micro-batched into shared chunked walks,

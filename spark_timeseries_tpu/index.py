@@ -102,6 +102,7 @@ class Frequency(ABC):
         return type(self) is type(other) and self.to_string() == other.to_string()
 
     def __hash__(self) -> int:
+        # lint: nondet(in-process dict identity only; never persisted)
         return hash((type(self).__name__, self.to_string()))
 
 
@@ -404,6 +405,7 @@ class DateTimeIndex(ABC):
         )
 
     def __hash__(self) -> int:
+        # lint: nondet(in-process dict identity only; never persisted)
         return hash((self.size, self.instants().tobytes()))
 
     def __repr__(self) -> str:  # pragma: no cover

@@ -1161,7 +1161,7 @@ def _write_auto_manifest(checkpoint_dir: str, auto_meta: dict,
     os.makedirs(checkpoint_dir, exist_ok=True)
     payload = {
         "kind": "auto_fit",
-        "written_at": time.time(),
+        "written_at": time.time(),  # lint: nondet(manifest wall-clock metadata)
         "auto_fit": auto_meta,
         "grid_dirs": grid_dirs,
     }

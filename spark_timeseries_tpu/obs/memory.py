@@ -38,6 +38,11 @@ import threading as _threading
 _staging_pools: "weakref.WeakSet" = weakref.WeakSet()
 _staging_pools_mu = _threading.Lock()
 
+# lock-discipline contract (tools/lint lock-map, module-level form):
+# registration (source construction, any thread) vs iteration (the
+# probe, committer workers) both hold the lock.
+_PROTECTED_BY_ = {"_staging_pools": "_staging_pools_mu"}
+
 
 def register_staging_pool(pool) -> None:
     """Track a staging pool so :func:`peak_memory` reports its bytes.

@@ -135,6 +135,17 @@ NULL_METRIC = _NullMetric()
 class MetricsRegistry:
     """Name -> metric, created on first touch (Prometheus-style)."""
 
+    # lock-discipline contract (tools/lint lock-map): any instrumented
+    # thread (driver, committer, lanes, abandoned watchdog workers) may
+    # create a metric; the name->metric maps mutate under _lock (the
+    # racy pre-check read is a fast path — setdefault under the lock is
+    # what actually inserts).
+    _protected_by_ = {
+        "_counters": "_lock",
+        "_gauges": "_lock",
+        "_histograms": "_lock",
+    }
+
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}

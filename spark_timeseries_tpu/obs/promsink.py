@@ -150,6 +150,10 @@ class PromTextfileSink:
     idle ticks), so the textfile always reflects a recent state and never
     a torn one."""
 
+    # lock-discipline contract (tools/lint lock-map): the serve loop and
+    # forced final writes (stop()) may overlap; one writer at a time.
+    _protected_by_ = {"writes": "_lock"}
+
     def __init__(self, path: str, prefix: str = PREFIX):
         self.path = os.path.abspath(path)
         self.prefix = prefix

@@ -43,6 +43,15 @@ SCHEMA_VERSION = 1
 class FlightRecorder:
     """Bounded event ring, optionally teeing every event to a JSONL file."""
 
+    # lock-discipline contract (tools/lint lock-map): every instrumented
+    # thread emits; ring, counter, and the teed file handle mutate only
+    # under _lock (emit downgrades _file to None on a broken stream).
+    _protected_by_ = {
+        "_ring": "_lock",
+        "events_emitted": "_lock",
+        "_file": "_lock",
+    }
+
     def __init__(self, run_id: str, ring_size: int = 4096,
                  jsonl_path: Optional[str] = None):
         self.run_id = run_id

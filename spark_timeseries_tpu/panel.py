@@ -143,7 +143,7 @@ def _cached_batched(fn: Callable, *args) -> Callable:
     cache slots."""
     try:
         key = (_fn_cache_key(fn), args)
-        hash(key)
+        hash(key)  # lint: nondet(hashability probe for the in-process cache)
     except (TypeError, ValueError):  # unhashable capture / empty cell: uncached
         key = None
     if key is not None:

@@ -89,6 +89,17 @@ class ChunkCommitter:
     ``peak_hbm_*`` manifest fields.
     """
 
+    # lock-discipline contract (tools/lint lock-map): attributes shared
+    # between the driver thread and the committer worker, each mutated
+    # only under its declared lock.  Driver-only state (_blocked_s,
+    # _closed, the queue handle) is deliberately not declared.
+    _protected_by_ = {
+        "_error": "_lock",  # worker sets, driver clears via take_error
+        "_commits": "_lock",
+        "_commit_wall_s": "_lock",
+        "_max_depth": "_lock",
+    }
+
     def __init__(self, journal, fetch: Callable[[object], dict], *,
                  depth: int = 2, probe: Optional[Callable] = None,
                  status_counts: Optional[Callable] = None):

@@ -74,7 +74,6 @@ import jax
 import numpy as np
 
 from .. import obs
-from ..obs import memory as memory_probe
 from . import committer as committer_mod
 from . import prefetcher as prefetcher_mod
 from . import source as source_mod
@@ -333,6 +332,18 @@ class LaneRunner:
     entries, telemetry rows, and result assembly agree across lanes.
     """
 
+    # lock-discipline contract (tools/lint lock-map): the elastic span
+    # state is mutated by this lane's thread AND by thieves calling
+    # try_steal from supervisor threads — every site holds the span
+    # lock.  _t0 is written once by the lane thread at run() entry
+    # (single writer; readers take the lock) and stays undeclared.
+    _protected_by_ = {
+        "_hi": "_mu",
+        "_busy_hi": "_mu",
+        "_steal_closed": "_mu",
+        "_rows_done": "_mu",
+    }
+
     def __init__(self, plan: ExecutionPlan, spec: LaneSpec, fit_fn: Callable,
                  fit_kwargs: dict, values, *, journal=None, deadline=None,
                  tele: bool = False, fit_key=None):
@@ -384,7 +395,7 @@ class LaneRunner:
         if journal is not None and plan.pipeline:
             self.committer = committer_mod.ChunkCommitter(
                 journal, _commit_arrays, depth=plan.pipeline_depth,
-                probe=memory_probe.peak_memory, status_counts=status_counts)
+                probe=obs.peak_memory, status_counts=status_counts)
         # input-side pipeline: stage chunk N+1's slice while chunk N
         # computes.  Only sliced walks stage (a whole-span chunk has no
         # next slice), and pipeline=False stays the fully serial escape
@@ -733,6 +744,9 @@ class LaneRunner:
                     # with a deadline armed the budget must cover the device
                     # computation, not just its async dispatch — block here,
                     # INSIDE the watchdog window
+                    # the watchdog must bound the computation itself,
+                    # not just its async dispatch:
+                    # lint: host-sync(deliberate watchdog barrier)
                     jax.block_until_ready(out)
                 return out
 
@@ -847,7 +861,7 @@ class LaneRunner:
                         lo, self.chunk = self._rollback(err)
                         continue
                     arrays = _commit_arrays(piece)
-                    pm = memory_probe.peak_memory()
+                    pm = obs.peak_memory()
                     journal.commit_chunk(
                         lo, hi, arrays,
                         wall_s=wall_s,
@@ -886,6 +900,11 @@ class WorkQueue:
     runner/journal lock, so the lock order cond → runner → journal is
     acyclic.
     """
+
+    # lock-discipline contract (tools/lint lock-map): every lane thread
+    # pushes/pulls spans; the ``*_locked`` helpers are called with the
+    # condition held (the codebase convention the linter honors).
+    _protected_by_ = {"_spans": "cond"}
 
     def __init__(self):
         self.cond = threading.Condition()
@@ -953,6 +972,23 @@ class LaneSupervisor:
     FIRST lane's original error re-raises — a job that loses all lanes
     still fails loudly.
     """
+
+    # lock-discipline contract (tools/lint lock-map): supervisor state
+    # is mutated from every lane thread; the ONE condition variable the
+    # whole supervisor synchronizes on (queue.cond) guards it all —
+    # keeping the lock order cond -> runner -> journal acyclic.
+    _protected_by_ = {
+        "results": "queue.cond",
+        "_active": "queue.cond",
+        "_busy": "queue.cond",
+        "_fatal": "queue.cond",
+        "_quarantined": "queue.cond",
+        "_errors": "queue.cond",
+        "_steals": "queue.cond",
+        "_retries": "queue.cond",
+        "_global_walls": "queue.cond",
+        "_lane_mean_wall": "queue.cond",
+    }
 
     def __init__(self, plan: ExecutionPlan, fit_fn: Callable,
                  fit_kwargs: dict, lanes: Sequence[tuple], *,
@@ -1150,7 +1186,11 @@ class LaneSupervisor:
                     span_hi = runner.close_steals()
                     failures += 1
                     if failures <= plan.lane_retries:
-                        self._retries += 1
+                        # concurrent peers retry too: the counter is
+                        # cond-guarded like the rest of the supervisor
+                        # state (a bare += here dropped increments)
+                        with cond:
+                            self._retries += 1
                         self._state(sid, "retrying")
                         obs.counter("lane.retry").inc()
                         obs.event("lane.retry", shard=sid, attempt=failures,
@@ -1224,7 +1264,10 @@ class LaneSupervisor:
             first = self._errors[0] if self._errors else RuntimeError(
                 f"elastic walk stalled with spans pending: {undone}")
             raise first
-        self.results.sort(key=lambda r: r.spec.lo)
+        with self.queue.cond:
+            # every lane joined, but the declared discipline (results is
+            # cond-guarded) holds uniformly — uncontended here
+            self.results.sort(key=lambda r: r.spec.lo)
         return self.results, self.elastic_meta()
 
     def _drive_safe(self, idx: int) -> None:

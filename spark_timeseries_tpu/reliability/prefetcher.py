@@ -121,6 +121,20 @@ class ChunkPrefetcher:
     worker and returns :class:`PrefetchStats`.
     """
 
+    # lock-discipline contract (tools/lint lock-map): slot map + stats
+    # are mutated from both the driver (schedule/take/invalidate) and
+    # the staging worker; every site holds _lock.  _closed and the
+    # queue handle are driver-only.
+    _protected_by_ = {
+        "_slots": "_lock",
+        "_staged": "_lock",
+        "_hits": "_lock",
+        "_misses": "_lock",
+        "_staging_wall_s": "_lock",
+        "_blocked_s": "_lock",
+        "_invalidated": "_lock",
+    }
+
     def __init__(self, panel, *, depth: int = 1):
         self._panel = panel
         self.depth = max(1, int(depth))
@@ -161,6 +175,8 @@ class ChunkPrefetcher:
                     # the SAME slice expression the serial driver uses:
                     # identical compiled program, identical bytes
                     vals = self._panel[lo:hi]
+                    # a taken slice must never re-pay the copy:
+                    # lint: host-sync(deliberate staging barrier)
                     jax.block_until_ready(vals)
                 slot.value = vals
                 vals = None

@@ -253,7 +253,7 @@ def resilient_fit(
                 base.shape
             )
             kw["init_params"] = jnp.asarray(
-                (base + jitter).astype(np.asarray(y_clean).dtype)
+                (base + jitter).astype(y_clean.dtype)  # no host round-trip for dtype
             )
         kw = _accepted_kwargs(fit_fn, kw)
         with obs.span(f"fit.rung.{rung.name}", rows=int(idx.size), cap=cap):

@@ -65,7 +65,6 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 from .. import obs
-from ..obs import memory as memory_probe
 
 __all__ = [
     "ChunkSource",
@@ -125,6 +124,20 @@ class StagingPool:
     report their staging RAM instead of undercounting host peaks.
     """
 
+    # lock-discipline contract (tools/lint lock-map): the pool is shared
+    # across prefetcher workers, lane threads, and (ISSUE 12) the whole
+    # serving process — free list and accounting mutate only under _lock.
+    _protected_by_ = {
+        "_free": "_lock",
+        "_n_buffers": "_lock",
+        "hits": "_lock",
+        "misses": "_lock",
+        "in_use_bytes": "_lock",
+        "peak_in_use_bytes": "_lock",
+        "total_bytes": "_lock",
+        "peak_host_bytes": "_lock",
+    }
+
     def __init__(self, n_cols: int, dtype):
         self.n_cols = int(n_cols)
         self.dtype = np.dtype(dtype)
@@ -137,7 +150,7 @@ class StagingPool:
         self.peak_in_use_bytes = 0
         self.total_bytes = 0
         self.peak_host_bytes = 0
-        memory_probe.register_staging_pool(self)
+        obs.register_staging_pool(self)
 
     class _Lease:
         __slots__ = ("pool", "buf", "view", "_released")
@@ -199,6 +212,20 @@ class ChunkSource:
     """
 
     kind = "abstract"
+
+    # lock-discipline contract (tools/lint lock-map): staging runs on
+    # prefetcher workers while the driver probes align mode /
+    # fingerprint and weakref finalizers retire buffers from arbitrary
+    # threads — every mutation holds _mu.
+    _protected_by_ = {
+        "_align_mode": "_mu",
+        "_fingerprint": "_mu",
+        "_live_device_bytes": "_mu",
+        "_peak_live_device_bytes": "_mu",
+        "h2d_copies": "_mu",
+        "h2d_bytes": "_mu",
+        "h2d_wall_s": "_mu",
+    }
 
     def __init__(self, shape: Tuple[int, int], dtype,
                  pool: Optional[StagingPool] = None):
@@ -283,6 +310,9 @@ class ChunkSource:
                 # the pool buffer is reused for the NEXT chunk the moment
                 # the lease releases: the transfer (and the alias-breaking
                 # copy, which reads the buffer) must be complete first
+                # pool-buffer reuse requires the H2D copy, and the
+                # alias-breaking read, to be complete first:
+                # lint: host-sync(deliberate pool-reuse barrier)
                 jax.block_until_ready(arr)
         finally:
             lease.release()

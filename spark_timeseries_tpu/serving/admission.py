@@ -37,6 +37,10 @@ __all__ = ["AdmissionQueue", "TenantQuota"]
 class TenantQuota:
     """Per-tenant in-flight budget (requests + rows, admission to answer)."""
 
+    # lock-discipline contract (tools/lint lock-map): caller threads
+    # acquire, the serve loop releases — the ledger mutates under _lock.
+    _protected_by_ = {"_inflight": "_lock"}
+
     def __init__(self, max_inflight_per_tenant: Optional[int] = None,
                  max_rows_per_tenant: Optional[int] = None,
                  max_rows_per_request: Optional[int] = None):
@@ -96,6 +100,21 @@ class AdmissionQueue:
     :meth:`take_batch`.  FIFO order is by admission sequence so batching
     is fair; priorities only matter under overload (who gets shed).
     """
+
+    # lock-discipline contract (tools/lint lock-map): producers offer
+    # from caller threads, the serve loop consumes; ``_not_empty`` is a
+    # Condition BUILT ON ``_lock``, so either spelling holds the same
+    # lock — both are declared as acceptable guards.
+    _protected_by_ = {
+        "_q": ("_lock", "_not_empty"),
+        "_rows": ("_lock", "_not_empty"),
+        "shed_total": ("_lock", "_not_empty"),
+        "rejected_total": ("_lock", "_not_empty"),
+        "admitted_total": ("_lock", "_not_empty"),
+        "last_refusal_at": ("_lock", "_not_empty"),
+        "_drain_rows_per_s": ("_lock", "_not_empty"),
+        "_closed": ("_lock", "_not_empty"),
+    }
 
     def __init__(self, max_queue_rows: int = 65_536,
                  max_queue_requests: int = 1024):

@@ -109,6 +109,16 @@ def _load_online_advisor() -> Optional[Callable]:
 class FitServer:
     """A long-lived in-process fit daemon (see module docstring).
 
+    .. attribute:: _protected_by_
+
+        Lock-discipline contract (tools/lint lock-map): caller threads
+        submit/cancel while the serve loop batches, delivers, and
+        recovers — the five shared maps/counters below mutate only
+        under their declared locks.  Serve-loop-private state
+        (``_batch_seq``, ``_prom_last``, ``_degraded_until``,
+        ``_crash_error``) and caller-set flags (``_drain``) have a
+        single writing role and stay undeclared.
+
     ``root`` is the server-owned checkpoint root — requests, batch
     journals, and results live under it, and a restarted server on the
     same root recovers everything in flight.  ``models`` extends the
@@ -120,6 +130,14 @@ class FitServer:
     stage/compute/commit internally, and ``shard=True`` in
     ``walk_kwargs`` adds elastic mesh lanes).
     """
+
+    _protected_by_ = {
+        "counters": "_counters_lock",
+        "_live": "_live_lock",
+        "_seq": "_seq_lock",
+        "_pools": "_pools_lock",
+        "_state": "_state_lock",
+    }
 
     def __init__(self, root: str, *,
                  models: Optional[Dict[str, Callable]] = None,
@@ -198,9 +216,7 @@ class FitServer:
         self._prom_interval_s = float(prom_interval_s)
         self._prom_last = 0.0
         if prom_path:
-            from ..obs.promsink import PromTextfileSink
-
-            self._prom = PromTextfileSink(prom_path)
+            self._prom = obs.PromTextfileSink(prom_path)
         self._state = "starting"
         self._state_lock = threading.Lock()
         self._degraded_until = 0.0

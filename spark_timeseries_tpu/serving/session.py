@@ -193,6 +193,16 @@ class FitTicket:
     __slots__ = ("req_id", "_done", "_result", "_error", "_cancelled",
                  "_lock", "_canceller")
 
+    # lock-discipline contract (tools/lint lock-map): the serve loop,
+    # shedding offers on other caller threads, and cancel() all race to
+    # land the ONE terminal transition; _lock arbitrates, _done.set()
+    # is the (atomic) publication.
+    _protected_by_ = {
+        "_result": "_lock",
+        "_error": "_lock",
+        "_cancelled": "_lock",
+    }
+
     def __init__(self, req_id: str):
         self.req_id = req_id
         self._done = threading.Event()

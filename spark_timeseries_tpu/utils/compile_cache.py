@@ -63,6 +63,10 @@ _misses = 0
 # otherwise lose increments to the non-atomic load/add/store
 _stats_lock = _threading.Lock()
 
+# lock-discipline contract (tools/lint lock-map, module-level form):
+# sharded lane threads report hits/misses concurrently.
+_PROTECTED_BY_ = {"_hits": "_stats_lock", "_misses": "_stats_lock"}
+
 
 def note_hit() -> None:
     """Record a program-cache hit (an already-built jitted program reused)."""

@@ -1,0 +1,249 @@
+"""Runtime lock-discipline smoke (ISSUE 13's runtime companion).
+
+The static lock-map checker (``python -m tools.lint``) verifies the
+declared ``_protected_by_`` maps lexically; this worker verifies them
+DYNAMICALLY on a real workload: it instruments every registered
+concurrency-bearing class with owner-tracking lock proxies
+(:mod:`tools.lint.runtime`), then drives
+
+1. a **negative self-check**: a seeded violation (a protected attribute
+   mutated off-lock from a second thread) MUST be caught — a tracker
+   that observes nothing must never pass vacuously;
+2. a journaled **pipelined + sharded + elastic** chunk walk (8 forced
+   CPU devices, one prefetch -> compute -> commit lane per device, a
+   ``faultinject.slow_lane`` straggler so idle lanes STEAL work — the
+   cross-thread path the lock maps exist for);
+3. a resident **FitServer** under a ``faultinject.request_storm`` burst
+   (caller threads racing the serve loop through admission, quotas,
+   shedding, tickets, and the prom sink);
+
+and asserts the real runs produced ZERO violations, while results stay
+exactly what the uninstrumented code produces (the tracker observes,
+never changes behavior: the fitted params of an instrumented walk are
+bitwise-identical to an uninstrumented one).
+
+Run by ci.sh as a slow smoke: ``python tests/_lockdiscipline_worker.py
+--smoke`` (sets up the forced-8-device CPU env itself when run alone).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+if __name__ == "__main__":  # env must be set before jax imports
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=8 "
+            + os.environ.get("XLA_FLAGS", ""))
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import tempfile
+import shutil
+
+import numpy as np
+
+
+def _panel(rows: int = 32, t: int = 96, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(rows, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    for i in range(1, t):
+        y[:, i] = 0.6 * y[:, i - 1] + e[:, i]
+    return y
+
+
+def negative_self_check(tracker_cls) -> None:
+    """A tracker that cannot see a seeded violation must fail the smoke."""
+    import threading as th
+
+    class Seeded:
+        _protected_by_ = {"_shared": "_lock"}
+
+        def __init__(self):
+            self._lock = th.Lock()
+            self._shared = 0
+            self._items = {}
+
+        def good(self):
+            with self._lock:
+                self._shared += 1
+
+        def bad(self):
+            self._shared += 1  # off-lock, on purpose
+
+    tracker = tracker_cls().install([Seeded])
+    try:
+        obj = Seeded()
+        obj.good()
+        assert not tracker.violations, (
+            "false positive: guarded mutation flagged\n" + tracker.report())
+        t = th.Thread(target=obj.bad)
+        t.start()
+        t.join()
+        assert len(tracker.violations) == 1, (
+            "tracker MISSED the seeded off-lock mutation — the runtime "
+            "guard is broken")
+        # container form: a guarded dict mutated off-lock is seen too
+        obj._items = {}  # attribute store checked (not in ctor)
+        n0 = len(tracker.violations)
+        obj._items["k"] = 1
+        assert len(tracker.violations) == n0, (
+            "undeclared attribute should not be tracked")
+
+        class SeededDict:
+            _protected_by_ = {"_m": "_lock"}
+
+            def __init__(self):
+                self._lock = th.Lock()
+                self._m = {}
+
+        tracker2 = tracker_cls().install([SeededDict])
+        try:
+            d = SeededDict()
+            d._m["k"] = 1  # subscript store without the lock
+            assert len(tracker2.violations) == 1, (
+                "tracker MISSED the seeded guarded-container mutation")
+            with d._lock:
+                d._m["j"] = 2
+            assert len(tracker2.violations) == 1, (
+                "false positive on an under-lock container mutation\n"
+                + tracker2.report())
+        finally:
+            tracker2.uninstall()
+    finally:
+        tracker.uninstall()
+    print("lockdiscipline: negative self-check OK "
+          "(seeded violations caught, guarded paths clean)")
+
+
+def instrumented_walk(tmp: str) -> np.ndarray:
+    """Pipelined + sharded + elastic walk under the tracker."""
+    from tools.lint.runtime import LockDisciplineTracker
+    from spark_timeseries_tpu import reliability as rel
+    from spark_timeseries_tpu.models import arima
+
+    y = _panel()
+    # a deterministic straggler: lane 1 sleeps per fit call, so idle
+    # survivors exercise the steal/rebalance path cross-thread
+    slow = rel.faultinject.slow_lane(arima.fit, shard_id=1, delay_s=0.05)
+
+    tracker = LockDisciplineTracker().install()
+    try:
+        res = rel.fit_chunked(
+            slow, y, chunk_rows=2, resilient=False, shard=True,
+            pipeline=True, prefetch_depth=1, order=(1, 0, 0), max_iters=15,
+            rebalance_threshold=0.5,
+            checkpoint_dir=os.path.join(tmp, "walk"))
+        assert res.meta["shards"]["n_shards"] == 8, res.meta["shards"]
+        n_classes = len(tracker._installed)
+    finally:
+        tracker.uninstall()
+    assert not tracker.violations, (
+        "sharded walk violated its declared lock maps:\n"
+        + tracker.report())
+    # the run must have DECIDED ownership on real mutations, or this
+    # assertion proves nothing (guards created before install etc.)
+    assert tracker.checks_decided > 100, (
+        tracker.checks_decided, tracker.checks_total)
+    print("lockdiscipline: pipelined+sharded+elastic walk OK "
+          f"(0 violations; {tracker.checks_decided} mutations checked "
+          f"across {n_classes} instrumented classes)")
+    return np.asarray(res.params)
+
+
+def uninstrumented_walk(tmp: str) -> np.ndarray:
+    from spark_timeseries_tpu import reliability as rel
+    from spark_timeseries_tpu.models import arima
+
+    y = _panel()
+    slow = rel.faultinject.slow_lane(arima.fit, shard_id=1, delay_s=0.05)
+    res = rel.fit_chunked(
+        slow, y, chunk_rows=2, resilient=False, shard=True,
+        pipeline=True, prefetch_depth=1, order=(1, 0, 0), max_iters=15,
+        rebalance_threshold=0.5,
+        checkpoint_dir=os.path.join(tmp, "walk_plain"))
+    return np.asarray(res.params)
+
+
+def instrumented_serving(tmp: str) -> None:
+    """FitServer under a request storm, fully instrumented."""
+    from tools.lint.runtime import LockDisciplineTracker
+    from spark_timeseries_tpu import serving
+    from spark_timeseries_tpu.reliability.faultinject import request_storm
+
+    y = _panel(rows=24)
+    tracker = LockDisciplineTracker().install()
+    try:
+        srv = serving.FitServer(
+            os.path.join(tmp, "serve"), cell_rows=8, batch_window_s=0.02,
+            max_queue_requests=6,  # small bound: the storm must shed
+            prom_path=os.path.join(tmp, "serve", "fits.prom"),
+            prom_interval_s=0.0)
+        calls = [((f"tenant{i % 4}", y[8 * (i % 3):8 * (i % 3) + 8],
+                   "arima"), {"order": (1, 0, 0), "max_iters": 15,
+                              "priority": i % 2})
+                 for i in range(10)]
+        srv.start()
+        tickets, errors = request_storm(srv.submit, calls, threads=6)
+        done = 0
+        for t in tickets:
+            if t is None:
+                continue
+            try:
+                t.result(timeout=600)
+                done += 1
+            except serving.RejectedError:
+                pass  # shed under overload: an explicit, counted outcome
+        srv.stop()
+        h = srv.health()
+        admitted = h["counters"]["admitted"]
+        rejected = h["counters"]["rejected"] + h["counters"]["shed"]
+        assert done > 0 and admitted > 0, (done, h["counters"])
+        assert done + 0 <= admitted and admitted + rejected >= len(calls), \
+            h["counters"]
+    finally:
+        tracker.uninstall()
+    assert not tracker.violations, (
+        "serving storm violated the declared lock maps:\n"
+        + tracker.report())
+    assert tracker.checks_decided > 50, (
+        tracker.checks_decided, tracker.checks_total)
+    print(f"lockdiscipline: serving storm OK (0 violations; "
+          f"{tracker.checks_decided} mutations checked; "
+          f"{done} answered, {rejected} explicitly refused of "
+          f"{len(calls)})")
+
+
+def smoke() -> None:
+    from tools.lint.runtime import LockDisciplineTracker
+
+    negative_self_check(LockDisciplineTracker)
+    tmp = tempfile.mkdtemp(prefix="lockdiscipline_")
+    try:
+        p_inst = instrumented_walk(tmp)
+        p_plain = uninstrumented_walk(tmp)
+        assert p_inst.tobytes() == p_plain.tobytes(), (
+            "tracker changed the walk's bytes — it must only observe")
+        print("lockdiscipline: instrumented walk bitwise-identical to "
+              "uninstrumented")
+        instrumented_serving(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("lockdiscipline smoke: PASS")
+
+
+def main() -> None:
+    if "--smoke" in sys.argv:
+        smoke()
+        return
+    print(__doc__)
+    raise SystemExit(2)
+
+
+if __name__ == "__main__":
+    main()

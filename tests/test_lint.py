@@ -1,0 +1,455 @@
+"""Tests for the invariant linter (tools/lint, ISSUE 13).
+
+Covers every checker with a positive/negative fixture pair (shared with
+``python -m tools.lint --self-test`` via :mod:`tools.lint.selftest`, so
+the CI gate and this suite cannot drift), waiver parsing (inline,
+function-scoped, empty-reason, stale), baseline diffing, the config-hash
+exclusion registry round-tripped through the REAL ``fit_chunked``
+signature, and the runtime lock-discipline tracker's seeded-violation
+negative check.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.lint import contracts, selftest  # noqa: E402
+from tools.lint.engine import (diff_baseline, lint_paths, lint_source,  # noqa: E402
+                               load_baseline, save_baseline)
+
+
+def _hits(findings, rule, include_waived=False):
+    return [f for f in findings if f.rule == rule
+            and (include_waived or not f.waived)]
+
+
+# ---------------------------------------------------------------------------
+# checker fixture pairs (positive must flag, negative must not)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule", sorted(selftest.FIXTURES))
+def test_checker_catches_seeded_violation(rule):
+    path, bad, good, checkers = selftest.FIXTURES[rule]
+    assert _hits(lint_source(bad, path, checkers), rule), \
+        f"{rule}: seeded violation not caught"
+    assert not _hits(lint_source(good, path, checkers), rule), \
+        f"{rule}: clean twin flagged"
+
+
+def test_self_test_entry_point():
+    assert selftest.run_self_test() == []
+
+
+def test_self_test_cli_exit_code():
+    r = subprocess.run([sys.executable, "-m", "tools.lint", "--self-test"],
+                       cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+# ---------------------------------------------------------------------------
+# rule-specific edges beyond the shared fixtures
+# ---------------------------------------------------------------------------
+
+
+HOT = "spark_timeseries_tpu/reliability/fixture.py"
+
+
+def test_hostsync_scope_is_hot_paths_only():
+    src = "import jax.numpy as jnp\ndef f(y):\n    return float(jnp.sum(y))\n"
+    assert _hits(lint_source(src, HOT), "host-sync")
+    assert not _hits(lint_source(
+        src, "spark_timeseries_tpu/serving/fixture.py"), "host-sync")
+
+
+def test_hostsync_metadata_and_opaque_calls_stop_taint():
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def f(y, helper):
+            yb = jnp.asarray(y)
+            rows = int(yb.shape[0])      # metadata: host
+            fp = helper(yb)              # opaque call: host result
+            if fp is None or rows > 2:   # identity + host compare
+                return str(fp)
+            return yb
+        """)
+    assert not _hits(lint_source(src, HOT), "host-sync")
+
+
+def test_hostsync_blocks_flagged_everywhere_in_hot_modules():
+    src = "import jax\ndef f(x):\n    jax.block_until_ready(x)\n    return x\n"
+    assert _hits(lint_source(src, HOT), "host-sync")
+
+
+def test_lockmap_locked_suffix_and_with_alias():
+    src = textwrap.dedent("""
+        import threading
+
+        class Q:
+            _protected_by_ = {"_spans": "cond"}
+
+            def __init__(self):
+                self.cond = threading.Condition()
+                self._spans = []
+
+            def push(self, s):
+                c = self.cond
+                with c:
+                    self._spans.append(s)
+
+            def _pop_locked(self):
+                return self._spans.pop()
+        """)
+    assert not _hits(lint_source(src, HOT), "lock-map")
+
+
+def test_lockmap_module_level_globals():
+    src = textwrap.dedent("""
+        import threading
+
+        _hits = 0
+        _lock = threading.Lock()
+        _PROTECTED_BY_ = {"_hits": "_lock"}
+
+        def bad():
+            global _hits
+            _hits += 1
+
+        def good():
+            global _hits
+            with _lock:
+                _hits += 1
+        """)
+    found = _hits(lint_source(src, HOT), "lock-map")
+    assert len(found) == 1 and "bad" in found[0].message
+
+
+def test_confighash_flags_stale_registry_entry():
+    surfaces = {
+        f"{HOT}::fit_x": {
+            "kwargs_param": "kw",
+            "hashed": {"a": "extra"},
+            "excluded": {"gone_knob": "stale"},
+        },
+    }
+    import functools
+    from tools.lint.checkers import confighash
+
+    src = "def fit_x(*, a=1, **kw):\n    return config_hash(fit_x, kw, extra={'a': a})\n"
+    found = _hits(lint_source(
+        src, HOT, [functools.partial(confighash.check, surfaces=surfaces)]),
+        "config-hash")
+    assert len(found) == 1 and "gone_knob" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# waivers
+# ---------------------------------------------------------------------------
+
+
+def test_inline_waiver_suppresses_and_requires_reason():
+    path, src, checkers = selftest.WAIVER_FIXTURE
+    res = lint_source(src, path, checkers)
+    assert any(f.rule == "nondet" and f.waived for f in res)
+    assert any(f.rule == "stale-waiver" for f in res)
+    assert any(f.rule == "waiver-syntax" for f in res)
+
+
+def test_scoped_waiver_covers_whole_function():
+    src = textwrap.dedent("""
+        import time
+
+        def stamps():  # lint: nondet(wall-clock metadata block, by design)
+            a = time.time()
+            b = time.time()
+            return a, b
+        """)
+    res = lint_source(src, HOT)
+    nondet = _hits(res, "nondet", include_waived=True)
+    assert len(nondet) == 2 and all(f.waived for f in nondet)
+    assert not _hits(res, "stale-waiver")
+
+
+def test_class_line_waiver_does_not_blanket_the_class():
+    """Scoped waivers are FUNCTION-level only: one comment above a class
+    must not silently suppress a rule across its whole body."""
+    src = textwrap.dedent("""
+        import time
+
+        # lint: nondet(should not blanket the class)
+        class C:
+            def stamp(self):
+                return time.time()
+        """)
+    res = lint_source(src, HOT)
+    assert _hits(res, "nondet"), "class-line waiver blanketed the class"
+    assert any(f.rule == "stale-waiver" for f in res)
+
+
+def test_waiver_inside_string_is_not_a_waiver():
+    src = 'import time\nS = "# lint: nondet(not a comment)"\n' \
+          'def f():\n    return time.time()\n'
+    assert _hits(lint_source(src, HOT), "nondet")
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    path, bad, _good, checkers = selftest.FIXTURES["nondet"]
+    live = _hits(lint_source(bad, path, checkers), "nondet")
+    assert live
+    bp = str(tmp_path / "base.json")
+    save_baseline(live, bp)
+    base = load_baseline(bp)
+    new, known, prunable = diff_baseline(live, base)
+    assert not new and len(known) == len(live) and not prunable
+    # one extra occurrence of a baselined key is NEW
+    extra = live + [live[0]]
+    new2, _k, _p = diff_baseline(extra, base)
+    assert len(new2) == 1
+    # all fixed -> every key prunable
+    _n, _k2, prunable3 = diff_baseline([], base)
+    assert len(prunable3) == len(base)
+
+
+def test_write_baseline_refuses_subset_scans():
+    """--write-baseline over explicit paths would truncate the baseline
+    to the subset's findings; it must refuse."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.lint",
+         "spark_timeseries_tpu/reliability/journal.py", "--write-baseline"],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 2 and "full scan" in r.stderr
+
+
+def test_committed_baseline_is_empty():
+    base = load_baseline(os.path.join(REPO, "LINT_BASELINE.json"))
+    assert base == {}, (
+        "LINT_BASELINE.json must stay empty — fix or waive, don't pin")
+
+
+# ---------------------------------------------------------------------------
+# the real repo: clean, and the registry matches live signatures
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean():
+    findings = [f for f in lint_paths(REPO) if not f.waived]
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_config_hash_registry_round_trips_fit_chunked_signature():
+    from spark_timeseries_tpu.reliability.chunked import fit_chunked
+
+    spec = contracts.CONFIG_HASH_SURFACES[
+        "spark_timeseries_tpu/reliability/chunked.py::fit_chunked"]
+    sig = inspect.signature(fit_chunked)
+    params = [p for p in sig.parameters.values()
+              if p.kind != inspect.Parameter.VAR_KEYWORD]
+    kwargs = [p.name for p in sig.parameters.values()
+              if p.kind == inspect.Parameter.VAR_KEYWORD]
+    covered = set(spec["hashed"]) | set(spec["excluded"])
+    for p in params:
+        assert p.name in covered, (
+            f"fit_chunked keyword {p.name!r} missing from the "
+            "config-hash registry")
+    for name in covered:
+        assert name in {p.name for p in params}, (
+            f"stale registry entry {name!r}")
+    assert kwargs == [spec["kwargs_param"]]
+    # every exclusion carries a non-trivial rationale
+    for knob, why in spec["excluded"].items():
+        assert len(why) > 20, f"exclusion {knob!r} needs a real rationale"
+
+
+def test_config_hash_registry_round_trips_panel_and_serving():
+    from spark_timeseries_tpu.panel import TimeSeriesPanel
+    from spark_timeseries_tpu.serving.server import FitServer
+
+    for fn, key in ((TimeSeriesPanel.fit,
+                     "spark_timeseries_tpu/panel.py::TimeSeriesPanel.fit"),
+                    (FitServer.submit,
+                     "spark_timeseries_tpu/serving/server.py::"
+                     "FitServer.submit")):
+        spec = contracts.CONFIG_HASH_SURFACES[key]
+        sig = inspect.signature(fn)
+        names = {p.name for p in sig.parameters.values()
+                 if p.kind != inspect.Parameter.VAR_KEYWORD} - {"self"}
+        covered = set(spec["hashed"]) | set(spec["excluded"])
+        assert names == covered, (key, names ^ covered)
+
+
+def test_file_write_owners_exist():
+    """Every registered owner call site resolves to a real symbol."""
+    import ast
+
+    for rel, owners in contracts.FILE_WRITE_OWNERS.items():
+        src = open(os.path.join(REPO, rel), encoding="utf-8").read()
+        tree = ast.parse(src)
+        names = {n.name for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef, ast.ClassDef))}
+        for owner in owners:
+            assert owner.split(".")[0] in names, (
+                f"{rel}: registered owner {owner!r} no longer exists")
+
+
+# ---------------------------------------------------------------------------
+# runtime tracker (fast negative check; the full walk smoke is ci.sh's
+# tests/_lockdiscipline_worker.py --smoke)
+# ---------------------------------------------------------------------------
+
+
+def test_runtime_tracker_catches_seeded_violation():
+    from tools.lint.runtime import LockDisciplineTracker
+
+    class Seeded:
+        _protected_by_ = {"_n": "_lock", "_m": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._m = {}
+
+        def good(self):
+            with self._lock:
+                self._n += 1
+                self._m["k"] = self._n
+
+    tracker = LockDisciplineTracker().install([Seeded])
+    try:
+        s = Seeded()
+        s.good()
+        assert not tracker.violations, tracker.report()
+        s._n = 5  # attribute store off-lock
+        s._m["x"] = 1  # container store off-lock
+        assert len(tracker.violations) == 2, tracker.report()
+        kinds = {v.kind for v in tracker.violations}
+        assert kinds == {"attribute", "container"}
+        assert tracker.checks_decided >= 4
+    finally:
+        tracker.uninstall()
+    # uninstalled: no further tracking, class behaves normally
+    s2 = Seeded()
+    s2._n = 7
+    assert len(tracker.violations) == 2
+
+
+def test_runtime_tracker_condition_guard():
+    from tools.lint.runtime import LockDisciplineTracker
+
+    class Q:
+        _protected_by_ = {"_items": "cond"}
+
+        def __init__(self):
+            self.cond = threading.Condition()
+            self._items = []
+
+        def push(self, x):
+            with self.cond:
+                self._items.append(x)
+                self.cond.notify_all()
+
+        def pop_bad(self):
+            return self._items.pop()
+
+    tracker = LockDisciplineTracker().install([Q])
+    try:
+        q = Q()
+        q.push(1)
+        q.push(2)
+        assert not tracker.violations, tracker.report()
+        q.pop_bad()
+        assert len(tracker.violations) == 1
+    finally:
+        tracker.uninstall()
+
+
+def test_runtime_tracker_condition_wait_preserves_reentrancy():
+    """A nested (reentrant) hold across Condition.wait() must fully
+    unwind and restore — an instrumented run must never deadlock code
+    that is correct uninstrumented."""
+    from tools.lint.runtime import LockDisciplineTracker
+
+    class Q:
+        _protected_by_ = {"_items": "cond"}
+
+        def __init__(self):
+            self.cond = threading.Condition()  # RLock-backed: reentrant
+            self._items = []
+
+        def put(self, x):
+            with self.cond:
+                self._items.append(x)
+                self.cond.notify_all()
+
+        def take_nested(self, timeout):
+            with self.cond:
+                with self.cond:  # reentrant hold, then wait
+                    while not self._items:
+                        if not self.cond.wait(timeout=timeout):
+                            raise TimeoutError("producer never got the "
+                                               "lock: wait() left a "
+                                               "reentrant level held")
+                    return self._items.pop()
+
+    tracker = LockDisciplineTracker().install([Q])
+    try:
+        q = Q()
+        out = []
+
+        def consumer():
+            out.append(q.take_nested(timeout=5.0))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        import time as _time
+
+        _time.sleep(0.1)
+        q.put(42)  # must acquire while the consumer waits
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "deadlock: wait() did not release the " \
+                                 "reentrant hold"
+        assert out == [42]
+        assert not tracker.violations, tracker.report()
+    finally:
+        tracker.uninstall()
+
+
+def test_runtime_registry_classes_all_declare_maps():
+    """Every runtime target resolves and carries a usable map."""
+    import importlib
+
+    for spec in contracts.LOCKMAP_RUNTIME_CLASSES:
+        mod_name, cls_name = spec.split(":")
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        from tools.lint.runtime import LockDisciplineTracker
+
+        pmap = LockDisciplineTracker._resolved_map(cls)
+        assert pmap, f"{spec} declares no _protected_by_"
+        for attr, guards in pmap.items():
+            assert isinstance(attr, str) and guards, (spec, attr)
+
+
+def test_explain_mode_documents_every_rule():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--explain", "all"],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 0
+    for rule in ("host-sync", "config-hash", "journal-writer", "lock-map",
+                 "obs-inert", "nondet", "stale-waiver"):
+        assert rule in r.stdout, f"--explain all missing {rule}"

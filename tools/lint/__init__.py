@@ -1,0 +1,53 @@
+"""ststpu-lint: project-specific static analysis for spark-timeseries-tpu.
+
+Eleven PRs in, the system's correctness rests on cross-cutting contracts
+no general-purpose linter knows about — bitwise reproducibility, the
+journal's single-writer-per-namespace protocol, deliberate config-hash
+knob exclusions, obs-off-by-default inertness, zero implicit host syncs
+in the chunk walk, and lock discipline across committer / prefetcher /
+lane / server threads.  Every one of them has been broken silently at
+least once (the PR 8 winners regression, the PR 7 CPU zero-copy aliasing
+bug, the PR 6 unguarded pool-registry iteration).  This package makes
+them machine-checked: one AST checker per load-bearing contract, run as
+
+    python -m tools.lint [--json] [--baseline LINT_BASELINE.json]
+    python -m tools.lint --explain <rule>
+    python -m tools.lint --self-test
+
+Rules (see ``--explain`` for the full contract text and waiver syntax):
+
+- ``host-sync``      implicit device->host syncs in hot-path modules
+- ``config-hash``    journal config-hash coverage of every driver knob
+- ``journal-writer`` file writes only from registered owner call sites
+- ``lock-map``       declared per-class lock protection maps, honored
+- ``obs-inert``      obs reached only through the guarded facade
+- ``nondet``         wall-clock / RNG / hash-order bans in bitwise code
+
+A genuine-but-deliberate violation carries an inline waiver comment
+``# lint: <rule>(<reason>)`` on the flagged line or the line above; the
+reason is mandatory and waivers that no longer cover a finding are
+themselves flagged (``stale-waiver``) so they cannot rot in place.
+
+``LINT_BASELINE.json`` (repo root) pins known findings: new findings
+fail, baselined ones are tracked to zero.  The committed baseline is
+EMPTY — every real violation the suite surfaced was fixed or waived.
+
+The runtime companion (:mod:`tools.lint.runtime`) enforces the lock-map
+contract dynamically: it instruments the declared classes with
+owner-tracking lock proxies and asserts, on a real pipelined + sharded +
+serving walk, that every declared attribute mutation happens under its
+declared lock (``tests/_lockdiscipline_worker.py --smoke`` in ci.sh).
+"""
+
+from .engine import (Finding, LintModule, Waiver, collect_waivers,
+                     lint_paths, lint_source, load_baseline)
+
+__all__ = [
+    "Finding",
+    "LintModule",
+    "Waiver",
+    "collect_waivers",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+]

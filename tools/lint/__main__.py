@@ -1,0 +1,132 @@
+"""CLI: ``python -m tools.lint`` / the ``ststpu-lint`` console script.
+
+Exit codes: 0 clean (no new findings), 1 new findings (or a failed
+self-test), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import checkers as checkers_mod
+from .engine import (DEFAULT_BASELINE, REPO_ROOT, diff_baseline,
+                     lint_paths, load_baseline, save_baseline)
+
+
+def _explain(rule: str) -> int:
+    rules = dict(checkers_mod.ENGINE_RULES)
+    for name, mod in checkers_mod.RULES.items():
+        rules[name] = (mod.__doc__ or "").strip()
+    if rule == "all":
+        for name in sorted(rules):
+            print(f"== {name} " + "=" * max(0, 66 - len(name)))
+            print(rules[name])
+            print()
+        return 0
+    if rule not in rules:
+        print(f"unknown rule {rule!r}; known: {', '.join(sorted(rules))}",
+              file=sys.stderr)
+        return 2
+    print(rules[rule])
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ststpu-lint",
+        description="Project-specific invariant linter for "
+                    "spark-timeseries-tpu (see --explain all).")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: LINT_BASELINE.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    ap.add_argument("--explain", metavar="RULE",
+                    help="print a rule's contract text ('all' for every "
+                         "rule) and exit")
+    ap.add_argument("--self-test", action="store_true",
+                    help="verify every checker catches its seeded "
+                         "violation (ci.sh runs this before the lint so "
+                         "a broken checker cannot pass vacuously)")
+    ap.add_argument("--show-waived", action="store_true",
+                    help="also list findings suppressed by waivers")
+    args = ap.parse_args(argv)
+
+    if args.explain:
+        return _explain(args.explain)
+
+    if args.self_test:
+        from .selftest import run_self_test
+
+        failures = run_self_test()
+        if failures:
+            for f in failures:
+                print(f"self-test FAIL: {f}", file=sys.stderr)
+            return 1
+        print("self-test: all checkers catch their seeded violations; "
+              "waiver + baseline machinery OK")
+        return 0
+
+    findings = lint_paths(REPO_ROOT, args.paths or None)
+    if args.write_baseline:
+        if args.paths:
+            # a subset scan would TRUNCATE the baseline to the subset's
+            # findings, and the next full run would report everything
+            # else as new — refuse instead of corrupting
+            print("--write-baseline requires a full scan; drop the "
+                  "explicit paths", file=sys.stderr)
+            return 2
+        save_baseline(findings, args.baseline)
+        print(f"baseline written: {args.baseline}")
+        return 0
+    baseline = load_baseline(args.baseline)
+    new, known, prunable = diff_baseline(findings, baseline)
+    waived = [f for f in findings if f.waived]
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in known],
+            "waived": [f.to_dict() for f in waived],
+            "baseline_prunable": prunable,
+            "counts": {"new": len(new), "baselined": len(known),
+                       "waived": len(waived),
+                       "baseline_prunable": len(prunable)},
+            "ok": not new,
+        }, indent=1))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    if known:
+        print(f"-- {len(known)} baselined finding(s) still present "
+              "(tracked to zero; do not add more)")
+        for f in known:
+            print(f"   {f.render()}")
+    if prunable:
+        print(f"-- {len(prunable)} baseline entr(y/ies) no longer fire — "
+              "prune with --write-baseline:")
+        for k in prunable:
+            print(f"   {k}")
+    if args.show_waived and waived:
+        print(f"-- {len(waived)} waived finding(s):")
+        for f in waived:
+            print(f"   {f.render()}")
+    if new:
+        print(f"\nststpu-lint: {len(new)} NEW finding(s).  Run "
+              "`python -m tools.lint --explain <rule>` for the contract "
+              "and the waiver syntax.")
+        return 1
+    n_files = "package"
+    print(f"ststpu-lint: clean ({n_files}; {len(waived)} waived, "
+          f"{len(known)} baselined)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,137 @@
+"""Shared AST helpers for the checkers: parent links, qualified names,
+attribute-chain dotting, and enclosing-``with`` lookup."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+
+def annotate_parents(tree: ast.Module) -> None:
+    """Attach ``._lint_parent`` to every node (idempotent)."""
+    if getattr(tree, "_lint_parented", False):
+        return
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._lint_parent = node  # type: ignore[attr-defined]
+    tree._lint_parent = None  # type: ignore[attr-defined]
+    tree._lint_parented = True  # type: ignore[attr-defined]
+
+
+def parents(node: ast.AST) -> Iterator[ast.AST]:
+    p = getattr(node, "_lint_parent", None)
+    while p is not None:
+        yield p
+        p = getattr(p, "_lint_parent", None)
+
+
+def qualname(node: ast.AST) -> str:
+    """Dotted enclosing-scope name of ``node`` (``Class.method`` /
+    ``function`` / ``<module>``)."""
+    names: List[str] = []
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            names.append(p.name)
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        names.append(node.name)
+    return ".".join(reversed(names)) or "<module>"
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Dotted name of the called object, if it is a plain chain."""
+    return dotted(node.func)
+
+
+def enclosing_function(node: ast.AST):
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return p
+    return None
+
+
+def enclosing_class(node: ast.AST):
+    for p in parents(node):
+        if isinstance(p, ast.ClassDef):
+            return p
+    return None
+
+
+def with_guards(node: ast.AST, stop: Optional[ast.AST] = None
+                ) -> List[ast.expr]:
+    """Context expressions of every ``with`` statement enclosing ``node``
+    (innermost first), up to (not including) ``stop``."""
+    out: List[ast.expr] = []
+    for p in parents(node):
+        if p is stop:
+            break
+        if isinstance(p, ast.With):
+            out.extend(item.context_expr for item in p.items)
+    return out
+
+
+def local_aliases(func: ast.AST) -> dict:
+    """``{local_name: "self.a.b"}`` for simple ``name = self.<chain>``
+    assignments anywhere in ``func`` — the codebase's
+    ``cond = self.queue.cond`` idiom."""
+    aliases: dict = {}
+    for sub in ast.walk(func):
+        if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Name)):
+            d = dotted(sub.value)
+            if d is not None and d.startswith("self."):
+                aliases[sub.targets[0].id] = d
+    return aliases
+
+
+def names_in(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+
+
+def literal_str_dict(node: ast.AST) -> Optional[dict]:
+    """Evaluate a dict literal whose keys are str constants and whose
+    values are str constants or tuples/lists of str constants."""
+    if not isinstance(node, ast.Dict):
+        return None
+    out: dict = {}
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            out[k.value] = (v.value,)
+        elif isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in v.elts):
+            out[k.value] = tuple(e.value for e in v.elts)
+        else:
+            return None
+    return out
+
+
+def keyword_arg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def func_params(fn: ast.AST) -> Tuple[List[str], Optional[str]]:
+    """(named parameter list incl. kw-only, **kwargs name) of a def."""
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    return names, (a.kwarg.arg if a.kwarg else None)

@@ -1,0 +1,45 @@
+"""Checker registry: one module per load-bearing contract.
+
+Each checker exposes ``check(module: LintModule) -> Iterable[Finding]``
+and a module docstring that doubles as its ``--explain`` text.
+"""
+
+from . import (confighash, hostsync, journalwriter, lockmap, nondet,
+               obsinert)
+
+ALL_CHECKERS = (
+    hostsync.check,
+    confighash.check,
+    journalwriter.check,
+    lockmap.check,
+    obsinert.check,
+    nondet.check,
+)
+
+# rule name -> checker module (the docstring is the --explain text)
+RULES = {
+    hostsync.RULE: hostsync,
+    confighash.RULE: confighash,
+    journalwriter.RULE: journalwriter,
+    lockmap.RULE: lockmap,
+    obsinert.RULE: obsinert,
+    nondet.RULE: nondet,
+}
+
+# engine-level rules explained inline (no checker module of their own)
+ENGINE_RULES = {
+    "stale-waiver": (
+        "A `# lint: <rule>(<reason>)` waiver no longer covers any "
+        "finding: the violation it excused is gone, so the excuse must "
+        "go with it.  Delete the comment (or move it back next to the "
+        "violation if it drifted during an edit)."),
+    "waiver-syntax": (
+        "A waiver comment with an empty reason.  The reason is the "
+        "point: it is the reviewed record of WHY the violation is "
+        "deliberate.  Write one, e.g.\n"
+        "    # lint: host-sync(commit fetch: the journal needs host "
+        "bytes)"),
+    "parse-error": "A target file failed to parse; fix the syntax error.",
+}
+
+__all__ = ["ALL_CHECKERS", "RULES", "ENGINE_RULES"]

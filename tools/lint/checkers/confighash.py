@@ -1,0 +1,142 @@
+"""config-hash: every knob a fit-entry surface accepts must be reachable
+by the journal config hash or registered as a deliberate exclusion.
+
+The journal accepts a resume exactly when ``config_hash`` matches — so a
+knob that changes what a chunk's bytes mean MUST reach the hash, and a
+knob that only moves work (pipeline depth, shard layout, prefetch
+depth) is EXCLUDED so a serial journal resumes under a pipelined run.
+Both sets were tribal knowledge; this checker pins them to the registry
+in :mod:`tools.lint.contracts` (``CONFIG_HASH_SURFACES``), each
+exclusion with a rationale.  Three failure modes are caught:
+
+- a NEW signature keyword with no registry entry (the bug: a knob that
+  silently forks journal compatibility, or silently doesn't),
+- a STALE registry entry naming a parameter the signature dropped,
+- registry drift from the code: a driver knob registered as hashed for
+  ``fit_chunked`` must appear as a literal key of the ``extra=`` dict
+  actually passed to ``config_hash`` (or be covered by the
+  ``**fit_kwargs`` catch-all / panel fingerprint).
+
+Adding a knob therefore forces an explicit decision, reviewed where the
+rationale lives.  (There is deliberately NO waiver for this rule — the
+registry IS the waiver, with teeth.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .. import astutil
+from .. import contracts
+from ..engine import Finding, LintModule
+
+RULE = "config-hash"
+
+
+def _find_def(tree: ast.Module, qual: str) -> Optional[ast.AST]:
+    parts = qual.split(".")
+    node: ast.AST = tree
+    for part in parts:
+        nxt = None
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)) and child.name == part:
+                nxt = child
+                break
+        if nxt is None:
+            return None
+        node = nxt
+    return node
+
+
+def _config_hash_extra_keys(fn: ast.AST) -> Optional[set]:
+    """Literal str keys of ``extra={...}`` in the first ``config_hash``
+    call inside ``fn`` that carries one (the journal-identity call)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.call_name(node)
+        if name is None or not name.endswith("config_hash"):
+            continue
+        extra = astutil.keyword_arg(node, "extra")
+        if isinstance(extra, ast.Dict):
+            keys = set()
+            for k in extra.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+                else:
+                    return None  # non-literal key: cannot verify
+            return keys
+    return None
+
+
+def check(module: LintModule,
+          surfaces: Optional[dict] = None) -> Iterator[Finding]:
+    surfaces = (contracts.CONFIG_HASH_SURFACES
+                if surfaces is None else surfaces)
+    for surface, spec in surfaces.items():
+        path, qual = surface.split("::", 1)
+        if module.path != path:
+            continue
+        fn = _find_def(module.tree, qual)
+        if fn is None or not isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield Finding(
+                rule=RULE, path=module.path, line=1, col=0,
+                message=f"registered surface `{qual}` not found — update "
+                        "CONFIG_HASH_SURFACES in tools/lint/contracts.py")
+            continue
+        params, kwargs_name = astutil.func_params(fn)
+        params = [p for p in params if p != "self"]
+        hashed = set(spec.get("hashed", {}))
+        excluded = set(spec.get("excluded", {}))
+        covered = hashed | excluded
+        for p in params:
+            if p not in covered:
+                yield Finding(
+                    rule=RULE, path=module.path, line=fn.lineno, col=0,
+                    message=f"`{qual}` keyword `{p}` is neither reachable "
+                            "by the journal config hash nor registered as "
+                            "a deliberate exclusion — decide which and "
+                            "record it (with rationale) in "
+                            "CONFIG_HASH_SURFACES")
+        for p in sorted(covered):
+            if p not in params:
+                yield Finding(
+                    rule=RULE, path=module.path, line=fn.lineno, col=0,
+                    message=f"CONFIG_HASH_SURFACES entry `{p}` names a "
+                            f"parameter `{qual}` no longer accepts — "
+                            "prune the stale registry entry")
+        if kwargs_name is not None and spec.get("kwargs_param") \
+                is not None and kwargs_name != spec["kwargs_param"]:
+            yield Finding(
+                rule=RULE, path=module.path, line=fn.lineno, col=0,
+                message=f"`{qual}` **{kwargs_name} does not match the "
+                        f"registered catch-all **{spec['kwargs_param']}")
+        # registry <-> code drift for the anchor surface: hashed driver
+        # knobs must be literal extra= keys of the config_hash call
+        extra_keys = spec.get("extra_keys")
+        if extra_keys is not None:
+            live = _config_hash_extra_keys(fn)
+            if live is None:
+                yield Finding(
+                    rule=RULE, path=module.path, line=fn.lineno, col=0,
+                    message=f"`{qual}` has no config_hash(extra={{...}}) "
+                            "call with literal keys — the checker can no "
+                            "longer verify hashed driver knobs")
+            else:
+                for k in sorted(set(extra_keys) - live):
+                    yield Finding(
+                        rule=RULE, path=module.path, line=fn.lineno, col=0,
+                        message=f"registered hashed knob `{k}` is NOT a "
+                                "key of the extra= dict passed to "
+                                "config_hash — the registry claims "
+                                "coverage the code does not provide")
+                for k in sorted(live - set(extra_keys)):
+                    yield Finding(
+                        rule=RULE, path=module.path, line=fn.lineno, col=0,
+                        message=f"config_hash extra= key `{k}` is not "
+                                "registered in CONFIG_HASH_SURFACES "
+                                "extra_keys — register it so coverage "
+                                "stays machine-readable")

@@ -1,0 +1,282 @@
+"""host-sync: flag implicit device->host synchronizations in hot-path
+modules.
+
+The pipelined chunk walk's perf contract is ZERO implicit host syncs on
+the critical path: stage N+1 / compute N / commit N-1 only overlap while
+the driver never blocks on a device value.  A stray ``float(nll)``,
+truthiness test on a jax array, or ``np.asarray`` of a device value
+stalls the walk for a full dispatch round trip — the exact bug class the
+PR 7 host-streamed NaN probe removed.  Deliberate syncs (the commit
+fetch, the staging materialization barrier) carry inline waivers:
+
+    jax.block_until_ready(arr)  # lint: host-sync(staging barrier: ...)
+
+Detection is a per-function value-flow approximation tuned for a CI
+gate (zero false positives beats exhaustive recall): names assigned
+from ``jnp.* / lax.* / jax.*`` calls are DEVICE-TAINTED, taint flows
+through operators / subscripts / ternaries / tuple unpacks — but NOT
+through the results of unknown function calls (helpers fed device
+values usually return host metadata), and host metadata access
+(``x.shape``), host casts, identity comparisons, and list-display
+names stop it.  The checker flags
+
+- ``float(x) / int(x) / bool(x) / np.asarray(x) / np.array(x) /
+  np.ascontiguousarray(x)`` where ``x`` contains a tainted name,
+- ``.item()`` / ``.tolist()`` calls (anywhere in a hot module),
+- ``jax.block_until_ready`` / ``jax.device_get`` /
+  ``<x>.block_until_ready()`` (anywhere in a hot module),
+- truthiness on tainted values (``if x:``, ``while x:``, ``assert x``,
+  boolean operators, non-``is`` comparisons used as branch tests).
+
+Host-side jax calls that never produce device values are exempt
+(``jax.process_index`` etc.).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from .. import astutil
+from ..contracts import HOT_PATH_PREFIXES
+from ..engine import Finding, LintModule
+
+RULE = "host-sync"
+
+# jax.* calls that return host values / objects, never device arrays
+_HOST_SIDE_JAX = {
+    "jax.process_index", "jax.process_count", "jax.device_count",
+    "jax.local_device_count", "jax.devices", "jax.local_devices",
+    "jax.default_backend", "jax.eval_shape", "jax.make_mesh",
+    "jax.tree_util", "jax.profiler", "jax.distributed",
+    "jax.block_until_ready", "jax.clear_caches",
+}
+
+_CAST_SINKS = {"float", "int", "bool", "complex"}
+_NP_SINKS = {"np.asarray", "np.array", "np.ascontiguousarray",
+             "numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+_SYNC_CALLS = {"jax.block_until_ready", "jax.device_get"}
+
+
+def applies(path: str) -> bool:
+    return any(path.startswith(p) or path == p.rstrip("/")
+               for p in HOT_PATH_PREFIXES)
+
+
+def _is_device_call(node: ast.Call) -> bool:
+    name = astutil.call_name(node)
+    if name is None:
+        return False
+    if name in _HOST_SIDE_JAX or any(
+            name.startswith(h + ".") for h in _HOST_SIDE_JAX):
+        return False
+    root = name.split(".", 1)[0]
+    if root in ("jnp", "lax"):
+        return True
+    if name.startswith(("jax.numpy.", "jax.lax.", "jax.random.")):
+        return True
+    if name in ("jax.device_put", "jax.jit", "jax.vmap", "jax.pmap",
+                "jax.grad", "jax.value_and_grad"):
+        return True
+    return False
+
+
+# attributes whose value is HOST metadata even on a device array: reading
+# them never touches device bytes, so taint stops there
+_METADATA_ATTRS = {
+    "shape", "dtype", "ndim", "size", "nbytes", "itemsize", "sharding",
+    "device", "devices", "is_fully_addressable", "addressable_shards",
+    "kind", "name", "__name__",
+}
+
+def _value_tainted(e: ast.AST, tainted: Set[str]) -> bool:
+    """Does evaluating ``e`` yield a device value?  Taint flows through
+    operators, subscripts, ternaries, and attribute access — but NOT
+    through the results of unknown function calls (a helper fed a device
+    value usually returns host metadata: fingerprints, plans, meta
+    dicts; treating those as tainted floods the walk with false
+    positives).  Device producers: direct ``jnp.*``/``lax.*``/seeded
+    ``jax.*`` calls, and calls of names themselves bound to jitted
+    callables.  Metadata attributes (``x.shape`` ...), host casts
+    (``int(x)`` ...), and identity comparisons stop the taint."""
+    if isinstance(e, ast.Call):
+        if _is_device_call(e):
+            return True
+        cn = astutil.call_name(e)
+        if cn is not None and cn.split(".", 1)[0] in tainted:
+            return True  # jitted callable bound earlier
+        return False  # opaque call: result assumed host-side
+    if isinstance(e, ast.Attribute):
+        if e.attr in _METADATA_ATTRS:
+            return False
+        return _value_tainted(e.value, tainted)
+    if isinstance(e, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+               for op in e.ops):
+            return False
+        return any(_value_tainted(c, tainted)
+                   for c in [e.left] + list(e.comparators))
+    if isinstance(e, ast.Name):
+        return e.id in tainted
+    return any(_value_tainted(c, tainted)
+               for c in ast.iter_child_nodes(e))
+
+
+def _tainted_names(fn: ast.AST) -> tuple:
+    """(tainted, containers): names bound to device-tainted values, and
+    names ever bound to list/tuple/dict/set displays (whose truthiness
+    is a host-side length check, not a device sync).
+
+    One forward pass plus propagation to fixpoint over plain assigns:
+    ``a = jnp.sum(x)``, ``b = a + 1``, ``lo, hi = a``, ``c = a.params``.
+    A call of ANY function on a tainted argument taints the result (a
+    fit on device inputs returns device outputs); metadata attributes
+    (``x.shape`` ...), host casts (``int(x)`` ...) and identity
+    comparisons stop the taint.
+    """
+    tainted: Set[str] = set()
+    containers: Set[str] = set()
+
+    def _is_display(v: ast.AST) -> bool:
+        if isinstance(v, (ast.List, ast.ListComp, ast.Tuple, ast.Dict,
+                          ast.Set, ast.DictComp, ast.SetComp)):
+            return True
+        # `x = [a] if flag else []` is still a list-valued name
+        if isinstance(v, ast.IfExp):
+            return _is_display(v.body) and _is_display(v.orelse)
+        return False
+
+    for _ in range(4):  # tiny fixpoint: chains are short in practice
+        before = (len(tainted), len(containers))
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign):
+                if _is_display(sub.value):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            containers.add(t.id)
+                    continue
+                if _value_tainted(sub.value, tainted):
+                    for t in sub.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+            elif isinstance(sub, ast.AugAssign):
+                if isinstance(sub.target, ast.Name) and \
+                        sub.target.id not in containers and \
+                        _value_tainted(sub.value, tainted):
+                    tainted.add(sub.target.id)
+        if (len(tainted), len(containers)) == before:
+            break
+    return tainted - containers, containers
+
+
+def _contains_tainted(e: ast.AST, tainted: Set[str]) -> bool:
+    return _value_tainted(e, tainted)
+
+
+def _truthy_test_tainted(test: ast.AST, tainted: Set[str]) -> bool:
+    """Branch tests that force a device value to a host bool.  ``is`` /
+    ``is not`` / ``in`` comparisons, ``isinstance``, ``len`` and
+    attribute existence checks never read device bytes and are exempt."""
+    if isinstance(test, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+               for op in test.ops):
+            return False
+        return _contains_tainted(test, tainted)
+    if isinstance(test, ast.BoolOp):
+        return any(_truthy_test_tainted(v, tainted) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _truthy_test_tainted(test.operand, tainted)
+    if isinstance(test, ast.Name):
+        return test.id in tainted
+    if isinstance(test, ast.Call):
+        name = astutil.call_name(test)
+        if name is not None and (
+                name in ("len", "isinstance", "hasattr", "getattr")
+                or name.endswith((".get", ".keys"))):
+            return False
+        return _is_device_call(test)
+    if isinstance(test, ast.Attribute):
+        # x.shape / x.dtype / x.ndim are metadata, not bytes
+        return False
+    return False
+
+
+def _scope_nodes(scope: ast.AST) -> Iterator[ast.AST]:
+    """Nodes belonging directly to ``scope`` (nested defs excluded — each
+    function scope reports its own findings against its own taint set)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def check(module: LintModule) -> Iterator[Finding]:
+    if not applies(module.path):
+        return
+    astutil.annotate_parents(module.tree)
+
+    scopes: List[ast.AST] = [module.tree] + [
+        n for n in ast.walk(module.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def finding(node: ast.AST, msg: str) -> Finding:
+        return Finding(rule=RULE, path=module.path, line=node.lineno,
+                       col=node.col_offset,
+                       message=f"{msg} in {astutil.qualname(node)}")
+
+    for scope in scopes:
+        tainted, _containers = _tainted_names(scope)
+        for node in _scope_nodes(scope):
+            if isinstance(node, ast.Call):
+                name = astutil.call_name(node)
+                if name in _SYNC_CALLS:
+                    yield finding(
+                        node, f"explicit device sync `{name}(...)` — "
+                              "waive with the reason if deliberate")
+                    continue
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("item", "tolist")
+                        and not node.args and not node.keywords
+                        and _contains_tainted(node.func.value, tainted)):
+                    yield finding(
+                        node, f"`.{node.func.attr}()` forces a "
+                              "device->host transfer")
+                    continue
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "block_until_ready"):
+                    yield finding(
+                        node, "`.block_until_ready()` is an explicit "
+                              "device sync — waive with the reason "
+                              "if deliberate")
+                    continue
+                if name in _CAST_SINKS and node.args and \
+                        _contains_tainted(node.args[0], tainted):
+                    yield finding(
+                        node, f"`{name}()` of a device value blocks "
+                              "on dispatch (host sync)")
+                    continue
+                if name in _NP_SINKS and node.args and \
+                        _contains_tainted(node.args[0], tainted):
+                    yield finding(
+                        node, f"`{name}()` of a device value is an "
+                              "implicit device->host copy")
+                    continue
+            elif isinstance(node, (ast.If, ast.While)):
+                if _truthy_test_tainted(node.test, tainted):
+                    yield finding(
+                        node.test, "truthiness of a device value in a "
+                                   "branch test blocks on dispatch")
+            elif isinstance(node, ast.Assert):
+                if _truthy_test_tainted(node.test, tainted):
+                    yield finding(
+                        node.test, "assert on a device value blocks "
+                                   "on dispatch")
+            elif isinstance(node, ast.IfExp):
+                if _truthy_test_tainted(node.test, tainted):
+                    yield finding(
+                        node.test, "conditional expression on a "
+                                   "device value blocks on dispatch")

@@ -1,0 +1,98 @@
+"""journal-writer: file writes only from registered owner call sites.
+
+The chunk journal's durability proof rests on a single-writer-per-
+namespace protocol: ``ChunkJournal`` owns its namespace's shards and
+manifest, the pipelined committer is a courier INTO that owner (one
+worker, FIFO, shard-before-manifest), and ``merge_job_manifest`` alone
+writes the merged root.  A future helper that writes "just one more
+file" under a journal root would splice a second writer into the
+protocol without tripping any test — until a crash lands between its
+write and the manifest's.
+
+This checker generalizes the rule to the whole library: every call site
+that writes a file must be registered in
+``tools.lint.contracts.FILE_WRITE_OWNERS`` with the namespace it owns.
+Write primitives detected: ``open(..., "w"/"a"/"x"/"+")``,
+``os.fdopen(..., "w"/"wb")``, ``os.replace`` / ``os.rename``,
+``np.savez`` / ``np.savez_compressed`` / ``np.save``,
+``shutil.move`` / ``shutil.copy*``, ``Path.write_text`` /
+``Path.write_bytes``.  One-off exceptions (there should be none) use
+``# lint: journal-writer(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .. import astutil
+from .. import contracts
+from ..engine import Finding, LintModule
+
+RULE = "journal-writer"
+
+_WRITE_FUNCS = {
+    "os.replace", "os.rename",
+    "np.savez", "np.savez_compressed", "np.save",
+    "numpy.savez", "numpy.savez_compressed", "numpy.save",
+    "shutil.move", "shutil.copy", "shutil.copy2", "shutil.copyfile",
+    "shutil.copytree",
+}
+_WRITE_METHODS = {"write_text", "write_bytes"}
+_WRITE_MODES = set("wax+")
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    """The mode literal of an open()/os.fdopen() call, '' if defaulted,
+    None if non-literal (conservatively treated as a write)."""
+    mode = astutil.keyword_arg(call, "mode")
+    if mode is None and len(call.args) >= 2:
+        mode = call.args[1]
+    if mode is None:
+        return ""
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+def _is_write(node: ast.Call) -> Optional[str]:
+    name = astutil.call_name(node)
+    if name in _WRITE_FUNCS:
+        return name
+    if name in ("open", "os.fdopen", "io.open", "gzip.open"):
+        mode = _open_mode(node)
+        if mode is None:
+            return f"{name}(mode=<non-literal>)"
+        if _WRITE_MODES & set(mode):
+            return f"{name}(mode={mode!r})"
+        return None
+    if isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _WRITE_METHODS:
+        return f".{node.func.attr}()"
+    return None
+
+
+def check(module: LintModule,
+          owners: Optional[dict] = None) -> Iterator[Finding]:
+    if not module.path.startswith("spark_timeseries_tpu/"):
+        return
+    owners = contracts.FILE_WRITE_OWNERS if owners is None else owners
+    allowed = owners.get(module.path, {})
+    astutil.annotate_parents(module.tree)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        what = _is_write(node)
+        if what is None:
+            continue
+        qual = astutil.qualname(node)
+        ok = any(qual == owner or qual.startswith(owner + ".")
+                 for owner in allowed)
+        if not ok:
+            yield Finding(
+                rule=RULE, path=module.path, line=node.lineno,
+                col=node.col_offset,
+                message=f"file write `{what}` in `{qual}` is not a "
+                        "registered owner call site — route it through "
+                        "the namespace's owner or register it (with the "
+                        "namespace it owns) in FILE_WRITE_OWNERS")

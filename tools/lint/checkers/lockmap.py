@@ -1,0 +1,285 @@
+"""lock-map: declared per-class lock protection maps, honored at every
+mutation site.
+
+Six thread roles mutate shared state in this codebase — driver,
+committer worker, prefetcher worker, elastic lane/supervisor threads,
+the serve loop, and caller threads — and the discipline that keeps them
+honest lived only in code review.  This checker makes it declarative:
+
+- a class that creates an instance lock (``self.x = threading.Lock() /
+  RLock() / Condition(...)``) MUST declare a class attribute
+
+      _protected_by_ = {"<attr>": "<lock attr>", ...}
+
+  naming, for every shared attribute mutated by more than one thread
+  role, the lock that guards it.  Values may be dotted paths rooted at
+  self (``"queue.cond"``) and may be a tuple when several spellings
+  guard the same state (``("_lock", "_not_empty")`` for a Condition
+  built on the lock).  An attribute mutated by a single role (e.g. a
+  driver-only accumulator) is deliberately NOT declared.
+
+- every mutation site of a declared attribute — plain/augmented
+  assignment, subscript stores/deletes, and mutating method calls
+  (``.append`` / ``.pop`` / ``.update`` / ...) — must sit lexically
+  inside a ``with self.<lock>:`` block of the declared lock (local
+  aliases like ``cond = self.queue.cond`` are resolved), with three
+  escapes: ``__init__``/``__new__`` (construction precedes sharing),
+  methods named ``*_locked`` (the codebase's called-with-lock-held
+  convention), and an inline ``# lint: lock-map(<reason>)`` waiver.
+
+Module-level twins use ``_PROTECTED_BY_ = {"<global>": "<lock global>"}``
+(see ``utils/compile_cache.py``).  The static check is an approximation
+— cross-function lock holding and aliased containers escape it — which
+is why the runtime tracker (:mod:`tools.lint.runtime`) enforces the
+same declarations dynamically on the ci.sh lock-discipline smoke.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from .. import astutil
+from ..engine import Finding, LintModule
+
+RULE = "lock-map"
+
+CLASS_MAP_NAME = "_protected_by_"
+MODULE_MAP_NAME = "_PROTECTED_BY_"
+
+_LOCK_CTORS = ("Lock", "RLock", "Condition")
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "add", "discard", "sort", "reverse",
+    "appendleft", "popleft", "extendleft", "put", "put_nowait",
+}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = astutil.call_name(node)
+    if name is None:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    return last in _LOCK_CTORS
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``attr`` for a bare ``self.attr`` expression."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _self_path(node: ast.AST) -> Optional[str]:
+    """``a.b`` for a ``self.a.b`` chain."""
+    d = astutil.dotted(node)
+    if d is not None and d.startswith("self."):
+        return d[len("self."):]
+    return None
+
+
+def _mutation_target(node: ast.AST) -> Optional[Tuple[str, str]]:
+    """(attr, kind) when ``node`` mutates ``self.<attr>`` directly."""
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if isinstance(t, ast.Tuple):
+                elts: List[ast.AST] = list(t.elts)
+            else:
+                elts = [t]
+            for e in elts:
+                attr = _self_attr(e)
+                if attr is not None:
+                    return attr, "assignment"
+                if isinstance(e, ast.Subscript):
+                    attr = _self_attr(e.value)
+                    if attr is not None:
+                        return attr, "subscript store"
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+                if attr is not None:
+                    return attr, "subscript delete"
+            else:
+                attr = _self_attr(t)
+                if attr is not None:
+                    return attr, "attribute delete"
+    elif isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                return attr, f".{node.func.attr}() call"
+    return None
+
+
+def _guards_held(node: ast.AST, aliases: dict) -> List[str]:
+    """Self-rooted dotted paths of every ``with`` guard lexically
+    enclosing ``node`` within its own function."""
+    out: List[str] = []
+    p = getattr(node, "_lint_parent", None)
+    while p is not None and not isinstance(
+            p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        if isinstance(p, ast.With):
+            for item in p.items:
+                path = _self_path(item.context_expr)
+                if path is None and isinstance(item.context_expr, ast.Name):
+                    ali = aliases.get(item.context_expr.id)
+                    if ali is not None:
+                        path = ali[len("self."):]
+                if path is not None:
+                    out.append(path)
+        p = getattr(p, "_lint_parent", None)
+    return out
+
+
+def _class_map(cls: ast.ClassDef) -> Optional[Tuple[dict, int]]:
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == CLASS_MAP_NAME
+                for t in stmt.targets):
+            m = astutil.literal_str_dict(stmt.value)
+            return (m, stmt.lineno)
+    return None
+
+
+def _check_class(module: LintModule, cls: ast.ClassDef
+                 ) -> Iterator[Finding]:
+    lock_attrs = set()
+    assigned_attrs = set()
+    for node in ast.walk(cls):
+        mt = _mutation_target(node)
+        if mt is not None and mt[1] == "assignment":
+            assigned_attrs.add(mt[0])
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None and _is_lock_ctor(node.value):
+                    lock_attrs.add(attr)
+
+    declared = _class_map(cls)
+    if declared is None:
+        if lock_attrs:
+            yield Finding(
+                rule=RULE, path=module.path, line=cls.lineno, col=0,
+                message=f"class `{cls.name}` creates instance lock(s) "
+                        f"{sorted(lock_attrs)} but declares no "
+                        f"`{CLASS_MAP_NAME}` protection map — declare "
+                        "which shared attributes each lock guards")
+        return
+    pmap, map_line = declared
+    if pmap is None:
+        yield Finding(
+            rule=RULE, path=module.path, line=map_line, col=0,
+            message=f"`{cls.name}.{CLASS_MAP_NAME}` must be a literal "
+                    "dict of str -> str (or tuple of str)")
+        return
+
+    for attr, guards in pmap.items():
+        if attr not in assigned_attrs:
+            yield Finding(
+                rule=RULE, path=module.path, line=map_line, col=0,
+                message=f"`{cls.name}.{CLASS_MAP_NAME}` declares `{attr}` "
+                        "but the class never assigns it — stale entry")
+        for g in guards:
+            head = g.split(".", 1)[0]
+            if "." not in g and g not in lock_attrs and \
+                    head not in assigned_attrs:
+                yield Finding(
+                    rule=RULE, path=module.path, line=map_line, col=0,
+                    message=f"`{cls.name}.{CLASS_MAP_NAME}` guard `{g}` "
+                            "is not a lock attribute this class creates")
+
+    for method in cls.body:
+        if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if method.name in ("__init__", "__new__") or \
+                method.name.endswith("_locked"):
+            continue
+        aliases = astutil.local_aliases(method)
+        for node in ast.walk(method):
+            mt = _mutation_target(node)
+            if mt is None or mt[0] not in pmap:
+                continue
+            attr, kind = mt
+            held = _guards_held(node, aliases)
+            if not any(g in held for g in pmap[attr]):
+                want = " or ".join(f"self.{g}" for g in pmap[attr])
+                yield Finding(
+                    rule=RULE, path=module.path, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"`{cls.name}.{attr}` {kind} in "
+                            f"`{method.name}` outside the declared guard "
+                            f"`with {want}:`")
+
+
+def _check_module_level(module: LintModule) -> Iterator[Finding]:
+    pmap: Optional[Dict[str, tuple]] = None
+    for stmt in module.tree.body:
+        if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == MODULE_MAP_NAME
+                for t in stmt.targets):
+            pmap = astutil.literal_str_dict(stmt.value)
+            if pmap is None:
+                yield Finding(
+                    rule=RULE, path=module.path, line=stmt.lineno, col=0,
+                    message=f"`{MODULE_MAP_NAME}` must be a literal dict "
+                            "of str -> str (or tuple of str)")
+                return
+    if pmap is None:
+        return
+    for fn in ast.walk(module.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name.endswith("_locked"):
+            continue
+        for node in ast.walk(fn):
+            name: Optional[str] = None
+            kind = "assignment"
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in pmap:
+                        name = t.id
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATORS and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in pmap:
+                name = node.func.value.id
+                kind = f".{node.func.attr}() call"
+            if name is None:
+                continue
+            held: List[str] = []
+            p = getattr(node, "_lint_parent", None)
+            while p is not None and not isinstance(
+                    p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                if isinstance(p, ast.With):
+                    for item in p.items:
+                        if isinstance(item.context_expr, ast.Name):
+                            held.append(item.context_expr.id)
+                p = getattr(p, "_lint_parent", None)
+            if not any(g in held for g in pmap[name]):
+                want = " or ".join(pmap[name])
+                yield Finding(
+                    rule=RULE, path=module.path, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"module global `{name}` {kind} in "
+                            f"`{fn.name}` outside the declared guard "
+                            f"`with {want}:`")
+
+
+def check(module: LintModule) -> Iterator[Finding]:
+    if not module.path.startswith("spark_timeseries_tpu/"):
+        return
+    astutil.annotate_parents(module.tree)
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            yield from _check_class(module, node)
+    yield from _check_module_level(module)

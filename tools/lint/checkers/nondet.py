@@ -1,0 +1,171 @@
+"""nondet: wall-clock, ambient RNG, and hash-order nondeterminism banned
+in bitwise-critical modules.
+
+The system's headline guarantee is bitwise reproducibility: the same
+panel + config produces identical bytes across runs, resumes, shard
+layouts, and placements.  Ambient nondeterminism is how that dies one
+innocent line at a time.  In every module outside the exempt telemetry
+and serving planes, the checker flags
+
+- ``time.time()`` / ``time.time_ns()`` (wall-clock identity;
+  ``perf_counter`` / ``monotonic`` are duration measurements and fine),
+- ``datetime.now`` / ``utcnow`` / ``date.today``,
+- the stdlib ``random`` module (any use; ``jax.random`` with explicit
+  keys and seeded ``np.random.default_rng(seed)`` are the sanctioned
+  spellings),
+- ambient numpy RNG: ``np.random.<draw>`` on the global state,
+  ``np.random.seed``, and ``np.random.default_rng()`` with NO seed,
+- ``uuid.uuid1`` / ``uuid.uuid4`` (fine as run identity — waive it),
+- builtin ``hash()`` (PYTHONHASHSEED-dependent across processes),
+- ``json.dumps`` without ``sort_keys=True`` feeding a ``hashlib``
+  digest (dict-order-dependent hashing; list/tuple literals are
+  order-stable and exempt).
+
+Telemetry timestamps and run ids inside critical modules are legitimate
+— they are metadata, never fitted bytes — and carry inline waivers:
+``# lint: nondet(manifest wall-clock metadata; never in fitted bytes)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from .. import astutil
+from ..contracts import NONDET_EXEMPT_PREFIXES
+from ..engine import Finding, LintModule
+
+RULE = "nondet"
+
+_NP_RANDOM_OK = {"default_rng", "Generator", "SeedSequence",
+                 "BitGenerator", "PCG64", "Philox"}
+
+
+def applies(path: str) -> bool:
+    return (path.startswith("spark_timeseries_tpu/")
+            and not any(path.startswith(p)
+                        for p in NONDET_EXEMPT_PREFIXES))
+
+
+def _stdlib_random_names(tree: ast.Module) -> Set[str]:
+    """Local names bound to the STDLIB random module (so ``from jax
+    import random`` does not false-positive)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "random":
+                    out.add(alias.asname or "random")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "random" and node.level == 0:
+                for alias in node.names:
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def _json_dumps_no_sort(node: ast.Call) -> bool:
+    if astutil.call_name(node) not in ("json.dumps",):
+        return False
+    sk = astutil.keyword_arg(node, "sort_keys")
+    if isinstance(sk, ast.Constant) and sk.value is True:
+        return False
+    # list/tuple displays are order-stable by construction
+    if node.args and isinstance(node.args[0], (ast.List, ast.Tuple)):
+        return False
+    return True
+
+
+def check(module: LintModule) -> Iterator[Finding]:
+    if not applies(module.path):
+        return
+    astutil.annotate_parents(module.tree)
+    rand_names = _stdlib_random_names(module.tree)
+
+    # names assigned from an unsorted json.dumps, for the hash-feed check
+    unsorted_json: Set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _json_dumps_no_sort(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    unsorted_json.add(t.id)
+
+    def _feeds_unsorted_json(call: ast.Call) -> Optional[str]:
+        for sub in ast.walk(call):
+            if sub is call:
+                continue
+            if isinstance(sub, ast.Call) and _json_dumps_no_sort(sub):
+                return "json.dumps(...) without sort_keys=True"
+            if isinstance(sub, ast.Name) and sub.id in unsorted_json:
+                return f"`{sub.id}` (json.dumps without sort_keys=True)"
+        return None
+
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.call_name(node)
+        if name is None:
+            continue
+        line, col = node.lineno, node.col_offset
+
+        if name in ("time.time", "time.time_ns"):
+            yield Finding(
+                rule=RULE, path=module.path, line=line, col=col,
+                message=f"`{name}()` is wall-clock nondeterminism in a "
+                        "bitwise-critical module — use perf_counter for "
+                        "durations, or waive for telemetry metadata")
+        elif name.endswith((".now", ".utcnow", ".today")) and \
+                name.split(".", 1)[0] in ("datetime", "date", "dt"):
+            yield Finding(
+                rule=RULE, path=module.path, line=line, col=col,
+                message=f"`{name}()` is wall-clock nondeterminism in a "
+                        "bitwise-critical module")
+        elif name.split(".", 1)[0] in rand_names:
+            yield Finding(
+                rule=RULE, path=module.path, line=line, col=col,
+                message=f"stdlib `random` use (`{name}`) — seed an "
+                        "explicit np.random.default_rng or use "
+                        "jax.random keys")
+        elif name.startswith(("np.random.", "numpy.random.")):
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf == "seed":
+                yield Finding(
+                    rule=RULE, path=module.path, line=line, col=col,
+                    message="`np.random.seed` mutates ambient global RNG "
+                            "state — pass an explicit default_rng")
+            elif leaf == "default_rng":
+                if not node.args and not node.keywords:
+                    yield Finding(
+                        rule=RULE, path=module.path, line=line, col=col,
+                        message="`np.random.default_rng()` with no seed "
+                                "draws OS entropy — pass an explicit "
+                                "seed")
+            elif leaf not in _NP_RANDOM_OK:
+                yield Finding(
+                    rule=RULE, path=module.path, line=line, col=col,
+                    message=f"ambient numpy RNG draw `{name}` — use an "
+                            "explicitly seeded default_rng")
+        elif name in ("uuid.uuid1", "uuid.uuid4"):
+            yield Finding(
+                rule=RULE, path=module.path, line=line, col=col,
+                message=f"`{name}()` in a bitwise-critical module — "
+                        "fine as run/request identity metadata: waive "
+                        "with that reason")
+        elif name == "hash":
+            yield Finding(
+                rule=RULE, path=module.path, line=line, col=col,
+                message="builtin `hash()` is PYTHONHASHSEED-dependent "
+                        "across processes — use hashlib for anything "
+                        "persisted or compared cross-process")
+        elif name.startswith("hashlib.") or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "update"
+                and astutil.dotted(node.func.value) is not None):
+            feed = _feeds_unsorted_json(node)
+            if feed is not None and (name.startswith("hashlib.")
+                                     or name.endswith(".update")):
+                yield Finding(
+                    rule=RULE, path=module.path, line=line, col=col,
+                    message=f"digest fed by {feed}: dict-order-dependent "
+                            "hashing — pass sort_keys=True")

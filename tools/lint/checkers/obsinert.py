@@ -1,0 +1,106 @@
+"""obs-inert: library modules reach obs only through the guarded facade.
+
+The telemetry plane's contract is OFF-BY-DEFAULT INERTNESS: with obs
+disabled, every facade call returns a shared no-op, adds no events, and
+leaves fit results bitwise-identical to the uninstrumented code.  That
+holds only while library code goes through the facade
+(``from .. import obs`` + ``obs.span`` / ``obs.counter`` / ... — every
+name ``obs/__init__`` exports).  Reaching into submodules
+(``obs.core``, ``obs.metrics``, ``obs.memory``, ``obs.promsink``,
+``obs.recorder``) bypasses the enabled() guard and couples the library
+to internals; calling ``obs.enable`` / ``obs.disable`` /
+``obs.enable_from_env`` from library code mutates global telemetry
+state that belongs to the application.  Flagged:
+
+- ``from ..obs.<submodule> import ...`` / ``import ...obs.<submodule>``,
+- ``from ..obs import <submodule>`` (importing the submodule by name
+  through the facade is the same bypass),
+- ``obs.<submodule>.<anything>`` attribute chains in code,
+- ``obs.enable(...)`` / ``obs.disable(...)`` / ``obs.enable_from_env``
+  calls outside the obs package.
+
+Waiver: ``# lint: obs-inert(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .. import astutil
+from ..engine import Finding, LintModule
+
+RULE = "obs-inert"
+
+_SUBMODULES = {"core", "memory", "metrics", "promsink", "recorder"}
+_STATE_CALLS = {"enable", "disable", "enable_from_env"}
+
+
+def applies(path: str) -> bool:
+    return (path.startswith("spark_timeseries_tpu/")
+            and not path.startswith("spark_timeseries_tpu/obs/"))
+
+
+def check(module: LintModule) -> Iterator[Finding]:
+    if not applies(module.path):
+        return
+    astutil.annotate_parents(module.tree)
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            parts = mod.split(".")
+            if "obs" in parts:
+                after = parts[parts.index("obs") + 1:]
+                if after and after[0] in _SUBMODULES:
+                    yield Finding(
+                        rule=RULE, path=module.path, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"import from obs submodule `{mod}` "
+                                "bypasses the guarded facade — import "
+                                "the facade name from `obs` instead")
+                elif parts[-1] == "obs":
+                    for alias in node.names:
+                        if alias.name in _SUBMODULES:
+                            yield Finding(
+                                rule=RULE, path=module.path,
+                                line=node.lineno, col=node.col_offset,
+                                message=f"`from ... obs import "
+                                        f"{alias.name}` pulls an obs "
+                                        "submodule into library code — "
+                                        "use the facade functions "
+                                        "obs/__init__ exports")
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                parts = alias.name.split(".")
+                if "obs" in parts and parts[-1] in _SUBMODULES:
+                    yield Finding(
+                        rule=RULE, path=module.path, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"`import {alias.name}` reaches an obs "
+                                "submodule — use the facade")
+        elif isinstance(node, ast.Attribute):
+            d = astutil.dotted(node)
+            if d is not None:
+                parts = d.split(".")
+                # exactly obs.<submodule>: a longer chain contains this
+                # node as its value child, so each chain flags once
+                if len(parts) == 2 and parts[0] == "obs" and \
+                        parts[1] in _SUBMODULES:
+                    yield Finding(
+                        rule=RULE, path=module.path, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"`{d}` reaches into an obs submodule — "
+                                "only facade names are inert when obs "
+                                "is disabled")
+        elif isinstance(node, ast.Call):
+            d = astutil.call_name(node)
+            if d is not None:
+                parts = d.split(".")
+                if len(parts) == 2 and parts[0] == "obs" and \
+                        parts[1] in _STATE_CALLS:
+                    yield Finding(
+                        rule=RULE, path=module.path, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"`{d}()` mutates global telemetry state "
+                                "from library code — enabling/disabling "
+                                "obs belongs to the application")

@@ -1,0 +1,266 @@
+"""Machine-readable contract registries for the invariant linter.
+
+This module is the single place where the codebase's cross-cutting
+contracts are written down as DATA: which modules are hot paths, which
+are bitwise-critical, which driver knobs are deliberately excluded from
+the journal config hash (and why), which call sites own file writes, and
+which classes the runtime lock-discipline tracker instruments.  Every
+entry carries a rationale — adding to a registry is an explicit,
+reviewable act, never a silent drift.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# host-sync (rule: host-sync)
+# ---------------------------------------------------------------------------
+
+# Modules on the chunk walk's critical path: an implicit device->host
+# sync here stalls the pipeline (stage N+1 / compute N / commit N-1) for
+# a full dispatch round trip.  Deliberate syncs (the commit fetch, the
+# staging materialization barrier) carry inline waivers naming the
+# reason.
+HOT_PATH_PREFIXES = (
+    "spark_timeseries_tpu/reliability/",
+    "spark_timeseries_tpu/models/",
+    "spark_timeseries_tpu/utils/optim.py",
+)
+
+# ---------------------------------------------------------------------------
+# nondeterminism (rule: nondet)
+# ---------------------------------------------------------------------------
+
+# Bitwise-critical modules: everything whose output must be reproducible
+# byte-for-byte across runs, resumes, and shard layouts.  The telemetry
+# plane (obs/) and the serving layer's wall-clock machinery (deadlines,
+# retry_after estimates) are inherently time-dependent and exempt;
+# manifest timestamps and run ids inside critical modules are identity /
+# telemetry metadata and carry inline waivers.
+NONDET_EXEMPT_PREFIXES = (
+    "spark_timeseries_tpu/obs/",
+    "spark_timeseries_tpu/serving/",
+)
+
+# ---------------------------------------------------------------------------
+# config-hash coverage (rule: config-hash)
+# ---------------------------------------------------------------------------
+
+# Every keyword a fit-entry surface accepts must be either REACHABLE by
+# the journal's config hash (it changes what a chunk's bytes mean) or
+# EXCLUDED here with a rationale (it moves work between threads /
+# devices / wall-clock budgets without changing a byte).  A new knob
+# that appears in a signature without an entry FAILS the lint — it can
+# no longer silently fork journal compatibility.
+#
+# "hashed" entries say HOW the knob reaches the journal identity; for
+# ``fit_chunked`` the checker additionally verifies each hashed driver
+# knob appears as a literal key of the ``extra=`` dict passed to
+# ``config_hash`` in the source (or rides the **fit_kwargs catch-all /
+# panel fingerprint), so this registry cannot drift from the code.
+CONFIG_HASH_SURFACES = {
+    "spark_timeseries_tpu/reliability/chunked.py::fit_chunked": {
+        "kwargs_param": "fit_kwargs",  # hashed wholesale by config_hash
+        "hashed": {
+            "fit_fn": "function identity + functools.partial layers",
+            "y": "panel fingerprint (content-sampled), not the config hash",
+            "chunk_rows": "extra= key 'chunk_rows'",
+            "min_chunk_rows": "extra= key 'min_chunk_rows'",
+            "resilient": "extra= key 'resilient'",
+            "policy": "extra= key 'policy'",
+            "ladder": "extra= key 'ladder'",
+            "align_mode": "resolved mode injected into fit_kwargs before "
+                          "config_hash — a resumed run must use the same "
+                          "static plan",
+        },
+        # keys that are extra= literals but not signature params (the
+        # checker uses this to verify the extra dict exactly)
+        "extra_keys": ("chunk_rows", "min_chunk_rows", "resilient",
+                       "policy", "ladder"),
+        "excluded": {
+            "max_backoffs": "bounds how many OOM halvings are ATTEMPTED "
+                            "before raising; committed boundaries land on "
+                            "the same grid either way and the journal "
+                            "accepts mixed boundaries on resume",
+            "checkpoint_dir": "the journal's LOCATION, not its identity — "
+                              "the same job may be journaled anywhere",
+            "resume": "selects adoption behavior for existing state; "
+                      "never changes what a fresh chunk computes",
+            "chunk_budget_s": "watchdog wall-clock budget; TIMEOUT rows "
+                              "are per-run status, recomputed on resume — "
+                              "a resumed run may use a different budget",
+            "job_budget_s": "same as chunk_budget_s, job-level",
+            "pipeline": "moves commit I/O to a background thread; bytes "
+                        "unchanged — a serial journal resumes under a "
+                        "pipelined run and vice versa (documented "
+                        "contract)",
+            "pipeline_depth": "bounds in-flight commits; same contract "
+                              "as pipeline",
+            "prefetch_depth": "bounds staged input slices; the staged "
+                              "buffer is the same yb[lo:hi] bytes",
+            "mesh": "device placement; the sharded walk is "
+                    "bitwise-identical to single-device and a merged "
+                    "manifest is adopted by a later single-device walk",
+            "shard": "same contract as mesh",
+            "lane_retries": "elastic containment: how often a failing "
+                            "lane retries before quarantine — recovery "
+                            "policy, not chunk content",
+            "lane_retry_backoff_s": "retry pacing, wall-clock only",
+            "rebalance_threshold": "when idle lanes steal a straggler's "
+                                   "tail; spans move between lanes on "
+                                   "the same chunk grid",
+            "process_index": "journal NAMESPACE selection under "
+                             "jax.distributed, not job identity",
+            "grid": "auto-fit grid coordinate recorded in manifest "
+                    "extra= for tooling; per-order walks hash their own "
+                    "fit configs",
+            "journal_extra": "opaque manifest extra= block, documented "
+                             "as non-hashed provenance",
+            "_journal_commit_hook": "fault-injection instrumentation "
+                                    "(tests only)",
+        },
+    },
+    "spark_timeseries_tpu/panel.py::TimeSeriesPanel.fit": {
+        "kwargs_param": "fit_kwargs",
+        "hashed": {
+            "model": "resolved to the model module's fit function, whose "
+                     "identity the config hash covers",
+            "chunk_rows": "forwarded to fit_chunked (hashed there)",
+            "resilient": "forwarded to fit_chunked (hashed there)",
+            "policy": "forwarded to fit_chunked (hashed there)",
+            "align_mode": "forwarded to fit_chunked (hashed there)",
+        },
+        "excluded": {
+            "checkpoint_dir": "see fit_chunked",
+            "resume": "see fit_chunked",
+            "chunk_budget_s": "see fit_chunked",
+            "job_budget_s": "see fit_chunked",
+            "pipeline": "see fit_chunked",
+            "pipeline_depth": "see fit_chunked",
+            "prefetch_depth": "see fit_chunked",
+            "shard": "see fit_chunked",
+            "mesh": "see fit_chunked",
+            "source": "placement spelling (in-HBM / host RAM / npz "
+                      "shards); panel identity is carried by the "
+                      "fingerprint, which follows the source domain",
+        },
+    },
+    "spark_timeseries_tpu/serving/server.py::FitServer.submit": {
+        "kwargs_param": "fit_kwargs",
+        "hashed": {
+            "values": "batched panel fingerprint (cell-padded grid), via "
+                      "the batch walk's journal",
+            "model": "part of the batch key AND the walk's fit_fn "
+                     "identity",
+        },
+        "excluded": {
+            "tenant": "admission/quota identity; rides the durable "
+                      "request record and the batch_id digest, not the "
+                      "walk config",
+            "priority": "shedding order under overload; never reaches "
+                        "the walk",
+            "deadline_s": "per-request wall-clock deadline (watchdog "
+                          "contract: TIMEOUT rows, recomputed on "
+                          "re-answer)",
+            "request_id": "idempotency identity for the durable record",
+        },
+    },
+}
+
+# ---------------------------------------------------------------------------
+# file-write ownership (rule: journal-writer)
+# ---------------------------------------------------------------------------
+
+# The journal's single-writer protocol generalized: every call site in
+# the library that writes a file is registered here with the namespace
+# it owns.  A helper that splices bytes into someone else's namespace
+# (the failure mode this guards: a future utility writing under a
+# journal root next to ChunkJournal's manifest) fails the lint until it
+# is either routed through the owner or registered as one.
+FILE_WRITE_OWNERS = {
+    "spark_timeseries_tpu/reliability/journal.py": {
+        "_atomic_write_bytes": "the shared tmp->fsync->replace primitive "
+                               "every journal-side owner routes through",
+        "ChunkJournal": "sole writer of its namespace's shards + manifest "
+                        "(one instance per namespace; the pipelined "
+                        "committer calls INTO this owner)",
+        "merge_job_manifest": "sole writer of the merged root "
+                              "manifest.json after sharded lanes join",
+    },
+    "spark_timeseries_tpu/reliability/source.py": {
+        "write_npz_shards": "explicit export utility: creates a brand-new "
+                            "shard directory it alone owns",
+    },
+    "spark_timeseries_tpu/reliability/faultinject.py": {
+        "tear_file": "the fault harness DELIBERATELY corrupts a named "
+                     "file to simulate a torn write — test-only, "
+                     "operator-invoked, never on a live namespace",
+    },
+    "spark_timeseries_tpu/obs/promsink.py": {
+        "PromTextfileSink": "sole writer of its textfile path (atomic "
+                            "replace; scrapers never see a torn file)",
+    },
+    "spark_timeseries_tpu/obs/recorder.py": {
+        "FlightRecorder": "sole writer of its JSONL stream and "
+                          "crash-dump path",
+    },
+    "spark_timeseries_tpu/serving/session.py": {
+        "FitRequest.save": "write-ahead request record under the "
+                           "server's requests/ namespace (one file per "
+                           "request id)",
+    },
+    "spark_timeseries_tpu/serving/server.py": {
+        "FitServer": "owner of the serving root's results/, knobs.json "
+                     "and server.json; batch WALK journals under "
+                     "batches/ are written by ChunkJournal, never here",
+    },
+    "spark_timeseries_tpu/serving/batcher.py": {
+        "MicroBatch": "durable batch-membership records under the batch "
+                      "journal directory it names (batch_id digest)",
+    },
+    "spark_timeseries_tpu/compat/sparkts.py": {
+        "_ModelBase.save": "user-facing model save API: writes exactly "
+                           "the path the caller names",
+    },
+    "spark_timeseries_tpu/panel.py": {
+        "TimeSeriesPanel.save_csv": "user-facing export API",
+        "TimeSeriesPanel.save": "user-facing export API",
+    },
+    "spark_timeseries_tpu/models/auto.py": {
+        "_write_auto_manifest": "sole writer of auto_manifest.json at "
+                                "the search root (per-order walk "
+                                "manifests belong to ChunkJournal)",
+    },
+}
+
+# ---------------------------------------------------------------------------
+# lock discipline (rule: lock-map) — runtime instrumentation targets
+# ---------------------------------------------------------------------------
+
+# Classes whose ``_protected_by_`` maps the runtime tracker instruments
+# on the ci.sh lock-discipline smoke (a real pipelined + sharded +
+# serving walk).  The static checker discovers maps by itself from the
+# AST; this list only feeds tests/_lockdiscipline_worker.py.
+LOCKMAP_RUNTIME_CLASSES = (
+    "spark_timeseries_tpu.reliability.committer:ChunkCommitter",
+    "spark_timeseries_tpu.reliability.prefetcher:ChunkPrefetcher",
+    "spark_timeseries_tpu.reliability.plan:LaneRunner",
+    "spark_timeseries_tpu.reliability.plan:WorkQueue",
+    "spark_timeseries_tpu.reliability.plan:LaneSupervisor",
+    "spark_timeseries_tpu.reliability.journal:ChunkJournal",
+    "spark_timeseries_tpu.reliability.source:StagingPool",
+    "spark_timeseries_tpu.reliability.source:ChunkSource",
+    "spark_timeseries_tpu.serving.admission:TenantQuota",
+    "spark_timeseries_tpu.serving.admission:AdmissionQueue",
+    "spark_timeseries_tpu.serving.session:FitTicket",
+    "spark_timeseries_tpu.serving.server:FitServer",
+    "spark_timeseries_tpu.obs.metrics:MetricsRegistry",
+    "spark_timeseries_tpu.obs.recorder:FlightRecorder",
+    "spark_timeseries_tpu.obs.promsink:PromTextfileSink",
+)
+
+# Thread roles that touch the classes above, for documentation and for
+# the runtime report: driver (caller of fit_chunked / panel.fit),
+# committer worker, prefetcher worker, lane supervisor threads, the
+# serve loop, and caller threads submitting to the server.
+THREAD_ROLES = ("driver", "committer", "prefetcher", "lane",
+                "supervisor", "server", "caller")

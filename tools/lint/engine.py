@@ -1,0 +1,292 @@
+"""Lint engine: file walking, waiver parsing, baseline diffing, reporting.
+
+The engine is checker-agnostic.  A checker is a callable
+``check(module: LintModule) -> Iterable[Finding]``; the engine parses
+each target file once, hands every checker the shared
+:class:`LintModule`, folds inline waivers over the raw findings, and
+diffs the surviving set against the committed baseline.
+
+**Waivers** are inline comments ``# lint: <rule>(<reason>)`` on the
+flagged line or on the immediately preceding line.  The reason is
+mandatory (an empty reason is itself a finding, rule ``waiver-syntax``)
+and a waiver that no longer covers any finding is flagged too (rule
+``stale-waiver``) — a removed violation must take its excuse with it.
+
+**Baseline** (``LINT_BASELINE.json``): maps finding keys
+(``rule|path|message``) to occurrence counts.  A finding beyond its
+baselined count is NEW and fails the run; a baselined finding that no
+longer fires is reported as prunable.  Keys are line-number-free so
+unrelated edits above a pinned finding do not churn the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+def _find_repo_root() -> str:
+    """The checkout to lint: the tree containing this package when it is
+    a source checkout, else (installed copy: site-packages has no
+    ``spark_timeseries_tpu`` SOURCE next to ``tools``) the cwd — so the
+    ``ststpu-lint`` console script lints the user's checkout, never the
+    installed copy."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if os.path.isdir(os.path.join(here, "spark_timeseries_tpu")) and \
+            os.path.isfile(os.path.join(here, "pyproject.toml")):
+        return here
+    return os.getcwd()
+
+
+REPO_ROOT = _find_repo_root()
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "LINT_BASELINE.json")
+PACKAGE_DIR = "spark_timeseries_tpu"
+
+WAIVER_RE = re.compile(r"#\s*lint:\s*([a-z][a-z0-9-]*)\s*\(([^)]*)\)")
+
+
+@dataclass
+class Finding:
+    """One contract violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    waived: bool = False
+    waiver_reason: Optional[str] = None
+
+    @property
+    def key(self) -> str:
+        """Baseline identity: line-free so edits elsewhere don't churn."""
+        return f"{self.rule}|{self.path}|{self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "waived": self.waived, "waiver_reason": self.waiver_reason}
+
+    def render(self) -> str:
+        tag = f" [waived: {self.waiver_reason}]" if self.waived else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: " \
+               f"{self.message}{tag}"
+
+
+@dataclass
+class Waiver:
+    """One parsed ``# lint: rule(reason)`` comment."""
+
+    rule: str
+    reason: str
+    line: int  # line the comment sits on
+    used: bool = False
+
+
+@dataclass
+class LintModule:
+    """One parsed target file, shared across checkers."""
+
+    path: str  # repo-relative, forward slashes
+    text: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    waivers: List[Waiver] = field(default_factory=list)
+
+    @classmethod
+    def from_source(cls, text: str, path: str) -> "LintModule":
+        tree = ast.parse(text, filename=path)
+        return cls(path=path.replace(os.sep, "/"), text=text, tree=tree,
+                   lines=text.splitlines(),
+                   waivers=collect_waivers(text))
+
+
+def collect_waivers(text: str) -> List[Waiver]:
+    """Parse every waiver comment via the tokenizer (so a ``# lint:``
+    inside a string literal is not a waiver)."""
+    out: List[Waiver] = []
+    try:
+        toks = tokenize.generate_tokens(StringIO(text).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = WAIVER_RE.search(tok.string)
+            if m:
+                out.append(Waiver(rule=m.group(1),
+                                  reason=m.group(2).strip(),
+                                  line=tok.start[0]))
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def apply_waivers(module: LintModule,
+                  findings: List[Finding]) -> List[Finding]:
+    """Mark findings covered by a waiver on their line or the line above;
+    a waiver sitting on a ``def`` line (or the line above it) is SCOPED
+    — it covers every finding of its rule inside that FUNCTION, for
+    deliberate whole-region violations like the resilient ladder's
+    host-side assembly (functions only: class bodies are too big for a
+    one-line excuse).  Then append waiver-syntax / stale-waiver findings
+    for bad or unused waivers."""
+    import ast as _ast
+
+    by_line: Dict[Tuple[int, str], Waiver] = {}
+    for w in module.waivers:
+        by_line[(w.line, w.rule)] = w
+    # (start, end, rule) -> waiver for def-line waivers.  FUNCTIONS
+    # only: a class-line waiver would blanket hundreds of lines while
+    # reading as a one-line excuse, and stale-waiver detection could
+    # never catch the overreach.
+    scoped: List[Tuple[int, int, str, Waiver]] = []
+    for node in _ast.walk(module.tree):
+        if isinstance(node, (_ast.FunctionDef, _ast.AsyncFunctionDef)):
+            for ln in (node.lineno, node.lineno - 1):
+                for w in module.waivers:
+                    if w.line == ln and w.reason:
+                        scoped.append((node.lineno, node.end_lineno or
+                                       node.lineno, w.rule, w))
+    for f in findings:
+        for ln in (f.line, f.line - 1):
+            w = by_line.get((ln, f.rule))
+            if w is not None and w.reason:
+                f.waived = True
+                f.waiver_reason = w.reason
+                w.used = True
+                break
+        if not f.waived:
+            for start, end, rule, w in scoped:
+                if rule == f.rule and start <= f.line <= end:
+                    f.waived = True
+                    f.waiver_reason = w.reason
+                    w.used = True
+                    break
+    extra: List[Finding] = []
+    for w in module.waivers:
+        if not w.reason:
+            extra.append(Finding(
+                rule="waiver-syntax", path=module.path, line=w.line, col=0,
+                message=f"waiver for rule '{w.rule}' has an empty reason — "
+                        "say WHY the violation is deliberate"))
+        elif not w.used:
+            extra.append(Finding(
+                rule="stale-waiver", path=module.path, line=w.line, col=0,
+                message=f"waiver for rule '{w.rule}' covers no finding — "
+                        "the violation is gone, remove its excuse"))
+    return findings + extra
+
+
+# ---------------------------------------------------------------------------
+# running
+# ---------------------------------------------------------------------------
+
+
+def _iter_target_files(root: str, paths: Optional[List[str]] = None):
+    if paths:
+        for p in paths:
+            ap = p if os.path.isabs(p) else os.path.join(root, p)
+            if os.path.isdir(ap):
+                yield from _iter_target_files(root, [
+                    os.path.join(p, f) for f in sorted(os.listdir(ap))])
+            elif ap.endswith(".py"):
+                yield ap
+        return
+    pkg = os.path.join(root, PACKAGE_DIR)
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames.sort()
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def lint_source(text: str, path: str,
+                checkers: Optional[List[Callable]] = None) -> List[Finding]:
+    """Lint one in-memory source blob as if it lived at ``path`` (repo
+    relative) — the unit-test / self-test entry point."""
+    from . import checkers as checkers_mod
+
+    module = LintModule.from_source(text, path)
+    found: List[Finding] = []
+    for chk in (checkers if checkers is not None
+                else checkers_mod.ALL_CHECKERS):
+        found.extend(chk(module))
+    found = apply_waivers(module, found)
+    found.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return found
+
+
+def lint_paths(root: str = REPO_ROOT,
+               paths: Optional[List[str]] = None,
+               checkers: Optional[List[Callable]] = None) -> List[Finding]:
+    """Lint the package (or explicit ``paths``) under ``root``."""
+    all_findings: List[Finding] = []
+    for ap in _iter_target_files(root, paths):
+        rel = os.path.relpath(ap, root).replace(os.sep, "/")
+        with open(ap, encoding="utf-8") as f:
+            text = f.read()
+        try:
+            all_findings.extend(lint_source(text, rel, checkers))
+        except SyntaxError as e:
+            all_findings.append(Finding(
+                rule="parse-error", path=rel, line=e.lineno or 0, col=0,
+                message=f"file does not parse: {e.msg}"))
+    return all_findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def save_baseline(findings: List[Finding],
+                  path: str = DEFAULT_BASELINE) -> None:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        if not f.waived:
+            counts[f.key] = counts.get(f.key, 0) + 1
+    payload = {
+        "comment": "ststpu-lint baseline: known findings tracked to zero. "
+                   "New findings FAIL; do not add entries to silence a "
+                   "checker — fix the violation or waive it inline with "
+                   "a reason (see python -m tools.lint --explain).",
+        "findings": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def diff_baseline(findings: List[Finding], baseline: Dict[str, int]):
+    """Split live findings into (new, known) vs the baseline and report
+    baselined keys that no longer fire (prunable)."""
+    live: Dict[str, List[Finding]] = {}
+    for f in findings:
+        if not f.waived and f.rule != "stale-waiver":
+            live.setdefault(f.key, []).append(f)
+    # stale-waiver findings always count as new: a baseline must not be
+    # able to pin an unused excuse in place
+    new: List[Finding] = [f for f in findings
+                          if not f.waived and f.rule == "stale-waiver"]
+    known: List[Finding] = []
+    for key, fs in live.items():
+        allowed = baseline.get(key, 0)
+        known.extend(fs[:allowed])
+        new.extend(fs[allowed:])
+    prunable = sorted(k for k in baseline if k not in live)
+    new.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return new, known, prunable

@@ -1,0 +1,362 @@
+"""Runtime lock-discipline tracker: the lock-map contract, enforced live.
+
+The static ``lock-map`` checker is a lexical approximation — it cannot
+see cross-function lock holding, aliased containers, or code paths only
+a real walk exercises.  This module closes the gap: it instruments the
+classes named in ``contracts.LOCKMAP_RUNTIME_CLASSES`` so that, while a
+tracker is installed,
+
+- every lock assigned to a declared guard attribute is wrapped in an
+  owner-tracking proxy (``Condition`` guards are rebuilt around a
+  proxied inner lock, so waits and notify handoffs keep the owner
+  accounting exact);
+- every assignment to a declared protected attribute checks that the
+  declared guard is held by the CURRENT thread (construction inside
+  ``__init__`` is exempt — the object is not shared yet);
+- dict/list/set values stored into protected attributes are wrapped in
+  guarded containers whose mutating methods perform the same check
+  (``server.counters["completed"] += 1`` is a subscript store, not an
+  attribute store — this is how it stays visible).
+
+Violations are RECORDED (class, attribute, thread, stack), never
+raised mid-run — a tracker must not change the system's behavior, only
+observe it.  ``tests/_lockdiscipline_worker.py --smoke`` (wired into
+ci.sh) runs a real pipelined + sharded + serving walk under a tracker,
+first proving the tracker itself catches a seeded violation, then
+asserting the real walk produced none.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+from . import contracts
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+
+
+class LockDisciplineViolation:
+    """One observed mutation of a protected attribute without its lock."""
+
+    def __init__(self, cls_name: str, attr: str, guard: str, kind: str):
+        self.cls_name = cls_name
+        self.attr = attr
+        self.guard = guard
+        self.kind = kind  # "attribute" | "container"
+        self.thread = threading.current_thread().name
+        self.stack = "".join(traceback.format_stack(limit=8)[:-2])
+
+    def __repr__(self) -> str:
+        return (f"<LockDisciplineViolation {self.cls_name}.{self.attr} "
+                f"({self.kind}) guard={self.guard} thread={self.thread}>")
+
+    def render(self) -> str:
+        return (f"{self.cls_name}.{self.attr} mutated ({self.kind}) on "
+                f"thread {self.thread!r} WITHOUT holding declared guard "
+                f"`{self.guard}`\n{self.stack}")
+
+
+class _OwnedLock:
+    """Owner-tracking wrapper around a Lock/RLock (duck-typed: supports
+    everything ``threading.Condition`` needs from a lock)."""
+
+    def __init__(self, real):
+        self._real = real
+        self._owner: Optional[int] = None
+        self._depth = 0
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = (self._real.acquire(blocking) if timeout in (-1, None)
+               else self._real.acquire(blocking, timeout))
+        if got:
+            self._owner = threading.get_ident()
+            self._depth += 1
+        return got
+
+    def release(self):
+        self._depth -= 1
+        if self._depth <= 0:
+            self._owner = None
+            self._depth = 0
+        self._real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self):
+        return self._real.locked() if hasattr(self._real, "locked") \
+            else self._owner is not None
+
+    def held_by_me(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    # Condition's fallback _is_owned probes acquire(False); give it the
+    # real answer instead
+    def _is_owned(self):
+        return self.held_by_me()
+
+    # Condition.wait() binds these at construction when the lock offers
+    # them.  Without them, a REENTRANT hold (RLock-backed condition,
+    # nested `with cond:`) would only release ONE level before waiting —
+    # the waiter would sleep still holding the lock and every peer would
+    # deadlock on code that is correct uninstrumented.  Full unwind +
+    # restore keeps the tracker strictly observational.
+    def _release_save(self):
+        depth = self._depth
+        self._owner = None
+        self._depth = 0
+        if hasattr(self._real, "_release_save"):
+            return ("rlock", self._real._release_save(), depth)
+        self._real.release()
+        return ("lock", None, depth)
+
+    def _acquire_restore(self, saved):
+        kind, state, depth = saved
+        if kind == "rlock":
+            self._real._acquire_restore(state)
+        else:
+            self._real.acquire()
+        self._owner = threading.get_ident()
+        self._depth = depth
+
+
+def _guard_held(obj, guard_path: str) -> Optional[bool]:
+    """True/False when ownership is decidable for ``obj.<guard_path>``,
+    None when the guard object cannot answer (left unchecked)."""
+    target = obj
+    for part in guard_path.split("."):
+        target = getattr(target, part, None)
+        if target is None:
+            return None
+    if isinstance(target, _OwnedLock):
+        return target.held_by_me()
+    if isinstance(target, threading.Condition):
+        lock = getattr(target, "_lock", None)
+        if isinstance(lock, _OwnedLock):
+            return lock.held_by_me()
+        try:
+            return target._is_owned()  # RLock-backed: exact
+        except Exception:  # noqa: BLE001 - foreign lock type
+            return None
+    if isinstance(target, _LOCK_TYPES):
+        try:
+            return target._is_owned()  # RLock: exact; Lock: no attr
+        except AttributeError:
+            return None  # plain Lock assigned before instrumentation
+    return None
+
+
+class _GuardedDict(dict):
+    __slots__ = ("_ld_check",)
+
+
+class _GuardedList(list):
+    __slots__ = ("_ld_check",)
+
+
+class _GuardedSet(set):
+    __slots__ = ("_ld_check",)
+
+
+def _add_guarded_mutators():
+    def make(base, name):
+        orig = getattr(base.__bases__[0], name, None)
+        if orig is None:
+            return
+
+        def mutator(self, *a, **kw):
+            check = getattr(self, "_ld_check", None)
+            if check is not None:
+                check()
+            return orig(self, *a, **kw)
+
+        mutator.__name__ = name
+        setattr(base, name, mutator)
+
+    for name in ("__setitem__", "__delitem__", "pop", "popitem", "clear",
+                 "update", "setdefault"):
+        make(_GuardedDict, name)
+    for name in ("__setitem__", "__delitem__", "append", "extend",
+                 "insert", "pop", "remove", "sort", "reverse", "__iadd__"):
+        make(_GuardedList, name)
+    for name in ("add", "discard", "remove", "pop", "clear", "update",
+                 "__iand__", "__ior__", "__isub__", "__ixor__"):
+        make(_GuardedSet, name)
+
+
+_add_guarded_mutators()
+
+
+class LockDisciplineTracker:
+    """Installs/uninstalls the instrumentation; collects violations."""
+
+    def __init__(self):
+        self.violations: List[LockDisciplineViolation] = []
+        # decidability accounting: a run whose checks were all
+        # undecidable (guards created before install) proves nothing —
+        # harnesses assert checks_decided > 0
+        self.checks_total = 0
+        self.checks_decided = 0
+        self._installed: List[Tuple[type, dict]] = []
+        self._tls = threading.local()
+        self._mu = threading.Lock()
+
+    # -- construction-phase bookkeeping ---------------------------------
+
+    def _ctor_ids(self) -> set:
+        ids = getattr(self._tls, "ctor_ids", None)
+        if ids is None:
+            ids = self._tls.ctor_ids = set()
+        return ids
+
+    def _record(self, v: LockDisciplineViolation) -> None:
+        with self._mu:
+            self.violations.append(v)
+
+    # -- instrumentation -------------------------------------------------
+
+    def install(self, classes=None) -> "LockDisciplineTracker":
+        """Instrument ``classes`` (defaults to the contracts registry:
+        every class may be a ``"module:Class"`` string or a type)."""
+        specs = list(classes if classes is not None
+                     else contracts.LOCKMAP_RUNTIME_CLASSES)
+        for spec in specs:
+            if isinstance(spec, str):
+                mod_name, cls_name = spec.split(":")
+                cls = getattr(importlib.import_module(mod_name), cls_name)
+            else:
+                cls = spec
+            pmap = self._resolved_map(cls)
+            if not pmap:
+                raise ValueError(
+                    f"{cls.__name__} declares no _protected_by_ map — "
+                    "nothing to enforce")
+            self._instrument(cls, pmap)
+        return self
+
+    @staticmethod
+    def _resolved_map(cls) -> Dict[str, tuple]:
+        pmap: Dict[str, tuple] = {}
+        for base in reversed(cls.__mro__):
+            m = base.__dict__.get("_protected_by_")
+            if isinstance(m, dict):
+                for k, v in m.items():
+                    pmap[k] = (v,) if isinstance(v, str) else tuple(v)
+        return pmap
+
+    def _instrument(self, cls: type, pmap: Dict[str, tuple]) -> None:
+        tracker = self
+        guard_attrs = {g.split(".")[0] for gs in pmap.values() for g in gs
+                       if "." not in g}
+        orig_init = cls.__dict__.get("__init__", None)
+        orig_setattr = cls.__dict__.get("__setattr__", None)
+        saved = {"__init__": orig_init, "__setattr__": orig_setattr}
+
+        base_init = cls.__init__
+
+        def wrapped_init(self, *a, **kw):
+            ids = tracker._ctor_ids()
+            ids.add(id(self))
+            try:
+                return base_init(self, *a, **kw)
+            finally:
+                ids.discard(id(self))
+
+        base_setattr = cls.__setattr__ if orig_setattr is not None \
+            else object.__setattr__
+
+        def wrapped_setattr(self, name, value):
+            if name in guard_attrs and isinstance(
+                    value, _LOCK_TYPES + (threading.Condition,)):
+                value = tracker._wrap_guard(value)
+            if name in pmap and id(self) not in tracker._ctor_ids():
+                tracker._check(self, cls, name, pmap[name], "attribute")
+            if name in pmap:
+                value = tracker._wrap_container(self, cls, name,
+                                                pmap[name], value)
+            return base_setattr(self, name, value)
+
+        cls.__init__ = wrapped_init
+        cls.__setattr__ = wrapped_setattr
+        self._installed.append((cls, saved))
+
+    def _wrap_guard(self, value):
+        if isinstance(value, threading.Condition):
+            inner = getattr(value, "_lock", None)
+            if inner is not None and not isinstance(inner, _OwnedLock):
+                return threading.Condition(_OwnedLock(inner))
+            return value
+        if isinstance(value, _OwnedLock):
+            return value
+        return _OwnedLock(value)
+
+    def _wrap_container(self, obj, cls, attr, guards, value):
+        wrapped = None
+        if type(value) is dict:
+            wrapped = _GuardedDict(value)
+        elif type(value) is list:
+            wrapped = _GuardedList(value)
+        elif type(value) is set:
+            wrapped = _GuardedSet(value)
+        if wrapped is None:
+            return value
+        tracker = self
+
+        def check():
+            if id(obj) not in tracker._ctor_ids():
+                tracker._check(obj, cls, attr, guards, "container")
+
+        wrapped._ld_check = check
+        return wrapped
+
+    def _check(self, obj, cls, attr, guards, kind) -> None:
+        with self._mu:
+            self.checks_total += 1
+        decidable = False
+        for g in guards:
+            held = _guard_held(obj, g)
+            if held is True:
+                with self._mu:
+                    self.checks_decided += 1
+                return
+            if held is not None:
+                decidable = True
+        if decidable:
+            with self._mu:
+                self.checks_decided += 1
+            self._record(LockDisciplineViolation(
+                cls.__name__, attr, " or ".join(guards), kind))
+
+    # -- teardown --------------------------------------------------------
+
+    def uninstall(self) -> None:
+        for cls, saved in reversed(self._installed):
+            for name, orig in saved.items():
+                if orig is None:
+                    try:
+                        delattr(cls, name)
+                    except AttributeError:
+                        pass
+                else:
+                    setattr(cls, name, orig)
+        self._installed.clear()
+
+    def __enter__(self) -> "LockDisciplineTracker":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def report(self) -> str:
+        if not self.violations:
+            return "lock-discipline: no violations"
+        out = [f"lock-discipline: {len(self.violations)} violation(s):"]
+        out.extend(v.render() for v in self.violations)
+        return "\n".join(out)

@@ -1,0 +1,238 @@
+"""Seeded-violation self-test: prove each checker still catches a
+violation of its contract (and stays quiet on the clean twin).
+
+A linter that silently stops matching is worse than no linter — CI
+would go green on a broken guard.  ``python -m tools.lint --self-test``
+(run by ci.sh before the real lint) feeds every checker a positive
+fixture (must flag) and a negative fixture (must not), plus waiver
+parsing and baseline-diff round trips.  Any miss exits nonzero.
+
+The same fixtures back ``tests/test_lint.py``; they live here so the
+CI gate and the test suite cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import functools
+import textwrap
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .checkers import confighash, hostsync, journalwriter, lockmap, \
+    nondet, obsinert
+from .engine import Finding, lint_source
+
+HOT = "spark_timeseries_tpu/reliability/fixture.py"
+LIB = "spark_timeseries_tpu/fixture.py"
+
+
+def _fix(s: str) -> str:
+    return textwrap.dedent(s).lstrip("\n")
+
+
+# each entry: rule -> (path, bad source, good source, checkers-or-None)
+FIXTURES: Dict[str, Tuple[str, str, str, Optional[List[Callable]]]] = {}
+
+FIXTURES["host-sync"] = (HOT, _fix("""
+    import jax.numpy as jnp
+
+    def walk(y):
+        nll = jnp.sum(y)
+        if nll > 0:            # truthiness on a device value
+            return float(nll)  # host-blocking cast
+        return nll.item()      # explicit transfer
+    """), _fix("""
+    import jax.numpy as jnp
+
+    def walk(y, meta):
+        nll = jnp.sum(y)
+        rows = int(meta["rows"])        # host value: fine
+        if meta is None or rows > 0:    # host-side test: fine
+            return nll
+        return jnp.where(nll > 0, nll, 0.0)   # stays on device
+    """), [hostsync.check])
+
+_SURFACES = {
+    f"{HOT}::fit_fixture": {
+        "kwargs_param": "fit_kwargs",
+        "hashed": {"chunk_rows": "extra= key 'chunk_rows'"},
+        "extra_keys": ("chunk_rows",),
+        "excluded": {"pipeline": "moves I/O between threads only"},
+    },
+}
+
+FIXTURES["config-hash"] = (HOT, _fix("""
+    def fit_fixture(*, chunk_rows=None, pipeline=True, new_knob=0,
+                    **fit_kwargs):
+        cfg = config_hash(fit_fixture, fit_kwargs,
+                          extra={"chunk_rows": chunk_rows})
+        return cfg
+    """), _fix("""
+    def fit_fixture(*, chunk_rows=None, pipeline=True, **fit_kwargs):
+        cfg = config_hash(fit_fixture, fit_kwargs,
+                          extra={"chunk_rows": chunk_rows})
+        return cfg
+    """), [functools.partial(confighash.check, surfaces=_SURFACES)])
+
+_OWNERS = {HOT: {"Owner": "fixture namespace owner"}}
+
+FIXTURES["journal-writer"] = (HOT, _fix("""
+    import os
+
+    def rogue_helper(path, data):
+        with open(path, "w") as f:     # unregistered writer
+            f.write(data)
+        os.replace(path, path + ".bak")
+    """), _fix("""
+    import os
+
+    class Owner:
+        def write(self, path, data):
+            with open(path, "w") as f:
+                f.write(data)
+            os.replace(path, path + ".bak")
+
+    def reader(path):
+        with open(path) as f:
+            return f.read()
+    """), [functools.partial(journalwriter.check, owners=_OWNERS)])
+
+FIXTURES["lock-map"] = (HOT, _fix("""
+    import threading
+
+    class Shared:
+        _protected_by_ = {"_pending": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending = []
+
+        def submit(self, item):
+            self._pending.append(item)   # mutation outside the lock
+
+    class Undeclared:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+    """), _fix("""
+    import threading
+
+    class Shared:
+        _protected_by_ = {"_pending": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending = []
+
+        def submit(self, item):
+            with self._lock:
+                self._pending.append(item)
+
+        def _drain_locked(self):
+            out, self._pending = self._pending, []
+            return out
+    """), [lockmap.check])
+
+FIXTURES["obs-inert"] = (LIB, _fix("""
+    from .obs import core
+    from .obs.promsink import PromTextfileSink
+
+    def run():
+        obs.enable("run.jsonl")
+    """), _fix("""
+    from . import obs
+
+    def run(lo, hi):
+        with obs.span("chunk", lo=lo, hi=hi):
+            obs.counter("chunks").inc()
+        return obs.enabled()
+    """), [obsinert.check])
+
+FIXTURES["nondet"] = (HOT, _fix("""
+    import json, time, hashlib
+    import numpy as np
+
+    def stamp(cfg):
+        t = time.time()
+        noise = np.random.normal(size=3)
+        key = hashlib.sha256(json.dumps(cfg).encode())
+        return t, noise, key, hash(("a", "b"))
+    """), _fix("""
+    import json, time, hashlib
+    import numpy as np
+
+    def stamp(cfg, seed):
+        t = time.perf_counter()
+        rng = np.random.default_rng(seed)
+        noise = rng.normal(size=3)
+        key = hashlib.sha256(
+            json.dumps(cfg, sort_keys=True).encode())
+        return t, noise, key
+    """), [nondet.check])
+
+
+WAIVER_FIXTURE = (HOT, _fix("""
+    import time
+
+    def stamp():
+        # lint: nondet(manifest wall-clock metadata; never fitted bytes)
+        return time.time()
+
+    def stale():
+        return time.perf_counter()  # lint: nondet(covers nothing now)
+
+    def empty():
+        return time.time()  # lint: nondet()
+    """), [nondet.check])
+
+
+def _only(rule: str, findings: List[Finding],
+          include_waived: bool = False) -> List[Finding]:
+    return [f for f in findings if f.rule == rule
+            and (include_waived or not f.waived)]
+
+
+def run_self_test(verbose: bool = True) -> List[str]:
+    """Returns a list of failure descriptions (empty = pass)."""
+    failures: List[str] = []
+    for rule, (path, bad, good, checkers) in FIXTURES.items():
+        got_bad = _only(rule, lint_source(bad, path, checkers))
+        got_good = _only(rule, lint_source(good, path, checkers))
+        if not got_bad:
+            failures.append(
+                f"{rule}: checker MISSED its seeded violation — the "
+                "guard is broken")
+        if got_good:
+            failures.append(
+                f"{rule}: checker flagged the clean fixture: "
+                + "; ".join(f.message for f in got_good))
+        if verbose and not failures:
+            pass
+    # waiver machinery: waived finding suppressed, stale + empty flagged
+    path, src, checkers = WAIVER_FIXTURE
+    res = lint_source(src, path, checkers)
+    if not any(f.rule == "nondet" and f.waived for f in res):
+        failures.append("waivers: a reasoned waiver did not suppress "
+                        "its finding")
+    if not any(f.rule == "stale-waiver" for f in res):
+        failures.append("waivers: an unused waiver was not flagged stale")
+    if not any(f.rule == "waiver-syntax" for f in res):
+        failures.append("waivers: an empty-reason waiver was not flagged")
+    # baseline diff round trip
+    from .engine import diff_baseline
+
+    live = _only("nondet", lint_source(
+        FIXTURES["nondet"][1], FIXTURES["nondet"][0],
+        FIXTURES["nondet"][3]))
+    base = {f.key: 1 for f in live}
+    new, known, prunable = diff_baseline(live, base)
+    if new or len(known) != len(live):
+        failures.append("baseline: fully-baselined findings reported "
+                        "as new")
+    new2, _known2, _ = diff_baseline(live, {})
+    if len(new2) != len(live):
+        failures.append("baseline: un-baselined findings not reported "
+                        "as new")
+    _new3, _k3, prunable3 = diff_baseline([], base)
+    if len(prunable3) != len(base):
+        failures.append("baseline: fixed findings not reported prunable")
+    return failures
