@@ -313,6 +313,72 @@ python tools/advise_budget.py "$AUTO_SMOKE_DIR/search" \
   || { echo "ci.sh: advise_budget did not suggest a fusion width" >&2; exit 1; }
 rm -rf "$AUTO_SMOKE_DIR"
 
+# backtest kill-and-resume smoke (ISSUE 14): a journaled 3-window
+# rolling-origin backtest campaign is SIGKILLed MID-CAMPAIGN — window 0's
+# metrics durable, window 1's warm-started fit walk torn after its first
+# chunk commits, window 2 unstarted — resumed, and the resumed campaign's
+# per-window metric arrays (MAE/RMSE/MAPE/interval coverage) must be
+# BITWISE-identical to an uninterrupted campaign: committed windows load
+# their digest-verified metric shards, the torn window's fit journal
+# replays only uncommitted chunks, forecasts recompute deterministically
+python tests/_backtest_worker.py --smoke
+
+# forecast tooling smoke (ISSUE 14): a journaled panel forecast walk and
+# a backtest campaign with telemetry on must leave (a) a forecast
+# manifest whose extra.forecast block the budget advisor turns into
+# horizon-aware chunk sizing, (b) a backtest_manifest.json that passes
+# the obs_report schema gate (digest-verified metric shards, per-window
+# fit journals), and (c) per-window campaign lanes in the rendered report
+FORECAST_SMOKE_DIR=$(python - <<'EOF'
+import json, os, tempfile
+import numpy as np
+from spark_timeseries_tpu import forecasting as fc, obs
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.models import arima
+
+root = tempfile.mkdtemp(prefix="forecast_smoke_")
+rng = np.random.default_rng(0)
+e = rng.normal(size=(16, 96)).astype(np.float32)
+y = np.zeros_like(e)
+for t in range(1, y.shape[1]):
+    y[:, t] = 0.6 * y[:, t - 1] + e[:, t]
+obs.enable(os.path.join(root, "events.jsonl"))
+r = rel.fit_chunked(arima.fit, y, chunk_rows=8, resilient=False,
+                    order=(1, 0, 0), max_iters=15,
+                    checkpoint_dir=os.path.join(root, "fit"))
+res = fc.forecast_chunked("arima", os.path.join(root, "fit"), y, 6,
+                          model_kwargs={"order": (1, 0, 0)},
+                          intervals=True, n_samples=32, chunk_rows=8,
+                          checkpoint_dir=os.path.join(root, "fcj"))
+bt = fc.run_backtest(y, "arima", 4, model_kwargs={"order": (1, 0, 0)},
+                     fit_kwargs={"max_iters": 15}, n_windows=2,
+                     chunk_rows=8, checkpoint_dir=os.path.join(root, "bt"))
+obs.disable()
+mem = fc.forecast_chunked("arima", r, y, 6,
+                          model_kwargs={"order": (1, 0, 0)},
+                          intervals=True, n_samples=32, chunk_rows=8)
+for f in ("forecast", "lo", "hi"):
+    np.testing.assert_array_equal(getattr(res, f), getattr(mem, f),
+                                  err_msg=f)  # from-journal == from-memory
+assert [w["status"] for w in bt.windows] == ["committed"] * 2, bt.windows
+assert bt.windows[1]["warm_start"] is True, bt.windows
+m = json.load(open(os.path.join(root, "fcj", "manifest.json")))
+assert m["extra"]["forecast"]["horizon"] == 6, m["extra"]
+print(root)
+EOF
+)
+python tools/obs_report.py --check "$FORECAST_SMOKE_DIR/events.jsonl" \
+  --manifest "$FORECAST_SMOKE_DIR/fcj"
+python tools/obs_report.py --check "$FORECAST_SMOKE_DIR/events.jsonl" \
+  --manifest "$FORECAST_SMOKE_DIR/bt"
+python tools/obs_report.py "$FORECAST_SMOKE_DIR/events.jsonl" \
+  | grep -q "backtest window lanes" \
+  || { echo "ci.sh: obs_report did not render backtest window lanes" >&2; exit 1; }
+python tools/advise_budget.py "$FORECAST_SMOKE_DIR/fcj" \
+  | grep -q "horizon-aware chunk_rows" \
+  || { echo "ci.sh: advise_budget did not suggest horizon-aware chunk_rows" >&2; exit 1; }
+rm -rf "$FORECAST_SMOKE_DIR"
+
 # sharded tooling smoke (ISSUE 6): a short journaled sharded walk with
 # telemetry on must produce a merged manifest whose `shards` block passes
 # the obs_report schema gate, render one timeline lane per shard, and give

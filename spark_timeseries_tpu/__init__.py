@@ -43,6 +43,7 @@ from .parallel import default_mesh
 from . import models
 from . import obs
 from . import reliability
+from . import forecasting
 from . import serving
 from . import stats
 from . import compat

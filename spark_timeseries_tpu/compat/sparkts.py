@@ -331,6 +331,44 @@ class _ModelBase:
     def coefficients(self) -> np.ndarray:
         return np.asarray(self.params)
 
+    # -- panel forecasting (ISSUE 14) -------------------------------------
+    # Subclasses that map onto a forecast-capable model family override
+    # ``_forecast_spec`` and inherit the durable panel wrapper: the
+    # chunked forecast walk over a WHOLE panel of series sharing this
+    # model's per-row params, with the driver's journaling/sharding/
+    # streaming knobs riding through (``forecasting.forecast_chunked``).
+
+    def _forecast_spec(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no panel forecast kernel yet")
+
+    def forecast_panel(self, ts, n_future: int, **walk_kwargs):
+        """Chunked panel forecast: ``ts [rows, T]`` (array, source, or
+        npz shard dir), one row of ``self.params`` per series (a single
+        shared param vector is broadcast).  Returns a
+        ``forecasting.ForecastResult``; ``checkpoint_dir=`` /
+        ``shard=`` / ``intervals=`` etc. ride through to
+        ``forecasting.forecast_chunked``."""
+        import os as _os
+
+        from .. import forecasting as _forecasting
+        from .. import reliability as rel
+
+        if isinstance(ts, (rel.ChunkSource, str, _os.PathLike)):
+            yb = rel.as_source(ts)
+            rows = int(yb.shape[0])
+        else:
+            yb = jnp.atleast_2d(jnp.asarray(ts))
+            rows = int(yb.shape[0])
+        params = np.atleast_2d(np.asarray(self.params))
+        if params.shape[0] == 1 and rows > 1:
+            params = np.repeat(params, rows, axis=0)
+        model, model_kwargs = self._forecast_spec()
+        with obs.span("compat.forecast_panel", model=model):
+            return _forecasting.forecast_chunked(
+                model, params, yb, n_future, model_kwargs=model_kwargs,
+                **walk_kwargs)
+
     # -- persistence -----------------------------------------------------
     # The reference's fitted models are plain serializable case classes
     # (SURVEY.md §5.4); here the analog is an ``.npz`` holding the parameter
@@ -397,6 +435,10 @@ class ARIMAModel(_ModelBase):
             _arima.forecast(self.params, jnp.asarray(ts), self.order, n_future,
                             self.has_intercept)
         )
+
+    def _forecast_spec(self):
+        return "arima", {"order": self.order,
+                         "include_intercept": self.has_intercept}
 
     def sample(self, n: int, seed: int = 0):
         return np.asarray(
@@ -615,6 +657,9 @@ class ARModel(_ModelBase):
             _ar.forecast(self.params, jnp.asarray(ts), self.max_lag, n_future)
         )
 
+    def _forecast_spec(self):
+        return "autoregression", {"max_lag": self.max_lag}
+
     def add_time_dependent_effects(self, ts):
         return np.asarray(
             _ar.add_time_dependent_effects(self.params, jnp.asarray(ts), self.max_lag)
@@ -641,6 +686,9 @@ class EWMAModel(_ModelBase):
 
     def forecast(self, ts, n_future: int):
         return np.asarray(_ewma.forecast(self.params, jnp.asarray(ts), n_future))
+
+    def _forecast_spec(self):
+        return "ewma", {}
 
     def add_time_dependent_effects(self, ts):
         return np.asarray(_ewma.add_time_dependent_effects(self.params, jnp.asarray(ts)))
@@ -679,6 +727,15 @@ class GARCHModel(_ModelBase):
 
     def log_likelihood(self, ts) -> float:
         return float(_garch.log_likelihood(self.params, jnp.asarray(ts)))
+
+    def forecast(self, ts, n_future: int):
+        """Variance-path forecast (``models.garch.forecast``): conditional
+        variances ``h_{T+1..T+n}`` — GARCH's mean forecast is zero."""
+        return np.asarray(_garch.forecast(self.params, jnp.asarray(ts),
+                                          n_future))
+
+    def _forecast_spec(self):
+        return "garch", {}
 
     def sample(self, n: int, seed: int = 0):
         return np.asarray(_garch.sample(self.params, jax.random.key(seed), n))
@@ -739,6 +796,10 @@ class HoltWintersModel(_ModelBase):
             _hw.forecast(self.params, jnp.asarray(ts), self.period, n_future,
                          self.model_type)
         )
+
+    def _forecast_spec(self):
+        return "holtwinters", {"period": self.period,
+                               "model_type": self.model_type}
 
     def sse(self, ts) -> float:
         return float(_hw.sse(self.params, jnp.asarray(ts), self.period,

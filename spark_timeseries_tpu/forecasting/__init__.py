@@ -1,0 +1,54 @@
+"""Panel-scale forecasting (ROADMAP item 2): the forecast walk, rolling
+origin backtest campaigns, and criterion-weighted ensembles.
+
+Three layers over the durable chunk driver:
+
+- :mod:`.walk` — ``forecast_chunked``: per-model forecast kernels run as
+  a chunked walk on the ``ExecutionPlan`` via an AUGMENTED panel
+  (``[y | params | status | row]``, :mod:`.augment`), so journaling,
+  pipelining, prefetch, ``ChunkSource`` streaming, sharding, and elastic
+  lanes compose for free and every composition is bitwise-identical to
+  the serial in-memory walk.  Fitted params come from memory or straight
+  from a fit journal (:mod:`.params` — fit once on disk, forecast many).
+  ``intervals=True`` adds Monte-Carlo quantile bands under counter-based
+  keys derived from the journal fingerprint (bitwise-reproducible).
+- :mod:`.backtest` — ``run_backtest``: an expanding-window refit x
+  horizon sweep as ONE journaled campaign, per-window walks warm-started
+  from the previous window's journaled params, MAE/RMSE/MAPE/coverage
+  into a durable ``backtest_manifest.json`` + metrics shards,
+  SIGKILL-resumable to bitwise-identical metrics.
+- :mod:`.ensemble` — ``ensemble_forecast``: softmax criterion weights
+  over an auto-fit grid's ``[G, B]`` criteria matrix blend member
+  forecasts (point + interval); ``temperature=0`` recovers the argmin
+  winner bitwise.
+"""
+
+from .augment import ColumnBlockSource, augmented_panel
+from .backtest import (BACKTEST_MANIFEST, BacktestResult,
+                       StaleBacktestError, default_origins, run_backtest)
+from .ensemble import (EnsembleForecast, criterion_weights,
+                       ensemble_forecast)
+from .params import load_auto_members, load_fit_result
+from .walk import (ForecastResult, as_result, forecast_chunked,
+                   forecast_fit, split_forecast, warmstart_fit)
+
+__all__ = [
+    "BACKTEST_MANIFEST",
+    "BacktestResult",
+    "ColumnBlockSource",
+    "EnsembleForecast",
+    "ForecastResult",
+    "StaleBacktestError",
+    "as_result",
+    "augmented_panel",
+    "criterion_weights",
+    "default_origins",
+    "ensemble_forecast",
+    "forecast_chunked",
+    "forecast_fit",
+    "load_auto_members",
+    "load_fit_result",
+    "run_backtest",
+    "split_forecast",
+    "warmstart_fit",
+]

@@ -1,0 +1,350 @@
+"""Per-model forecast kernels for the chunked forecast walk.
+
+One vocabulary for every fit-capable model family: a **point kernel**
+``(params [B, k], y [B, T]) -> [B, H]`` reusing each model module's own
+jitted forecast program (nested jit inlines — one compiled program per
+chunk shape), and a **simulation kernel**
+``(params, y, keys [B]) -> paths [B, S, H]`` that runs the model's
+forward recursion with Gaussian innovations whose scale is estimated
+from the model's own in-sample one-step errors — the vmapped ``sample``
+path bent forward from the end state instead of from zero.  Interval
+quantiles over the ``S`` axis are per-row and per-horizon, so they
+inherit the row-independence that makes the walk chunk-layout-invariant.
+
+Everything here is TRACEABLE (not jitted): the walk's chunk program
+(``forecasting.walk``) composes point + simulation + masking into ONE
+compiled program per static configuration.
+
+Alignment is handled per row ON DEVICE (``base.align_right`` /
+``align_mode="general"``) — a forecast chunk never pays a host probe, so
+the walk stays dispatch-ahead with zero per-chunk syncs.
+
+Model configuration (``model_kwargs``) is normalized to a sorted tuple of
+``(key, value)`` pairs with lists coerced to tuples
+(:func:`normalize_model_kwargs`): the canonical form is what reaches the
+compiled-program cache AND the journal config hash, so a live walk and a
+JSON-round-tripped serving/recovery walk hash identically.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models import arima as _arima
+from ..models import base as _base
+from ..models import ewma as _ewma
+from ..models import garch as _garch
+from ..models import holtwinters as _hw
+
+__all__ = ["MODELS", "normalize_model_kwargs", "param_width",
+           "point_fn", "sim_fn"]
+
+# model name -> allowed config keys (with defaults applied at normalize)
+MODELS = {
+    "arima": {"order": None, "include_intercept": True},
+    "autoregression": {"max_lag": 1},
+    "ewma": {},
+    "holtwinters": {"period": None, "model_type": "additive"},
+    "garch": {},
+}
+
+
+def _norm_val(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_norm_val(x) for x in v)
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, float) and float(v).is_integer():
+        return int(v)  # JSON round trips can float-ify ints
+    return v
+
+
+def normalize_model_kwargs(model: str, kwargs) -> Tuple:
+    """Validated canonical config tuple for ``model`` (see module doc)."""
+    if model not in MODELS:
+        raise ValueError(
+            f"unknown forecast model {model!r} (one of {sorted(MODELS)})")
+    allowed = MODELS[model]
+    kw = dict(kwargs or ())
+    bad = sorted(set(kw) - set(allowed))
+    if bad:
+        raise ValueError(
+            f"forecast model {model!r} does not accept {bad} "
+            f"(allowed: {sorted(allowed)})")
+    cfg = {}
+    for key, default in allowed.items():
+        v = _norm_val(kw.get(key, default))
+        if v is None:
+            raise ValueError(f"forecast model {model!r} requires {key}=")
+        cfg[key] = v
+    if model == "arima":
+        order = tuple(cfg["order"])
+        if len(order) == 4:
+            raise ValueError(
+                "seasonal ARIMA forecasting is not supported yet "
+                "(ROADMAP follow-on); pass a plain (p, d, q) order")
+        if len(order) != 3:
+            raise ValueError(f"bad ARIMA order {cfg['order']!r}")
+        order = tuple(int(x) for x in order)
+        if min(order) < 0:
+            raise ValueError(f"bad ARIMA order {cfg['order']!r}")
+        cfg["order"] = order
+        cfg["include_intercept"] = bool(cfg["include_intercept"])
+    elif model == "autoregression":
+        cfg["max_lag"] = int(cfg["max_lag"])
+        if cfg["max_lag"] < 1:
+            raise ValueError("max_lag must be >= 1")
+    elif model == "holtwinters":
+        cfg["period"] = int(cfg["period"])
+        if cfg["period"] < 2:
+            raise ValueError("period must be >= 2")
+        if cfg["model_type"] not in ("additive", "multiplicative"):
+            raise ValueError(
+                f"bad model_type {cfg['model_type']!r}")
+    return tuple(sorted(cfg.items()))
+
+
+def param_width(model: str, cfg: dict) -> int:
+    """The params-block width the augmented panel must carry."""
+    if model == "arima":
+        return _arima._n_params(cfg["order"], cfg["include_intercept"])
+    if model == "autoregression":
+        return cfg["max_lag"] + 1  # [c, phi_1..phi_p], c = 0 if no intercept
+    if model in ("ewma",):
+        return 1
+    if model in ("holtwinters", "garch"):
+        return 3
+    raise ValueError(f"unknown forecast model {model!r}")
+
+
+# ---------------------------------------------------------------------------
+# point kernels — each model module's own jitted forecast program
+# ---------------------------------------------------------------------------
+
+
+def point_fn(model: str, cfg: dict, horizon: int):
+    """Traceable ``(pb, yb) -> [B, horizon]`` point forecasts."""
+    if model == "arima":
+        prog = _arima._forecast_program(
+            cfg["order"], horizon, cfg["include_intercept"], "scan",
+            "general")
+        return lambda pb, yb: prog(pb, yb)
+    if model == "autoregression":
+        prog = _arima._forecast_program(
+            (cfg["max_lag"], 0, 0), horizon, True, "scan", "general")
+        return lambda pb, yb: prog(pb, yb)
+    if model == "ewma":
+        prog = _ewma._forecast_program(horizon)
+        return lambda pb, yb: prog(pb, yb)
+    if model == "holtwinters":
+        prog = _hw._forecast_program(
+            cfg["period"], cfg["model_type"] == "multiplicative", horizon)
+        return lambda pb, yb: prog(pb, yb)
+    if model == "garch":
+        prog = _garch._forecast_program(horizon)
+        return lambda pb, yb: prog(pb, yb)
+    raise ValueError(f"unknown forecast model {model!r}")
+
+
+# ---------------------------------------------------------------------------
+# simulation kernels — forward recursions with Gaussian innovations
+# ---------------------------------------------------------------------------
+
+
+def sim_fn(model: str, cfg: dict, horizon: int, n_samples: int):
+    """Traceable ``(pb, yb, keys [B]) -> paths [B, S, horizon]``.
+
+    Paths simulate the FUTURE OBSERVATIONS under the fitted model with
+    innovations of the in-sample one-step error scale — except GARCH,
+    whose point forecast is the variance path and whose paths simulate
+    future RETURNS (the quantity its interval bands bound).
+    """
+    if model == "arima":
+        return _arima_sim(cfg["order"], cfg["include_intercept"],
+                          horizon, n_samples)
+    if model == "autoregression":
+        return _arima_sim((cfg["max_lag"], 0, 0), True, horizon, n_samples)
+    if model == "ewma":
+        return _ewma_sim(horizon, n_samples)
+    if model == "holtwinters":
+        return _hw_sim(cfg["period"],
+                       cfg["model_type"] == "multiplicative",
+                       horizon, n_samples)
+    if model == "garch":
+        return _garch_sim(horizon, n_samples)
+    raise ValueError(f"unknown forecast model {model!r}")
+
+
+def _arima_sim(order, include_intercept: bool, horizon: int, n_samples: int):
+    p, d, q = order
+    i0 = int(include_intercept)
+
+    def f(pb, yb, keys):
+        def one(pr, yv, key):
+            ya, nv0 = _base.align_right(yv)
+            yd = ya
+            for _ in range(d):
+                yd = yd[1:] - yd[:-1]
+            nvd = nv0 - d
+            n = yd.shape[0]
+            start = (n - nvd).astype(yd.dtype)
+            t_idx = jnp.arange(n, dtype=yd.dtype)
+            ydz = jnp.where(t_idx >= start, yd, 0.0)
+            e = _arima._css_errors(pr, ydz, order, include_intercept,
+                                   condition=False, n_valid=nvd)
+            n_eff = jnp.maximum(nvd - p, 1).astype(yv.dtype)
+            sigma = jnp.sqrt(jnp.sum(e * e) / n_eff)
+            elast = e[::-1][:q] if q else jnp.zeros((0,), yv.dtype)
+            ydlast = ydz[::-1][:p] if p else jnp.zeros((0,), yv.dtype)
+            c = pr[0] if include_intercept else jnp.zeros((), yv.dtype)
+            phi = pr[i0:i0 + p]
+            theta = pr[i0 + p:i0 + p + q]
+            levels = []
+            lv = ya
+            for _ in range(d):
+                levels.append(lv[-1])
+                lv = lv[1:] - lv[:-1]
+            lvl0 = (jnp.stack(levels) if d
+                    else jnp.zeros((0,), yv.dtype))
+            S = n_samples
+            eps = sigma * jax.random.normal(key, (horizon, S), yv.dtype)
+            init = (jnp.broadcast_to(ydlast, (S, p)),
+                    jnp.broadcast_to(elast, (S, q)),
+                    jnp.broadcast_to(lvl0, (S, d)))
+
+            def step(carry, et):
+                ydl, el, lvl = carry
+                pred = c
+                if p:
+                    pred = pred + ydl @ phi
+                if q:
+                    pred = pred + el @ theta
+                ynew = pred + et  # the innovation IS the error at t
+                new_ydl = (jnp.concatenate(
+                    [ynew[:, None], ydl[:, :-1]], axis=1) if p else ydl)
+                new_el = (jnp.concatenate(
+                    [et[:, None], el[:, :-1]], axis=1) if q else el)
+                acc = ynew
+                new_lvl = lvl
+                for i in reversed(range(d)):
+                    acc = lvl[:, i] + acc
+                    new_lvl = new_lvl.at[:, i].set(acc)
+                out = acc if d else ynew
+                return (new_ydl, new_el, new_lvl), out
+
+            _, paths = lax.scan(step, init, eps)  # [H, S]
+            return paths.T  # [S, H]
+
+        return jax.vmap(one)(pb, yb, keys)
+
+    return f
+
+
+def _ewma_sim(horizon: int, n_samples: int):
+    def f(pb, yb, keys):
+        def one(pr, yv, key):
+            a = pr[0]
+            ya, nv = _base.align_right(yv)
+            s = _ewma.smooth(a, ya, nv)
+            t_len = ya.shape[0]
+            start = t_len - nv
+            err = ya[1:] - s[:-1]
+            err = jnp.where(jnp.arange(1, t_len) > start, err, 0.0)
+            n_eff = jnp.maximum(nv - 1, 1).astype(yv.dtype)
+            sigma = jnp.sqrt(jnp.sum(err * err) / n_eff)
+            S = n_samples
+            eps = sigma * jax.random.normal(key, (horizon, S), yv.dtype)
+            s0 = jnp.broadcast_to(s[-1], (S,))
+
+            def step(sp, et):
+                x = sp + et
+                return a * x + (1.0 - a) * sp, x
+
+            _, paths = lax.scan(step, s0, eps)
+            out = paths.T
+            return jnp.where(nv >= 2, out, jnp.nan)
+
+        return jax.vmap(one)(pb, yb, keys)
+
+    return f
+
+
+def _hw_sim(period: int, multiplicative: bool, horizon: int,
+            n_samples: int):
+    def f(pb, yb, keys):
+        def one(pr, yv, key):
+            ya, nv = _base.align_right(yv)
+            preds, (level, trend, seasonal) = _hw._run(
+                pr, ya, period, multiplicative, nv)
+            t_len = ya.shape[0]
+            start = t_len - nv
+            err = ya - preds
+            err = jnp.where(
+                jnp.arange(t_len) >= start + period, err, 0.0)
+            n_eff = jnp.maximum(nv - period, 1).astype(yv.dtype)
+            sigma = jnp.sqrt(jnp.sum(err * err) / n_eff)
+            alpha, beta, gamma = pr[0], pr[1], pr[2]
+            S = n_samples
+            eps = sigma * jax.random.normal(key, (horizon, S), yv.dtype)
+            init = (jnp.broadcast_to(level, (S,)),
+                    jnp.broadcast_to(trend, (S,)),
+                    jnp.broadcast_to(seasonal, (S, period)))
+
+            def step(carry, et):
+                lv, tr, seas = carry
+                s0 = seas[:, 0]
+                if multiplicative:
+                    pred = (lv + tr) * s0
+                    yt = pred + et
+                    nl = (alpha * yt / jnp.maximum(s0, 1e-12)
+                          + (1 - alpha) * (lv + tr))
+                    ns = (gamma * yt / jnp.maximum(nl, 1e-12)
+                          + (1 - gamma) * s0)
+                else:
+                    pred = lv + tr + s0
+                    yt = pred + et
+                    nl = alpha * (yt - s0) + (1 - alpha) * (lv + tr)
+                    ns = gamma * (yt - nl) + (1 - gamma) * s0
+                nt = beta * (nl - lv) + (1 - beta) * tr
+                nseas = jnp.concatenate([seas[:, 1:], ns[:, None]], axis=1)
+                return (nl, nt, nseas), yt
+
+            _, paths = lax.scan(step, init, eps)
+            out = paths.T
+            # same structural gate as the point forecast: seeding needs
+            # two full seasons
+            return jnp.where(nv >= 2 * period, out, jnp.nan)
+
+        return jax.vmap(one)(pb, yb, keys)
+
+    return f
+
+
+def _garch_sim(horizon: int, n_samples: int):
+    def f(pb, yb, keys):
+        def one(pr, rv, key):
+            ra, nv = _base.align_right(rv)
+            h = _garch.variances(pr, ra, nv)
+            omega, alpha, beta = pr[0], pr[1], pr[2]
+            S = n_samples
+            eps = jax.random.normal(key, (horizon, S), rv.dtype)
+            init = (jnp.broadcast_to(h[-1], (S,)),
+                    jnp.broadcast_to(ra[-1], (S,)))
+
+            def step(carry, et):
+                hp, rp = carry
+                hn = omega + alpha * rp ** 2 + beta * hp
+                r = jnp.sqrt(jnp.maximum(hn, 1e-12)) * et
+                return (hn, r), r
+
+            _, paths = lax.scan(step, init, eps)
+            out = paths.T
+            return jnp.where(nv >= 2, out, jnp.nan)
+
+        return jax.vmap(one)(pb, yb, keys)
+
+    return f

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils import optim
-from .base import (FitResult, debatch,
+from .base import (FitResult, align_right, debatch,
                    debatch_fit, derive_status,
                    require_pallas_for_count_evals,
                    ensure_batched, maybe_align,
@@ -317,6 +317,46 @@ def _fit_stage2_program(max_iters, tol, backend):
         res = optim.lbfgs_batched_stage2(
             fb_s, aux["res"], aux["carry"], max_iters=max_iters, tol=tol)
         return _finalize_garch_fit(res, aux["ok"], aux["n_eff"])
+
+    return run
+
+
+def forecast(params, r, n_future: int):
+    """Variance-path forecast -> ``[batch?, n_future]`` conditional variances.
+
+    GARCH's mean forecast is identically zero; what users forecast is the
+    VOLATILITY path: ``h_{T+1} = omega + alpha r_T^2 + beta h_T`` from the
+    in-sample recursion's end state, then — future squared returns
+    entering at their conditional expectation ``E[r^2] = h`` —
+    ``h_{T+k} = omega + (alpha + beta) h_{T+k-1}``, decaying geometrically
+    toward the unconditional variance.  Leading/trailing NaNs are
+    tolerated (right-aligned span, same contract as :func:`fit`); rows
+    with non-finite params or fewer than 2 valid observations come back
+    NaN rather than a plausible-looking zero.
+    """
+    rb, single = ensure_batched(r)
+    pb = jnp.atleast_2d(params)
+    out = _forecast_program(n_future)(pb, rb)
+    return out[0] if single else out
+
+
+@jit_program
+def _forecast_program(n_future):
+    def run(pb, rb):
+        def one(pr, rv):
+            ra, nv = align_right(rv)
+            h = variances(pr, ra, nv)
+            omega, alpha, beta = pr[0], pr[1], pr[2]
+            h1 = omega + alpha * ra[-1] ** 2 + beta * h[-1]
+
+            def step(hp, _):
+                return omega + (alpha + beta) * hp, hp
+
+            _, hs = lax.scan(step, h1, None, length=n_future)
+            ok = (nv >= 2) & jnp.all(jnp.isfinite(pr))
+            return jnp.where(ok, hs, jnp.nan)
+
+        return jax.vmap(one)(pb, rb)
 
     return run
 

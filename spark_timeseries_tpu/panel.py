@@ -532,6 +532,86 @@ class TimeSeriesPanel:
                 prefetch_depth=prefetch_depth, align_mode=align_mode,
                 shard=shard, mesh=mesh, **fit_kwargs)
 
+    def forecast(self, model, horizon, fitted, *, status=None,
+                 intervals: bool = False, level: float = 0.9,
+                 n_samples: int = 256, seed: Optional[int] = None,
+                 chunk_rows: Optional[int] = None,
+                 checkpoint_dir: Optional[str] = None, resume: str = "auto",
+                 chunk_budget_s: Optional[float] = None,
+                 job_budget_s: Optional[float] = None,
+                 pipeline: bool = True, pipeline_depth: int = 2,
+                 prefetch_depth: int = 1, shard: bool = False, mesh=None,
+                 source=None, _journal_commit_hook=None, **model_kwargs):
+        """Forecast ``horizon`` steps for every series via the chunked
+        forecast walk (``forecasting.forecast_chunked`` — ROADMAP item 2).
+
+        ``model`` is a forecast-capable model name (``"arima"``,
+        ``"autoregression"``, ``"ewma"``, ``"holtwinters"``,
+        ``"garch"``); ``model_kwargs`` its structural config (e.g.
+        ``order=(1, 1, 1)``).  ``fitted`` supplies the per-row params:
+        the fit result a previous :meth:`fit` returned, a raw
+        ``[n_series, k]`` array, or a PATH to a fit walk's journal
+        (fit once on disk, forecast many later — committed rows load
+        byte-identical to the original fit).  An :meth:`auto_fit`
+        SELECTION is rejected (each row's params follow its own winning
+        order's layout) — forecast it with
+        ``forecasting.ensemble_forecast(auto_root=..., temperature=0)``
+        instead.  Rows whose fit failed forecast NaN and keep their
+        ``FitStatus``, never garbage.
+
+        The walk rides the SAME durable chunk driver as :meth:`fit`:
+        ``checkpoint_dir=`` journals forecast chunks (SIGKILL-resume
+        replays only uncommitted chunks, bitwise), ``shard=True`` runs
+        one elastic lane per mesh device, ``source=`` streams a
+        larger-than-HBM panel, and every composition is
+        bitwise-identical to the serial in-memory walk on the same chunk
+        grid.  ``intervals=True`` adds Monte-Carlo ``level`` quantile
+        bands whose sampling keys are counter-based per GLOBAL row
+        (reproducible bitwise across runs/resumes/shards).
+
+        Returns a ``forecasting.ForecastResult`` whose rows align with
+        ``self.keys``.
+        """
+        from . import forecasting as _forecasting
+
+        if source is not None:
+            from .reliability import source as source_mod
+
+            src = source_mod.as_source(source)
+            if tuple(src.shape) != (int(self.n_series), int(self.n_time)):
+                raise ValueError(
+                    f"source shape {src.shape} does not match this panel "
+                    f"({self.n_series} series x {self.n_time} obs); the "
+                    "source must hold the panel's own values")
+            values = src
+        else:
+            values = self.series_values()
+        return _forecasting.forecast_chunked(
+            model, fitted, values, horizon,
+            model_kwargs=model_kwargs, status=status,
+            intervals=intervals, level=level, n_samples=n_samples,
+            seed=seed, chunk_rows=chunk_rows,
+            checkpoint_dir=checkpoint_dir, resume=resume,
+            chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
+            pipeline=pipeline, pipeline_depth=pipeline_depth,
+            prefetch_depth=prefetch_depth, shard=shard, mesh=mesh,
+            _journal_commit_hook=_journal_commit_hook)
+
+    def backtest(self, model, horizon, *, checkpoint_dir: Optional[str] = None,
+                 **backtest_kwargs):
+        """Rolling-origin backtest campaign over this panel
+        (``forecasting.run_backtest``): expanding-window refits x a
+        ``horizon`` sweep as ONE journaled campaign, warm-started
+        window-to-window, with MAE/RMSE/MAPE/coverage in a durable
+        ``backtest_manifest.json`` — SIGKILL-resumable to
+        bitwise-identical metrics.  See ``forecasting.run_backtest``
+        for the knobs."""
+        from . import forecasting as _forecasting
+
+        return _forecasting.run_backtest(
+            self.series_values(), model, horizon,
+            checkpoint_dir=checkpoint_dir, **backtest_kwargs)
+
     def lags(self, max_lag: int, include_original: bool = True,
              lagged_key: Callable[[object, int], object] = None) -> "TimeSeriesPanel":
         """Panel of lagged copies of every series — the upstream

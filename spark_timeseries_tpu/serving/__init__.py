@@ -37,11 +37,12 @@ Quickstart::
 from . import admission, batcher, server, session
 from .admission import AdmissionQueue, TenantQuota
 from .batcher import MicroBatch, batch_key
-from .server import FitServer
+from .server import FORECAST_MODEL, FitServer
 from .session import (CancelledError, FitRequest, FitTicket, RejectedError,
                       ServerClosedError, TenantFitResult)
 
 __all__ = [
+    "FORECAST_MODEL",
     "AdmissionQueue",
     "CancelledError",
     "FitRequest",

@@ -66,7 +66,11 @@ from .admission import AdmissionQueue, TenantQuota
 from .session import (CancelledError, FitRequest, FitTicket, RejectedError,
                       ServerClosedError, TenantFitResult)
 
-__all__ = ["FitServer"]
+__all__ = ["FORECAST_MODEL", "FitServer"]
+
+# registry name of the chunked forecast walk's fit function — forecast
+# requests reference it BY NAME so they survive restarts like model fits
+FORECAST_MODEL = "panel_forecast"
 
 
 def _align_mode_host(values: np.ndarray) -> str:
@@ -378,6 +382,89 @@ class FitServer:
         obs.counter("server.admitted").inc()
         return req.ticket
 
+    def submit_forecast(self, tenant: str, values, fitted, *,
+                        model: str = "arima",
+                        horizon: int = 1,
+                        model_kwargs: Optional[dict] = None,
+                        status=None,
+                        intervals: bool = False, level: float = 0.9,
+                        n_samples: int = 256,
+                        seed: Optional[int] = None,
+                        priority: int = 0,
+                        deadline_s: Optional[float] = None,
+                        request_id: Optional[str] = None) -> FitTicket:
+        """Admit one tenant panel FORECAST (fit-once / forecast-many: the
+        serving half users actually call).
+
+        ``values`` is the tenant's ``[rows, T]`` history and ``fitted``
+        its per-row params (a fit result, a raw ``[rows, k]`` array, or
+        a journal path — ``forecasting.forecast_chunked`` semantics).
+        The request rides the NORMAL admission/batching/durability
+        machinery as a ``panel_forecast`` walk over the AUGMENTED panel
+        (``forecasting.augment``): compatible forecast requests (same
+        model/horizon/config/width) coalesce into ONE journaled chunk
+        walk on the cell grid and demux bitwise-identically to solo
+        submits; the write-ahead request record carries the augmented
+        panel, so a SIGKILLed server re-answers forecasts bitwise like
+        fits.  Interval keys are counter-based per request-local row
+        with a base seed derived from the request's own content (or
+        ``seed``), so batching composition cannot move a row's bands.
+
+        The result's ``params`` is the packed ``[point | lo | hi]``
+        forecast block — unpack with ``forecasting.as_result(res,
+        horizon, intervals)``.
+        """
+        from .. import forecasting as _forecasting
+        from ..forecasting import kernels as _fkernels
+        from ..reliability import journal as _journal
+
+        if int(horizon) < 1:
+            raise ValueError(f"horizon must be >= 1, got {horizon}")
+        mk = _fkernels.normalize_model_kwargs(model, model_kwargs or {})
+        cfg = dict(mk)
+        k = _fkernels.param_width(model, cfg)
+        if isinstance(fitted, str):
+            fitted = _forecasting.load_fit_result(fitted)
+        if hasattr(fitted, "order_index"):
+            raise ValueError(
+                "an auto-fit selection mixes parameter layouts per row; "
+                "forecast it with forecasting.ensemble_forecast("
+                "auto_root=..., temperature=0), not a single-order "
+                "forecast request")
+        if hasattr(fitted, "params"):
+            params = np.asarray(fitted.params)
+            if status is None:
+                status = getattr(fitted, "status", None)
+        else:
+            params = np.asarray(fitted)
+        if params.ndim != 2 or params.shape[1] < k:
+            raise ValueError(
+                f"model {model!r} needs [rows, >={k}] params, got "
+                f"{params.shape}")
+        params = np.ascontiguousarray(params[:, :k])
+        arr = np.ascontiguousarray(np.asarray(values))
+        if arr.ndim != 2 or arr.shape[0] != params.shape[0]:
+            raise ValueError(
+                f"values {arr.shape} and params {params.shape} disagree "
+                "on rows")
+        st = _forecasting.augment.derive_status(params, status)
+        aug = _forecasting.augment.augmented_host(arr, params, st)
+        base_seed = 0
+        if intervals:
+            base_seed = (int(seed) if seed is not None
+                         else _forecasting.walk._derive_base_seed(
+                             _journal.panel_fingerprint(aug)))
+        return self.submit(
+            tenant, aug, FORECAST_MODEL,
+            priority=priority, deadline_s=deadline_s,
+            request_id=request_id,
+            forecast_model=model, horizon=int(horizon),
+            n_time=int(arr.shape[1]), k=int(k),
+            model_kwargs={key: (list(v) if isinstance(v, tuple) else v)
+                          for key, v in cfg.items()},
+            intervals=bool(intervals), level=float(level),
+            n_samples=int(n_samples), base_seed=int(base_seed))
+
     def _count_rejected(self) -> None:
         """Every refusal — queue, quota, duplicate — is load evidence:
         it must show in the counters and flip the degraded signal, or a
@@ -549,13 +636,18 @@ class FitServer:
                                               batch.values.dtype))
         ckpt = os.path.join(batch.dir(self.root), "journal")
         job_budget = batch.job_budget_s()
+        # forecast walks NEVER run the resilient ladder: the augmented
+        # panel's extra columns are fitted parameters, and the sanitizer
+        # "repairing" them would corrupt the forecast inputs (the walk's
+        # own status propagation is the forecast-side resilience)
+        resilient = head.resilient and head.model != FORECAST_MODEL
         with watchdog_mod.request_context(batch.tenants):
             with obs.span("server.batch", batch_id=batch.batch_id,
                           members=len(batch.members), rows=batch.rows):
                 return fit_chunked(
                     fit_fn, src,
                     chunk_rows=batch.cell_rows,
-                    resilient=head.resilient,
+                    resilient=resilient,
                     policy=head.policy,
                     checkpoint_dir=ckpt,
                     chunk_budget_s=self.chunk_budget_s,
@@ -820,6 +912,14 @@ class FitServer:
         fn = self._models.get(model)
         if fn is not None:
             return fn
+        if model == FORECAST_MODEL:
+            # the chunked forecast walk's fit function: requests carry
+            # an augmented panel + the forecast config in fit_kwargs
+            # (submit_forecast) — a built-in name so forecast requests
+            # stay durable/re-resolvable across restarts like model fits
+            from ..forecasting import walk as _fwalk
+
+            return _fwalk.forecast_fit
         from .. import models as _models
 
         mod = getattr(_models, model, None)

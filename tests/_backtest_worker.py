@@ -1,0 +1,169 @@
+"""Subprocess worker for the backtest-campaign kill-and-resume smoke
+(ISSUE 14).
+
+Runs a journaled 3-window rolling-origin backtest of a deterministic
+ARMA panel, optionally SIGKILLing itself after N durable chunk commits
+of the campaign's fit walks — a real process death mid-campaign (window
+0 committed, window 1's fit mid-walk, window 2 unstarted) — so both
+``tests/test_forecast.py`` and the ``ci.sh`` smoke can prove the
+campaign resumes to BITWISE-identical metrics across genuine process
+boundaries.
+
+Modes:
+    --run --dir D [--kill-after N] [--out F]
+        one campaign; with --kill-after the process dies mid-campaign
+        (exit by SIGKILL), else the per-window metric arrays + campaign
+        aggregates are saved to F.
+    --smoke
+        full orchestration (used by ci.sh): kill a child after 6 chunk
+        commits, verify the campaign manifest shows window 0 committed
+        and window 1 incomplete, resume, compare every metric byte
+        against an uninterrupted campaign in a fresh directory, and
+        print PASS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+CHUNK_ROWS = 8
+N_ROWS = 16
+N_TIME = 100
+HORIZON = 5
+N_WINDOWS = 3
+
+
+def make_panel() -> np.ndarray:
+    rng = np.random.default_rng(11)
+    e = rng.normal(size=(N_ROWS, N_TIME)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, y.shape[1]):
+        y[:, i] = 0.7 * y[:, i - 1] + 0.2 * e[:, i - 1] + e[:, i]
+    return y
+
+
+def run_campaign(directory: str, kill_after, out) -> None:
+    from spark_timeseries_tpu import forecasting as fc
+    from spark_timeseries_tpu.reliability import faultinject as fi
+
+    hook = None
+    if kill_after is not None:
+        hook = fi.kill_after_commits(int(kill_after))
+    bt = fc.run_backtest(
+        make_panel(), "arima", HORIZON,
+        model_kwargs={"order": (1, 0, 1)},
+        fit_kwargs={"max_iters": 20},
+        n_windows=N_WINDOWS, chunk_rows=CHUNK_ROWS,
+        intervals=True, n_samples=32,
+        checkpoint_dir=directory,
+        _journal_commit_hook=hook,
+    )
+    if kill_after is not None:
+        sys.exit(f"kill_after={kill_after} but the campaign finished — "
+                 "the hook never fired")
+    if out:
+        arrays = {}
+        for w in bt.windows:
+            i = w["index"]
+            with np.load(os.path.join(directory, w["metrics_file"]),
+                         allow_pickle=False) as z:
+                for key in z.files:
+                    arrays[f"w{i}_{key}"] = np.array(z[key])
+        arrays["agg"] = np.frombuffer(
+            json.dumps(bt.metrics, sort_keys=True).encode(), dtype=np.uint8)
+        np.savez(out, **arrays)
+
+
+def _child(args: list) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), *args],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=900,
+    )
+
+
+def smoke() -> None:
+    with tempfile.TemporaryDirectory() as td:
+        root = os.path.join(td, "campaign")
+        # 1. child killed by SIGKILL mid-campaign: window 0's 2-chunk fit
+        #    walk commits + its metrics land, window 1's fit walk is torn
+        #    after its first commits
+        r = _child(["--run", "--dir", root, "--kill-after", "3"])
+        if r.returncode != -9:
+            sys.exit(f"expected SIGKILL (-9), got rc={r.returncode}\n"
+                     f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}")
+        manifest = json.load(open(os.path.join(
+            root, "backtest_manifest.json")))
+        done = [w["index"] for w in manifest["windows"]
+                if w["status"] == "committed"]
+        if done != [0]:
+            sys.exit(f"expected only window 0 committed at the kill, "
+                     f"got {done}")
+        w1 = json.load(open(os.path.join(root, "window_00001",
+                                         "manifest.json")))
+        w1_done = sum(1 for c in w1["chunks"]
+                      if c["status"] == "committed")
+        if not (0 < w1_done < N_ROWS // CHUNK_ROWS):
+            sys.exit(f"window 1 should be torn mid-walk, has {w1_done} "
+                     "committed chunks")
+        # 2. resume completes the campaign
+        resumed_out = os.path.join(td, "resumed.npz")
+        r = _child(["--run", "--dir", root, "--out", resumed_out])
+        if r.returncode != 0:
+            sys.exit(f"resume failed rc={r.returncode}\nstderr:\n{r.stderr}")
+        # 3. uninterrupted reference campaign in a fresh directory
+        full_out = os.path.join(td, "full.npz")
+        r = _child(["--run", "--dir", os.path.join(td, "fresh"),
+                    "--out", full_out])
+        if r.returncode != 0:
+            sys.exit(f"reference run failed rc={r.returncode}\n{r.stderr}")
+        a, b = np.load(full_out), np.load(resumed_out)
+        if sorted(a.files) != sorted(b.files):
+            sys.exit(f"metric key sets differ: {sorted(a.files)} vs "
+                     f"{sorted(b.files)}")
+        for k in a.files:
+            if not np.array_equal(a[k], b[k]):
+                sys.exit(f"resumed campaign differs from uninterrupted "
+                         f"run on {k!r} — resume is NOT bitwise-identical")
+        manifest = json.load(open(os.path.join(
+            root, "backtest_manifest.json")))
+        done = [w["index"] for w in manifest["windows"]
+                if w["status"] == "committed"]
+        if done != list(range(N_WINDOWS)):
+            sys.exit(f"manifest should show all {N_WINDOWS} windows "
+                     f"committed, got {done}")
+        print("backtest kill-and-resume smoke: PASS "
+              "(SIGKILL mid-window-1 fit, resumed campaign metrics "
+              f"bitwise-identical across all {N_WINDOWS} windows incl. "
+              "interval coverage)")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--run", action="store_true")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--dir")
+    ap.add_argument("--kill-after", type=int, default=None)
+    ap.add_argument("--out")
+    args = ap.parse_args()
+    if args.smoke:
+        return smoke()
+    if not args.run or not args.dir:
+        ap.error("need --run --dir D or --smoke")
+    run_campaign(args.dir, args.kill_after, args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,697 @@
+"""Panel-scale forecasting tests (ISSUE 14).
+
+The forecast walk rides the durable chunk driver via an augmented panel,
+so the contracts under test are COMPOSITION contracts:
+
+- forecast-from-journal equals forecast-from-memory bitwise (fit once on
+  disk, forecast many later);
+- serial, pipelined, sharded (forced 8-device CPU mesh), and
+  source-streamed forecasts are bitwise-identical on the same chunk
+  grid — point forecasts AND Monte-Carlo interval bands (counter-based
+  per-row keys);
+- a journaled forecast walk crash-resumes bitwise (in-process
+  SimulatedCrash here; the real-SIGKILL campaign smoke rides
+  ``tests/_backtest_worker.py``);
+- non-OK ``FitStatus`` rows forecast NaN (never garbage) and keep their
+  status;
+- rolling-origin backtest campaigns resume to bitwise-identical
+  metrics, reject stale manifests, and validate under the obs_report
+  gate;
+- ensemble weights sum to 1 per row and ``temperature=0`` recovers the
+  argmin winner bitwise;
+- the GARCH variance-path forecast (the walk's last missing kernel) is
+  positive, decays to the unconditional variance, and NaN-gates.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu import forecasting as fc
+from spark_timeseries_tpu import obs
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu import serving
+from spark_timeseries_tpu.forecasting import augment, kernels
+from spark_timeseries_tpu.models import arima, auto, ewma, garch
+from spark_timeseries_tpu.reliability import faultinject as fi
+from spark_timeseries_tpu.reliability.status import FitStatus
+
+TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+
+B, T, H = 24, 96, 5
+ORDER = (1, 0, 1)
+MK = {"order": ORDER}
+FIT_KW = dict(resilient=False, order=ORDER, max_iters=20)
+
+
+def make_panel(b=B, t=T, seed=0, ragged=True) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(b, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, t):
+        y[:, i] = 0.6 * y[:, i - 1] + 0.3 * e[:, i - 1] + e[:, i]
+    if ragged:
+        y[1, : t // 8] = np.nan  # leading NaNs: ragged row
+        y[2, :] = np.nan  # all-NaN row: EXCLUDED by the fit
+    return y
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return make_panel()
+
+
+@pytest.fixture(scope="module")
+def fitres(panel):
+    return rel.fit_chunked(arima.fit, panel, chunk_rows=8, **FIT_KW)
+
+
+def _assert_same(a: fc.ForecastResult, b: fc.ForecastResult, msg=""):
+    for f in ("forecast", "lo", "hi"):
+        x, y = getattr(a, f), getattr(b, f)
+        if x is None or y is None:
+            assert x is None and y is None, (msg, f)
+            continue
+        assert np.array_equal(x, y, equal_nan=True), (msg, f)
+    assert np.array_equal(a.status, b.status), (msg, "status")
+
+
+# ---------------------------------------------------------------------------
+# the composition matrix
+# ---------------------------------------------------------------------------
+
+
+class TestCompositionMatrix:
+    KW = dict(model_kwargs=MK, intervals=True, n_samples=32, chunk_rows=4)
+
+    def test_serial_pipelined_sharded_source_bitwise(self, panel, fitres,
+                                                     lane_mesh):
+        base = fc.forecast_chunked("arima", fitres, panel, H,
+                                   pipeline=False, **self.KW)
+        pipe = fc.forecast_chunked("arima", fitres, panel, H,
+                                   prefetch_depth=2, **self.KW)
+        shd = fc.forecast_chunked("arima", fitres, panel, H, shard=True,
+                                  **self.KW)
+        hsrc = fc.forecast_chunked("arima", fitres,
+                                   rel.HostChunkSource(panel), H,
+                                   **self.KW)
+        _assert_same(base, pipe, "pipelined")
+        _assert_same(base, shd, "sharded")
+        _assert_same(base, hsrc, "host-source")
+        # 24 rows on the 4-row grid feed 6 lanes of the 8-device mesh
+        assert shd.meta["shards"]["n_shards"] == 6
+        assert hsrc.meta["source"]["kind"] == "columns"
+
+    def test_npz_shard_source_bitwise(self, panel, fitres, tmp_path):
+        d = str(tmp_path / "shards")
+        rel.write_npz_shards(d, panel, rows_per_shard=4)
+        base = fc.forecast_chunked("arima", fitres, panel, H, **self.KW)
+        nz = fc.forecast_chunked("arima", fitres, rel.as_source(d), H,
+                                 **self.KW)
+        _assert_same(base, nz, "npz-source")
+
+    def test_forecast_from_journal_bitwise(self, panel, fitres, tmp_path):
+        d = str(tmp_path / "fitj")
+        jr = rel.fit_chunked(arima.fit, panel, chunk_rows=8,
+                             checkpoint_dir=d, **FIT_KW)
+        assert np.array_equal(np.asarray(jr.params),
+                              np.asarray(fitres.params), equal_nan=True)
+        mem = fc.forecast_chunked("arima", fitres, panel, H, **self.KW)
+        disk = fc.forecast_chunked("arima", d, panel, H, **self.KW)
+        _assert_same(mem, disk, "from-journal")
+
+    def test_journaled_resume_bitwise(self, panel, fitres, tmp_path):
+        d = str(tmp_path / "fcj")
+        first = fc.forecast_chunked("arima", fitres, panel, H,
+                                    checkpoint_dir=d, **self.KW)
+        again = fc.forecast_chunked("arima", fitres, panel, H,
+                                    checkpoint_dir=d, **self.KW)
+        assert again.meta["journal"]["chunks_resumed"] == B // 4
+        _assert_same(first, again, "full-resume")
+
+    def test_crash_resume_bitwise(self, panel, fitres, tmp_path):
+        ref = fc.forecast_chunked("arima", fitres, panel, H, **self.KW)
+        d = str(tmp_path / "crash")
+        with pytest.raises(fi.SimulatedCrash):
+            fc.forecast_chunked(
+                "arima", fitres, panel, H, checkpoint_dir=d,
+                _journal_commit_hook=fi.crash_after_commits(2), **self.KW)
+        resumed = fc.forecast_chunked("arima", fitres, panel, H,
+                                      checkpoint_dir=d, **self.KW)
+        assert 0 < resumed.meta["journal"]["chunks_resumed"] < B // 4
+        _assert_same(ref, resumed, "crash-resume")
+
+    def test_stale_journal_rejected(self, panel, fitres, tmp_path):
+        d = str(tmp_path / "stale")
+        fc.forecast_chunked("arima", fitres, panel, H, checkpoint_dir=d,
+                            **self.KW)
+        with pytest.raises(rel.StaleJournalError):
+            fc.forecast_chunked("arima", fitres, panel, H + 1,
+                                checkpoint_dir=d, **self.KW)
+
+
+# ---------------------------------------------------------------------------
+# status propagation + intervals
+# ---------------------------------------------------------------------------
+
+
+class TestStatusAndIntervals:
+    def test_non_ok_rows_nan_and_propagate(self, panel, fitres):
+        st = np.asarray(fitres.status, np.int8).copy()
+        st[4] = int(FitStatus.DIVERGED)
+        st[5] = int(FitStatus.TIMEOUT)
+        st[6] = int(FitStatus.SANITIZED)  # rescued: still usable
+        res = fc.forecast_chunked("arima", fitres, panel, H,
+                                  model_kwargs=MK, status=st)
+        assert np.isnan(res.forecast[4]).all()
+        assert np.isnan(res.forecast[5]).all()
+        assert np.isfinite(res.forecast[6]).all()
+        # the all-NaN row was EXCLUDED by the fit itself
+        assert res.status[2] == int(FitStatus.EXCLUDED)
+        assert np.isnan(res.forecast[2]).all()
+        assert res.status[4] == int(FitStatus.DIVERGED)
+        assert res.status[5] == int(FitStatus.TIMEOUT)
+        assert res.status[6] == int(FitStatus.SANITIZED)
+
+    def test_nan_params_never_garbage(self, panel):
+        params = np.full((B, arima._n_params(ORDER, True)), np.nan,
+                         np.float32)
+        res = fc.forecast_chunked("arima", params, panel, H,
+                                  model_kwargs=MK)
+        assert np.isnan(res.forecast).all()
+        assert (res.status == int(FitStatus.DIVERGED)).all()
+
+    def test_interval_seed_determinism(self, panel, fitres):
+        kw = dict(model_kwargs=MK, intervals=True, n_samples=32)
+        a = fc.forecast_chunked("arima", fitres, panel, H, seed=5, **kw)
+        b = fc.forecast_chunked("arima", fitres, panel, H, seed=5, **kw)
+        c = fc.forecast_chunked("arima", fitres, panel, H, seed=6, **kw)
+        _assert_same(a, b, "same-seed")
+        assert not np.array_equal(a.lo, c.lo, equal_nan=True)
+        # derived (fingerprint) seed is deterministic too
+        d1 = fc.forecast_chunked("arima", fitres, panel, H, **kw)
+        d2 = fc.forecast_chunked("arima", fitres, panel, H, **kw)
+        _assert_same(d1, d2, "derived-seed")
+        assert d1.meta["forecast"]["base_seed"] == \
+            d2.meta["forecast"]["base_seed"]
+
+    def test_bands_bracket_point(self, panel, fitres):
+        res = fc.forecast_chunked("arima", fitres, panel, H,
+                                  model_kwargs=MK, intervals=True,
+                                  n_samples=128, level=0.9, seed=0)
+        ok = np.isfinite(res.forecast)
+        assert (res.lo[ok] <= res.hi[ok]).all()
+        # the point forecast is the conditional mean; with 128 samples it
+        # sits inside a 90% band essentially always
+        inside = (res.forecast[ok] >= res.lo[ok]) & \
+                 (res.forecast[ok] <= res.hi[ok])
+        assert inside.mean() > 0.95
+
+
+# ---------------------------------------------------------------------------
+# model kernels
+# ---------------------------------------------------------------------------
+
+
+class TestModelKernels:
+    def test_garch_forecast_variance_path(self):
+        rng = np.random.default_rng(3)
+        r = (0.05 * rng.normal(size=(8, 160))).astype(np.float32)
+        res = garch.fit(r, max_iters=60, backend="scan")
+        fcast = np.asarray(garch.forecast(res.params, r, 50))
+        p = np.asarray(res.params)
+        fin = np.isfinite(p).all(axis=1)
+        assert fin.any()
+        assert (fcast[fin] > 0).all()
+        # geometric decay toward the unconditional variance
+        uncond = p[fin, 0] / (1.0 - p[fin, 1] - p[fin, 2])
+        d0 = np.abs(fcast[fin, 0] - uncond)
+        d49 = np.abs(fcast[fin, 49] - uncond)
+        assert (d49 <= d0 + 1e-7).all()
+
+    def test_garch_forecast_nan_gates(self):
+        r = np.full((2, 40), np.nan, np.float32)
+        out = np.asarray(garch.forecast(
+            np.array([[0.1, 0.1, 0.8], [np.nan, 0.1, 0.8]], np.float32),
+            r, 3))
+        assert np.isnan(out).all()  # no valid span / non-finite params
+
+    def test_garch_forecast_single_series(self):
+        rng = np.random.default_rng(4)
+        r = (0.05 * rng.normal(size=120)).astype(np.float32)
+        res = garch.fit(r, max_iters=60, backend="scan")
+        out = np.asarray(garch.forecast(res.params, r, 4))
+        assert out.shape == (4,)
+
+    @pytest.mark.parametrize("model,mk,gen", [
+        ("ewma", {}, lambda rng: np.cumsum(
+            0.1 * rng.normal(size=(6, 64)).astype(np.float32), axis=1)),
+        ("autoregression", {"max_lag": 2}, lambda rng: rng.normal(
+            size=(6, 64)).astype(np.float32)),
+        ("holtwinters", {"period": 4}, lambda rng: (
+            10 + 2 * np.sin(np.arange(64) * np.pi / 2)
+            + 0.1 * rng.normal(size=(6, 64))).astype(np.float32)),
+    ])
+    def test_walk_supports_every_model(self, model, mk, gen):
+        rng = np.random.default_rng(9)
+        y = gen(rng)
+        from spark_timeseries_tpu import models as _models
+
+        mod = getattr(_models, model)
+        fkw = {"max_iters": 20} if model != "autoregression" else {}
+        r = rel.fit_chunked(mod.fit, y, resilient=False, **mk, **fkw)
+        res = fc.forecast_chunked(model, r, y, 4, model_kwargs=mk,
+                                  intervals=True, n_samples=16,
+                                  chunk_rows=3)
+        res2 = fc.forecast_chunked(model, r, y, 4, model_kwargs=mk,
+                                   intervals=True, n_samples=16,
+                                   chunk_rows=3, shard=True)
+        _assert_same(res, res2, f"{model}-sharded")
+        fin = np.isfinite(res.forecast)
+        assert fin.any()
+        assert (res.lo[fin] <= res.hi[fin]).all()
+
+    def test_model_kwargs_validation(self, panel, fitres):
+        with pytest.raises(ValueError, match="unknown forecast model"):
+            fc.forecast_chunked("nope", fitres, panel, H)
+        with pytest.raises(ValueError, match="does not accept"):
+            fc.forecast_chunked("ewma", fitres, panel, H,
+                                model_kwargs={"period": 4})
+        with pytest.raises(ValueError, match="seasonal"):
+            kernels.normalize_model_kwargs(
+                "arima", {"order": (1, 0, 1, (1, 0, 0, 4))})
+        with pytest.raises(ValueError, match="requires"):
+            kernels.normalize_model_kwargs("holtwinters", {})
+
+    def test_param_width_mismatch_loud(self, panel):
+        with pytest.raises(ValueError, match="needs"):
+            fc.forecast_chunked("arima", np.zeros((B, 1), np.float32),
+                                panel, H, model_kwargs=MK)
+
+    def test_auto_fit_selection_rejected(self, panel, tmp_path):
+        """An AutoFitResult packs each row's params in its WINNING
+        order's layout — a single-order forecast would read wrong-but-
+        finite coefficients as status-OK numbers.  Both the walk and
+        the serving surface must refuse and point at the ensemble."""
+        res = auto.auto_fit(panel, [(1, 0, 0), (2, 0, 1)], max_iters=10,
+                            chunk_rows=8)
+        with pytest.raises(ValueError, match="ensemble_forecast"):
+            fc.forecast_chunked("arima", res, panel, H, model_kwargs=MK)
+        srv = serving.FitServer(str(tmp_path / "s"), autotune=False)
+        with pytest.raises(ValueError, match="ensemble_forecast"):
+            srv.submit_forecast("a", panel, res, model="arima",
+                                horizon=H, model_kwargs=MK)
+
+    def test_bad_horizon_loud(self, panel, fitres, tmp_path):
+        with pytest.raises(ValueError, match="horizon"):
+            fc.forecast_chunked("arima", fitres, panel, 0,
+                                model_kwargs=MK)
+        with pytest.raises(ValueError, match="horizon"):
+            fc.forecast_chunked("arima", fitres, panel, -3,
+                                model_kwargs=MK)
+        srv = serving.FitServer(str(tmp_path / "h"), autotune=False)
+        with pytest.raises(ValueError, match="horizon"):
+            srv.submit_forecast("a", panel, np.asarray(fitres.params),
+                                model="arima", horizon=0,
+                                model_kwargs=MK)
+        with pytest.raises(ValueError, match="horizon"):
+            fc.run_backtest(panel, "arima", 0, model_kwargs=MK)
+
+    def test_column_source_scratch_reuse(self, panel, fitres):
+        """read_rows reuses one per-thread scratch for inner-source
+        blocks instead of allocating a fresh full-width array per
+        chunk (the backtest streaming hot path)."""
+        src, _, _ = augment.augmented_panel(
+            rel.HostChunkSource(panel), np.asarray(fitres.params),
+            augment.derive_status(np.asarray(fitres.params),
+                                  fitres.status))
+        out = np.empty((8, src.shape[1]), src.dtype)
+        src.read_rows(0, 8, out)
+        buf1 = src._scratch.bufs[0]
+        src.read_rows(8, 16, out)
+        assert src._scratch.bufs[0] is buf1  # same buffer, reused
+        src.read_rows(0, 4, out[:4])  # smaller read: no shrink/realloc
+        assert src._scratch.bufs[0] is buf1
+
+
+# ---------------------------------------------------------------------------
+# augmented panel / ColumnBlockSource
+# ---------------------------------------------------------------------------
+
+
+class TestAugment:
+    def test_column_source_matches_materialized(self, panel, fitres):
+        params = np.asarray(fitres.params)
+        st = augment.derive_status(params, fitres.status)
+        aug_dev, nt, k = augment.augmented_panel(panel, params, st)
+        src, nt2, k2 = augment.augmented_panel(
+            rel.HostChunkSource(panel), params, st)
+        assert (nt, k) == (nt2, k2)
+        assert tuple(src.shape) == tuple(aug_dev.shape)
+        out = np.empty((B, src.shape[1]), src.dtype)
+        src.read_rows(0, B, out)
+        assert np.array_equal(out, np.asarray(aug_dev), equal_nan=True)
+        # fingerprint identical to the materialized panel's — the
+        # cross-residency journal contract
+        from spark_timeseries_tpu.reliability.journal import \
+            panel_fingerprint
+
+        assert src.fingerprint() == panel_fingerprint(np.asarray(aug_dev))
+
+    def test_column_source_rejects_mismatch(self, panel):
+        with pytest.raises(rel.SourceError, match="rows"):
+            augment.ColumnBlockSource([panel, np.zeros((3, 2),
+                                                       np.float32)])
+        with pytest.raises(rel.SourceError, match="dtype"):
+            augment.ColumnBlockSource([panel, np.zeros((B, 2),
+                                                       np.float64)])
+        with pytest.raises(rel.SourceError, match="column window"):
+            augment.ColumnBlockSource([(rel.HostChunkSource(panel), 0,
+                                        T + 1)])
+
+    def test_row_index_range_guard(self):
+        with pytest.raises(ValueError, match="row-index"):
+            augment._check_row_index((1 << 24) + 1, np.dtype(np.float32))
+        augment._check_row_index((1 << 24) + 1, np.dtype(np.float64))
+
+    def test_split_forecast_degenerate(self):
+        pack = np.full((4, 1), np.nan, np.float32)  # all-TIMEOUT width
+        point, lo, hi = fc.split_forecast(pack, 6, True)
+        assert point.shape == (4, 6) and np.isnan(point).all()
+        assert lo.shape == (4, 6) and hi.shape == (4, 6)
+
+
+# ---------------------------------------------------------------------------
+# backtests
+# ---------------------------------------------------------------------------
+
+
+class TestBacktest:
+    @pytest.fixture(scope="class")
+    def bt_panel(self):
+        return make_panel(16, 100, seed=5, ragged=False)
+
+    KW = dict(model_kwargs={"order": (1, 0, 0)},
+              fit_kwargs={"max_iters": 15}, n_windows=3, chunk_rows=8,
+              intervals=True, n_samples=16)
+
+    def test_campaign_and_resume_bitwise(self, bt_panel, tmp_path):
+        root = str(tmp_path / "c")
+        bt = fc.run_backtest(bt_panel, "arima", 4, checkpoint_dir=root,
+                             **self.KW)
+        assert [w["status"] for w in bt.windows] == ["committed"] * 3
+        # warm start engaged from window 1 on (arima takes init_params)
+        assert [w["warm_start"] for w in bt.windows] == [False, True,
+                                                         True]
+        assert len(bt.metrics["mae_h"]) == 4
+        assert "coverage_h" in bt.metrics
+        # the manifest + metric shards are the durable truth
+        m = json.load(open(bt.manifest_path))
+        assert m["kind"] == "backtest" and len(m["windows"]) == 3
+        bt2 = fc.run_backtest(bt_panel, "arima", 4, checkpoint_dir=root,
+                              **self.KW)
+        for w1, w2 in zip(bt.windows, bt2.windows):
+            assert w1["digest"] == w2["digest"]
+        assert bt.metrics == bt2.metrics
+        # per-window metric ARRAYS are byte-identical on resume
+        for w in bt.windows:
+            a = np.load(os.path.join(root, w["metrics_file"]))
+            for k in a.files:
+                assert np.array_equal(a[k], a[k])
+
+    def test_unjournaled_campaign_matches_journaled(self, bt_panel,
+                                                    tmp_path):
+        root = str(tmp_path / "j")
+        j = fc.run_backtest(bt_panel, "arima", 4, checkpoint_dir=root,
+                            **self.KW)
+        u = fc.run_backtest(bt_panel, "arima", 4, checkpoint_dir=None,
+                            **self.KW)
+        assert j.metrics == u.metrics
+
+    def test_stale_campaign_rejected(self, bt_panel, tmp_path):
+        root = str(tmp_path / "s")
+        fc.run_backtest(bt_panel, "arima", 4, checkpoint_dir=root,
+                        **self.KW)
+        kw = dict(self.KW, model_kwargs={"order": (2, 0, 0)})
+        with pytest.raises(fc.StaleBacktestError):
+            fc.run_backtest(bt_panel, "arima", 4, checkpoint_dir=root,
+                            **kw)
+
+    def test_job_budget_times_out_windows(self, bt_panel, tmp_path):
+        bt = fc.run_backtest(bt_panel, "arima", 4,
+                             checkpoint_dir=str(tmp_path / "b"),
+                             job_budget_s=1e-9, **self.KW)
+        assert bt.meta["windows_timeout"] == 3
+        assert all(w["status"] == "timeout" for w in bt.windows)
+
+    def test_obs_report_validates_campaign(self, bt_panel, tmp_path):
+        sys.path.insert(0, TOOLS)
+        import obs_report
+
+        root = str(tmp_path / "v")
+        fc.run_backtest(bt_panel, "arima", 4, checkpoint_dir=root,
+                        **self.KW)
+        assert obs_report.validate_backtest_manifest(root) == []
+        # a torn metrics shard is caught
+        m = json.load(open(os.path.join(root, "backtest_manifest.json")))
+        victim = os.path.join(root, m["windows"][0]["metrics_file"])
+        with open(victim, "r+b") as f:
+            f.seek(200)  # inside member data: content (and digest) change
+            f.write(b"\xff\xff\xff\xff")
+        errs = obs_report.validate_backtest_manifest(root)
+        assert errs and any("window 0" in e for e in errs)
+
+    def test_default_origins(self):
+        o = fc.default_origins(100, 10, 4, min_train=50)
+        assert o[0] >= 50 and o[-1] == 90 and o == sorted(set(o))
+        with pytest.raises(ValueError):
+            fc.default_origins(20, 15, 2, min_train=10)
+
+    @pytest.mark.slow
+    def test_sigkill_campaign_smoke(self):
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_backtest_worker.py"), "--smoke"],
+            capture_output=True, text=True, timeout=900)
+        assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+        assert "PASS" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# ensembles
+# ---------------------------------------------------------------------------
+
+
+class TestEnsemble:
+    ORDERS = [(1, 0, 0), (0, 0, 1), (1, 0, 1)]
+
+    @pytest.fixture(scope="class")
+    def ens_inputs(self, tmp_path_factory):
+        y = make_panel(16, 90, seed=7, ragged=False)
+        root = str(tmp_path_factory.mktemp("auto") / "search")
+        auto.auto_fit(y, self.ORDERS, max_iters=15, chunk_rows=8,
+                      checkpoint_dir=root)
+        return y, root
+
+    def test_weights_sum_to_one(self, ens_inputs):
+        y, root = ens_inputs
+        ens = fc.ensemble_forecast(y, 4, auto_root=root, temperature=1.0,
+                                   chunk_rows=8)
+        s = ens.weights.sum(axis=0)
+        elig = ens.order_index >= 0
+        assert np.allclose(s[elig], 1.0)
+        assert (s[~elig] == 0).all()
+        assert np.isfinite(ens.forecast[elig]).all()
+
+    def test_temperature_zero_is_argmin_bitwise(self, ens_inputs):
+        y, root = ens_inputs
+        ens = fc.ensemble_forecast(y, 4, auto_root=root, temperature=0.0,
+                                   chunk_rows=8)
+        rows = np.arange(y.shape[0])
+        winner = ens.member_forecasts[ens.order_index, rows]
+        assert np.array_equal(ens.forecast, winner, equal_nan=True)
+        # one-hot weights at the argmin
+        w = ens.weights
+        assert set(np.unique(w)) <= {0.0, 1.0}
+        assert np.array_equal(np.argmax(w, axis=0)[ens.order_index >= 0],
+                              ens.order_index[ens.order_index >= 0])
+
+    def test_matches_auto_fit_selection(self, ens_inputs):
+        y, root = ens_inputs
+        res = auto.auto_fit(y, self.ORDERS, max_iters=15, chunk_rows=8,
+                            checkpoint_dir=root, return_criteria=True)
+        ens = fc.ensemble_forecast(y, 4, auto_root=root, temperature=0.0,
+                                   chunk_rows=8)
+        assert np.array_equal(ens.order_index, res.order_index)
+
+    def test_lower_criterion_higher_weight(self, ens_inputs):
+        y, root = ens_inputs
+        ens = fc.ensemble_forecast(y, 4, auto_root=root, temperature=2.0,
+                                   chunk_rows=8)
+        c = ens.meta["criteria_matrix"]
+        for b in range(y.shape[0]):
+            fin = np.isfinite(c[:, b])
+            if fin.sum() < 2:
+                continue
+            order = np.argsort(c[fin, b])
+            wts = ens.weights[fin, b][order]
+            assert (np.diff(wts) <= 1e-12).all()
+
+    def test_fresh_fit_path_and_criterion_weights_unit(self):
+        y = make_panel(8, 80, seed=9, ragged=False)
+        ens = fc.ensemble_forecast(
+            y, 3, orders=[(1, 0, 0), (0, 0, 1)], temperature=1.0,
+            chunk_rows=8, fit_kwargs={"max_iters": 15})
+        assert np.allclose(ens.weights.sum(0)[ens.order_index >= 0], 1.0)
+        # unit: all-inf column -> zero weights; temperature=0 one-hot
+        c = np.array([[1.0, np.inf], [2.0, np.inf]])
+        w = fc.criterion_weights(c, 1.0)
+        assert np.allclose(w[:, 0].sum(), 1.0) and (w[:, 1] == 0).all()
+        w0 = fc.criterion_weights(c, 0.0)
+        assert w0[0, 0] == 1.0 and w0[1, 0] == 0.0
+
+    def test_seasonal_orders_rejected(self, ens_inputs):
+        y, _ = ens_inputs
+        with pytest.raises(ValueError, match="seasonal"):
+            fc.ensemble_forecast(y, 4,
+                                 orders=[(1, 0, 0, (1, 0, 0, 4))],
+                                 fit_kwargs={"max_iters": 5})
+
+
+# ---------------------------------------------------------------------------
+# surfaces: panel, compat, serving
+# ---------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def test_panel_forecast(self, panel, fitres):
+        from spark_timeseries_tpu import TimeSeriesPanel, index as dtix
+
+        p = TimeSeriesPanel(
+            dtix.uniform("2020-01-01", T, dtix.DayFrequency(1)),
+            [f"s{i}" for i in range(B)], panel)
+        res = p.forecast("arima", H, fitres, order=ORDER)
+        direct = fc.forecast_chunked("arima", fitres, panel, H,
+                                     model_kwargs=MK)
+        assert np.array_equal(res.forecast, direct.forecast,
+                              equal_nan=True)
+
+    def test_panel_backtest(self):
+        from spark_timeseries_tpu import TimeSeriesPanel, index as dtix
+
+        y = make_panel(8, 80, seed=2, ragged=False)
+        p = TimeSeriesPanel(
+            dtix.uniform("2020-01-01", 80, dtix.DayFrequency(1)),
+            [f"s{i}" for i in range(8)], y)
+        bt = p.backtest("arima", 4, model_kwargs={"order": (1, 0, 0)},
+                        fit_kwargs={"max_iters": 10}, n_windows=2,
+                        chunk_rows=8)
+        assert bt.meta["windows_committed"] == 2
+
+    def test_compat_forecast_panel(self, panel, fitres):
+        from spark_timeseries_tpu.compat import sparkts
+
+        m = sparkts.ARIMAModel(*ORDER, np.asarray(fitres.params))
+        res = m.forecast_panel(panel, H)
+        direct = fc.forecast_chunked("arima", np.asarray(fitres.params),
+                                     panel, H, model_kwargs=MK)
+        assert np.array_equal(res.forecast, direct.forecast,
+                              equal_nan=True)
+
+    def test_compat_garch_forecast(self):
+        from spark_timeseries_tpu.compat import sparkts
+
+        rng = np.random.default_rng(5)
+        r = (0.05 * rng.normal(size=160)).astype(np.float32)
+        m = sparkts.GARCH.fit_model(r)
+        out = m.forecast(r, 4)
+        assert out.shape == (4,) and (np.isnan(out) | (out > 0)).all()
+
+    def test_compat_broadcast_shared_params(self):
+        from spark_timeseries_tpu.compat import sparkts
+
+        y = make_panel(4, 64, seed=3, ragged=False)
+        res = ewma.fit(y[0], max_iters=20)
+        m = sparkts.EWMAModel(res.params)
+        out = m.forecast_panel(y, 3)  # one param row broadcast to 4
+        assert out.forecast.shape == (4, 3)
+
+    def test_serving_batched_equals_solo(self, panel, fitres, tmp_path):
+        params = np.asarray(fitres.params)
+        kw = dict(model="arima", horizon=H, model_kwargs=MK,
+                  intervals=True, n_samples=16, seed=3)
+        # dense slices: rows 0-8 carry the panel's NaN rows, whose aug
+        # panels probe a different align mode and (correctly) refuse to
+        # share a batch key with the dense requests
+        srv = serving.FitServer(str(tmp_path / "a"), cell_rows=8,
+                                batch_window_s=0.05, autotune=False)
+        t1 = srv.submit_forecast("a", panel[8:16], params[8:16], **kw)
+        t2 = srv.submit_forecast("b", panel[16:24], params[16:24], **kw)
+        srv.start()
+        r1 = t1.result(timeout=600)
+        t2.result(timeout=600)
+        srv.stop()
+        assert r1.meta["batch_members"] == 2
+        with serving.FitServer(str(tmp_path / "b"), cell_rows=8,
+                               batch_window_s=0.0, max_batch_rows=8,
+                               autotune=False) as solo:
+            rs = solo.submit_forecast("a", panel[8:16], params[8:16],
+                                      **kw).result(timeout=600)
+        _assert_same(fc.as_result(r1, H, True), fc.as_result(rs, H, True),
+                     "served-batched-vs-solo")
+
+    def test_serving_forecast_never_resilient(self, panel, fitres,
+                                              tmp_path):
+        """A resilient=True server must NOT run the sanitize/retry
+        ladder over an augmented forecast panel."""
+        params = np.asarray(fitres.params)
+        with serving.FitServer(str(tmp_path / "r"), cell_rows=8,
+                               batch_window_s=0.0, resilient=True,
+                               autotune=False) as srv:
+            r = srv.submit_forecast("a", panel[:8], params[:8],
+                                    model="arima", horizon=H,
+                                    model_kwargs=MK).result(timeout=600)
+        direct = fc.forecast_chunked("arima", params[:8], panel[:8], H,
+                                     model_kwargs=MK,
+                                     status=np.asarray(
+                                         fitres.status[:8]), chunk_rows=8)
+        # NOTE: submit_forecast derives status from params finiteness
+        # when none is passed; compare through the same derivation
+        direct2 = fc.forecast_chunked("arima", params[:8], panel[:8], H,
+                                      model_kwargs=MK, chunk_rows=8)
+        got = fc.as_result(r, H, False)
+        assert np.array_equal(got.forecast, direct2.forecast,
+                              equal_nan=True)
+        del direct
+
+    def test_advise_budget_horizon_aware(self, panel, fitres, tmp_path):
+        sys.path.insert(0, TOOLS)
+        import advise_budget
+
+        d = str(tmp_path / "fcj")
+        fc.forecast_chunked("arima", fitres, panel, H, model_kwargs=MK,
+                            intervals=True, n_samples=16, chunk_rows=4,
+                            checkpoint_dir=d)
+        m = advise_budget.load_manifest(d)
+        a = advise_budget.advise(m)
+        assert a["observed"]["forecast"]["horizon"] == H
+        assert a["suggest"]["forecast"]["chunk_rows_at_2x_horizon"] >= 1
+
+    def test_obs_counters(self, panel, fitres, tmp_path):
+        obs.enable(str(tmp_path / "ev.jsonl"))
+        try:
+            fc.forecast_chunked("arima", fitres, panel, H,
+                                model_kwargs=MK)
+        finally:
+            snap = obs.snapshot()
+            obs.disable()
+        assert snap["counters"].get("forecast.walks", 0) >= 1

@@ -39,13 +39,14 @@ def _hits(findings, rule, include_waived=False):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("rule", sorted(selftest.FIXTURES))
-def test_checker_catches_seeded_violation(rule):
-    path, bad, good, checkers = selftest.FIXTURES[rule]
+@pytest.mark.parametrize("key", sorted(selftest.FIXTURES))
+def test_checker_catches_seeded_violation(key):
+    path, bad, good, checkers = selftest.FIXTURES[key]
+    rule = selftest.fixture_rule(key)
     assert _hits(lint_source(bad, path, checkers), rule), \
-        f"{rule}: seeded violation not caught"
+        f"{key}: seeded violation not caught"
     assert not _hits(lint_source(good, path, checkers), rule), \
-        f"{rule}: clean twin flagged"
+        f"{key}: clean twin flagged"
 
 
 def test_self_test_entry_point():

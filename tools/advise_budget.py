@@ -300,6 +300,40 @@ def advise(m: dict) -> dict:
                 for q in quarantined],
         }
 
+    # -- forecast walks: horizon-aware chunk sizing (ISSUE 14) ---------------
+    # a forecast manifest (`extra.forecast`) records the walk's horizon,
+    # augmented width, and Monte-Carlo sampling config; the per-row
+    # working set then scales with horizon (packed output + S simulated
+    # paths), so the proven chunk size carries as a rows x working-set
+    # budget — the next run at horizon h' solves rows from the same
+    # budget instead of replaying the OOM ladder
+    forecast_extra = (m.get("extra") or {}).get("forecast") or {}
+    forecast_obs = None
+    forecast_suggest = None
+    if forecast_extra:
+        fh = int(forecast_extra.get("horizon") or 1)
+        f_nt = int(forecast_extra.get("n_time") or 0)
+        f_k = int(forecast_extra.get("k") or 0)
+        f_iv = bool(forecast_extra.get("intervals"))
+        f_ns = int(forecast_extra.get("n_samples") or 0) if f_iv else 0
+        row_floats = (f_nt + f_k + 2) + fh * (3 if f_iv else 1) + f_ns * fh
+        budget_floats = sustained * row_floats  # proven per-chunk set
+        forecast_obs = {
+            "model": forecast_extra.get("model"),
+            "horizon": fh,
+            "intervals": f_iv,
+            "n_samples": f_ns or None,
+            "row_working_set_floats": row_floats,
+        }
+        forecast_suggest = {
+            "horizon": fh,
+            # rows for a DIFFERENT horizon h': budget // working_set(h')
+            "chunk_rows_working_set_floats": budget_floats,
+            "chunk_rows_at_2x_horizon": max(1, budget_floats // (
+                (f_nt + f_k + 2) + 2 * fh * (3 if f_iv else 1)
+                + f_ns * 2 * fh)),
+        }
+
     return {
         "config_hash": m.get("config_hash"),
         "panel_fingerprint": m.get("panel_fingerprint"),
@@ -328,6 +362,7 @@ def advise(m: dict) -> dict:
             "staging_pool": pool_obs,
             "shards": shard_obs,
             "rebalance": rebalance_obs,
+            "forecast": forecast_obs,
         },
         "suggest": {
             "chunk_rows": chunk_rows,
@@ -343,6 +378,7 @@ def advise(m: dict) -> dict:
             "chunk_rows_per_shard": chunk_rows_sharded,
             "lane_retries": lane_retries,
             "rebalance_threshold": rebalance_threshold,
+            "forecast": forecast_suggest,
         },
     }
 
@@ -767,6 +803,15 @@ def main():
               f"{s['staging_pool_buffers']})")
     if s["align_mode"] is not None:
         print(f"    align_mode     = {s['align_mode']!r}")
+    if s.get("forecast") is not None:
+        fo, fs = o["forecast"], s["forecast"]
+        print(f"    horizon-aware chunk_rows: this forecast walk proved "
+              f"rows x working-set <= {fs['chunk_rows_working_set_floats']}"
+              f" floats at horizon {fs['horizon']}"
+              + (f" ({fo['n_samples']} interval samples/row)"
+                 if fo["intervals"] else "")
+              + f"; at 2x the horizon use chunk_rows <= "
+                f"{fs['chunk_rows_at_2x_horizon']}")
     print(f"    shards         = {s['shards']}  (shard=True/mesh=; clamped "
           "to the mesh's series devices at runtime)")
     if s["shards"] > 1:

@@ -24,6 +24,12 @@ HOT_PATH_PREFIXES = (
     "spark_timeseries_tpu/reliability/",
     "spark_timeseries_tpu/models/",
     "spark_timeseries_tpu/utils/optim.py",
+    # the forecast walk's kernels and chunk program run INSIDE the
+    # pipelined walk — an implicit sync there stalls stage/compute/commit
+    # exactly like a model fit would (backtest/ensemble drivers assemble
+    # host-side between walks and are not hot)
+    "spark_timeseries_tpu/forecasting/kernels.py",
+    "spark_timeseries_tpu/forecasting/walk.py",
 )
 
 # ---------------------------------------------------------------------------
@@ -144,6 +150,140 @@ CONFIG_HASH_SURFACES = {
                       "fingerprint, which follows the source domain",
         },
     },
+    "spark_timeseries_tpu/forecasting/walk.py::forecast_chunked": {
+        "hashed": {
+            "model": "reaches forecast_fit's `forecast_model` kwarg "
+                     "(hashed wholesale by config_hash)",
+            "fitted": "params + statuses become augmented-panel COLUMNS, "
+                      "covered by the panel fingerprint",
+            "y": "panel fingerprint (content-sampled augmented panel)",
+            "horizon": "forecast_fit kwarg (hashed)",
+            "model_kwargs": "normalized tuple, forecast_fit kwarg "
+                            "(hashed)",
+            "status": "augmented-panel status column, covered by the "
+                      "panel fingerprint",
+            "intervals": "forecast_fit kwarg (hashed)",
+            "level": "forecast_fit kwarg (hashed)",
+            "n_samples": "forecast_fit kwarg (hashed)",
+            "seed": "resolved into base_seed, a forecast_fit kwarg "
+                    "(hashed) — a different seed is a different interval "
+                    "job",
+            "chunk_rows": "forwarded to fit_chunked (hashed there)",
+        },
+        "excluded": {
+            "checkpoint_dir": "see fit_chunked",
+            "resume": "see fit_chunked",
+            "chunk_budget_s": "see fit_chunked",
+            "job_budget_s": "see fit_chunked",
+            "pipeline": "see fit_chunked",
+            "pipeline_depth": "see fit_chunked",
+            "prefetch_depth": "see fit_chunked",
+            "shard": "see fit_chunked",
+            "mesh": "see fit_chunked",
+            "_journal_commit_hook": "fault-injection instrumentation "
+                                    "(tests only)",
+        },
+    },
+    "spark_timeseries_tpu/forecasting/backtest.py::run_backtest": {
+        "hashed": {
+            "model": "campaign_hash extra= key 'model' (and each "
+                     "window's walk hashes its own fit config)",
+            "y": "campaign panel_fingerprint (stale manifests rejected)",
+            "horizon": "campaign_hash extra= key 'horizon'",
+            "origins": "campaign_hash extra= key 'origins'",
+            "n_windows": "resolved into origins (hashed)",
+            "min_train": "resolved into origins (hashed)",
+            "model_kwargs": "campaign_hash extra= key 'model_kwargs'",
+            "fit_kwargs": "hashed wholesale through the campaign fit_fn "
+                          "partial and each window walk's config hash",
+            "warm_start": "campaign_hash extra= key 'warm_start' — warm "
+                          "and cold windows fit different programs",
+            "intervals": "campaign_hash extra= key 'intervals'",
+            "level": "campaign_hash extra= key 'level'",
+            "n_samples": "campaign_hash extra= key 'n_samples'",
+            "seed": "campaign_hash extra= key 'seed'",
+            "chunk_rows": "campaign_hash extra= key 'chunk_rows' (low "
+                          "order bits follow the chunk grid, so metrics "
+                          "identity requires the same grid)",
+        },
+        "excluded": {
+            "checkpoint_dir": "the campaign's LOCATION, not its "
+                              "identity (see fit_chunked)",
+            "resume": "see fit_chunked",
+            "pipeline": "see fit_chunked",
+            "pipeline_depth": "see fit_chunked",
+            "prefetch_depth": "see fit_chunked",
+            "shard": "see fit_chunked",
+            "mesh": "see fit_chunked",
+            "chunk_budget_s": "see fit_chunked",
+            "job_budget_s": "wall-clock bound; timed-out windows are "
+                            "per-run status, retried on resume",
+            "server": "routes window forecasts through a FitServer's "
+                      "batching — placement, not content (batched == "
+                      "solo bitwise is the server's contract)",
+            "_journal_commit_hook": "fault-injection instrumentation "
+                                    "(tests only)",
+        },
+    },
+    "spark_timeseries_tpu/panel.py::TimeSeriesPanel.forecast": {
+        "kwargs_param": "model_kwargs",
+        "hashed": {
+            "model": "forwarded to forecast_chunked (hashed there)",
+            "horizon": "forwarded to forecast_chunked (hashed there)",
+            "fitted": "forwarded to forecast_chunked (fingerprinted "
+                      "there)",
+            "status": "forwarded to forecast_chunked (fingerprinted "
+                      "there)",
+            "intervals": "forwarded to forecast_chunked (hashed there)",
+            "level": "forwarded to forecast_chunked (hashed there)",
+            "n_samples": "forwarded to forecast_chunked (hashed there)",
+            "seed": "forwarded to forecast_chunked (hashed there)",
+            "chunk_rows": "forwarded to fit_chunked (hashed there)",
+        },
+        "excluded": {
+            "checkpoint_dir": "see fit_chunked",
+            "resume": "see fit_chunked",
+            "chunk_budget_s": "see fit_chunked",
+            "job_budget_s": "see fit_chunked",
+            "pipeline": "see fit_chunked",
+            "pipeline_depth": "see fit_chunked",
+            "prefetch_depth": "see fit_chunked",
+            "shard": "see fit_chunked",
+            "mesh": "see fit_chunked",
+            "source": "placement spelling; panel identity is carried by "
+                      "the augmented-panel fingerprint, which samples "
+                      "VALUES in every residency",
+            "_journal_commit_hook": "fault-injection instrumentation "
+                                    "(tests only)",
+        },
+    },
+    "spark_timeseries_tpu/serving/server.py::FitServer.submit_forecast": {
+        "hashed": {
+            "values": "augmented-panel fingerprint via the batch walk's "
+                      "journal",
+            "fitted": "params/status columns of the augmented panel "
+                      "(fingerprinted)",
+            "model": "rides as forecast_fit's `forecast_model` fit "
+                     "kwarg (hashed)",
+            "horizon": "forecast_fit kwarg (hashed)",
+            "model_kwargs": "forecast_fit kwarg (hashed, JSON "
+                            "canonicalized at admission)",
+            "status": "augmented-panel status column (fingerprinted)",
+            "intervals": "forecast_fit kwarg (hashed)",
+            "level": "forecast_fit kwarg (hashed)",
+            "n_samples": "forecast_fit kwarg (hashed)",
+            "seed": "resolved into base_seed, a forecast_fit kwarg "
+                    "(hashed)",
+        },
+        "excluded": {
+            "tenant": "admission/quota identity (see FitServer.submit)",
+            "priority": "shedding order under overload; never reaches "
+                        "the walk",
+            "deadline_s": "per-request wall-clock deadline (watchdog "
+                          "contract)",
+            "request_id": "idempotency identity for the durable record",
+        },
+    },
     "spark_timeseries_tpu/serving/server.py::FitServer.submit": {
         "kwargs_param": "fit_kwargs",
         "hashed": {
@@ -230,6 +370,15 @@ FILE_WRITE_OWNERS = {
                                 "the search root (per-order walk "
                                 "manifests belong to ChunkJournal)",
     },
+    "spark_timeseries_tpu/forecasting/backtest.py": {
+        "_write_backtest_manifest": "sole writer of the campaign-level "
+                                    "backtest_manifest.json (per-window "
+                                    "fit-walk manifests belong to "
+                                    "ChunkJournal)",
+        "_write_metrics_npz": "sole writer of the per-window metrics "
+                              "npz shards next to the campaign "
+                              "manifest (atomic tmp->fsync->replace)",
+    },
 }
 
 # ---------------------------------------------------------------------------
@@ -249,6 +398,7 @@ LOCKMAP_RUNTIME_CLASSES = (
     "spark_timeseries_tpu.reliability.journal:ChunkJournal",
     "spark_timeseries_tpu.reliability.source:StagingPool",
     "spark_timeseries_tpu.reliability.source:ChunkSource",
+    "spark_timeseries_tpu.forecasting.augment:ColumnBlockSource",
     "spark_timeseries_tpu.serving.admission:TenantQuota",
     "spark_timeseries_tpu.serving.admission:AdmissionQueue",
     "spark_timeseries_tpu.serving.session:FitTicket",

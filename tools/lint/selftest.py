@@ -29,8 +29,14 @@ def _fix(s: str) -> str:
     return textwrap.dedent(s).lstrip("\n")
 
 
-# each entry: rule -> (path, bad source, good source, checkers-or-None)
+# each entry: rule (optionally "rule/variant" for extra seeded cases of
+# one rule) -> (path, bad source, good source, checkers-or-None)
 FIXTURES: Dict[str, Tuple[str, str, str, Optional[List[Callable]]]] = {}
+
+
+def fixture_rule(key: str) -> str:
+    """The rule a fixture key seeds (keys may carry a '/variant')."""
+    return key.split("/", 1)[0]
 
 FIXTURES["host-sync"] = (HOT, _fix("""
     import jax.numpy as jnp
@@ -72,6 +78,49 @@ FIXTURES["config-hash"] = (HOT, _fix("""
                           extra={"chunk_rows": chunk_rows})
         return cfg
     """), [functools.partial(confighash.check, surfaces=_SURFACES)])
+
+# ISSUE 14: the forecasting surfaces joined the registries — seed a
+# violation of each NEW entry shape so a checker that stopped matching
+# them cannot pass vacuously.  (a) config-hash: a forecast-walk-shaped
+# surface grows an unregistered knob; (b) journal-writer: a rogue helper
+# writes backtest_manifest.json outside the registered owner.
+_FC = "spark_timeseries_tpu/forecasting/fixture.py"
+_FC_SURFACES = {
+    f"{_FC}::forecast_fixture": {
+        "hashed": {"horizon": "forecast_fit kwarg (hashed)",
+                   "seed": "resolved into base_seed (hashed)"},
+        "excluded": {"checkpoint_dir": "journal location, not identity"},
+    },
+}
+
+FIXTURES["config-hash/forecast"] = (_FC, _fix("""
+    def forecast_fixture(*, horizon=1, seed=None, checkpoint_dir=None,
+                         band_style=None):
+        return horizon, seed, checkpoint_dir, band_style
+    """), _fix("""
+    def forecast_fixture(*, horizon=1, seed=None, checkpoint_dir=None):
+        return horizon, seed, checkpoint_dir
+    """), [functools.partial(confighash.check, surfaces=_FC_SURFACES)])
+
+_FC_OWNERS = {_FC: {"_write_backtest_manifest":
+                    "sole writer of the campaign manifest"}}
+
+FIXTURES["journal-writer/backtest"] = (_FC, _fix("""
+    import os
+
+    def rogue_campaign_note(root, data):
+        path = os.path.join(root, "backtest_manifest.json")
+        with open(path, "w") as f:     # unregistered writer
+            f.write(data)
+    """), _fix("""
+    import os
+
+    def _write_backtest_manifest(root, data):
+        path = os.path.join(root, "backtest_manifest.json")
+        with open(path, "w") as f:
+            f.write(data)
+        os.replace(path, path)
+    """), [functools.partial(journalwriter.check, owners=_FC_OWNERS)])
 
 _OWNERS = {HOT: {"Owner": "fixture namespace owner"}}
 
@@ -194,16 +243,17 @@ def _only(rule: str, findings: List[Finding],
 def run_self_test(verbose: bool = True) -> List[str]:
     """Returns a list of failure descriptions (empty = pass)."""
     failures: List[str] = []
-    for rule, (path, bad, good, checkers) in FIXTURES.items():
+    for key, (path, bad, good, checkers) in FIXTURES.items():
+        rule = fixture_rule(key)
         got_bad = _only(rule, lint_source(bad, path, checkers))
         got_good = _only(rule, lint_source(good, path, checkers))
         if not got_bad:
             failures.append(
-                f"{rule}: checker MISSED its seeded violation — the "
+                f"{key}: checker MISSED its seeded violation — the "
                 "guard is broken")
         if got_good:
             failures.append(
-                f"{rule}: checker flagged the clean fixture: "
+                f"{key}: checker flagged the clean fixture: "
                 + "; ".join(f.message for f in got_good))
         if verbose and not failures:
             pass

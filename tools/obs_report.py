@@ -115,6 +115,11 @@ def validate_manifest_telemetry(ckpt_dir: str) -> list:
         if (os.path.exists(os.path.join(path, "auto_manifest.json"))
                 and not os.path.exists(os.path.join(path, "manifest.json"))):
             return validate_auto_manifest(path)
+        if (os.path.exists(os.path.join(path, "backtest_manifest.json"))
+                and not os.path.exists(os.path.join(path, "manifest.json"))):
+            # a backtest campaign root (ISSUE 14): campaign manifest +
+            # per-window fit journals, no root manifest.json
+            return validate_backtest_manifest(path)
         path = os.path.join(path, "manifest.json")
     try:
         with open(path, "rb") as f:
@@ -335,6 +340,113 @@ def validate_auto_manifest(root: str) -> list:
             if os.path.exists(os.path.join(sub, "manifest.json")):
                 errors += [f"{d}: {e}"
                            for e in validate_manifest_telemetry(sub)]
+    return errors
+
+
+def validate_backtest_manifest(root: str) -> list:
+    """Validate a rolling-origin backtest campaign root (ISSUE 14).
+
+    Checks the campaign-level ``backtest_manifest.json`` (identity
+    fields, ascending origins, per-window entries with metric vectors of
+    horizon length), verifies each committed window's metrics npz exists
+    and matches its recorded content digest, and recurses into every
+    window's fit-walk journal when it carries a telemetry block.
+    """
+    import hashlib
+
+    import numpy as np
+
+    errors = []
+    mp = os.path.join(root, "backtest_manifest.json")
+    try:
+        with open(mp, "rb") as f:
+            m = json.loads(f.read().decode())
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        return [f"backtest manifest {mp}: unreadable ({e})"]
+    if m.get("kind") != "backtest":
+        errors.append(f"backtest manifest: kind {m.get('kind')!r} != "
+                      "'backtest'")
+    for key in ("campaign_hash", "panel_fingerprint", "model"):
+        if not isinstance(m.get(key), str) or not m.get(key):
+            errors.append(f"backtest manifest: missing {key}")
+    horizon = m.get("horizon")
+    if not isinstance(horizon, int) or horizon < 1:
+        errors.append(f"backtest manifest: bad horizon {horizon!r}")
+        horizon = None
+    origins = m.get("origins")
+    if (not isinstance(origins, list) or not origins
+            or origins != sorted(origins)):
+        errors.append(f"backtest manifest: origins not an ascending "
+                      f"list: {origins!r}")
+        origins = None
+    windows = m.get("windows")
+    if not isinstance(windows, list):
+        return errors + ["backtest manifest: windows missing"]
+    seen = set()
+    for w in windows:
+        i = w.get("index")
+        if not isinstance(i, int) or (origins is not None
+                                      and not 0 <= i < len(origins)):
+            errors.append(f"backtest window {i!r}: bad index")
+            continue
+        if i in seen:
+            errors.append(f"backtest window {i}: duplicate entry")
+        seen.add(i)
+        if origins is not None and w.get("origin") != origins[i]:
+            errors.append(f"backtest window {i}: origin {w.get('origin')} "
+                          f"!= manifest origins[{i}] {origins[i]}")
+        if w.get("status") not in ("committed", "timeout"):
+            errors.append(f"backtest window {i}: bad status "
+                          f"{w.get('status')!r}")
+            continue
+        if w.get("status") != "committed":
+            continue
+        for key in ("mae", "rmse", "mape"):
+            v = w.get(key)
+            if (not isinstance(v, list)
+                    or (horizon is not None and len(v) != horizon)):
+                errors.append(f"backtest window {i}: {key} is not a "
+                              f"length-{horizon} vector")
+        mf = w.get("metrics_file")
+        if mf:
+            npz_path = os.path.join(root, mf)
+            import zipfile
+
+            try:
+                with np.load(npz_path, allow_pickle=False) as z:
+                    arrays = {key: np.array(z[key]) for key in z.files}
+            except (OSError, ValueError, KeyError,
+                    zipfile.BadZipFile) as e:
+                errors.append(f"backtest window {i}: metrics shard "
+                              f"{mf} unreadable ({e})")
+                continue
+            h = hashlib.sha256()
+            for name in sorted(arrays):
+                a = np.ascontiguousarray(arrays[name])
+                h.update(f"{name}:{a.shape}:{a.dtype}".encode())
+                h.update(a.tobytes())
+            if h.hexdigest()[:16] != w.get("digest"):
+                errors.append(f"backtest window {i}: metrics shard "
+                              f"digest mismatch (torn write?)")
+        fd = w.get("fit_dir")
+        if fd:
+            wmp = os.path.join(root, fd, "manifest.json")
+            if not os.path.exists(wmp):
+                errors.append(f"backtest window {i}: fit journal "
+                              f"{fd}/manifest.json missing")
+            else:
+                try:
+                    with open(wmp, "rb") as f:
+                        wm = json.loads(f.read().decode())
+                except (OSError, json.JSONDecodeError,
+                        UnicodeDecodeError) as e:
+                    errors.append(f"backtest window {i}: fit manifest "
+                                  f"unreadable ({e})")
+                    continue
+                if isinstance(wm.get("telemetry"), dict):
+                    errors += [f"window {i}: {e2}" for e2 in
+                               validate_manifest_telemetry(
+                                   os.path.join(root, fd))]
     return errors
 
 
@@ -591,6 +703,38 @@ def _render(s: dict) -> None:
                        if str(ev.get("name", "")).startswith("stage.")]
             staging_ids = {id(ev) for ev in staging}
             rows = [ev for ev in rows if id(ev) not in staging_ids]
+        # backtest campaigns (ISSUE 14) wrap each expanding window in a
+        # backtest.window span: split the stream into ONE LANE PER
+        # WINDOW (rows falling inside the window's wall interval) so the
+        # refit-and-score sweep reads as W parallel-structured rows,
+        # with campaign-level rows kept in their own section
+        wins = [ev for ev in rows if ev["kind"] == "span"
+                and ev.get("name") == "backtest.window"]
+        if wins:
+            wins.sort(key=lambda ev: (ev.get("attrs") or {})
+                      .get("window", 0))
+            taken = {id(ev) for ev in wins}
+            print(f"\ntimeline (s from start; {len(wins)} backtest "
+                  "window lanes):")
+            for wspan in wins:
+                attrs = wspan.get("attrs") or {}
+                w0 = wspan.get("t0", 0.0)
+                w1 = w0 + wspan.get("wall_s", 0.0)
+                mine = [ev for ev in rows if id(ev) not in taken
+                        and w0 <= ev.get("t0", ev.get("ts", 0.0)) <= w1]
+                taken.update(id(ev) for ev in mine)
+                print(f"  window {attrs.get('window')} "
+                      f"origin={attrs.get('origin')}  "
+                      f"({len(mine)} rows, wall "
+                      f"{wspan.get('wall_s', 0.0):.4f}s):")
+                for ev in mine:
+                    _row(ev, pad="    ")
+            drv = [ev for ev in rows if id(ev) not in taken]
+            if drv:
+                print("  campaign driver:")
+                for ev in drv:
+                    _row(ev, pad="    ")
+            rows = []
         # sharded walks (ISSUE 6) tag every lane's spans/events with its
         # shard id: split the merged stream into ONE LANE PER SHARD so the
         # concurrent walks read as parallel rows, with the driver-level
@@ -661,7 +805,7 @@ def _render(s: dict) -> None:
                     print("  search driver:")
                     for ev in drv:
                         _row(ev, pad="    ")
-            else:
+            elif rows:  # empty when the campaign lanes consumed them
                 print("\ntimeline (s from start):")
                 for ev in rows:
                     _row(ev)
