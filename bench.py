@@ -2176,6 +2176,139 @@ def _forecast_northstar(jnp, quick, on_tpu):
     }
 
 
+def _delta_refit_northstar(jnp, quick, on_tpu):
+    """ISSUE 15 acceptance: tick-to-fit — refit cost vs fraction touched.
+
+    The target scenario is a market-data feed mutating a fitted panel.
+    Two legs, both journaled and both proven bitwise:
+
+    - **10%-dirty delta** (the floor-gated headline): fit the panel once,
+      revise the rows of 10% of its chunks, then refit — a full cold
+      walk vs ``fit_chunked(delta_from=...)``, which adopts the 90% of
+      chunks whose content fingerprints still match and recomputes only
+      the dirty 10%.  ``delta_gate_ok`` requires the delta refit >= 3x
+      faster than the full refit AND bitwise-identical to it.
+    - **appended-ticks warm delta**: append new time steps to every row
+      (``write_npz_shards(append_time=...)``'s in-memory twin) and refit
+      warm-started from the journaled params — reported as
+      ``warm_speedup`` vs the full cold refit of the grown panel, with
+      warm results pinned bitwise against a warm-started full walk.
+    """
+    import tempfile
+
+    from spark_timeseries_tpu import reliability as rel
+    from spark_timeseries_tpu.models import arima as _arima
+    from spark_timeseries_tpu.reliability import delta as delta_mod
+
+    if on_tpu and not quick:
+        b, t_len, iters, n_chunks = 131_072, 1000, 60, 20
+    elif quick:
+        b, t_len, iters, n_chunks = 160, 120, 15, 20
+    else:
+        # sized so the per-chunk FIT dominates the walk (like any real
+        # refit): the delta win is compute avoided, and a toy fit would
+        # bench the journal's I/O instead
+        b, t_len, iters, n_chunks = 2560, 512, 96, 20
+    order = (1, 0, 1)
+    chunk_rows = b // n_chunks
+    y = gen_arima_panel(b, t_len, seed=45)
+    root = tempfile.mkdtemp(prefix="deltans_")
+    kw = dict(chunk_rows=chunk_rows, resilient=False, order=order,
+              max_iters=iters)
+
+    # the original fit: its v2 manifest carries the chunk fingerprints
+    # every later delta diffs against (warm pass: compiles the program)
+    rel.fit_chunked(_arima.fit, jnp.asarray(y),
+                    checkpoint_dir=os.path.join(root, "full"), **kw)
+
+    # -- leg 1: 10% of chunks revised -----------------------------------
+    dirty_chunks = max(1, n_chunks // 10)
+    y2 = np.array(y)
+    y2[:dirty_chunks * chunk_rows] += np.float32(0.01)
+    y2j = jnp.asarray(y2)
+    t0 = time.perf_counter()
+    ref = rel.fit_chunked(_arima.fit, y2j,
+                          checkpoint_dir=os.path.join(root, "ref"), **kw)
+    wall_full = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    d = rel.fit_chunked(_arima.fit, y2j,
+                        checkpoint_dir=os.path.join(root, "delta"),
+                        delta_from=os.path.join(root, "full"), **kw)
+    wall_delta = time.perf_counter() - t0
+    bitwise = all(
+        np.array_equal(np.asarray(getattr(ref, f)),
+                       np.asarray(getattr(d, f)), equal_nan=True)
+        for f in ("params", "neg_log_likelihood", "converged", "iters",
+                  "status"))
+    counts = d.meta["delta"]["counts"]
+    dirty_fraction = 1.0 - counts["adopted"] / max(1, sum(counts.values()))
+    speedup = wall_full / wall_delta if wall_delta > 0 else None
+
+    # -- leg 2: ticks appended to every row (warm-start refit) ----------
+    ticks = max(8, t_len // 16)
+    y3 = np.concatenate(
+        [np.array(y), gen_arima_panel(b, ticks, seed=46)
+         + np.array(y)[:, -1:]], axis=1).astype(np.float32)
+    y3j = jnp.asarray(y3)
+    t0 = time.perf_counter()
+    # full cold refit of the grown panel — JOURNALED like the delta side,
+    # so the pair measures the warm start, not journal-I/O asymmetry
+    rel.fit_chunked(_arima.fit, y3j,
+                    checkpoint_dir=os.path.join(root, "grown_full"), **kw)
+    wall_grown_full = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    w = rel.fit_chunked(_arima.fit, y3j,
+                        checkpoint_dir=os.path.join(root, "warm"),
+                        delta_from=os.path.join(root, "full"), **kw)
+    wall_warm = time.perf_counter() - t0
+    # warm results verify against a warm-started FULL walk with the same
+    # inits (warm starts change iteration counts, so the cold walk is
+    # not the reference for this leg)
+    plan = rel.plan_delta(os.path.join(root, "full"), y3,
+                          chunk_rows=chunk_rows)
+    wref = rel.fit_chunked(
+        delta_mod.WarmstartFit(_arima.fit, t_len + ticks, plan.k),
+        delta_mod.warm_panel(y3j, plan.init), align_mode="dense", **kw)
+    warm_bitwise = all(
+        np.array_equal(np.asarray(getattr(wref, f)),
+                       np.asarray(getattr(w, f)), equal_nan=True)
+        for f in ("params", "neg_log_likelihood", "converged", "iters",
+                  "status"))
+    warm_speedup = (wall_grown_full / wall_warm
+                    if wall_warm > 0 else None)
+    # quick (CI smoke) sizes are deliberately tiny, so the fixed plan/
+    # adopt I/O dominates and the 3x floor is meaningless there — quick
+    # gates on the bitwise contracts; full runs gate the speedup floor
+    gate_ok = bool(bitwise and warm_bitwise
+                   and (quick or (speedup is not None and speedup >= 3.0)))
+    import shutil
+
+    shutil.rmtree(root, ignore_errors=True)
+    return {
+        "series_total": b,
+        "obs_per_series": t_len,
+        "chunks": n_chunks,
+        "dirty_fraction": round(dirty_fraction, 4),
+        "delta_counts": counts,
+        "wall_s_full_refit": round(wall_full, 3),
+        "wall_s_delta_refit": round(wall_delta, 3),
+        "delta_speedup": round(speedup, 3) if speedup else None,
+        "delta_bitwise_identical": bool(bitwise),
+        "appended_ticks": ticks,
+        "warm_counts": w.meta["delta"]["counts"],
+        "wall_s_grown_full_refit": round(wall_grown_full, 3),
+        "wall_s_warm_delta": round(wall_warm, 3),
+        "warm_speedup": round(warm_speedup, 3) if warm_speedup else None,
+        "warm_bitwise_vs_warm_reference": bool(warm_bitwise),
+        "delta_gate_ok": gate_ok,
+        "data": f"journaled delta refits of a {b} x {t_len} panel "
+                f"({n_chunks} chunks): {dirty_chunks}-chunk revision "
+                "adopts the rest byte-for-byte (floor: >=3x vs full "
+                f"refit), then {ticks} appended ticks warm-start every "
+                "chunk from the journaled params",
+    }
+
+
 def bench_arima_headline(jnp, quick, on_tpu, n_chips, platform, parity=None):
     from spark_timeseries_tpu.models import arima
 
@@ -2259,6 +2392,12 @@ def bench_arima_headline(jnp, quick, on_tpu, n_chips, platform, parity=None):
     # ensemble overhead
     _progress("config 3: forecast north-star (journaled forecast walk)...")
     acct["forecast_northstar"] = _forecast_northstar(jnp, quick, on_tpu)
+    # ISSUE 15: tick-to-fit — a 10%-dirty panel revision refit as a delta
+    # walk (adopt clean chunks, recompute dirty) vs the full refit, plus
+    # the appended-ticks warm-start leg
+    _progress("config 3: delta-refit north-star (incremental refit)...")
+    acct["delta_refit_northstar"] = _delta_refit_northstar(jnp, quick,
+                                                           on_tpu)
 
     cpu_rate, n_done = cpu_rate_arima(t, 2.0 if quick else CPU_BUDGET_S)
     n_cores = os.cpu_count() or 1
@@ -2382,6 +2521,18 @@ def _telemetry_regression_gate(headline):
             "forecast_rows_per_sec": fo.get("forecast_rows_per_sec"),
             "forecast_gate_ok": 1.0 if fo.get("forecast_gate_ok") else 0.0,
         }
+    # delta-refit gate inputs (ISSUE 15): the incremental-refit win and
+    # its bitwise contract — a planner regression (adoption silently off,
+    # fingerprints churning) degenerates every delta to a full refit
+    # while the cold headline stays flat
+    de = headline.get("delta_refit_northstar") or {}
+    if de.get("delta_speedup") is not None:
+        inputs = {
+            **(inputs or {}),
+            "delta_speedup": de.get("delta_speedup"),
+            "delta_warm_speedup": de.get("warm_speedup"),
+            "delta_gate_ok": 1.0 if de.get("delta_gate_ok") else 0.0,
+        }
     cur = {
         "metric": "telemetry_summary: regression-gate inputs "
                   "(compile share, commit latency, map_series cache, "
@@ -2451,6 +2602,8 @@ def _telemetry_regression_gate(headline):
         "serving_p99_latency_s": ("rel", 1.0, "lower"),
         "serving_batch_amplification": ("rel", 0.4, "higher"),
         "forecast_rows_per_sec": ("rel", 0.5, "higher"),
+        "delta_speedup": ("rel", 0.4, "higher"),
+        "delta_warm_speedup": ("rel", 0.5, "higher"),
     }
     drifts, flagged = {}, []
     for k, (mode, tol, direction) in thresholds.items():
@@ -2511,6 +2664,16 @@ def _telemetry_regression_gate(headline):
             "tolerance": 0.0, "mode": "abs", "direction": "higher",
             "flagged": True}
         flagged.append("forecast_bitwise_floor")
+    # ABSOLUTE floor (ISSUE 15): a 10%-dirty delta must beat the full
+    # refit by >= 3x AND stay bitwise — anything less means adoption is
+    # broken or splicing wrong bytes, regardless of the previous run
+    dg = inputs.get("delta_gate_ok")
+    if dg is not None and dg < 1.0:
+        drifts["delta_refit_floor"] = {
+            "prev": 1.0, "cur": dg, "drift": 1.0,
+            "tolerance": 0.0, "mode": "abs", "direction": "higher",
+            "flagged": True}
+        flagged.append("delta_refit_floor")
     if not drifts:
         # the prior summary carried none of the tracked keys (e.g. a
         # --quick run): comparing NOTHING must not read as a green gate
@@ -2620,6 +2783,12 @@ def _summary_line(emitted):
                     "forecast_bitwise_identical", "backtest_wall_s",
                     "backtest_windows", "ensemble_overhead",
                     "ensemble_argmin_bitwise", "forecast_gate_ok")}
+            de = obj.get("delta_refit_northstar")
+            if de:
+                entry["delta_refit_northstar"] = {k: de.get(k) for k in (
+                    "series_total", "dirty_fraction", "delta_speedup",
+                    "delta_bitwise_identical", "warm_speedup",
+                    "warm_bitwise_vs_warm_reference", "delta_gate_ok")}
         configs[key] = entry
     line = {
         "metric": "bench_summary: all configs, tail-truncation-proof "

@@ -323,6 +323,67 @@ rm -rf "$AUTO_SMOKE_DIR"
 # replays only uncommitted chunks, forecasts recompute deterministically
 python tests/_backtest_worker.py --smoke
 
+# crash-mid-delta smoke (ISSUE 15): a delta walk — 3 chunks adopted
+# byte-for-byte from a prior journal, 1 revised + 1 appended chunk
+# computed — is SIGKILLed after 4 durable commits, resumed, and the
+# resumed result must be BITWISE-identical to an uninterrupted delta walk
+# AND to the from-scratch cold walk of the new panel, with the adopted
+# chunks' manifest entries untouched by the resume (adopted chunks are
+# never recomputed)
+python tests/_delta_worker.py --smoke
+
+# delta tooling smoke (ISSUE 15): a journaled delta refit with telemetry
+# on must leave (a) a manifest whose extra.delta block passes the
+# obs_report schema gate (class counts sum to the grid, adopted chunks
+# name their source manifest), (b) an inspect_journal --delta dry-run
+# that classifies a new panel against the prior journal, and (c) a
+# dirty-fraction line + delta_from suggestion from the budget advisor
+DELTA_SMOKE_DIR=$(python - <<'EOF'
+import json, os, tempfile
+import numpy as np
+from spark_timeseries_tpu import obs
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.models import arima
+
+root = tempfile.mkdtemp(prefix="delta_smoke_")
+rng = np.random.default_rng(0)
+e = rng.normal(size=(32, 96)).astype(np.float32)
+y = np.zeros_like(e)
+for t in range(1, y.shape[1]):
+    y[:, t] = 0.6 * y[:, t - 1] + e[:, t]
+kw = dict(chunk_rows=8, resilient=False, order=(1, 0, 0), max_iters=15)
+rel.fit_chunked(arima.fit, y, checkpoint_dir=os.path.join(root, "full"), **kw)
+y2 = y.copy(); y2[8:16] += 0.01
+np.save(os.path.join(root, "y2.npy"), y2)
+obs.enable(os.path.join(root, "events.jsonl"))
+ref = rel.fit_chunked(arima.fit, y2, **kw)
+d = rel.fit_chunked(arima.fit, y2, checkpoint_dir=os.path.join(root, "d"),
+                    delta_from=os.path.join(root, "full"), **kw)
+obs.disable()
+for f in ("params", "neg_log_likelihood", "converged", "iters", "status"):
+    np.testing.assert_array_equal(np.asarray(getattr(ref, f)),
+                                  np.asarray(getattr(d, f)), err_msg=f)
+assert d.meta["delta"]["counts"] == {"adopted": 3, "warm": 0, "dirty": 1,
+                                     "new": 0}, d.meta["delta"]
+m = json.load(open(os.path.join(root, "d", "manifest.json")))
+assert m["extra"]["delta"]["counts"]["adopted"] == 3
+print(root)
+EOF
+)
+python tools/obs_report.py --check "$DELTA_SMOKE_DIR/events.jsonl" \
+  --manifest "$DELTA_SMOKE_DIR/d"
+python tools/inspect_journal.py "$DELTA_SMOKE_DIR/full" \
+  --delta "$DELTA_SMOKE_DIR/y2.npy" \
+  | grep -q "3 adopted" \
+  || { echo "ci.sh: inspect_journal --delta did not classify the plan" >&2; exit 1; }
+python tools/advise_budget.py "$DELTA_SMOKE_DIR/d" \
+  | grep -q "dirty fraction" \
+  || { echo "ci.sh: advise_budget did not report the dirty fraction" >&2; exit 1; }
+python tools/advise_budget.py "$DELTA_SMOKE_DIR/full" \
+  | grep -q "delta_from" \
+  || { echo "ci.sh: advise_budget did not suggest delta_from" >&2; exit 1; }
+rm -rf "$DELTA_SMOKE_DIR"
+
 # forecast tooling smoke (ISSUE 14): a journaled panel forecast walk and
 # a backtest campaign with telemetry on must leave (a) a forecast
 # manifest whose extra.forecast block the budget advisor turns into

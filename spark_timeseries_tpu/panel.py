@@ -359,6 +359,7 @@ class TimeSeriesPanel:
             pipeline: bool = True, pipeline_depth: int = 2,
             prefetch_depth: int = 1, align_mode: Optional[str] = None,
             shard: bool = False, mesh=None, source=None,
+            delta_from: Optional[str] = None, delta_warmstart: bool = True,
             **fit_kwargs):
         """Fit a model family over every series via the resilient chunk driver.
 
@@ -409,6 +410,18 @@ class TimeSeriesPanel:
         ``reliability.fit_chunked`` elastic lanes).  Note this is the
         chunk DRIVER's mesh knob, independent of the panel's own
         ``mesh``-attached SPMD fit path.
+
+        ``delta_from=PRIOR_ROOT`` runs an **incremental (delta) refit**
+        against a committed journal of an earlier fit of this panel's
+        lineage (``reliability.delta``): chunks whose rows are unchanged
+        are adopted from the prior journal byte-for-byte (zero compute),
+        chunks whose history grew with an identical prefix refit
+        warm-started from the journaled params (requires
+        ``resilient=False`` and an ``init_params``-capable model — the
+        arima family; ``delta_warmstart=False`` refits them cold
+        instead, keeping the whole result bitwise vs a from-scratch
+        fit), and only revised/new chunks refit in full.  Requires
+        ``checkpoint_dir=``; see ``reliability.fit_chunked``.
 
         ``source=`` opts the walk into **host-resident execution** for
         panels larger than device memory (``reliability.source``): pass a
@@ -461,6 +474,7 @@ class TimeSeriesPanel:
                 pipeline=pipeline, pipeline_depth=pipeline_depth,
                 prefetch_depth=prefetch_depth, align_mode=align_mode,
                 shard=shard, mesh=mesh,
+                delta_from=delta_from, delta_warmstart=delta_warmstart,
                 **fit_kwargs,
             )
 

@@ -51,9 +51,11 @@ same guarantees at row granularity:
   torn manifests) so every recovery path runs in tier-1 CPU tests.
 """
 
-from . import (chunked, committer, faultinject, journal, plan, prefetcher,
+from . import (chunked, committer, delta, faultinject, journal, plan, prefetcher,
                runner, sanitize, source, status, watchdog)
 from .chunked import OOMBackoffExceeded, fit_chunked, is_resource_exhausted
+from .delta import (DeltaError, DeltaPlan, StalePriorError, WarmstartFit,
+                    plan_delta)
 from .committer import ChunkCommitter, CommitterStats
 from .plan import (ExecutionPlan, LaneRunner, LaneSpec, LaneSupervisor,
                    RestagedPanel, WorkQueue, shard_spans)
@@ -102,11 +104,17 @@ __all__ = [
     "SanitizeReport",
     "StaleJournalError",
     "TornManifestError",
+    "DeltaError",
+    "DeltaPlan",
+    "StalePriorError",
+    "WarmstartFit",
     "call_with_deadline",
     "chunked",
     "committer",
     "config_hash",
     "default_ladder",
+    "delta",
+    "plan_delta",
     "faultinject",
     "fit_chunked",
     "is_resource_exhausted",

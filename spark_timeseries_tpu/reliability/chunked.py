@@ -71,6 +71,7 @@ addressable shards (build the global panel with
 
 from __future__ import annotations
 
+import functools
 import os
 import threading
 from typing import Callable, Optional
@@ -80,6 +81,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from . import delta as delta_mod
 from . import journal as journal_mod
 from . import plan as plan_mod
 from . import source as source_mod
@@ -118,6 +120,8 @@ def fit_chunked(
     rebalance_threshold: float = 4.0,
     process_index: Optional[int] = None,
     grid: Optional[tuple] = None,
+    delta_from: Optional[str] = None,
+    delta_warmstart: bool = True,
     journal_extra: Optional[dict] = None,
     _journal_commit_hook=None,
     **fit_kwargs,
@@ -275,6 +279,37 @@ def fit_chunked(
     process-local, so a process cannot re-stage another process's rows)
     the static fail-fast layout is kept.
 
+    **Delta walks** (``delta_from=PRIOR_ROOT``, ISSUE 15): refit only
+    what changed.  The planner (:mod:`.delta`) diffs this panel against
+    the committed journal at ``PRIOR_ROOT`` using the per-chunk content
+    fingerprints every version-2 manifest records: unchanged chunks
+    (**clean**) are spliced into this walk's NEW journal namespace as
+    ordinary commits up front — zero compute, provenance recorded in the
+    manifest's ``extra.delta`` block and the entries' ``delta.class`` —
+    so the resume machinery skips them; chunks whose history GREW with a
+    byte-identical prefix (**warm**) refit warm-started from the
+    journaled params via augmented init-param columns
+    (:class:`~.delta.WarmstartFit`; requires ``resilient=False`` and a
+    fit with ``init_params=``, e.g. the arima family); revised/new
+    chunks refit in full.  The delta result is bitwise-identical to a
+    from-scratch refit of the new panel on the same chunk grid — a
+    same-length delta (clean + dirty) against the COLD walk
+    (determinism: identical rows + identical config + aligned grid
+    reproduce identical bytes; off-grid prior boundaries are refused
+    adoption and recomputed), a grown (warm) delta against a
+    warm-started full walk of the same augmented panel (EVERY computed
+    chunk rides the warm wrapper there — dirty/new rows start from
+    zeroed inits rather than the model's own cold init);
+    ``delta_warmstart=False`` (exact mode) refits everything cold,
+    pinning the WHOLE result bitwise against the cold walk — prefer it
+    when the delta is mostly new/revised rows rather than appended
+    ticks.  A prior journal without chunk fingerprints (journal
+    version 1 — still resumable), with shrunk rows/time, or fitted
+    under a different config is rejected loudly
+    (:class:`~.delta.StalePriorError`).  Requires ``checkpoint_dir=``;
+    a SIGKILLed delta walk resumes bitwise and never recomputes an
+    adopted chunk.  ``meta["delta"]`` reports the class counts.
+
     **Grid coordinate** (``grid=(index, total)`` or
     ``(index, total, members)``): an auto-fit order search
     (``models.auto``) runs one ordinary walk per candidate order — or,
@@ -310,6 +345,7 @@ def fit_chunked(
     # DeviceChunkSource unwraps to the resident-array walk, byte-identical
     # to passing the array itself.
     src = None
+    chunk_rows_from_source = False
     if isinstance(y, source_mod.ChunkSource):
         if isinstance(y, source_mod.DeviceChunkSource):
             yb = y.array
@@ -317,6 +353,7 @@ def fit_chunked(
             src = y
             yb = None
             if chunk_rows is None and src.default_chunk_rows:
+                chunk_rows_from_source = True
                 # sources know their natural chunking — shard size for
                 # npz dirs, a bounded slice for host arrays — and the
                 # grid lands there unless the caller says otherwise (a
@@ -339,6 +376,111 @@ def fit_chunked(
         b = yb.shape[0]
         t_len = int(yb.shape[1])
         panel_dtype = np.dtype(str(yb.dtype))
+
+    # -- delta walk (ISSUE 15) -----------------------------------------------
+    # delta_from= diffs THIS panel against a committed prior journal
+    # (reliability.delta): unchanged chunks are spliced into the new
+    # journal as ordinary commits up front (zero compute — the resume
+    # machinery then skips them), grown-history chunks refit warm-started
+    # from the journaled params via augmented init columns, and only the
+    # revised/new remainder refits cold.  Everything after this branch is
+    # the ordinary walk: pipelining, prefetch, sources, sharding, elastic
+    # lanes, and serving compose with no delta-specific driver code.
+    delta_plan = None
+    delta_wrapped = False
+    data_cols = None
+    # grid- and placement-independent identity of the INNER fit (the
+    # model + its kwargs, align/driver knobs excluded), recorded in every
+    # journaled manifest (`extra.fit`) and checked before a warm delta
+    # splices another job's params in as inits: warm-starting
+    # arima(1,0,1) from an arima(2,0,1) journal must fail loudly, not as
+    # an opaque shape error (or worse, a silent wrong-basin init)
+    fit_base = journal_mod.config_hash(
+        fit_fn, {k: v for k, v in fit_kwargs.items() if k != "align_mode"})
+    _inner = fit_fn
+    while isinstance(_inner, functools.partial):
+        _inner = _inner.func
+    fit_name = (getattr(_inner, "__module__", "?") + "."
+                + getattr(_inner, "__qualname__", repr(_inner)))
+    if delta_from is not None:
+        if checkpoint_dir is None:
+            raise ValueError(
+                "delta_from= requires checkpoint_dir=: the delta walk "
+                "journals adopted + recomputed chunks into a NEW namespace")
+        try:
+            _n_procs0 = jax.process_count()
+        except Exception:  # noqa: BLE001 - no backend yet: single process
+            _n_procs0 = 1
+        if _n_procs0 > 1:
+            raise ValueError(
+                "delta walks are single-process (the planner streams the "
+                "panel's rows on the host to fingerprint each chunk)")
+        # only a CALLER-chosen chunk_rows constrains the delta grid: a
+        # source's natural chunking (npz shard size) must not preempt
+        # the prior walk's grid, or the documented "omit chunk_rows and
+        # the delta defaults to the prior grid" workflow would reject
+        # itself whenever the shard size differs from the prior grid
+        delta_plan = delta_mod.plan_delta(
+            delta_from, src if src is not None else yb,
+            chunk_rows=None if chunk_rows_from_source else chunk_rows,
+            warmstart=delta_warmstart)
+        # the prior walk's grid: delta identity is per-chunk, so the
+        # grids must align for adoption to mean anything
+        chunk_rows = delta_plan.chunk_rows
+        data_cols = t_len  # the new walk's fingerprints cover the raw data
+        if delta_plan.counts["warm"] and delta_warmstart:
+            pfit = ((delta_plan.manifest.get("extra") or {})
+                    .get("fit") or {})
+            if pfit.get("base_config") and \
+                    pfit["base_config"] != fit_base:
+                raise delta_mod.StalePriorError(
+                    f"prior journal {delta_plan.prior_dir} fitted "
+                    f"{pfit.get('name')} under a different model "
+                    "configuration; its params cannot warm-start this "
+                    "fit — refit from scratch or point delta_from at a "
+                    "journal of the SAME fit/kwargs")
+            if resilient:
+                raise ValueError(
+                    "a warm-started delta walk must run resilient=False "
+                    "(the sanitizer would 'repair' the init-param "
+                    "columns); pass resilient=False, or "
+                    "delta_warmstart=False for an exact cold delta")
+            import inspect as _dinspect
+
+            try:
+                _fit_params = _dinspect.signature(fit_fn).parameters
+            except (TypeError, ValueError):
+                _fit_params = {}
+            for need in ("init_params", "align_mode"):
+                if need not in _fit_params:
+                    raise TypeError(
+                        "delta_warmstart=True needs a fit_fn with an "
+                        f"explicit {need}= parameter (the arima family "
+                        "has one); pass delta_warmstart=False for an "
+                        "exact cold delta")
+            if align_mode is None:
+                # resolved on the RAW panel before augmentation: the init
+                # columns carry NaN on dirty/new rows, which would
+                # otherwise downgrade the plan to "general" for data the
+                # fit never sees unaligned
+                from ..models import base as _model_base
+
+                align_mode = (src.align_mode() if src is not None
+                              else _model_base.align_mode_on_host(yb))
+            fit_fn = delta_mod.WarmstartFit(fit_fn, t_len, delta_plan.k)
+            aug = delta_mod.warm_panel(src if src is not None else yb,
+                                       delta_plan.init)
+            delta_wrapped = True
+            if isinstance(aug, source_mod.ChunkSource):
+                src = aug
+                b, t_len = src.shape
+                panel_dtype = src.dtype
+                src_stats0 = src.stats()
+                src.reset_peak_live()
+            else:
+                yb = aug
+                b = yb.shape[0]
+                t_len = int(yb.shape[1])
 
     # -- lane layout (the sharded half of the ExecutionPlan) -----------------
     # resolved BEFORE the align plan and the journal: the shard count can
@@ -510,10 +652,23 @@ def fit_chunked(
         # hashed): the budget advisor needs panel bytes from an IN-HBM
         # manifest to say "the next run of this panel should go
         # host-resident" — advice that is moot once a source already ran
+        if data_cols is None:
+            data_cols = t_len
         journal_extra = {
             **(journal_extra or {}),
             "panel": {"bytes": int(b) * int(t_len) * panel_dtype.itemsize,
-                      "time": int(t_len), "dtype": str(panel_dtype)}}
+                      "time": int(t_len), "dtype": str(panel_dtype)},
+            # how many leading DATA columns the per-chunk fingerprints
+            # cover (ISSUE 15) — a warm delta walk's init columns are
+            # deliberately excluded so tick-feed chains stay delta-eligible
+            "chunk_fp_cols": int(data_cols),
+            # the INNER fit's identity (warm-wrapped walks record the
+            # wrapped model, not the wrapper) — what a later warm delta
+            # checks before adopting these params as inits
+            "fit": {"name": fit_name, "base_config": fit_base}}
+        if delta_plan is not None:
+            journal_extra["delta"] = delta_mod.delta_extra(
+                delta_plan, warmstart=delta_wrapped, data_cols=data_cols)
         if process_index is None:
             try:
                 process_index = jax.process_index()
@@ -533,6 +688,31 @@ def fit_chunked(
                    "resilient": resilient, "policy": policy,
                    "ladder": "default" if ladder is None else repr(ladder)})
         fp = src.fingerprint() if src is not None else _fingerprint(yb)
+        if delta_plan is not None and not delta_plan.grown \
+                and delta_plan.prior_config_hash != cfg:
+            # clean adoption rests on determinism: identical rows under an
+            # IDENTICAL config reproduce identical bytes.  A same-shape
+            # prior fitted under a different config cannot donate a single
+            # chunk — pointing delta_from at it is operator error, not a
+            # silent full refit
+            raise delta_mod.StalePriorError(
+                f"prior journal {delta_plan.prior_dir} was fitted under a "
+                f"different configuration (config_hash "
+                f"{delta_plan.prior_config_hash} != {cfg}); its chunks "
+                "cannot be adopted into this walk — refit from scratch or "
+                "point delta_from at the matching journal")
+        # per-chunk content fingerprint sampler (ISSUE 15): every commit
+        # records the chunk's own row identity so a LATER delta walk can
+        # adopt unchanged chunks.  Multi-process global arrays are not
+        # host-sampleable here; their entries simply omit the field.
+        chunk_fp = None
+        try:
+            _addressable = (True if src is not None
+                            else getattr(yb, "is_fully_addressable", True))
+        except Exception:  # noqa: BLE001 - duck typing over jax versions
+            _addressable = False
+        if _addressable:
+            chunk_fp = delta_mod.chunk_fp_fn(src, yb, data_cols)
         if not sharded:
             journals = [journal_mod.ChunkJournal(
                 checkpoint_dir,
@@ -544,6 +724,7 @@ def fit_chunked(
                 process_index=process_index,
                 extra=journal_extra,
                 commit_hook=_journal_commit_hook,
+                chunk_fp=chunk_fp,
             )]
         else:
             # one journal namespace per shard (shard_00000/…): lanes are
@@ -575,6 +756,7 @@ def fit_chunked(
                         shard_index=sid,
                         extra=extra,
                         commit_hook=_journal_commit_hook,
+                        chunk_fp=chunk_fp,
                     ))
             except BaseException:
                 # stale/torn LOCAL journal state is asymmetric across
@@ -583,6 +765,14 @@ def fit_chunked(
                 # join it so the error surfaces cluster-wide
                 _distributed_barrier()
                 raise
+        if delta_plan is not None and delta_plan.adopted:
+            # splice the clean chunks' committed results into the NEW
+            # namespace as ordinary commits BEFORE the walk starts: the
+            # resume machinery then skips them like any committed chunk,
+            # and a resumed delta walk (committed() already true) never
+            # re-adopts — nor recomputes — them
+            _delta_adopt(delta_plan, journals,
+                         spans if sharded else None, sharded)
     deadline = watchdog_mod.Deadline(job_budget_s)
 
     # per-chunk telemetry rows for meta["telemetry"] / the manifest block;
@@ -810,6 +1000,10 @@ def fit_chunked(
         meta["grid"] = {"index": grid[0], "total": grid[1]}
         if grid_members is not None:
             meta["grid"]["fused"] = grid_members
+    if delta_plan is not None:
+        meta["delta"] = {"from": delta_plan.prior_dir,
+                         "counts": dict(delta_plan.counts),
+                         "warmstart": delta_wrapped}
     if journals is not None and not sharded:
         meta["journal"] = journals[0].accounting()
     if plan_mode is not None:
@@ -1009,6 +1203,48 @@ def _pipeline_meta(results, sharded: bool) -> Optional[dict]:
             })
         pipe_meta["shards"] = [by_shard[sid] for sid in sorted(by_shard)]
     return pipe_meta
+
+
+def _delta_adopt(plan, journals, spans, sharded: bool) -> None:
+    """Commit a delta plan's clean chunks into the new walk's journal(s).
+
+    Adoption is an ordinary ``commit_chunk`` of the prior result arrays
+    (zero compute, entry tagged ``delta.class == "adopted"`` with the
+    source manifest), routed into the shard namespace whose span holds
+    the chunk under a sharded plan — single-writer protocol untouched,
+    and the elastic ``ShardJournalView`` sees cross-namespace adoption
+    like any reassigned commit.  Already-committed chunks (a resumed
+    delta walk) are left exactly as they are: adopted chunks are never
+    recomputed OR re-spliced on resume.
+    """
+    src_manifest = os.path.join(plan.prior_dir, "manifest.json")
+    batches: dict = {}  # journal -> [(lo, hi, shard_path, info), ...]
+    for entry, shard_path in plan.adopted:
+        lo, hi = int(entry["lo"]), int(entry["hi"])
+        if sharded:
+            sid = next((i for i, (slo, shi) in enumerate(spans)
+                        if slo <= lo < shi), 0)
+            j = journals[sid]
+        else:
+            j = journals[0]
+        if j.committed(lo) is not None:
+            continue
+        counts = entry.get("status_counts")
+        if counts is None:
+            with np.load(shard_path, allow_pickle=False) as z:
+                counts = status_counts(np.asarray(z["status"]))
+        info = {"wall_s": 0.0, "status_counts": counts,
+                "delta": {"class": "adopted",
+                          "source_manifest": src_manifest}}
+        if entry.get("chunk_fingerprint"):
+            # the planner just PROVED the new panel's rows hash to this —
+            # recording the prior value verbatim skips a redundant sample
+            info["chunk_fingerprint"] = entry["chunk_fingerprint"]
+        batches.setdefault(id(j), (j, []))[1].append(
+            (lo, hi, shard_path, info))
+    for j, items in batches.values():
+        adopted = j.adopt_chunks(items)
+        obs.counter("delta.chunks_adopted").add(len(adopted))
 
 
 def _fingerprint(yb) -> str:

@@ -65,6 +65,7 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 from .. import obs
+from .journal import durable_replace as _durable_replace
 
 __all__ = [
     "ChunkSource",
@@ -553,8 +554,12 @@ class NpzShardSource(ChunkSource):
                  cache_shards: int = 2):
         self.directory = os.path.abspath(os.fspath(directory))
         self.key = key
+        # hidden files excluded: a crashed append (ISSUE 15) can leave a
+        # fully-valid ".tmp-*.npz" orphan behind, and ".tmp-" sorts
+        # before "part_" — counting it as shard 0 would silently shift
+        # every row offset in the panel
         names = sorted(n for n in os.listdir(self.directory)
-                       if n.endswith(".npz"))
+                       if n.endswith(".npz") and not n.startswith("."))
         if not names:
             raise SourceError(f"no .npz shards in {self.directory}")
         self._shards: list = []  # (path, member, row_lo, row_hi, crc)
@@ -662,6 +667,33 @@ class NpzShardSource(ChunkSource):
                 break
         return nan_any, nan_last
 
+    def append_rows(self, values, rows_per_shard: Optional[int] = None
+                    ) -> "NpzShardSource":
+        """Append NEW series to the shard directory (new ``part_*``
+        files; existing shards untouched) and return a fresh source over
+        the extended directory — this instance's cached headers describe
+        the OLD layout and stay valid for it."""
+        write_npz_shards(self.directory, values,
+                         rows_per_shard=rows_per_shard,
+                         key=self.key or self._member_key(),
+                         append_rows=True)
+        return NpzShardSource(self.directory, key=self.key,
+                              cache_shards=self._cache_n)
+
+    def append_time(self, values) -> "NpzShardSource":
+        """Append new time steps (``values [B, dt]``) to EVERY row —
+        each shard atomically rewritten with its slice of the new
+        columns — and return a fresh source over the grown panel."""
+        write_npz_shards(self.directory, values,
+                         key=self.key or self._member_key(),
+                         append_time=True)
+        return NpzShardSource(self.directory, key=self.key,
+                              cache_shards=self._cache_n)
+
+    def _member_key(self) -> str:
+        member = self._shards[0][1]
+        return member[:-len(".npy")]
+
     def fingerprint(self) -> str:
         """Content-derived without decompression: shape/dtype plus every
         shard's (name, rows, zip CRC-32) — the CRC is computed from the
@@ -702,15 +734,98 @@ def as_source(obj, **kwargs) -> ChunkSource:
     return DeviceChunkSource(obj)
 
 
-def write_npz_shards(directory, values, rows_per_shard: int,
-                     key: str = "values") -> Sequence[str]:
+def write_npz_shards(directory, values, rows_per_shard: Optional[int] = None,
+                     key: str = "values", *, append_rows: bool = False,
+                     append_time: bool = False) -> Sequence[str]:
     """Write ``values [B, T]`` as a row-partitioned shard directory that
     :class:`NpzShardSource` reads back — the test/bench/docs helper for
     producing larger-than-HBM inputs (real pipelines write shards from
-    their own ingest)."""
+    their own ingest).
+
+    **Appending** (ISSUE 15, the tick-feed scenario):
+
+    - ``append_rows=True``: ``values`` are NEW series appended to an
+      existing shard directory as additional ``part_*.npz`` files after
+      the existing ones — clean shards are never rewritten, so a delta
+      walk over the extended directory adopts every old chunk
+      byte-for-byte.  ``rows_per_shard`` defaults to the directory's
+      existing shard size.
+    - ``append_time=True``: ``values [B_existing, dt]`` are new time
+      steps for EVERY existing row; each shard is rewritten atomically
+      (tmp → ``os.replace``) with its row-slice of the new columns —
+      rewriting is unavoidable (every row grows), but a reader never
+      sees a torn shard.
+
+    Both flags assume the ``part_%05d`` naming this function writes.
+    Returns the paths written.
+    """
     values = np.asarray(values)
     if values.ndim != 2:
         raise SourceError(f"expected [batch, time], got {values.shape}")
+    if append_rows and append_time:
+        raise SourceError("append_rows and append_time are exclusive: "
+                          "appended series and appended time steps are "
+                          "different shard edits")
+    if append_rows or append_time:
+        # hidden files excluded (crashed-append .tmp-* orphans, see
+        # NpzShardSource) — they are neither shards to extend nor a
+        # numbering anchor
+        existing = sorted(n for n in os.listdir(directory)
+                          if n.endswith(".npz") and not n.startswith("."))
+        if not existing:
+            raise SourceError(f"nothing to append to: no .npz shards in "
+                              f"{directory}")
+    if append_time:
+        # row-count validated UP FRONT from the zip headers: failing
+        # mid-loop would leave the directory torn across shards (some
+        # rewritten at T+dt, the rest still at T)
+        total_rows = 0
+        for fname in existing:
+            with zipfile.ZipFile(os.path.join(directory, fname)) as zf:
+                member = next(n for n in zf.namelist()
+                              if n.endswith(".npy"))
+                shape, _dt = _npz_member_header(zf, member)
+            total_rows += int(shape[0])
+        if total_rows != values.shape[0]:
+            raise SourceError(
+                f"append_time values have {values.shape[0]} rows but the "
+                f"directory holds {total_rows}")
+        paths = []
+        row = 0
+        for fname in existing:
+            path = os.path.join(directory, fname)
+            with np.load(path, allow_pickle=False) as z:
+                names = list(z.files)
+                k = key if key in names else names[0]
+                old = z[k]
+            lo, hi = row, row + old.shape[0]
+            merged = np.concatenate(
+                [old, values[lo:hi].astype(old.dtype)], axis=1)
+            _durable_replace(path, lambda f, k=k, m=merged:
+                             np.savez(f, **{k: m}), suffix=".npz")
+            paths.append(path)
+            row = hi
+        return paths
+    start = 0
+    if append_rows:
+        # the new series must match the LIVE directory's layout BEFORE
+        # anything is written: a mismatched width/dtype shard under its
+        # final part_* name would make every future source open fail
+        start = len(existing)
+        with zipfile.ZipFile(os.path.join(directory, existing[0])) as zf:
+            member = next(n for n in zf.namelist() if n.endswith(".npy"))
+            shape, dt = _npz_member_header(zf, member)
+        if values.shape[1] != int(shape[1]) or \
+                values.dtype != np.dtype(dt):
+            raise SourceError(
+                f"append_rows values are [*, {values.shape[1]}] "
+                f"{values.dtype}, but the directory holds [*, {shape[1]}] "
+                f"{np.dtype(dt)} shards")
+        if rows_per_shard is None:
+            rows_per_shard = max(1, int(shape[0]))
+    if rows_per_shard is None:
+        raise SourceError("rows_per_shard is required when writing a "
+                          "fresh shard directory")
     rows_per_shard = max(1, int(rows_per_shard))
     os.makedirs(directory, exist_ok=True)
     paths = []
@@ -718,7 +833,12 @@ def write_npz_shards(directory, values, rows_per_shard: int,
     for i in range(n):
         lo = i * rows_per_shard
         hi = min(lo + rows_per_shard, values.shape[0])
-        path = os.path.join(directory, f"part_{i:05d}.npz")
-        np.savez(path, **{key: values[lo:hi]})
+        path = os.path.join(directory, f"part_{start + i:05d}.npz")
+        # durable like every journal write: a crash mid-append must never
+        # leave a torn shard under its final name in a LIVE directory
+        # (fresh directories get the same treatment for free)
+        _durable_replace(path, lambda f, lo=lo, hi=hi:
+                         np.savez(f, **{key: values[lo:hi]}),
+                         suffix=".npz")
         paths.append(path)
     return paths

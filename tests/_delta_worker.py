@@ -1,0 +1,217 @@
+"""Subprocess worker for the crash-mid-delta durability tests (ISSUE 15).
+
+A delta walk splices a prior journal's clean chunks into a NEW namespace
+and computes only the warm/dirty remainder; this worker proves the
+durability half of that contract across REAL process death: a delta walk
+SIGKILLed mid-run resumes bitwise-identical to an uninterrupted delta
+walk (and to the from-scratch cold walk of the new panel), and the
+adopted chunks are NEVER recomputed on resume — their manifest entries
+keep the first delta run's run id and provenance.
+
+Modes:
+    --prep --dir A [--out F]
+        the ORIGINAL full fit whose v2 manifest carries the chunk
+        fingerprints every delta diffs against.
+    --run --dir D --prior A [--kill-after N] [--out F]
+        one delta walk of the revised+appended panel; with --kill-after
+        the process dies by SIGKILL after N durable commits (the 3
+        adoption commits land first, so N=4 kills mid-computed-walk).
+    --smoke
+        full orchestration (used by ci.sh): prep, kill a delta child
+        after 4 commits, resume, compare bitwise against an
+        uninterrupted delta AND the cold reference, verify adopted
+        entries survived the resume untouched, and print PASS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+CHUNK_ROWS = 8
+N_ROWS = 32
+
+
+def make_panel() -> np.ndarray:
+    rng = np.random.default_rng(7)
+    e = rng.normal(size=(N_ROWS, 120)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, y.shape[1]):
+        y[:, i] = 0.6 * y[:, i - 1] + e[:, i]
+    return y
+
+
+def make_new_panel() -> np.ndarray:
+    """The original panel with chunk [8, 16) revised and 8 rows appended:
+    the delta plan is 3 adopted + 1 dirty + 1 new."""
+    y = make_panel()
+    y[8:16] += np.float32(0.01)
+    rng = np.random.default_rng(11)
+    e = rng.normal(size=(8, y.shape[1])).astype(np.float32)
+    extra = np.zeros_like(e)
+    extra[:, 0] = e[:, 0]
+    for i in range(1, e.shape[1]):
+        extra[:, i] = 0.6 * extra[:, i - 1] + e[:, i]
+    return np.concatenate([y, extra])
+
+
+def _save(res, out: str) -> None:
+    np.savez(out, params=res.params, nll=res.neg_log_likelihood,
+             converged=res.converged, iters=res.iters, status=res.status,
+             journal=json.dumps(res.meta.get("journal", {})),
+             delta=json.dumps(res.meta.get("delta", {})))
+
+
+def run_prep(directory: str, out: str | None) -> None:
+    from spark_timeseries_tpu import reliability as rel
+    from spark_timeseries_tpu.models import arima
+
+    res = rel.fit_chunked(
+        arima.fit, make_panel(), chunk_rows=CHUNK_ROWS, resilient=False,
+        checkpoint_dir=directory, order=(1, 0, 0), max_iters=25,
+    )
+    if out:
+        _save(res, out)
+
+
+def run_delta(directory: str, prior: str, kill_after: int | None,
+              out: str | None, cold: bool = False) -> None:
+    from spark_timeseries_tpu import reliability as rel
+    from spark_timeseries_tpu.models import arima
+    from spark_timeseries_tpu.reliability import faultinject as fi
+
+    hook = None
+    if kill_after is not None:
+        hook = fi.kill_after_commits(kill_after)
+    kw = dict(chunk_rows=CHUNK_ROWS, resilient=False, order=(1, 0, 0),
+              max_iters=25)
+    if cold:
+        res = rel.fit_chunked(arima.fit, make_new_panel(),
+                              checkpoint_dir=directory, **kw)
+    else:
+        res = rel.fit_chunked(arima.fit, make_new_panel(),
+                              checkpoint_dir=directory, delta_from=prior,
+                              _journal_commit_hook=hook, **kw)
+    if kill_after is not None:
+        sys.exit(f"kill_after={kill_after} but the walk finished — the "
+                 "hook never fired")
+    if out:
+        _save(res, out)
+
+
+def _child(args: list) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), *args],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=600,
+    )
+
+
+def smoke() -> None:
+    with tempfile.TemporaryDirectory() as td:
+        prior = os.path.join(td, "prior")
+        r = _child(["--prep", "--dir", prior])
+        if r.returncode != 0:
+            sys.exit(f"prep failed rc={r.returncode}\nstderr:\n{r.stderr}")
+        # 1. delta child killed by SIGKILL after 4 durable commits: the 3
+        #    adoption commits land in one batch up front, so the kill
+        #    strikes with the computed walk (dirty + new chunks) in flight
+        ddir = os.path.join(td, "delta")
+        r = _child(["--run", "--dir", ddir, "--prior", prior,
+                    "--kill-after", "4"])
+        if r.returncode != -9:
+            sys.exit(f"expected SIGKILL (-9), got rc={r.returncode}\n"
+                     f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}")
+        manifest = json.load(open(os.path.join(ddir, "manifest.json")))
+        adopted = {c["lo"]: c for c in manifest["chunks"]
+                   if (c.get("delta") or {}).get("class") == "adopted"}
+        if sorted(adopted) != [0, 16, 24]:
+            sys.exit(f"expected chunks 0/16/24 adopted before the kill, "
+                     f"got {sorted(adopted)}")
+        n_committed = sum(1 for c in manifest["chunks"]
+                          if c["status"] == "committed")
+        if not 4 <= n_committed < 5:
+            sys.exit(f"expected exactly 4 durable commits at the kill, "
+                     f"got {n_committed}")
+        # 2. resume completes the delta from the journal
+        resumed_out = os.path.join(td, "resumed.npz")
+        r = _child(["--run", "--dir", ddir, "--prior", prior,
+                    "--out", resumed_out])
+        if r.returncode != 0:
+            sys.exit(f"resume failed rc={r.returncode}\nstderr:\n{r.stderr}")
+        # 3. uninterrupted delta walk in a fresh directory
+        full_out = os.path.join(td, "full.npz")
+        r = _child(["--run", "--dir", os.path.join(td, "fresh"),
+                    "--prior", prior, "--out", full_out])
+        if r.returncode != 0:
+            sys.exit(f"reference delta failed rc={r.returncode}\n{r.stderr}")
+        # 4. from-scratch COLD walk of the new panel (the bitwise anchor:
+        #    no warm chunks in this plan, so delta == cold)
+        cold_out = os.path.join(td, "cold.npz")
+        r = _child(["--run", "--cold", "--dir", os.path.join(td, "cold"),
+                    "--prior", prior, "--out", cold_out])
+        if r.returncode != 0:
+            sys.exit(f"cold reference failed rc={r.returncode}\n{r.stderr}")
+        a = np.load(resumed_out)
+        for name, other in (("uninterrupted delta", np.load(full_out)),
+                            ("from-scratch cold walk", np.load(cold_out))):
+            for k in ("params", "nll", "converged", "iters", "status"):
+                if not np.array_equal(a[k], other[k], equal_nan=True):
+                    sys.exit(f"resumed delta differs from {name} on {k!r} "
+                             "— crash-mid-delta resume is NOT bitwise")
+        # 5. adopted chunks were never recomputed: their entries keep the
+        #    FIRST delta run's run id and provenance through the resume
+        final = json.load(open(os.path.join(ddir, "manifest.json")))
+        for lo, pre in adopted.items():
+            post = next(c for c in final["chunks"] if c["lo"] == lo)
+            if post["run_id"] != pre["run_id"] or \
+                    (post.get("delta") or {}).get("class") != "adopted":
+                sys.exit(f"adopted chunk at lo={lo} was touched on resume "
+                         f"(run_id {pre['run_id']} -> {post['run_id']})")
+        j = json.loads(str(a["journal"]))
+        d = json.loads(str(a["delta"]))
+        if d.get("counts") != {"adopted": 3, "warm": 0, "dirty": 1,
+                               "new": 1}:
+            sys.exit(f"delta accounting wrong: {d}")
+        if j.get("chunks_committed") != 5:
+            sys.exit(f"journal accounting wrong: {j}")
+        print("delta kill-and-resume smoke: PASS (SIGKILL after 4 commits "
+              "with 3 chunks adopted, resumed bitwise vs uninterrupted "
+              "delta AND cold walk, adopted chunks untouched on resume)")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prep", action="store_true")
+    ap.add_argument("--run", action="store_true")
+    ap.add_argument("--cold", action="store_true")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--dir")
+    ap.add_argument("--prior")
+    ap.add_argument("--kill-after", type=int, default=None)
+    ap.add_argument("--out")
+    args = ap.parse_args()
+    if args.smoke:
+        smoke()
+    elif args.prep:
+        run_prep(args.dir, args.out)
+    elif args.run:
+        run_delta(args.dir, args.prior, args.kill_after, args.out,
+                  cold=args.cold)
+    else:
+        ap.error("pick a mode")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,731 @@
+"""Delta walks: incremental refit for appended and revised panels
+(ISSUE 15, tier-1 CPU).
+
+The acceptance bar: a ``fit_chunked(delta_from=...)`` walk classifies
+every chunk of a new panel against a committed prior journal's per-chunk
+content fingerprints — **clean** chunks adopt the committed bytes with
+zero compute, **warm** chunks (history grew, prefix identical) refit
+warm-started from the journaled params, **dirty/new** chunks refit cold
+— and the result is pinned BITWISE: clean+dirty against the from-scratch
+cold walk of the new panel (determinism), warm against a warm-started
+full walk of the same augmented panel; ``delta_warmstart=False`` keeps
+the whole result bitwise vs the cold walk.  Composition (sharding,
+host/npz sources, the FitServer's batch walks) rides the ordinary
+driver; crash-mid-delta resume never recomputes an adopted chunk; and
+priors that cannot support the contract (no fingerprints, shrunk
+panels, different configs) are rejected loudly.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.reliability import delta as delta_mod
+from spark_timeseries_tpu.reliability import faultinject as fi
+from spark_timeseries_tpu.reliability import journal as journal_mod
+from spark_timeseries_tpu.reliability import source as source_mod
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KW = dict(chunk_rows=8, resilient=False, order=(1, 0, 0), max_iters=20)
+FIELDS = ("params", "neg_log_likelihood", "converged", "iters", "status")
+
+
+def _ar_panel(b=32, t=96, seed=7, phi=0.6):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(b, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, t):
+        y[:, i] = phi * y[:, i - 1] + e[:, i]
+    return y
+
+
+def _assert_bitwise(a, b, what=""):
+    for f in FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+            err_msg=f"{what}{f}")
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return _ar_panel()
+
+
+@pytest.fixture(scope="module")
+def prior_root(tmp_path_factory, panel):
+    """One committed full fit whose v2 manifest seeds every delta test."""
+    d = str(tmp_path_factory.mktemp("prior"))
+    rel.fit_chunked(arima.fit, panel, checkpoint_dir=d, **KW)
+    return d
+
+
+class TestChunkFingerprint:
+    def test_sample_steps(self):
+        assert journal_mod.chunk_sample_steps(8, 96) == (1, 1)
+        assert journal_mod.chunk_sample_steps(1000, 4000) == (8, 32)
+
+    def test_content_and_shape_sensitive(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        fp = journal_mod.chunk_fingerprint(a, 3, 4)
+        assert fp != journal_mod.chunk_fingerprint(a + 1, 3, 4)
+        assert fp != journal_mod.chunk_fingerprint(a, 4, 4)
+        b = a.copy()
+        b[0, 0] = np.nan  # bit patterns count: NaN placement matters
+        assert fp != journal_mod.chunk_fingerprint(b, 3, 4)
+
+    def test_residencies_agree(self, panel, tmp_path):
+        nd = str(tmp_path / "shards")
+        source_mod.write_npz_shards(nd, panel, 8)
+        fns = [
+            delta_mod.chunk_fp_fn(None, jnp.asarray(panel), panel.shape[1]),
+            delta_mod.chunk_fp_fn(None, panel, panel.shape[1]),
+            delta_mod.chunk_fp_fn(source_mod.HostChunkSource(panel), None,
+                                  panel.shape[1]),
+            delta_mod.chunk_fp_fn(source_mod.NpzShardSource(nd), None,
+                                  panel.shape[1]),
+        ]
+        for lo, hi in ((0, 8), (8, 32), (5, 19)):
+            fps = {f(lo, hi) for f in fns}
+            assert len(fps) == 1, f"residencies disagree on [{lo},{hi})"
+
+    def test_prefix_cols(self, panel):
+        """data_cols bounds the hash: a grown panel's prefix fingerprint
+        equals the original panel's full fingerprint."""
+        grown = np.concatenate(
+            [panel, np.ones((panel.shape[0], 16), np.float32)], axis=1)
+        f_old = delta_mod.chunk_fp_fn(None, panel, panel.shape[1])
+        f_new = delta_mod.chunk_fp_fn(None, grown, panel.shape[1])
+        assert f_old(0, 8) == f_new(0, 8)
+
+    def test_every_commit_records_fingerprint(self, prior_root):
+        m = json.load(open(os.path.join(prior_root, "manifest.json")))
+        assert m["journal_version"] == 2
+        assert m["extra"]["chunk_fp_cols"] == 96
+        assert all("chunk_fingerprint" in c for c in m["chunks"])
+
+
+class TestPlanner:
+    def test_revised_classifies_dirty(self, prior_root, panel):
+        y2 = panel.copy()
+        y2[8:16] += 0.01
+        plan = rel.plan_delta(prior_root, y2)
+        assert plan.counts == {"adopted": 3, "warm": 0, "dirty": 1,
+                               "new": 0}
+        assert [c.cls for c in plan.chunks] == [
+            "adopted", "dirty", "adopted", "adopted"]
+        assert not plan.grown and plan.init is None
+
+    def test_appended_rows_classify_new(self, prior_root, panel):
+        y2 = np.concatenate([panel, _ar_panel(8, 96, seed=9)])
+        plan = rel.plan_delta(prior_root, y2)
+        assert plan.counts == {"adopted": 4, "warm": 0, "dirty": 0,
+                               "new": 1}
+        assert plan.chunks[-1] == (32, 40, "new")
+
+    def test_appended_time_classifies_warm(self, prior_root, panel):
+        y2 = np.concatenate(
+            [panel, _ar_panel(32, 16, seed=10)], axis=1)
+        plan = rel.plan_delta(prior_root, y2)
+        assert plan.grown
+        assert plan.counts["warm"] == 4
+        # init matrix carries the journaled params on warm rows
+        assert plan.init.shape == (32, plan.k)
+        assert np.isfinite(plan.init).all()
+
+    def test_warmstart_false_reclassifies_dirty(self, prior_root, panel):
+        y2 = np.concatenate(
+            [panel, _ar_panel(32, 16, seed=10)], axis=1)
+        plan = rel.plan_delta(prior_root, y2, warmstart=False)
+        assert plan.counts == {"adopted": 0, "warm": 0, "dirty": 4,
+                               "new": 0}
+        assert plan.init is None
+
+    def test_torn_prior_shard_downgrades(self, prior_root, panel,
+                                         tmp_path):
+        import shutil
+
+        d = str(tmp_path / "torn")
+        shutil.copytree(prior_root, d)
+        shard = sorted(glob.glob(os.path.join(d, "chunk_*")))[0]
+        with open(shard, "wb") as f:
+            f.write(b"torn")
+        plan = rel.plan_delta(d, panel)
+        assert plan.counts == {"adopted": 3, "warm": 0, "dirty": 1,
+                               "new": 0}
+        assert plan.chunks[0].cls == "dirty"
+
+    def test_v1_manifest_rejected_loudly(self, prior_root, panel,
+                                         tmp_path):
+        import shutil
+
+        d = str(tmp_path / "v1")
+        shutil.copytree(prior_root, d)
+        mp = os.path.join(d, "manifest.json")
+        m = json.load(open(mp))
+        for c in m["chunks"]:
+            c.pop("chunk_fingerprint", None)
+        m["journal_version"] = 1
+        json.dump(m, open(mp, "w"))
+        with pytest.raises(rel.StalePriorError, match="RESUMABLE"):
+            rel.plan_delta(d, panel)
+
+    def test_shrunk_rows_rejected(self, prior_root, panel):
+        with pytest.raises(rel.StalePriorError, match="rows disappeared"):
+            rel.plan_delta(prior_root, panel[:24])
+
+    def test_shrunk_time_rejected(self, prior_root, panel):
+        with pytest.raises(rel.StalePriorError, match="time axis shrank"):
+            rel.plan_delta(prior_root, panel[:, :80])
+
+    def test_missing_prior_rejected(self, panel, tmp_path):
+        with pytest.raises(rel.DeltaError, match="no manifest"):
+            rel.plan_delta(str(tmp_path / "nope"), panel)
+
+    def test_offgrid_trailing_chunk_not_adopted(self, tmp_path):
+        """A prior panel whose row count is NOT a grid multiple ends in
+        a partial chunk; appending rows after it must NOT adopt that
+        chunk — the cold walk of the new panel chunks [24,32) where the
+        prior committed [24,30), and adopting the off-grid boundary
+        would shift every downstream chunk's shape (review finding:
+        silently breaks bitwise-vs-cold)."""
+        y = _ar_panel(30, 96, seed=17)
+        prior = str(tmp_path / "prior")
+        rel.fit_chunked(arima.fit, y, checkpoint_dir=prior, **KW)
+        y2 = np.concatenate([y, _ar_panel(10, 96, seed=18)])
+        plan = rel.plan_delta(prior, y2)
+        assert [c.cls for c in plan.chunks][:3] == ["adopted"] * 3
+        trailing = next(c for c in plan.chunks if c.lo == 24)
+        assert trailing.cls == "dirty"  # [24,30): off-grid, recompute
+        ref = rel.fit_chunked(arima.fit, y2, **KW)
+        d = rel.fit_chunked(arima.fit, y2,
+                            checkpoint_dir=str(tmp_path / "d"),
+                            delta_from=prior, **KW)
+        _assert_bitwise(ref, d, "off-grid trailing ")
+        # WITHOUT appended rows the trailing partial chunk ends the
+        # panel in both walks and stays adoptable
+        plan_same = rel.plan_delta(prior, y)
+        assert plan_same.counts == {"adopted": 4, "warm": 0, "dirty": 0,
+                                    "new": 0}
+
+    def test_grid_mismatch_rejected_by_name(self, prior_root, panel):
+        """A same-T delta on a different chunk grid names the GRID as
+        the problem (the config hash would catch it too, but as an
+        opaque hash mismatch)."""
+        with pytest.raises(rel.StalePriorError, match="chunk grid"):
+            rel.plan_delta(prior_root, panel, chunk_rows=16)
+
+    def test_warm_across_different_model_config_rejected(
+            self, prior_root, panel, tmp_path):
+        """Warm-starting from a journal fitted under a DIFFERENT model
+        config must fail loudly — not as an opaque shape error, and
+        never as a silently wrong-basin init (review finding)."""
+        y2 = np.concatenate([panel, _ar_panel(32, 16, seed=10)], axis=1)
+        kw = dict(KW)
+        kw["order"] = (2, 0, 0)  # same param WIDTH risk class as (1,0,1)
+        with pytest.raises(rel.StalePriorError, match="warm-start"):
+            rel.fit_chunked(arima.fit, y2,
+                            checkpoint_dir=str(tmp_path / "d"),
+                            delta_from=prior_root, **kw)
+
+
+class TestDeltaWalk:
+    def test_revised_bitwise_and_provenance(self, prior_root, panel,
+                                            tmp_path):
+        y2 = panel.copy()
+        y2[8:16] += 0.01
+        ref = rel.fit_chunked(arima.fit, y2, **KW)
+        d = rel.fit_chunked(arima.fit, y2,
+                            checkpoint_dir=str(tmp_path / "d"),
+                            delta_from=prior_root, **KW)
+        _assert_bitwise(ref, d, "revised ")
+        assert d.meta["delta"]["counts"]["adopted"] == 3
+        m = json.load(open(tmp_path / "d" / "manifest.json"))
+        prior = json.load(open(os.path.join(prior_root, "manifest.json")))
+        adopted = [c for c in m["chunks"]
+                   if (c.get("delta") or {}).get("class") == "adopted"]
+        assert len(adopted) == 3
+        for c in adopted:
+            assert c["delta"]["source_manifest"].endswith("manifest.json")
+            pc = next(p for p in prior["chunks"] if p["lo"] == c["lo"])
+            with open(tmp_path / "d" / c["shard"], "rb") as f_new, \
+                    open(os.path.join(prior_root, pc["shard"]),
+                         "rb") as f_old:
+                assert f_new.read() == f_old.read(), \
+                    "adoption must splice the prior shard BYTES"
+        dx = m["extra"]["delta"]
+        assert dx["counts"] == d.meta["delta"]["counts"]
+        assert dx["prior_run_id"] == prior["run_id"]
+
+    def test_appended_rows_bitwise(self, prior_root, panel, tmp_path):
+        y2 = np.concatenate([panel, _ar_panel(8, 96, seed=9)])
+        ref = rel.fit_chunked(arima.fit, y2, **KW)
+        d = rel.fit_chunked(arima.fit, y2,
+                            checkpoint_dir=str(tmp_path / "d"),
+                            delta_from=prior_root, **KW)
+        _assert_bitwise(ref, d, "appended-rows ")
+        assert d.meta["delta"]["counts"]["new"] == 1
+
+    def test_appended_time_warm_bitwise_vs_warm_reference(
+            self, prior_root, panel, tmp_path):
+        y2 = np.concatenate([panel, _ar_panel(32, 16, seed=10)], axis=1)
+        d = rel.fit_chunked(arima.fit, y2,
+                            checkpoint_dir=str(tmp_path / "d"),
+                            delta_from=prior_root, **KW)
+        assert d.meta["delta"] == {"from": prior_root,
+                                   "counts": {"adopted": 0, "warm": 4,
+                                              "dirty": 0, "new": 0},
+                                   "warmstart": True}
+        plan = rel.plan_delta(prior_root, y2)
+        ref = rel.fit_chunked(
+            rel.WarmstartFit(arima.fit, y2.shape[1], plan.k),
+            delta_mod.warm_panel(y2, plan.init),
+            align_mode="dense", **KW)
+        _assert_bitwise(ref, d, "warm ")
+        # warm results genuinely differ from the cold walk (iteration
+        # counts shift) — the warm reference is not vacuously the cold one
+        cold = rel.fit_chunked(arima.fit, y2, **KW)
+        assert not np.array_equal(np.asarray(cold.iters),
+                                  np.asarray(d.iters))
+
+    def test_exact_mode_bitwise_vs_cold(self, prior_root, panel,
+                                        tmp_path):
+        y2 = np.concatenate([panel, _ar_panel(32, 16, seed=10)], axis=1)
+        ref = rel.fit_chunked(arima.fit, y2, **KW)
+        d = rel.fit_chunked(arima.fit, y2,
+                            checkpoint_dir=str(tmp_path / "d"),
+                            delta_from=prior_root, delta_warmstart=False,
+                            **KW)
+        _assert_bitwise(ref, d, "exact ")
+        assert d.meta["delta"]["warmstart"] is False
+
+    def test_mixed_append_rows_and_time(self, prior_root, panel,
+                                        tmp_path):
+        """Ticks appended AND new series added: old chunks warm, new
+        rows cold — one walk, one journal, bitwise vs the warm
+        reference."""
+        y2 = np.concatenate([panel, _ar_panel(32, 16, seed=10)], axis=1)
+        y2 = np.concatenate([y2, _ar_panel(8, 112, seed=12)])
+        d = rel.fit_chunked(arima.fit, y2,
+                            checkpoint_dir=str(tmp_path / "d"),
+                            delta_from=prior_root, **KW)
+        assert d.meta["delta"]["counts"] == {"adopted": 0, "warm": 4,
+                                             "dirty": 0, "new": 1}
+        plan = rel.plan_delta(prior_root, y2)
+        assert not np.isfinite(plan.init[32:]).any()  # new rows: cold-ish
+        ref = rel.fit_chunked(
+            rel.WarmstartFit(arima.fit, y2.shape[1], plan.k),
+            delta_mod.warm_panel(y2, plan.init),
+            align_mode="dense", **KW)
+        _assert_bitwise(ref, d, "mixed ")
+
+    def test_crash_mid_delta_resume_bitwise(self, prior_root, panel,
+                                            tmp_path):
+        y2 = panel.copy()
+        y2[8:16] += 0.01
+        y2 = np.concatenate([y2, _ar_panel(8, 96, seed=9)])
+        d_dir = str(tmp_path / "d")
+        # crash after the 3 adoption commits + 1 computed commit
+        with pytest.raises(fi.SimulatedCrash):
+            rel.fit_chunked(arima.fit, y2, checkpoint_dir=d_dir,
+                            delta_from=prior_root,
+                            _journal_commit_hook=fi.crash_after_commits(4),
+                            **KW)
+        m = json.load(open(os.path.join(d_dir, "manifest.json")))
+        committed = [c for c in m["chunks"] if c["status"] == "committed"]
+        assert len(committed) == 4
+        pre_adopted = {c["lo"]: c["run_id"] for c in committed
+                       if (c.get("delta") or {}).get("class") == "adopted"}
+        assert sorted(pre_adopted) == [0, 16, 24]
+        resumed = rel.fit_chunked(arima.fit, y2, checkpoint_dir=d_dir,
+                                  delta_from=prior_root, **KW)
+        ref = rel.fit_chunked(arima.fit, y2,
+                              checkpoint_dir=str(tmp_path / "ref"),
+                              delta_from=prior_root, **KW)
+        _assert_bitwise(ref, resumed, "crash-resume ")
+        # adopted chunks never recomputed NOR re-adopted on resume
+        final = json.load(open(os.path.join(d_dir, "manifest.json")))
+        for c in final["chunks"]:
+            if c["lo"] in pre_adopted:
+                assert c["run_id"] == pre_adopted[c["lo"]]
+                assert c["delta"]["class"] == "adopted"
+
+    def test_stale_config_rejected(self, prior_root, panel, tmp_path):
+        kw = dict(KW)
+        kw["order"] = (2, 0, 0)
+        with pytest.raises(rel.StalePriorError, match="different config"):
+            rel.fit_chunked(arima.fit, panel,
+                            checkpoint_dir=str(tmp_path / "d"),
+                            delta_from=prior_root, **kw)
+
+    def test_requires_checkpoint_dir(self, prior_root, panel):
+        with pytest.raises(ValueError, match="checkpoint_dir"):
+            rel.fit_chunked(arima.fit, panel, delta_from=prior_root, **KW)
+
+    def test_warm_requires_nonresilient(self, prior_root, panel,
+                                        tmp_path):
+        y2 = np.concatenate([panel, _ar_panel(32, 16, seed=10)], axis=1)
+        kw = dict(KW)
+        kw["resilient"] = True
+        with pytest.raises(ValueError, match="resilient=False"):
+            rel.fit_chunked(arima.fit, y2,
+                            checkpoint_dir=str(tmp_path / "d"),
+                            delta_from=prior_root, **kw)
+
+    def test_warm_requires_init_params_fit(self, panel, tmp_path):
+        def opaque_fit(y, align_mode=None, **kw):  # no explicit init_params
+            return arima.fit(y, align_mode=align_mode, **kw)
+
+        # prior fitted with the SAME opaque fit (identity check passes),
+        # so the missing-init_params capability check is what fires
+        prior = str(tmp_path / "prior")
+        rel.fit_chunked(opaque_fit, panel[:16], checkpoint_dir=prior,
+                        **KW)
+        y2 = np.concatenate(
+            [panel[:16], _ar_panel(16, 16, seed=10)], axis=1)
+        with pytest.raises(TypeError, match="init_params"):
+            rel.fit_chunked(opaque_fit, y2,
+                            checkpoint_dir=str(tmp_path / "d"),
+                            delta_from=prior, **KW)
+
+    def test_delta_resume_is_idempotent(self, prior_root, panel,
+                                        tmp_path):
+        y2 = panel.copy()
+        y2[8:16] += 0.01
+        d_dir = str(tmp_path / "d")
+        first = rel.fit_chunked(arima.fit, y2, checkpoint_dir=d_dir,
+                                delta_from=prior_root, **KW)
+        m1 = json.load(open(os.path.join(d_dir, "manifest.json")))
+        again = rel.fit_chunked(arima.fit, y2, checkpoint_dir=d_dir,
+                                delta_from=prior_root, **KW)
+        _assert_bitwise(first, again, "idempotent ")
+        assert again.meta["journal"]["chunks_resumed"] == 4
+        m2 = json.load(open(os.path.join(d_dir, "manifest.json")))
+        assert [c["run_id"] for c in m2["chunks"]] == \
+            [c["run_id"] for c in m1["chunks"]]
+
+    def test_warmstart_fit_repr_stable(self):
+        a = rel.WarmstartFit(arima.fit, 96, 4)
+        b = rel.WarmstartFit(arima.fit, 96, 4)
+        assert repr(a) == repr(b)
+        assert a.__qualname__ == b.__qualname__
+        assert "arima" in repr(a) and "n_time=96" in repr(a)
+        # different column splits are different configs
+        assert repr(rel.WarmstartFit(arima.fit, 112, 4)) != repr(a)
+
+
+class TestComposition:
+    def test_sharded_delta_bitwise(self, prior_root, panel, tmp_path,
+                                   cpu_devices):
+        y2 = panel.copy()
+        y2[8:16] += 0.01
+        ref = rel.fit_chunked(arima.fit, y2, **KW)
+        # the prior grid is 8-row chunks; a sharded delta on a 4-row grid
+        # cannot align and must refuse up front
+        kw4 = dict(KW)
+        kw4["chunk_rows"] = 4
+        with pytest.raises(rel.StalePriorError, match="chunk grid"):
+            rel.fit_chunked(arima.fit, y2,
+                            checkpoint_dir=str(tmp_path / "bad"),
+                            delta_from=prior_root, shard=True, **kw4)
+        d = rel.fit_chunked(arima.fit, y2,
+                            checkpoint_dir=str(tmp_path / "d"),
+                            delta_from=prior_root, shard=True, **KW)
+        _assert_bitwise(ref, d, "sharded ")
+        assert d.meta["delta"]["counts"]["adopted"] == 3
+        m = json.load(open(tmp_path / "d" / "manifest.json"))
+        assert m["extra"]["delta"]["counts"]["adopted"] == 3
+
+    def test_host_and_npz_sources_bitwise(self, prior_root, panel,
+                                          tmp_path):
+        y2 = panel.copy()
+        y2[8:16] += 0.01
+        ref = rel.fit_chunked(arima.fit, y2, **KW)
+        dh = rel.fit_chunked(arima.fit, source_mod.HostChunkSource(y2),
+                             checkpoint_dir=str(tmp_path / "dh"),
+                             delta_from=prior_root, **KW)
+        _assert_bitwise(ref, dh, "host-source ")
+        nd = str(tmp_path / "shards")
+        source_mod.write_npz_shards(nd, y2, 8)
+        dn = rel.fit_chunked(arima.fit, source_mod.NpzShardSource(nd),
+                             checkpoint_dir=str(tmp_path / "dn"),
+                             delta_from=prior_root, **KW)
+        _assert_bitwise(ref, dn, "npz-source ")
+
+    def test_source_default_chunking_defers_to_prior_grid(self, panel,
+                                                          tmp_path):
+        """An npz source's natural chunking (shard size) must not
+        preempt the prior walk's grid when chunk_rows is omitted — the
+        documented tick-feed workflow (review finding: the delta
+        rejected itself whenever shard size != prior grid)."""
+        prior = str(tmp_path / "prior")
+        kw = dict(KW)
+        kw["chunk_rows"] = 16  # prior grid: 16-row chunks
+        rel.fit_chunked(arima.fit, panel, checkpoint_dir=prior, **kw)
+        nd = str(tmp_path / "shards")
+        source_mod.write_npz_shards(nd, panel, 8)  # 8-row shards
+        d = rel.fit_chunked(
+            arima.fit, source_mod.NpzShardSource(nd),
+            checkpoint_dir=str(tmp_path / "d"), delta_from=prior,
+            resilient=False, order=KW["order"], max_iters=KW["max_iters"])
+        assert d.meta["delta"]["counts"] == {"adopted": 2, "warm": 0,
+                                             "dirty": 0, "new": 0}
+        # an EXPLICIT mismatched chunk_rows still refuses
+        with pytest.raises(rel.StalePriorError, match="chunk grid"):
+            rel.fit_chunked(
+                arima.fit, source_mod.NpzShardSource(nd),
+                checkpoint_dir=str(tmp_path / "d2"), delta_from=prior,
+                chunk_rows=8, resilient=False, order=KW["order"],
+                max_iters=KW["max_iters"])
+
+    def test_advise_timing_ignores_adopted_walls(self, prior_root,
+                                                 panel, tmp_path):
+        """Budget advice on a delta manifest must learn timing from the
+        COMPUTED chunks only — adopted chunks carry wall_s=0.0 (review
+        finding: zero walls taught the advisor that chunks are free)."""
+        sys.path.insert(0, os.path.join(_ROOT, "tools"))
+        from advise_budget import advise, load_manifest
+
+        y2 = panel.copy()
+        y2[8:16] += 0.01
+        d_dir = str(tmp_path / "d")
+        rel.fit_chunked(arima.fit, y2, checkpoint_dir=d_dir,
+                        delta_from=prior_root, **KW)
+        a = advise(load_manifest(d_dir))
+        assert a["observed"]["chunk_wall_s_max"] > 0.0
+        assert a["suggest"]["chunk_budget_s"] >= 1
+
+    def test_warm_source_matches_device(self, prior_root, panel,
+                                        tmp_path):
+        y2 = np.concatenate([panel, _ar_panel(32, 16, seed=10)], axis=1)
+        dd = rel.fit_chunked(arima.fit, y2,
+                             checkpoint_dir=str(tmp_path / "dd"),
+                             delta_from=prior_root, **KW)
+        ds = rel.fit_chunked(arima.fit, source_mod.HostChunkSource(y2),
+                             checkpoint_dir=str(tmp_path / "ds"),
+                             delta_from=prior_root, **KW)
+        _assert_bitwise(dd, ds, "warm src-vs-device ")
+
+    def test_panel_fit_surface(self, prior_root, panel, tmp_path):
+        from spark_timeseries_tpu import index as dtix
+        from spark_timeseries_tpu.panel import TimeSeriesPanel
+
+        y2 = panel.copy()
+        y2[8:16] += 0.01
+        p = TimeSeriesPanel(
+            dtix.uniform("2024-01-01", periods=y2.shape[1],
+                         frequency=dtix.DayFrequency(1)),
+            [f"s{i}" for i in range(y2.shape[0])], y2)
+        ref = rel.fit_chunked(arima.fit, y2, **KW)
+        d = p.fit("arima", checkpoint_dir=str(tmp_path / "d"),
+                  delta_from=prior_root, **KW)
+        _assert_bitwise(ref, d, "panel.fit ")
+
+    def test_serving_delta_submit(self, tmp_path):
+        """A FitServer with delta_from in its walk kwargs: a repeated
+        panel's batch walk adopts every chunk from the prior batch's
+        journal — zero compute, bitwise-identical answers."""
+        from spark_timeseries_tpu import serving
+
+        y = _ar_panel(16, 96, seed=21)
+        s1 = serving.FitServer(str(tmp_path / "s1"), cell_rows=8,
+                               batch_window_s=0.05)
+        t1 = s1.submit("a", y, "arima", order=(1, 0, 0), max_iters=20)
+        s1.start()
+        r1 = t1.result(timeout=600)
+        s1.stop()
+        jdirs = glob.glob(str(tmp_path / "s1" / "batches" / "*" /
+                              "journal"))
+        assert len(jdirs) == 1
+        s2 = serving.FitServer(str(tmp_path / "s2"), cell_rows=8,
+                               batch_window_s=0.05,
+                               walk_kwargs={"delta_from": jdirs[0]})
+        t2 = s2.submit("a", y, "arima", order=(1, 0, 0), max_iters=20)
+        s2.start()
+        r2 = t2.result(timeout=600)
+        s2.stop()
+        for f in ("params", "status"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(r1, f)), np.asarray(getattr(r2, f)),
+                err_msg=f"serving delta {f}")
+        m = json.load(open(glob.glob(str(
+            tmp_path / "s2" / "batches" / "*" / "journal" /
+            "manifest.json"))[0]))
+        counts = m["extra"]["delta"]["counts"]
+        assert counts["adopted"] == len(m["chunks"])
+        assert counts["dirty"] == 0 and counts["new"] == 0
+
+
+class TestAppendHelpers:
+    def test_append_rows_never_rewrites_clean_shards(self, tmp_path):
+        y = _ar_panel(24, 64)
+        nd = str(tmp_path / "shards")
+        source_mod.write_npz_shards(nd, y, 8)
+        before = {p: open(p, "rb").read()
+                  for p in glob.glob(nd + "/*.npz")}
+        src = source_mod.NpzShardSource(nd)
+        src2 = src.append_rows(_ar_panel(8, 64, seed=3))
+        assert src2.shape == (32, 64)
+        for p, blob in before.items():
+            with open(p, "rb") as f:
+                assert f.read() == blob, f"{p} was rewritten"
+        assert len(glob.glob(nd + "/*.npz")) == 4
+
+    def test_append_time_grows_every_row(self, tmp_path):
+        y = _ar_panel(24, 64)
+        nd = str(tmp_path / "shards")
+        source_mod.write_npz_shards(nd, y, 8)
+        ticks = _ar_panel(24, 8, seed=4)
+        src2 = source_mod.NpzShardSource(nd).append_time(ticks)
+        assert src2.shape == (24, 72)
+        buf = np.empty((24, 72), np.float32)
+        src2.read_rows(0, 24, buf)
+        np.testing.assert_array_equal(buf[:, :64], y)
+        np.testing.assert_array_equal(buf[:, 64:], ticks)
+
+    def test_append_flags_exclusive(self, tmp_path):
+        y = _ar_panel(8, 16)
+        nd = str(tmp_path / "shards")
+        source_mod.write_npz_shards(nd, y, 8)
+        with pytest.raises(source_mod.SourceError, match="exclusive"):
+            source_mod.write_npz_shards(nd, y, append_rows=True,
+                                        append_time=True)
+
+    def test_append_to_empty_dir_rejected(self, tmp_path):
+        os.makedirs(tmp_path / "empty")
+        with pytest.raises(source_mod.SourceError, match="nothing to"):
+            source_mod.write_npz_shards(str(tmp_path / "empty"),
+                                        _ar_panel(8, 16),
+                                        append_rows=True)
+
+    def test_append_time_row_mismatch_rejected(self, tmp_path):
+        y = _ar_panel(16, 32)
+        nd = str(tmp_path / "shards")
+        source_mod.write_npz_shards(nd, y, 8)
+        with pytest.raises(source_mod.SourceError, match="rows"):
+            source_mod.write_npz_shards(nd, _ar_panel(8, 4),
+                                        append_time=True)
+
+    def test_fresh_write_still_requires_rows_per_shard(self, tmp_path):
+        with pytest.raises(source_mod.SourceError, match="rows_per_shard"):
+            source_mod.write_npz_shards(str(tmp_path / "f"),
+                                        _ar_panel(8, 16))
+
+    def test_crashed_append_tmp_orphan_ignored(self, tmp_path):
+        """A fully-valid .tmp-*.npz orphan from a crashed append must
+        not become shard 0 (it sorts before part_*) — neither for the
+        source nor for a later append (review finding)."""
+        y = _ar_panel(16, 32)
+        nd = str(tmp_path / "shards")
+        source_mod.write_npz_shards(nd, y, 8)
+        np.savez(os.path.join(nd, ".tmp-orphan.npz"),
+                 values=_ar_panel(8, 32, seed=5))
+        src = source_mod.NpzShardSource(nd)
+        assert src.shape == (16, 32)
+        buf = np.empty((16, 32), np.float32)
+        src.read_rows(0, 16, buf)
+        np.testing.assert_array_equal(buf, y)
+        src2 = src.append_rows(_ar_panel(8, 32, seed=6))
+        assert src2.shape == (24, 32)
+
+    def test_append_time_wrong_rows_leaves_directory_whole(self,
+                                                           tmp_path):
+        """A wrong-sized append_time must fail BEFORE mutating any
+        shard — a mid-loop failure would tear the directory across
+        mixed time lengths (review finding)."""
+        y = _ar_panel(64, 32)
+        nd = str(tmp_path / "shards")
+        source_mod.write_npz_shards(nd, y, 8)
+        with pytest.raises(source_mod.SourceError, match="rows"):
+            source_mod.write_npz_shards(nd, _ar_panel(40, 4),
+                                        append_time=True)
+        src = source_mod.NpzShardSource(nd)  # still opens: nothing torn
+        assert src.shape == (64, 32)
+
+
+class TestTooling:
+    def test_obs_report_validates_delta_block(self, prior_root, panel,
+                                              tmp_path):
+        sys.path.insert(0, _ROOT)
+        from tools.obs_report import validate_manifest_delta
+
+        y2 = panel.copy()
+        y2[8:16] += 0.01
+        d_dir = str(tmp_path / "d")
+        rel.fit_chunked(arima.fit, y2, checkpoint_dir=d_dir,
+                        delta_from=prior_root, **KW)
+        mp = os.path.join(d_dir, "manifest.json")
+        m = json.load(open(mp))
+        assert validate_manifest_delta(m, mp) == []
+        # seeded violations: counts drift, grid gap, missing provenance
+        bad = json.loads(json.dumps(m))
+        bad["extra"]["delta"]["counts"]["adopted"] = 99
+        assert any("counts" in e for e in
+                   validate_manifest_delta(bad, mp))
+        bad = json.loads(json.dumps(m))
+        bad["extra"]["delta"]["chunks"][1][0] = 9
+        assert any("contiguous" in e for e in
+                   validate_manifest_delta(bad, mp))
+        bad = json.loads(json.dumps(m))
+        for c in bad["chunks"]:
+            if (c.get("delta") or {}).get("class") == "adopted":
+                del c["delta"]["source_manifest"]
+        assert any("source manifest" in e for e in
+                   validate_manifest_delta(bad, mp))
+
+    def test_advise_budget_reports_delta(self, prior_root, panel,
+                                         tmp_path):
+        sys.path.insert(0, os.path.join(_ROOT, "tools"))
+        from advise_budget import advise, load_manifest
+
+        y2 = panel.copy()
+        y2[8:16] += 0.01
+        d_dir = str(tmp_path / "d")
+        rel.fit_chunked(arima.fit, y2, checkpoint_dir=d_dir,
+                        delta_from=prior_root, **KW)
+        a = advise(load_manifest(d_dir))
+        assert a["observed"]["delta"]["dirty_fraction"] == 0.25
+        assert a["observed"]["delta"]["counts"]["adopted"] == 3
+        # a NON-delta manifest with fingerprints suggests delta_from
+        a2 = advise(load_manifest(prior_root))
+        assert a2["observed"]["delta"] is None
+        assert "delta_from" in (a2["suggest"]["delta_from"] or "")
+
+    def test_inspect_journal_delta_cli(self, prior_root, panel,
+                                       tmp_path):
+        y2 = panel.copy()
+        y2[8:16] += 0.01
+        npy = str(tmp_path / "y2.npy")
+        np.save(npy, y2)
+        r = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools",
+                                          "inspect_journal.py"),
+             prior_root, "--delta", npy, "--json"],
+            capture_output=True, text=True, timeout=300, cwd=_ROOT,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stderr
+        out = json.loads(r.stdout)
+        assert out["counts"] == {"adopted": 3, "warm": 0, "dirty": 1,
+                                 "new": 0}
+        assert out["dirty_fraction"] == 0.25
+
+
+@pytest.mark.slow
+def test_delta_sigkill_smoke():
+    """Real-SIGKILL crash-mid-delta resume (also the ci.sh smoke)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tests", "_delta_worker.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=600, cwd=_ROOT)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "PASS" in r.stdout

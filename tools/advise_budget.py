@@ -118,9 +118,15 @@ def advise(m: dict) -> dict:
         return {"error": "no committed chunks to learn from",
                 "config_hash": m.get("config_hash")}
 
-    walls = [e["wall_s"] for e in committed if e.get("wall_s") is not None]
+    # adopted delta chunks (ISSUE 15) carry a synthetic wall_s of 0.0 —
+    # they were spliced, not computed — and must not teach the timing
+    # model that chunks are free (a 90%-adopted manifest would otherwise
+    # suggest budgets that TIMEOUT the next full refit's compile chunk)
+    computed = [e for e in committed
+                if (e.get("delta") or {}).get("class") != "adopted"]
+    walls = [e["wall_s"] for e in computed if e.get("wall_s") is not None]
     sizes = [e["hi"] - e["lo"] for e in committed]
-    after = [e.get("chunk_rows_after") for e in committed
+    after = [e.get("chunk_rows_after") for e in computed
              if e.get("chunk_rows_after")]
     requested = int(m.get("chunk_rows") or max(sizes))
 
@@ -334,6 +340,33 @@ def advise(m: dict) -> dict:
                 + f_ns * 2 * fh)),
         }
 
+    # -- delta walks: what fraction of the panel actually changed ------------
+    # a delta manifest (`extra.delta`, ISSUE 15) records the planner's
+    # adopted/warm/dirty/new classification; the dirty fraction is THE
+    # number that says whether the tick-feed pipeline is paying
+    # incremental cost or silently degenerating to full refits.  A
+    # non-delta manifest whose chunks carry content fingerprints is
+    # delta-ELIGIBLE: the next run of a grown/revised version of this
+    # panel should pass delta_from= instead of refitting everything.
+    delta_block = (m.get("extra") or {}).get("delta") or {}
+    delta_obs = None
+    delta_from_suggest = None
+    if delta_block:
+        dc = delta_block.get("counts") or {}
+        total = max(1, sum(dc.values()))
+        delta_obs = {
+            "from": delta_block.get("from"),
+            "counts": dc,
+            "warmstart": delta_block.get("warmstart"),
+            "dirty_fraction": round(
+                1.0 - (dc.get("adopted") or 0) / total, 4),
+        }
+    elif any(e.get("chunk_fingerprint") for e in committed):
+        delta_from_suggest = (
+            "chunk fingerprints present: an appended/revised rerun of "
+            "this panel can pass delta_from= at this journal and adopt "
+            "every unchanged chunk")
+
     return {
         "config_hash": m.get("config_hash"),
         "panel_fingerprint": m.get("panel_fingerprint"),
@@ -363,6 +396,7 @@ def advise(m: dict) -> dict:
             "shards": shard_obs,
             "rebalance": rebalance_obs,
             "forecast": forecast_obs,
+            "delta": delta_obs,
         },
         "suggest": {
             "chunk_rows": chunk_rows,
@@ -379,6 +413,7 @@ def advise(m: dict) -> dict:
             "lane_retries": lane_retries,
             "rebalance_threshold": rebalance_threshold,
             "forecast": forecast_suggest,
+            "delta_from": delta_from_suggest,
         },
     }
 
@@ -783,6 +818,13 @@ def main():
               "carried work"
               + (f"; wall balance max/mean {so['shard_wall_balance']}"
                  if so["shard_wall_balance"] is not None else ""))
+    if o.get("delta") is not None:
+        do = o["delta"]
+        dc = do["counts"]
+        print(f"  delta walk: dirty fraction {do['dirty_fraction']} "
+              f"({dc.get('adopted', 0)} adopted / {dc.get('warm', 0)} warm"
+              f" / {dc.get('dirty', 0)} dirty / {dc.get('new', 0)} new; "
+              f"warmstart={do['warmstart']}) from {do['from']}")
     if o.get("rebalance") is not None:
         ro = o["rebalance"]
         print(f"  elastic: {ro['steals']} steals, "
@@ -812,6 +854,8 @@ def main():
                  if fo["intervals"] else "")
               + f"; at 2x the horizon use chunk_rows <= "
                 f"{fs['chunk_rows_at_2x_horizon']}")
+    if s.get("delta_from") is not None:
+        print(f"    delta_from     = {args.path}  ({s['delta_from']})")
     print(f"    shards         = {s['shards']}  (shard=True/mesh=; clamped "
           "to the mesh's series devices at runtime)")
     if s["shards"] > 1:

@@ -9,6 +9,13 @@ which chunks committed, which TIMED OUT, what is still pending, what the
 per-row FitStatus totals look like, and how much HBM the run peaked at.
 
     python tools/inspect_journal.py CHECKPOINT_DIR [--json]
+    python tools/inspect_journal.py CHECKPOINT_DIR --delta NEW_PANEL
+
+``--delta NEW_PANEL`` (ISSUE 15) dry-runs the delta planner: the new
+panel (npz shard directory or ``.npy`` file) is diffed against this
+journal's per-chunk content fingerprints, and the report shows which
+chunks a ``fit_chunked(delta_from=...)`` walk would adopt byte-for-byte,
+warm-start from journaled params, or refit in full.
 
 Accepts the journal directory (reads ``manifest.json``; pass a
 ``manifest.proc_*.json`` path directly for a non-zero process's namespace)
@@ -96,12 +103,68 @@ def summarize(m: dict) -> dict:
     }
 
 
+def delta_report(journal_dir: str, new_panel: str, as_json: bool = False):
+    """Classify a new panel against a committed journal (ISSUE 15): the
+    dry-run of ``fit_chunked(delta_from=journal_dir)`` — prints which
+    chunks a delta walk would adopt byte-for-byte, warm-start, or refit,
+    and the dirty fraction the refit would pay for."""
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from spark_timeseries_tpu.reliability import delta as delta_mod
+    from spark_timeseries_tpu.reliability import source as source_mod
+
+    if os.path.isdir(new_panel):
+        panel = source_mod.NpzShardSource(new_panel)
+    elif new_panel.endswith(".npy"):
+        panel = np.load(new_panel, allow_pickle=False)
+    else:
+        sys.exit(f"--delta expects an npz shard directory or a .npy "
+                 f"panel file, got {new_panel}")
+    try:
+        plan = delta_mod.plan_delta(journal_dir, panel)
+    except delta_mod.DeltaError as e:
+        sys.exit(f"not delta-eligible: {e}")
+    c = plan.counts
+    total = max(1, len(plan.chunks))
+    dirty_frac = 1.0 - c["adopted"] / total
+    if as_json:
+        print(json.dumps({
+            "journal": os.path.abspath(journal_dir),
+            "new_panel": os.path.abspath(new_panel),
+            "grown": plan.grown,
+            "counts": c,
+            "chunks": [[ch.lo, ch.hi, ch.cls] for ch in plan.chunks],
+            "dirty_fraction": round(dirty_frac, 4),
+        }, indent=1, sort_keys=True))
+        return
+    print(f"delta plan: journal {journal_dir} vs panel {new_panel}")
+    print(f"  history {'GREW' if plan.grown else 'same length'} "
+          f"(fingerprints cover {plan.data_cols} data columns)")
+    print(f"  {c['adopted']} adopted (zero compute), {c['warm']} warm "
+          f"(journaled-param warm start), {c['dirty']} dirty + "
+          f"{c['new']} new (full refit)")
+    print(f"  dirty fraction {dirty_frac:.2%} — a delta walk computes "
+          f"{c['warm'] + c['dirty'] + c['new']} of {total} chunks")
+    for ch in plan.chunks:
+        print(f"  [{ch.lo:>9}, {ch.hi:>9})  {ch.cls}")
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="journal directory or manifest path")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable summary instead of the table")
+    ap.add_argument("--delta", default=None, metavar="NEW_PANEL",
+                    help="classify a NEW panel (npz shard directory or "
+                         ".npy file) against this journal's per-chunk "
+                         "fingerprints: which chunks a delta walk would "
+                         "adopt / warm-start / refit (ISSUE 15)")
     args = ap.parse_args()
+    if args.delta is not None:
+        return delta_report(args.path, args.delta, as_json=args.json)
     m = load_manifest(args.path)
     s = summarize(m)
     if args.json:

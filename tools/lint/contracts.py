@@ -21,6 +21,10 @@ from __future__ import annotations
 # staging materialization barrier) carry inline waivers naming the
 # reason.
 HOT_PATH_PREFIXES = (
+    # the reliability/ prefix covers the whole chunk driver, including
+    # the delta planner (delta.py, ISSUE 15) whose commit-path chunk
+    # fingerprinting and WarmstartFit wrapper run inside the pipelined
+    # walk — its one deliberate sync carries an inline waiver
     "spark_timeseries_tpu/reliability/",
     "spark_timeseries_tpu/models/",
     "spark_timeseries_tpu/utils/optim.py",
@@ -77,6 +81,12 @@ CONFIG_HASH_SURFACES = {
             "align_mode": "resolved mode injected into fit_kwargs before "
                           "config_hash — a resumed run must use the same "
                           "static plan",
+            "delta_warmstart": "warm mode resolves into the WarmstartFit "
+                               "wrapper (a DIFFERENT fit_fn identity) "
+                               "over the augmented init-column panel (a "
+                               "DIFFERENT fingerprint) — both reach the "
+                               "journal identity; the flag only selects "
+                               "that resolution",
         },
         # keys that are extra= literals but not signature params (the
         # checker uses this to verify the extra dict exactly)
@@ -119,6 +129,13 @@ CONFIG_HASH_SURFACES = {
             "grid": "auto-fit grid coordinate recorded in manifest "
                     "extra= for tooling; per-order walks hash their own "
                     "fit configs",
+            "delta_from": "adoption SOURCE location (ISSUE 15): clean "
+                          "chunks are spliced only when the prior "
+                          "config hash equals this walk's and the rows "
+                          "are fingerprint-identical, so the delta "
+                          "result is bitwise the full walk's on the "
+                          "same grid — provenance rides manifest "
+                          "extra.delta, never the hash",
             "journal_extra": "opaque manifest extra= block, documented "
                              "as non-hashed provenance",
             "_journal_commit_hook": "fault-injection instrumentation "
@@ -134,6 +151,9 @@ CONFIG_HASH_SURFACES = {
             "resilient": "forwarded to fit_chunked (hashed there)",
             "policy": "forwarded to fit_chunked (hashed there)",
             "align_mode": "forwarded to fit_chunked (hashed there)",
+            "delta_warmstart": "forwarded to fit_chunked (resolved into "
+                               "the warm fit_fn + augmented fingerprint "
+                               "there)",
         },
         "excluded": {
             "checkpoint_dir": "see fit_chunked",
@@ -148,6 +168,7 @@ CONFIG_HASH_SURFACES = {
             "source": "placement spelling (in-HBM / host RAM / npz "
                       "shards); panel identity is carried by the "
                       "fingerprint, which follows the source domain",
+            "delta_from": "see fit_chunked",
         },
     },
     "spark_timeseries_tpu/forecasting/walk.py::forecast_chunked": {
@@ -318,8 +339,12 @@ CONFIG_HASH_SURFACES = {
 # is either routed through the owner or registered as one.
 FILE_WRITE_OWNERS = {
     "spark_timeseries_tpu/reliability/journal.py": {
-        "_atomic_write_bytes": "the shared tmp->fsync->replace primitive "
-                               "every journal-side owner routes through",
+        "durable_replace": "THE durable-file primitive (tmp->fsync->"
+                           "replace, hidden-orphan crash semantics) "
+                           "every journal-side owner and the npz append "
+                           "helpers route through",
+        "_atomic_write_bytes": "the shared byte-payload wrapper over "
+                               "durable_replace",
         "ChunkJournal": "sole writer of its namespace's shards + manifest "
                         "(one instance per namespace; the pipelined "
                         "committer calls INTO this owner)",
@@ -328,7 +353,21 @@ FILE_WRITE_OWNERS = {
     },
     "spark_timeseries_tpu/reliability/source.py": {
         "write_npz_shards": "explicit export utility: creates a brand-new "
-                            "shard directory it alone owns",
+                            "shard directory it alone owns — and (ISSUE "
+                            "15) extends one in place: append_rows adds "
+                            "NEW part_* files, append_time atomically "
+                            "rewrites each shard with its new columns "
+                            "(the NpzShardSource append helpers route "
+                            "through here)",
+    },
+    "spark_timeseries_tpu/reliability/delta.py": {
+        "plan_delta": "READS prior shards only; the delta walk's "
+                      "adopted-chunk splice is committed exclusively "
+                      "through ChunkJournal.adopt_chunks (the namespace "
+                      "owner's batched commit: shards durable first, "
+                      "ONE manifest update) — this module performs no "
+                      "direct writes, registered so the ownership of "
+                      "the manifest splice is written down",
     },
     "spark_timeseries_tpu/reliability/faultinject.py": {
         "tear_file": "the fault harness DELIBERATELY corrupts a named "

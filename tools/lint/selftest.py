@@ -102,6 +102,38 @@ FIXTURES["config-hash/forecast"] = (_FC, _fix("""
         return horizon, seed, checkpoint_dir
     """), [functools.partial(confighash.check, surfaces=_FC_SURFACES)])
 
+# ISSUE 15: the delta-walk knobs joined the fit_chunked registry entry —
+# seed a violation of that shape (a delta-shaped surface growing an
+# unregistered delta knob) so a checker that stopped cross-checking the
+# driver signature cannot pass vacuously.
+_DELTA = "spark_timeseries_tpu/reliability/fixture_delta.py"
+_DELTA_SURFACES = {
+    f"{_DELTA}::delta_fixture": {
+        "kwargs_param": "fit_kwargs",
+        "hashed": {"chunk_rows": "extra= key 'chunk_rows'",
+                   "delta_warmstart": "resolves into the warm wrapper "
+                                      "fit_fn + augmented fingerprint"},
+        "extra_keys": ("chunk_rows",),
+        "excluded": {"delta_from": "adoption source location; results "
+                                   "bitwise the full walk's"},
+    },
+}
+
+FIXTURES["config-hash/delta"] = (_DELTA, _fix("""
+    def delta_fixture(*, chunk_rows=None, delta_from=None,
+                      delta_warmstart=True, delta_adopt_torn=False,
+                      **fit_kwargs):
+        cfg = config_hash(delta_fixture, fit_kwargs,
+                          extra={"chunk_rows": chunk_rows})
+        return cfg
+    """), _fix("""
+    def delta_fixture(*, chunk_rows=None, delta_from=None,
+                      delta_warmstart=True, **fit_kwargs):
+        cfg = config_hash(delta_fixture, fit_kwargs,
+                          extra={"chunk_rows": chunk_rows})
+        return cfg
+    """), [functools.partial(confighash.check, surfaces=_DELTA_SURFACES)])
+
 _FC_OWNERS = {_FC: {"_write_backtest_manifest":
                     "sole writer of the campaign manifest"}}
 

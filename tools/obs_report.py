@@ -191,6 +191,70 @@ def validate_manifest_telemetry(ckpt_dir: str) -> list:
                             f"h2d_wall_s invalid: {pool.get('h2d_wall_s')!r}")
     errors += validate_manifest_shards(m, path)
     errors += validate_manifest_auto_extra(m, path)
+    errors += validate_manifest_delta(m, path)
+    return errors
+
+
+DELTA_CLASSES = ("adopted", "warm", "dirty", "new")
+
+
+def validate_manifest_delta(m: dict, path: str) -> list:
+    """Validate a delta walk's ``extra.delta`` provenance block
+    (ISSUE 15).  Manifests without the block (ordinary walks) pass
+    untouched; a walk that claims a delta plan must carry a coherent
+    one: the classified chunk grid covers the panel exactly, the class
+    counts tally, and every adopted chunk entry names the manifest its
+    bytes were spliced from."""
+    d = (m.get("extra") or {}).get("delta")
+    if d is None:
+        return []
+    errors = []
+    counts = d.get("counts")
+    if not isinstance(counts, dict) or \
+            set(counts) != set(DELTA_CLASSES):
+        errors.append(f"extra.delta.counts malformed: {counts!r}")
+        counts = {}
+    grid = d.get("chunks")
+    if not isinstance(grid, list) or not grid:
+        errors.append("extra.delta.chunks missing/empty")
+        grid = []
+    tallies = {k: 0 for k in DELTA_CLASSES}
+    pos = 0
+    for ent in grid:
+        if (not isinstance(ent, (list, tuple)) or len(ent) != 3
+                or ent[2] not in DELTA_CLASSES):
+            errors.append(f"extra.delta.chunks entry malformed: {ent!r}")
+            continue
+        lo, hi, cls = int(ent[0]), int(ent[1]), ent[2]
+        if lo != pos or hi <= lo:
+            errors.append(f"extra.delta.chunks not contiguous at "
+                          f"[{lo}, {hi}) (expected lo={pos})")
+        tallies[cls] += 1
+        pos = max(pos, hi)
+    if grid and pos != int(m.get("n_rows", -1)):
+        errors.append(f"extra.delta.chunks cover [0, {pos}) but the "
+                      f"panel has {m.get('n_rows')} rows")
+    for k in DELTA_CLASSES:
+        if counts and counts.get(k) != tallies[k]:
+            errors.append(f"extra.delta.counts[{k!r}] = {counts.get(k)} "
+                          f"but the classified grid holds {tallies[k]}")
+    if not isinstance(d.get("source_manifest"), str):
+        errors.append("extra.delta.source_manifest missing")
+    adopted_entries = [e for e in m.get("chunks", [])
+                       if isinstance(e.get("delta"), dict)
+                       and e["delta"].get("class") == "adopted"]
+    for e in adopted_entries:
+        if not isinstance(e["delta"].get("source_manifest"), str):
+            errors.append(f"adopted chunk [{e.get('lo')}, {e.get('hi')}) "
+                          "does not name its source manifest")
+        if e.get("status") != "committed":
+            errors.append(f"adopted chunk [{e.get('lo')}, {e.get('hi')}) "
+                          f"has status {e.get('status')!r} — adoption IS "
+                          "a commit")
+    if counts and len(adopted_entries) > counts.get("adopted", 0):
+        errors.append(
+            f"{len(adopted_entries)} adopted chunk entries exceed the "
+            f"plan's adopted count {counts.get('adopted')}")
     return errors
 
 
