@@ -2052,6 +2052,151 @@ def _serving_northstar(jnp, quick, on_tpu):
     }
 
 
+def _fleet_serving_northstar(jnp, quick, on_tpu):
+    """ISSUE 16 acceptance: the fleet behind a socket.
+
+    Drives a 2-replica :class:`serving.fleet.FleetReplica` fleet (one
+    shared checkpoint root, lease-fenced) through the length-prefixed
+    wire protocol with a concurrent :class:`FitClient` request storm and
+    reports what a fleet operator buys: sustained **through-the-wire
+    request throughput and p50/p99 latency** (client-measured, socket
+    included), and the **failover-recovery latency** — a doomed primary
+    crashes mid-batch after its first durable commit, the standby takes
+    the lease over, and the SAME in-flight request is re-answered
+    through the client's poll loop; the penalty over the steady-state
+    p50 is the price of a failover.  The re-answer must be bitwise an
+    uninterrupted server's (floor-gated ``fleet_gate_ok`` together with
+    storm conservation and the lease landing on the survivor).
+    """
+    import tempfile
+    import threading
+
+    from spark_timeseries_tpu import serving
+    from spark_timeseries_tpu.reliability import faultinject as fi
+    from spark_timeseries_tpu.reliability.journal import read_lease
+    from spark_timeseries_tpu.serving.client import FitClient
+    from spark_timeseries_tpu.serving.fleet import (FleetReplica,
+                                                    discover_endpoints)
+
+    if on_tpu and not quick:
+        n_reqs, rows, t_len, iters = 32, 8192, 1000, 60
+    elif quick:
+        n_reqs, rows, t_len, iters = 6, 16, 120, 15
+    else:
+        n_reqs, rows, t_len, iters = 12, 64, 200, 25
+    kw = dict(order=(1, 1, 1), max_iters=iters)
+    panel = gen_arima_panel(n_reqs * rows, t_len, seed=47)
+    panels = [np.ascontiguousarray(panel[i * rows:(i + 1) * rows])
+              for i in range(n_reqs)]
+    srv_kw = dict(cell_rows=rows, batch_window_s=0.01,
+                  max_batch_rows=max(rows * 8, rows), autotune=False,
+                  max_queue_rows=n_reqs * rows * 4,
+                  max_queue_requests=4 * n_reqs + 8)
+    fields = ("params", "neg_log_likelihood", "converged", "iters",
+              "status")
+
+    # warm-up: compile the cell program once, process-wide
+    with serving.FitServer(tempfile.mkdtemp(prefix="fleetns_warm_"),
+                           **srv_kw) as warm:
+        warm.submit("warm", panels[0], "arima", **kw).result(timeout=1800)
+
+    def _storm(cli, reqs, prefix, timeout=1800.0):
+        lat = [None] * len(reqs)
+        errs = [None] * len(reqs)
+
+        def one(i):
+            t0 = time.perf_counter()
+            try:
+                tk = cli.submit(f"{prefix}-{i}", reqs[i], "arima",
+                                request_id=f"{prefix}-{i}", **kw)
+                tk.result(timeout=timeout)
+                lat[i] = time.perf_counter() - t0
+            except Exception as e:  # noqa: BLE001 - per-request record
+                errs[i] = e
+
+        ts = [threading.Thread(target=one, args=(i,), daemon=True)
+              for i in range(len(reqs))]
+        t0 = time.perf_counter()
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(timeout=timeout)
+        return time.perf_counter() - t0, lat, errs
+
+    # 1. sustained storm THROUGH THE WIRE against a 2-replica fleet
+    root = tempfile.mkdtemp(prefix="fleetns_storm_")
+    with FleetReplica(root, owner="p", ttl_s=2.0,
+                      server_kwargs=srv_kw) as p:
+        p.wait_role("primary", 600)
+        with FleetReplica(root, owner="s", ttl_s=2.0,
+                          server_kwargs=srv_kw):
+            cli = FitClient(discover_endpoints(root), seed=5,
+                            deadline_s=1800.0)
+            wall_b, lat_b, errs_b = _storm(cli, panels, "req")
+            cli.close()
+    lats = sorted(v for v in lat_b if v is not None)
+    storm_ok = not any(errs_b) and len(lats) == n_reqs
+    p50 = float(np.percentile(lats, 50)) if lats else None
+
+    # 2. failover-recovery latency: primary crashes mid-batch after its
+    #    first durable commit; the standby takes over and re-answers
+    root2 = tempfile.mkdtemp(prefix="fleetns_fail_")
+    a = FleetReplica(root2, owner="a", ttl_s=1.0, retire_on_crash=True,
+                     server_kwargs=dict(
+                         srv_kw, _commit_hook=fi.crash_after_commits(1)))
+    b = FleetReplica(root2, owner="b", ttl_s=1.0, server_kwargs=srv_kw)
+    with a, b:
+        a.wait_role("primary", 600)
+        cli = FitClient(discover_endpoints(root2), seed=6,
+                        deadline_s=1800.0)
+        t0 = time.perf_counter()
+        got = cli.submit("fo", panels[0], "arima", request_id="fo-1",
+                         **kw).result(timeout=1800)
+        failover_wall = time.perf_counter() - t0
+        took_over = b.wait_role("primary", 600)
+        elections = b.counters["elections"]
+        survivor_holds = (read_lease(root2) or {}).get("owner") == "b"
+        cli.close()
+    with serving.FitServer(tempfile.mkdtemp(prefix="fleetns_ref_"),
+                           **srv_kw) as ref:
+        want = ref.submit("fo", panels[0], "arima", request_id="fo-1",
+                          **kw).result(timeout=1800)
+    bitwise = all(
+        np.array_equal(np.asarray(getattr(got, f)),
+                       np.asarray(getattr(want, f)), equal_nan=True)
+        for f in fields)
+    gate_ok = bool(storm_ok and took_over and bitwise and survivor_holds)
+    return {
+        "replicas": 2,
+        "requests": n_reqs,
+        "rows_per_request": rows,
+        "obs_per_series": t_len,
+        "wall_s": round(wall_b, 3),
+        "rows_per_sec": (round(n_reqs * rows / wall_b, 1)
+                         if wall_b > 0 else None),
+        "requests_per_sec": (round(n_reqs / wall_b, 2)
+                             if wall_b > 0 else None),
+        "p50_request_latency_s": (round(p50, 4)
+                                  if p50 is not None else None),
+        "p99_request_latency_s": (round(float(np.percentile(lats, 99)), 4)
+                                  if lats else None),
+        "storm_errors": [repr(e)[:120] for e in errs_b if e][:3],
+        # submit -> re-answered THROUGH a primary crash + lease takeover;
+        # the penalty over steady-state p50 is the failover price
+        "failover_request_wall_s": round(failover_wall, 3),
+        "failover_recovery_penalty_s": (round(failover_wall - p50, 3)
+                                        if p50 is not None else None),
+        "failover_bitwise_identical": bitwise,
+        "failover_elections": elections,
+        "fleet_gate_ok": gate_ok,
+        "data": "2 FleetReplica on one lease-fenced root; socket storm "
+                f"of {n_reqs} tenant requests x {rows} rows through "
+                "FitClient (length-prefixed frames, idempotent ids), + "
+                "a crash-mid-batch failover leg re-answered by the "
+                "surviving standby",
+    }
+
+
 def _forecast_northstar(jnp, quick, on_tpu):
     """ISSUE 14 acceptance: the panel-scale forecast surface behind the
     long-dormant ``forecast_latency_s`` field.
@@ -2387,6 +2532,12 @@ def bench_arima_headline(jnp, quick, on_tpu, n_chips, platform, parity=None):
     # throughput/latency, batching amplification, 2x-overload shedding
     _progress("config 3: serving north-star (resident fit server)...")
     acct["serving_northstar"] = _serving_northstar(jnp, quick, on_tpu)
+    # ISSUE 16: the fleet behind a socket — through-the-wire storm
+    # throughput/latency + the failover-recovery price of a primary
+    # crash under the lease/fencing protocol
+    _progress("config 3: fleet north-star (lease-fenced replicas)...")
+    acct["fleet_serving_northstar"] = _fleet_serving_northstar(
+        jnp, quick, on_tpu)
     # ISSUE 14: the panel forecast surface — journaled forecast walk
     # rows/sec, resume/from-journal bitwise, backtest campaign wall,
     # ensemble overhead
@@ -2510,6 +2661,18 @@ def _telemetry_regression_gate(headline):
             "serving_p99_latency_s": sv.get("p99_request_latency_s"),
             "serving_batch_amplification": sv.get("batch_amplification"),
             "serving_gate_ok": 1.0 if sv.get("serving_gate_ok") else 0.0,
+        }
+    # fleet gate inputs (ISSUE 16): through-the-wire throughput, the
+    # failover price, and the takeover contract — a fleet regression
+    # (fencing broken, takeover re-answers drifting) hides behind the
+    # in-process serving numbers
+    fl = headline.get("fleet_serving_northstar") or {}
+    if fl.get("rows_per_sec") is not None:
+        inputs = {
+            **(inputs or {}),
+            "fleet_rows_per_sec": fl.get("rows_per_sec"),
+            "fleet_failover_wall_s": fl.get("failover_request_wall_s"),
+            "fleet_gate_ok": 1.0 if fl.get("fleet_gate_ok") else 0.0,
         }
     # forecast gate inputs (ISSUE 14): panel forecast throughput and the
     # composed bitwise contracts — a forecast-walk regression (resume
@@ -2653,6 +2816,17 @@ def _telemetry_regression_gate(headline):
             "tolerance": 0.0, "mode": "abs", "direction": "higher",
             "flagged": True}
         flagged.append("serving_overload_floor")
+    # ABSOLUTE floor (ISSUE 16): a failover must re-answer the in-flight
+    # request bitwise with the lease on the survivor — a fleet that
+    # loses a request or splices stale bytes across a takeover is broken
+    # regardless of the previous run
+    flg = inputs.get("fleet_gate_ok")
+    if flg is not None and flg < 1.0:
+        drifts["fleet_failover_floor"] = {
+            "prev": 1.0, "cur": flg, "drift": 1.0,
+            "tolerance": 0.0, "mode": "abs", "direction": "higher",
+            "flagged": True}
+        flagged.append("fleet_failover_floor")
     # ABSOLUTE floor (ISSUE 14): the composed forecast contracts — resume
     # bitwise, from-journal bitwise, ensemble argmin/weights, the
     # campaign completing — are correctness, not perf: any miss is broken
@@ -2776,6 +2950,14 @@ def _summary_line(emitted):
                     "p50_request_latency_s", "p99_request_latency_s",
                     "batch_amplification", "overload_shed_rate",
                     "overload_conserved", "serving_gate_ok")}
+            fl = obj.get("fleet_serving_northstar")
+            if fl:
+                entry["fleet_serving_northstar"] = {k: fl.get(k) for k in (
+                    "replicas", "requests", "rows_per_request",
+                    "rows_per_sec", "p50_request_latency_s",
+                    "p99_request_latency_s", "failover_request_wall_s",
+                    "failover_recovery_penalty_s",
+                    "failover_bitwise_identical", "fleet_gate_ok")}
             fo = obj.get("forecast_northstar")
             if fo:
                 entry["forecast_northstar"] = {k: fo.get(k) for k in (

@@ -204,6 +204,17 @@ python tests/_lockdiscipline_worker.py --smoke
 # valid (atomic writes: a scraper never sees a torn file)
 python tests/_serving_worker.py --smoke
 
+# fleet failover smoke (ISSUE 16): two FleetReplica processes share one
+# checkpoint root under the lease/fencing protocol; the fleet is stormed
+# through the socket client (direct submits + a run_backtest(server=)
+# leg), the primary is REALLY SIGKILLed mid-commit after 3 durable chunk
+# commits, and the surviving standby must take the lease over (higher
+# fencing token) and RE-ANSWER every in-flight request bitwise vs an
+# uninterrupted single server — then the restarted zombie must be fenced
+# back to standby instead of splicing stale bytes. The runtime lock
+# tracker rides the survivor and the orchestrator's client retry paths.
+python tests/_fleet_worker.py --smoke
+
 # serving tooling smoke (ISSUE 12): a short server run with telemetry on
 # must leave (a) a prom textfile that passes the obs_report --prom gate —
 # exposition syntax + every registry metric present under its mapped name,

@@ -60,9 +60,11 @@ from .committer import ChunkCommitter, CommitterStats
 from .plan import (ExecutionPlan, LaneRunner, LaneSpec, LaneSupervisor,
                    RestagedPanel, WorkQueue, shard_spans)
 from .prefetcher import ChunkPrefetcher, PrefetchStats
-from .journal import (ChunkJournal, JournalError, MergeWarmer,
-                      ShardJournalView, StaleJournalError, TornManifestError,
-                      config_hash, merge_job_manifest, panel_fingerprint)
+from .journal import (ChunkJournal, FencedError, JournalError, Lease,
+                      LeaseError, MergeWarmer, ShardJournalView,
+                      StaleJournalError, TornManifestError, acquire_lease,
+                      config_hash, merge_job_manifest, panel_fingerprint,
+                      read_lease)
 from .source import (ChunkSource, DeviceChunkSource, HostChunkSource,
                      NpzShardSource, SourceError, StagingPool, as_source,
                      write_npz_shards)
@@ -90,8 +92,13 @@ __all__ = [
     "Deadline",
     "DeadlineExceeded",
     "ExecutionPlan",
+    "FencedError",
     "FitStatus",
     "JournalError",
+    "Lease",
+    "LeaseError",
+    "acquire_lease",
+    "read_lease",
     "LaneRunner",
     "LaneSpec",
     "LaneSupervisor",

@@ -49,6 +49,18 @@ process after N durable chunk commits across its batch walks;
 straggle, keyed on the thread-local request tag
 (:func:`~.watchdog.current_request`) exactly like the lane faults key on
 the lane tag.
+
+**Transport faults** (ISSUE 16 — the fleet's socket plane): a
+:func:`frame_fault_schedule` maps a seed to a deterministic per-frame
+fault sequence (drop / duplicate / tear / pass), and :class:`FaultyWire`
+wraps a client socket so each ``sendall`` — exactly one wire frame by
+the transport contract — suffers its scheduled fault: dropped frames
+exercise the client's reconnect-and-resubmit path, duplicated frames the
+server's idempotent-resubmit ack and the client's msg-id reply pairing,
+torn frames (a prefix followed by an abrupt reset) the CRC frame
+validation, and ``reset_after`` connection resets the mid-batch failover
+path.  Replica death mid-storm reuses :func:`server_kill` — the fleet
+primary is just a FitServer.
 """
 
 from __future__ import annotations
@@ -65,9 +77,11 @@ from .status import STATUS_DTYPE, FitStatus
 from .watchdog import current_lane, current_request
 
 __all__ = [
+    "FaultyWire",
     "SimulatedCrash",
     "SimulatedLaneFailure",
     "SimulatedResourceExhausted",
+    "frame_fault_schedule",
     "inject_nan_rows",
     "inject_inf_rows",
     "make_constant_rows",
@@ -493,6 +507,120 @@ def slow_tenant(fit_fn: Callable, tenant: str, delay_s: float) -> Callable:
         return fit_fn(yb, **kwargs)
 
     return wrapped
+
+
+# ---------------------------------------------------------------------------
+# transport faults (ISSUE 16: the fleet's socket plane — dropped/duplicated/
+# half-written frames and connection resets, deterministically seeded)
+# ---------------------------------------------------------------------------
+
+
+def frame_fault_schedule(seed: int, n: int, *, drop_frac: float = 0.1,
+                         dup_frac: float = 0.1,
+                         tear_frac: float = 0.05) -> list:
+    """A deterministic per-frame fault plan: ``n`` entries drawn from
+    ``{"pass", "drop", "dup", "tear"}`` with the given rates.  Same seed
+    → same schedule, bit-for-bit, so a transport test's fault pattern is
+    reproducible from its seed alone (the client's backoff jitter is
+    seeded the same way — :func:`serving.client.backoff_schedule`)."""
+    if drop_frac + dup_frac + tear_frac > 1.0:
+        raise ValueError("fault fractions must sum to at most 1.0")
+    rng = np.random.default_rng(int(seed))
+    u = rng.random(int(n))
+    out = []
+    for x in u:
+        if x < drop_frac:
+            out.append("drop")
+        elif x < drop_frac + dup_frac:
+            out.append("dup")
+        elif x < drop_frac + dup_frac + tear_frac:
+            out.append("tear")
+        else:
+            out.append("pass")
+    return out
+
+
+class FaultyWire:
+    """A lossy socket: each ``sendall`` (one wire frame, by the transport
+    layer's one-``sendall``-per-message contract) consumes the next entry
+    of a :func:`frame_fault_schedule` — ``pass`` forwards the frame,
+    ``drop`` swallows it (the peer never sees it; the client's deadline +
+    resubmit machinery must recover), ``dup`` forwards it twice (the
+    server must ack idempotently and the client must pair replies by
+    msg id), ``tear`` forwards a half-frame prefix then resets the
+    connection (the peer's CRC/EOF validation must reject the torn frame
+    loudly).  ``reset_after=k`` additionally drops the connection after
+    ``k`` successful frames — the mid-batch reset fault.  Past the end of
+    the schedule every frame passes (faults are a finite storm, not a
+    dead wire).  Duck-types the socket surface the transport layer uses
+    (``sendall/recv/settimeout/close``); wrap client connections via
+    ``FitClient(_wire_wrap=...)``."""
+
+    def __init__(self, sock, schedule, *, reset_after: Optional[int] = None):
+        self._sock = sock
+        self._schedule = list(schedule)
+        self._sent = 0
+        self._ok = 0
+        self._reset_after = None if reset_after is None else int(reset_after)
+        self.log: list = []
+
+    def _next_fault(self) -> str:
+        i = self._sent
+        self._sent += 1
+        if self._reset_after is not None and self._ok >= self._reset_after:
+            return "reset"
+        return self._schedule[i] if i < len(self._schedule) else "pass"
+
+    def sendall(self, data: bytes) -> None:
+        fault = self._next_fault()
+        self.log.append(fault)
+        if fault == "drop":
+            return
+        if fault == "dup":
+            self._sock.sendall(data)
+            self._sock.sendall(data)
+            self._ok += 1
+            return
+        if fault == "tear":
+            self._sock.sendall(data[: max(1, len(data) // 2)])
+            self._reset()
+            raise ConnectionResetError(
+                "simulated torn frame (reliability.faultinject.FaultyWire)")
+        if fault == "reset":
+            self._reset()
+            raise ConnectionResetError(
+                "simulated connection reset "
+                "(reliability.faultinject.FaultyWire)")
+        self._sock.sendall(data)
+        self._ok += 1
+
+    def _reset(self) -> None:
+        try:
+            import socket as socket_mod
+            import struct
+
+            # SO_LINGER 0: RST on close, not FIN — an abrupt peer death
+            self._sock.setsockopt(socket_mod.SOL_SOCKET,
+                                  socket_mod.SO_LINGER,
+                                  struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def recv(self, n: int) -> bytes:
+        return self._sock.recv(n)
+
+    def settimeout(self, t) -> None:
+        self._sock.settimeout(t)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
 
 def tear_file(path: str, keep_frac: float = 0.5) -> None:

@@ -74,17 +74,22 @@ from .. import obs
 
 __all__ = [
     "ChunkJournal",
+    "FencedError",
     "JournalError",
+    "Lease",
+    "LeaseError",
     "LoadedChunk",
     "MergeWarmer",
     "ShardJournalView",
     "StaleJournalError",
     "TornManifestError",
+    "acquire_lease",
     "chunk_fingerprint",
     "chunk_sample_steps",
     "config_hash",
     "merge_job_manifest",
     "panel_fingerprint",
+    "read_lease",
 ]
 
 # version 2 (ISSUE 15): manifest chunk entries gain a per-chunk content
@@ -109,6 +114,20 @@ class TornManifestError(JournalError):
 
 class StaleJournalError(JournalError):
     """The manifest belongs to a different panel or fit configuration."""
+
+
+class LeaseError(JournalError):
+    """Base class for lease-protocol failures (ISSUE 16)."""
+
+
+class FencedError(LeaseError):
+    """A stale-token holder tried to act on a root it no longer owns.
+
+    The fencing contract (ISSUE 16): every durable write a lease holder
+    performs is preceded by a token check, and a holder whose token is no
+    longer the highest claim LOSES LOUDLY — it must stop writing, never
+    fall back to best-effort.  Raised by :meth:`Lease.check` (and so by
+    every fenced write path in ``serving.fleet``)."""
 
 
 def _array_digest(v) -> str:
@@ -1062,5 +1081,201 @@ def merge_job_manifest(
         **({"rebalance": manifest["rebalance"]}
            if rebalance is not None else {}),
     }
+
+
+# ---------------------------------------------------------------------------
+# lease records (ISSUE 16: fleet serving's single-writer election)
+# ---------------------------------------------------------------------------
+# A fleet of FitServer replicas shares ONE checkpoint root, but the root's
+# durability story (write-ahead requests, batch journals, results) is a
+# single-writer protocol — so exactly one replica may run a server at a
+# time.  The lease is built from the primitives this module already
+# guarantees:
+#
+# - **fencing tokens** are allocated by atomic claim manifests:
+#   ``<root>/lease_claims/claim_<token>.json`` created with
+#   ``O_CREAT | O_EXCL`` — the filesystem arbitrates, exactly one process
+#   ever owns a token, and tokens are strictly monotonic (next = highest
+#   existing + 1).  The HIGHEST claim is the lease holder.
+# - **the lease record** ``<root>/lease.json`` is the holder's heartbeat,
+#   written via :func:`durable_replace` (whole or absent, never torn).
+#
+# Liveness: a lease is LIVE while its highest claim is fresh — either the
+# lease record's ``heartbeat_at`` or the claim file's mtime is within
+# ``ttl_s``.  A SIGKILLed holder simply stops heartbeating; after ttl a
+# standby claims token+1 and takes over.  A restarted zombie holding the
+# OLD token fails :meth:`Lease.check` on its next write — stale-token
+# writers lose loudly (:class:`FencedError`), they never splice bytes
+# into the new holder's root.
+
+LEASE_FILE = "lease.json"
+LEASE_CLAIMS_DIR = "lease_claims"
+
+
+def _lease_path(root: str) -> str:
+    return os.path.join(root, LEASE_FILE)
+
+
+def _claims_dir(root: str) -> str:
+    return os.path.join(root, LEASE_CLAIMS_DIR)
+
+
+def _claim_path(root: str, token: int) -> str:
+    return os.path.join(_claims_dir(root), f"claim_{int(token):08d}.json")
+
+
+def highest_claim(root: str) -> int:
+    """The highest fencing token ever claimed under ``root`` (0 = none)."""
+    top = 0
+    try:
+        for fn in os.listdir(_claims_dir(root)):
+            if fn.startswith("claim_") and fn.endswith(".json"):
+                try:
+                    top = max(top, int(fn[len("claim_"):-len(".json")]))
+                except ValueError:
+                    pass
+    except OSError:
+        pass
+    return top
+
+
+def read_lease(root: str) -> Optional[dict]:
+    """The current lease record, or None when absent/unreadable.
+
+    ``lease.json`` is written via :func:`durable_replace`, so an
+    unreadable record only happens under manual corruption — token
+    monotonicity (and therefore fencing safety) rests on the claim
+    manifests, never on this record, so unreadable degrades to None."""
+    try:
+        with open(_lease_path(root)) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def lease_is_live(root: str, *, now: Optional[float] = None) -> bool:
+    """Whether SOME holder currently owns the root (highest claim fresh).
+
+    The freshness source is the lease record's heartbeat when it carries
+    the highest token, else the highest claim file's mtime (the window
+    between a claim landing and its first heartbeat write)."""
+    top = highest_claim(root)
+    if top == 0:
+        return False
+    now = time.time() if now is None else now  # lint: nondet(lease liveness is wall-clock by design; never fitted bytes)
+    rec = read_lease(root)
+    if rec is not None and int(rec.get("token", 0)) == top:
+        if rec.get("released"):
+            return False
+        ttl = float(rec.get("ttl_s", 5.0))
+        return (now - float(rec.get("heartbeat_at", 0.0))) < ttl
+    # highest claimant has not heartbeated yet: fresh claim == live
+    try:
+        claim_path = _claim_path(root, top)
+        with open(claim_path) as f:
+            claim = json.load(f)
+        ttl = float(claim.get("ttl_s", 5.0))
+        return (now - os.stat(claim_path).st_mtime) < ttl
+    except (OSError, json.JSONDecodeError, ValueError):
+        return False
+
+
+class Lease:
+    """A held fleet lease: fencing token + heartbeat record (ISSUE 16).
+
+    Instances come from :func:`acquire_lease`; holders call
+    :meth:`heartbeat` at most every ``ttl_s / 3`` and :meth:`check`
+    before every durable write they gate.  Both raise
+    :class:`FencedError` the moment a higher claim exists — the holder
+    must stop writing and step down.
+    """
+
+    def __init__(self, root: str, owner: str, token: int, ttl_s: float):
+        self.root = os.path.abspath(root)
+        self.owner = str(owner)
+        self.token = int(token)
+        self.ttl_s = float(ttl_s)
+
+    def __repr__(self) -> str:
+        return (f"Lease(root={self.root!r}, owner={self.owner!r}, "
+                f"token={self.token}, ttl_s={self.ttl_s})")
+
+    def check(self) -> None:
+        """Raise :class:`FencedError` unless this token is still the
+        highest claim — the gate every fenced write runs behind."""
+        top = highest_claim(self.root)
+        if top != self.token:
+            raise FencedError(
+                f"lease token {self.token} (owner {self.owner!r}) is "
+                f"fenced: highest claim on {self.root} is {top} — "
+                "stale-token writers must stop, not retry")
+
+    def heartbeat(self) -> None:
+        """Refresh the lease record's liveness (check first: a fenced
+        holder must not resurrect its record over the new holder's)."""
+        self.check()
+        self._write_record()
+
+    def release(self) -> None:
+        """Mark the lease released so a successor acquires immediately
+        instead of waiting out the ttl.  No-op once fenced."""
+        try:
+            self.check()
+        except FencedError:
+            return
+        self._write_record(released=True)
+
+    def _write_record(self, released: bool = False) -> None:
+        rec = {
+            "token": self.token,
+            "owner": self.owner,
+            "ttl_s": self.ttl_s,
+            "heartbeat_at": time.time(),  # lint: nondet(lease liveness metadata; never fitted bytes)
+            "released": bool(released),
+        }
+        _atomic_write_bytes(
+            _lease_path(self.root),
+            (json.dumps(rec, indent=1, sort_keys=True) + "\n").encode())
+
+
+def acquire_lease(root: str, owner: str, *,
+                  ttl_s: float = 5.0) -> Optional[Lease]:
+    """Try to acquire the root's lease; None while another holder is live.
+
+    The claim write is the election: ``O_CREAT | O_EXCL`` on the next
+    token's claim manifest means the filesystem picks exactly one winner
+    per token, and a fresh claim counts as live (``lease_is_live``), so
+    a racer that lost the claim sees the winner as the holder and backs
+    off.  Callers poll — a standby loops ``acquire_lease`` until the
+    incumbent's heartbeat goes stale."""
+    root = os.path.abspath(root)
+    os.makedirs(_claims_dir(root), exist_ok=True)
+    for _ in range(64):
+        if lease_is_live(root):
+            return None
+        token = highest_claim(root) + 1
+        claim = {
+            "token": token,
+            "owner": str(owner),
+            "ttl_s": float(ttl_s),
+            "claimed_at": time.time(),  # lint: nondet(lease liveness metadata; never fitted bytes)
+        }
+        try:
+            fd = os.open(_claim_path(root, token),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            continue  # lost the election for this token; re-evaluate
+        with os.fdopen(fd, "wb") as f:
+            f.write((json.dumps(claim, indent=1, sort_keys=True)
+                     + "\n").encode())
+            f.flush()
+            os.fsync(f.fileno())
+        lease = Lease(root, owner, token, ttl_s)
+        lease._write_record()
+        obs.event("lease.acquired", root=root, owner=str(owner),
+                  token=token)
+        return lease
+    return None
 
 

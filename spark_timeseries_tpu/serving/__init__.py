@@ -32,30 +32,58 @@ Quickstart::
 - :mod:`.batcher` — micro-batch packing/demux and the durable batch
   membership records recovery replays.
 - :mod:`.server` — the :class:`FitServer` daemon itself.
+- :mod:`.transport` — the length-prefixed socket wire protocol
+  (ISSUE 16): CRC-framed messages carrying the durable npz+JSON request
+  spelling verbatim, and :class:`TransportServer`, the per-replica
+  socket front end.
+- :mod:`.client` — :class:`FitClient`: kill-tolerant remote access with
+  idempotent resubmit on existing request ids, bounded deterministic
+  backoff, per-call deadlines, and reconnect-safe result polling.
+- :mod:`.fleet` — :class:`FleetReplica`: N replicas on one checkpoint
+  root under a lease/fencing protocol; a SIGKILLed primary's write-ahead
+  requests are taken over and re-answered bitwise by a surviving peer,
+  and stale-token zombies lose loudly (:class:`FencedError`).
 """
 
-from . import admission, batcher, server, session
+from . import admission, batcher, client, fleet, server, session, transport
 from .admission import AdmissionQueue, TenantQuota
 from .batcher import MicroBatch, batch_key
+from .client import ClientDeadlineError, FitClient, RemoteTicket, backoff_schedule
+from .fleet import FleetReplica, discover_endpoints
 from .server import FORECAST_MODEL, FitServer
 from .session import (CancelledError, FitRequest, FitTicket, RejectedError,
                       ServerClosedError, TenantFitResult)
+from .transport import (FrameError, NotLeaderError, TransportError,
+                        TransportServer)
 
 __all__ = [
     "FORECAST_MODEL",
     "AdmissionQueue",
     "CancelledError",
+    "ClientDeadlineError",
+    "FitClient",
     "FitRequest",
     "FitServer",
     "FitTicket",
+    "FleetReplica",
+    "FrameError",
     "MicroBatch",
+    "NotLeaderError",
     "RejectedError",
+    "RemoteTicket",
     "ServerClosedError",
     "TenantFitResult",
     "TenantQuota",
+    "TransportError",
+    "TransportServer",
     "admission",
+    "backoff_schedule",
     "batch_key",
     "batcher",
+    "client",
+    "discover_endpoints",
+    "fleet",
     "server",
     "session",
+    "transport",
 ]

@@ -1,0 +1,395 @@
+"""Lease-fenced FitServer replicas sharing one checkpoint root.
+
+ISSUE 16's failover half.  The durable story under ``<root>`` (write-
+ahead requests, batch journals, results) is a single-writer protocol, so
+a fleet of N replicas must elect exactly one writer — and keep a
+SIGKILLed writer's ZOMBIE (the same process restarted, or a stalled
+thread waking up mid-write) from ever splicing bytes over its
+successor's.  Both come from ``reliability.journal``'s lease records:
+
+- a replica becomes **primary** by winning :func:`~..reliability.journal.
+  acquire_lease` (an ``O_EXCL`` claim manifest allocates a strictly
+  monotonic fencing token); it then constructs a :class:`~.server.
+  FitServer` on the shared root — whose normal crash RECOVERY is what
+  re-answers the dead peer's write-ahead requests, bitwise — and
+  heartbeats the lease while serving.
+- every durable write the primary performs is **fenced**: the journal
+  commit hook and the result store both re-check the token first, so a
+  stale holder dies with :class:`~..reliability.journal.FencedError`
+  mid-write instead of corrupting the root (stale-token writers lose
+  loudly).
+- **standbys** poll the lease and serve the transport meanwhile:
+  submits answer ``not_leader`` (the client rotates and retries), but
+  result polls are answered FROM THE DURABLE FILES — a completed
+  request's result is readable through any replica, which is what makes
+  client polling survive the primary's death without waiting out the
+  lease TTL.
+
+Topology: every replica runs its own :class:`~.transport.TransportServer`
+and advertises its endpoint under ``<root>/endpoints/`` so clients (and
+the ci fleet smoke) can discover the fleet from the root alone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from .. import obs
+from ..reliability import journal as journal_mod
+from ..reliability.journal import FencedError
+from . import transport as transport_mod
+from .server import FitServer
+from .session import TenantFitResult
+from .transport import NotLeaderError, TransportServer
+
+__all__ = [
+    "FleetReplica",
+    "advertise_endpoint",
+    "discover_endpoints",
+    "withdraw_endpoint",
+]
+
+ENDPOINTS_DIR = "endpoints"
+
+
+# ---------------------------------------------------------------------------
+# endpoint advertisement (fleet discovery from the root alone)
+# ---------------------------------------------------------------------------
+
+
+def advertise_endpoint(root: str, owner: str, host: str, port: int) -> None:
+    """Durably advertise a replica's transport endpoint under the root
+    (atomic: a discovering client never reads a torn advert)."""
+    d = os.path.join(os.path.abspath(root), ENDPOINTS_DIR)
+    os.makedirs(d, exist_ok=True)
+    journal_mod._atomic_write_bytes(
+        os.path.join(d, f"{owner}.json"),
+        (json.dumps({"owner": str(owner), "host": str(host),
+                     "port": int(port), "pid": os.getpid()},
+                    sort_keys=True) + "\n").encode())
+
+
+def withdraw_endpoint(root: str, owner: str) -> None:
+    try:
+        os.remove(os.path.join(os.path.abspath(root), ENDPOINTS_DIR,
+                               f"{owner}.json"))
+    except OSError:
+        pass
+
+
+def discover_endpoints(root: str) -> List[Tuple[str, int]]:
+    """Every advertised ``(host, port)`` under the root, owner-sorted.
+    Stale adverts (a SIGKILLed replica never withdraws) are harmless:
+    clients treat a refused connection as one more rotate-and-retry."""
+    d = os.path.join(os.path.abspath(root), ENDPOINTS_DIR)
+    out = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for fn in names:
+        if not fn.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(d, fn)) as f:
+                rec = json.load(f)
+            out.append((str(rec["host"]), int(rec["port"])))
+        except (OSError, json.JSONDecodeError, KeyError, ValueError):
+            continue
+    return out
+
+
+def _load_result_file(path: str) -> TenantFitResult:
+    """A stored result, loaded WITHOUT a server instance (the standby
+    poll path): same npz spelling as ``FitServer._store_result``."""
+    with open(path, "rb") as f:
+        return transport_mod.decode_result_blob(f.read())
+
+
+# ---------------------------------------------------------------------------
+# the fenced server: every durable write re-checks the token first
+# ---------------------------------------------------------------------------
+
+
+class _FencedFitServer(FitServer):
+    """A FitServer whose durable writes are gated by a fleet lease.
+
+    Two fences cover every byte the server lands on the shared root:
+    the journal commit hook (checked at each durable chunk commit, so a
+    zombie's batch walk dies mid-batch) and the result store (so a walk
+    that finished before the fence flipped still cannot splice its
+    result file over the new primary's).  Both raise
+    :class:`FencedError` — the crash path, not a degrade."""
+
+    def __init__(self, root: str, lease: journal_mod.Lease, **kwargs):
+        self._fleet_lease = lease
+        user_hook = kwargs.pop("_commit_hook", None)
+
+        def fenced_hook(event: str, lo: int) -> None:
+            if event == "committed":
+                lease.check()
+            if user_hook is not None:
+                user_hook(event, lo)
+
+        super().__init__(root, _commit_hook=fenced_hook, **kwargs)
+
+    def _store_result(self, req_id: str, res) -> None:
+        self._fleet_lease.check()
+        super()._store_result(req_id, res)
+
+
+# ---------------------------------------------------------------------------
+# the replica
+# ---------------------------------------------------------------------------
+
+
+class FleetReplica:
+    """One member of a FitServer fleet on a shared checkpoint root.
+
+    .. attribute:: _protected_by_
+
+        Lock-discipline contract (tools/lint lock-map): the control
+        thread elects/demotes while transport handler threads read the
+        role and delegate to the leased server, and ``stop()`` may come
+        from any thread — the role/lease/server triple and the counters
+        mutate only under their locks.
+
+    Lifecycle: ``start()`` brings up the transport (standbys answer),
+    advertises the endpoint, and runs the control thread — a loop of
+    ``acquire_lease`` → serve-as-primary (heartbeating every ``ttl/3``)
+    → demote on crash/fence/stop.  ``server_kwargs`` configure the
+    FitServer a primary constructs (fault hooks ride ``_commit_hook``
+    exactly as on a standalone server).  ``retire_on_crash=True`` keeps
+    a crashed replica down instead of re-electing it — what the
+    deterministic failover tests use to pin WHO takes over.
+    """
+
+    _protected_by_ = {
+        "_server": "_state_lock",
+        "_lease": "_state_lock",
+        "_role": "_state_lock",
+        "counters": "_counters_lock",
+    }
+
+    def __init__(self, root: str, *,
+                 owner: Optional[str] = None,
+                 ttl_s: float = 5.0,
+                 host: str = "127.0.0.1",
+                 port: int = 0,
+                 standby_poll_s: Optional[float] = None,
+                 server_kwargs: Optional[dict] = None,
+                 retire_on_crash: bool = False,
+                 server_ready_timeout_s: float = 300.0):
+        self.root = os.path.abspath(root)
+        self.owner = owner or f"replica-{uuid.uuid4().hex[:8]}"
+        self.ttl_s = float(ttl_s)
+        self.standby_poll_s = (self.ttl_s / 4.0 if standby_poll_s is None
+                               else float(standby_poll_s))
+        self.server_kwargs = dict(server_kwargs or {})
+        self.retire_on_crash = bool(retire_on_crash)
+        self.server_ready_timeout_s = float(server_ready_timeout_s)
+        self._requests_dir = os.path.join(self.root, "requests")
+        self._results_dir = os.path.join(self.root, "results")
+        self._transport = TransportServer(self, host=host, port=port)
+        self._state_lock = threading.Lock()
+        self._server: Optional[FitServer] = None
+        self._lease: Optional[journal_mod.Lease] = None
+        self._role = "standby"
+        self.counters: Dict[str, int] = {
+            "elections": 0, "fenced_demotions": 0, "crash_demotions": 0,
+            "heartbeats": 0,
+        }
+        self._counters_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._control: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FleetReplica":
+        if self._control is not None:
+            raise RuntimeError("FleetReplica.start() called twice")
+        self._transport.start()
+        host, port = self._transport.address
+        advertise_endpoint(self.root, self.owner, host, port)
+        self._control = threading.Thread(
+            target=self._control_loop, daemon=True,
+            name=f"fleet-control-{self.owner}")
+        self._control.start()
+        return self
+
+    def stop(self, timeout_s: float = 300.0) -> None:
+        self._stop.set()
+        t = self._control
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout_s)
+        self._transport.stop()
+        withdraw_endpoint(self.root, self.owner)
+
+    def __enter__(self) -> "FleetReplica":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._transport.address
+
+    def role(self) -> str:
+        with self._state_lock:
+            return self._role
+
+    def lease_token(self) -> Optional[int]:
+        with self._state_lock:
+            return None if self._lease is None else self._lease.token
+
+    def wait_role(self, role: str, timeout_s: float = 60.0) -> bool:
+        """Poll until this replica reports ``role`` (tests/orchestration)."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            if self.role() == role:
+                return True
+            time.sleep(0.02)
+        return self.role() == role
+
+    # -- the control loop (election / heartbeat / demotion) ------------------
+
+    def _control_loop(self) -> None:
+        while not self._stop.is_set():
+            lease = journal_mod.acquire_lease(self.root, self.owner,
+                                              ttl_s=self.ttl_s)
+            if lease is None:
+                self._stop.wait(self.standby_poll_s)
+                continue
+            srv = _FencedFitServer(self.root, lease, **self.server_kwargs)
+            with self._counters_lock:
+                self.counters["elections"] += 1
+            obs.event("fleet.elected", owner=self.owner, token=lease.token)
+            try:
+                srv.start(wait_ready=False)
+            except RuntimeError:
+                pass  # start() raced stop(); the loop below settles it
+            with self._state_lock:
+                self._lease = lease
+                self._server = srv
+                self._role = "recovering"
+            outcome = self._serve_as_primary(srv, lease)
+            # demotion: tear the server down first, then settle the lease
+            try:
+                srv.stop(drain=(outcome == "stopping"))
+            except Exception:  # noqa: BLE001 - demotion must complete
+                pass
+            try:
+                lease.release()
+            except FencedError:
+                pass  # the successor already owns the root
+            with self._state_lock:
+                self._lease = None
+                self._server = None
+                self._role = "standby"
+            if outcome == "fenced":
+                with self._counters_lock:
+                    self.counters["fenced_demotions"] += 1
+                obs.event("fleet.fenced", owner=self.owner,
+                          token=lease.token)
+            elif outcome == "crashed":
+                with self._counters_lock:
+                    self.counters["crash_demotions"] += 1
+                if self.retire_on_crash:
+                    with self._state_lock:
+                        self._role = "retired"
+                    return
+        with self._state_lock:
+            if self._role != "retired":
+                self._role = "stopped"
+
+    def _serve_as_primary(self, srv: FitServer,
+                          lease: journal_mod.Lease) -> str:
+        """Heartbeat until stop/crash/fence; returns the demotion cause.
+        The heartbeat runs DURING recovery too — a takeover whose replay
+        outlives the ttl must not lose the lease it is replaying under."""
+        beat = max(0.01, self.ttl_s / 3.0)
+        last = 0.0
+        ready_at: Optional[float] = None
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now - last >= beat:
+                try:
+                    lease.heartbeat()
+                except FencedError:
+                    return "fenced"
+                last = now
+                with self._counters_lock:
+                    self.counters["heartbeats"] += 1
+            state = srv.state()
+            if state == "crashed":
+                return "crashed"
+            if state in ("ready", "degraded"):
+                with self._state_lock:
+                    if self._role == "recovering":
+                        self._role = "primary"
+                ready_at = ready_at or now
+            elif ready_at is None and srv._ready.is_set():
+                # recovery finished but crashed/stopped settles next tick
+                ready_at = now
+            self._stop.wait(min(beat / 2.0, 0.05))
+        return "stopping"
+
+    # -- serving backend facade (what TransportServer dispatches into) -------
+
+    def _primary(self) -> FitServer:
+        with self._state_lock:
+            srv, role = self._server, self._role
+        if srv is None or role not in ("primary", "recovering"):
+            holder = journal_mod.read_lease(self.root) or {}
+            raise NotLeaderError(
+                f"replica {self.owner!r} is {role}; current lease holder "
+                f"is {holder.get('owner')!r} (token {holder.get('token')})")
+        return srv
+
+    def submit(self, tenant, values, model="arima", **kwargs):
+        return self._primary().submit(tenant, values, model, **kwargs)
+
+    def submit_forecast(self, tenant, values, fitted, **kwargs):
+        return self._primary().submit_forecast(tenant, values, fitted,
+                                               **kwargs)
+
+    def request_pending(self, req_id: str) -> bool:
+        with self._state_lock:
+            srv = self._server
+        if srv is not None:
+            return srv.request_pending(req_id)
+        return os.path.exists(os.path.join(self._requests_dir,
+                                           f"{req_id}.npz"))
+
+    def result_for(self, req_id: str) -> TenantFitResult:
+        """Results are durable files: ANY replica answers a completed
+        request's poll, so clients never wait out a lease TTL just to
+        read an answer that already exists."""
+        path = os.path.join(self._results_dir, f"{req_id}.npz")
+        if not os.path.exists(path):
+            raise KeyError(f"no stored result for request {req_id!r}")
+        return _load_result_file(path)
+
+    def health(self) -> dict:
+        with self._state_lock:
+            srv, role = self._server, self._role
+            token = None if self._lease is None else self._lease.token
+        with self._counters_lock:
+            counters = dict(self.counters)
+        out = {
+            "role": role,
+            "owner": self.owner,
+            "lease_token": token,
+            "fleet": counters,
+            "lease": journal_mod.read_lease(self.root),
+            "root": self.root,
+        }
+        if srv is not None and role in ("primary", "recovering"):
+            out["server"] = srv.health()
+        return out

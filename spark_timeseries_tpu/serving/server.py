@@ -545,6 +545,16 @@ class FitServer:
             raise KeyError(f"no stored result for request {req_id!r}")
         return self._load_result(path)
 
+    def request_pending(self, req_id: str) -> bool:
+        """Whether ``req_id`` is admitted and still in flight (live in
+        this instance, or durable under ``requests/`` awaiting recovery)
+        — the transport layer's idempotent-resubmit probe (ISSUE 16): a
+        pending id is acked, not re-admitted."""
+        with self._live_lock:
+            if req_id in self._live:
+                return True
+        return os.path.exists(self._request_path(req_id))
+
     # -- the serve loop ------------------------------------------------------
 
     def _serve(self) -> None:

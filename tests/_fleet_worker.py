@@ -1,0 +1,299 @@
+"""Subprocess worker for the fleet failover smoke (ISSUE 16).
+
+Runs :class:`serving.fleet.FleetReplica` processes sharing one checkpoint
+root — a primary and a standby — storms the fleet through the socket
+client (direct ``FitClient.submit`` traffic plus a rolling-origin
+``run_backtest(server=client)`` leg), SIGKILLs the primary MID-STORM
+(``faultinject.server_kill`` after N durable chunk commits: real process
+death with leased write-ahead requests in flight), and verifies
+
+- the standby takes over the lease and its recovery RE-ANSWERS every
+  in-flight request **bitwise** vs an uninterrupted single server on a
+  fresh root (zero lost, zero duplicated answers);
+- the backtest leg's metrics through the fleet equal the serverless
+  local campaign bitwise (the batched == solo contract, through a
+  socket, across a failover);
+- a RESTARTED primary process (same owner, new pid) is fenced to
+  standby by the survivor's higher lease token — the zombie rejoins,
+  it never writes;
+- the runtime lock-discipline tracker, installed inside the surviving
+  replica and around the orchestrator's storm, observes ZERO violations
+  of the declared ``_protected_by_`` maps on the takeover/recovery and
+  client retry paths (satellite of ISSUE 16: recovery paths get runtime
+  lock coverage, not just lexical).
+
+Modes:
+    --replica --root R --owner X [--ttl S] [--kill-commits N]
+              [--retire-on-crash] [--track-locks]
+        run one replica until ``<root>/stop_<owner>`` appears.
+    --smoke
+        full orchestration (used by ci.sh); prints PASS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+T = 96
+CELL = 8
+N_REQS = 4
+TTL_S = 1.0
+FIELDS = ("params", "neg_log_likelihood", "converged", "iters", "status")
+KW = dict(order=(1, 0, 0), max_iters=15)
+
+
+def make_panels():
+    rng = np.random.default_rng(23)
+    e = rng.normal(size=(N_REQS * CELL, T)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, T):
+        y[:, i] = 0.6 * y[:, i - 1] + e[:, i]
+    return [y[i * CELL:(i + 1) * CELL] for i in range(N_REQS)]
+
+
+def backtest_panel():
+    rng = np.random.default_rng(29)
+    e = rng.normal(size=(CELL, T)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, T):
+        y[:, i] = 0.6 * y[:, i - 1] + e[:, i]
+    return y
+
+
+SRV_KW = dict(cell_rows=CELL, batch_window_s=0.05, autotune=False)
+
+
+def replica(root: str, owner: str, ttl_s: float,
+            kill_commits: int | None, retire_on_crash: bool,
+            track_locks: bool) -> None:
+    from spark_timeseries_tpu.reliability import faultinject as fi
+    from spark_timeseries_tpu.serving.fleet import FleetReplica
+
+    tracker = None
+    if track_locks:
+        from tools.lint.runtime import LockDisciplineTracker
+
+        tracker = LockDisciplineTracker().install()
+    server_kwargs = dict(SRV_KW)
+    if kill_commits is not None:
+        server_kwargs["_commit_hook"] = fi.server_kill(kill_commits,
+                                                       mid_commit=True)
+    rep = FleetReplica(root, owner=owner, ttl_s=ttl_s,
+                       server_kwargs=server_kwargs,
+                       retire_on_crash=retire_on_crash)
+    rep.start()
+    stop_file = os.path.join(root, f"stop_{owner}")
+    while not os.path.exists(stop_file):
+        time.sleep(0.05)
+    rep.stop()
+    if tracker is not None:
+        tracker.uninstall()
+        if tracker.violations:
+            sys.exit(f"replica {owner}: lock-discipline violations on the "
+                     f"takeover/recovery path:\n{tracker.report()}")
+        print(f"replica {owner}: lock discipline OK "
+              f"({tracker.checks_decided} mutations checked)")
+    print(f"replica {owner}: stopped (final role {rep.role()})")
+
+
+def _spawn_replica(root: str, owner: str, *, kill_commits: int | None = None,
+                   retire_on_crash: bool = False,
+                   track_locks: bool = False) -> subprocess.Popen:
+    args = [sys.executable, os.path.abspath(__file__), "--replica",
+            "--root", root, "--owner", owner, "--ttl", str(TTL_S)]
+    if kill_commits is not None:
+        args += ["--kill-commits", str(kill_commits)]
+    if retire_on_crash:
+        args += ["--retire-on-crash"]
+    if track_locks:
+        args += ["--track-locks"]
+    return subprocess.Popen(
+        args, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _wait_lease_owner(root: str, owner: str, timeout_s: float = 120.0) -> dict:
+    from spark_timeseries_tpu.reliability.journal import read_lease
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        rec = read_lease(root)
+        if rec and rec.get("owner") == owner and not rec.get("released"):
+            return rec
+        time.sleep(0.05)
+    sys.exit(f"lease never went to {owner!r}: {read_lease(root)}")
+
+
+def _role_of(addr, timeout_s: float = 60.0) -> str:
+    from spark_timeseries_tpu.serving.client import FitClient
+
+    with FitClient([addr], deadline_s=timeout_s) as cli:
+        return cli.health()["role"]
+
+
+def smoke() -> None:
+    from tools.lint.runtime import LockDisciplineTracker
+    from spark_timeseries_tpu import serving
+    from spark_timeseries_tpu.forecasting import run_backtest
+    from spark_timeseries_tpu.reliability import faultinject as fi
+    from spark_timeseries_tpu.reliability.journal import read_lease
+    from spark_timeseries_tpu.serving.client import FitClient
+    from spark_timeseries_tpu.serving.fleet import discover_endpoints
+
+    panels = make_panels()
+    bt_y = backtest_panel()
+    bt_kw = dict(model_kwargs={"order": (1, 0, 0)},
+                 fit_kwargs={"max_iters": 15}, n_windows=2,
+                 chunk_rows=CELL, intervals=True, n_samples=32, seed=7)
+
+    with tempfile.TemporaryDirectory() as td:
+        # 0. uninterrupted references: a standalone server on a fresh
+        #    root (per-request results) + a serverless local backtest
+        ref_root = os.path.join(td, "ref")
+        with serving.FitServer(ref_root, **SRV_KW) as ref:
+            want = {
+                f"req-{i}": ref.submit(f"t{i}", panels[i], "arima",
+                                       request_id=f"req-{i}",
+                                       **KW).result(timeout=600)
+                for i in range(N_REQS)}
+        bt_ref = run_backtest(bt_y, "arima", 4, **bt_kw)
+
+        # 1. two replicas, one root; A (armed to die after 3 durable
+        #    commits, mid-commit) must win the election before B starts
+        root = os.path.join(td, "fleet")
+        os.makedirs(root)
+        a = _spawn_replica(root, "a", kill_commits=3, retire_on_crash=True)
+        _wait_lease_owner(root, "a")
+        b = _spawn_replica(root, "b", track_locks=True)
+        tok_a = read_lease(root)["token"]
+
+        # 2. storm the fleet through the socket client: direct submits
+        #    from a thread burst + the rolling-origin backtest leg, with
+        #    the orchestrator's own lock discipline tracked
+        tracker = LockDisciplineTracker().install()
+        try:
+            eps = discover_endpoints(root)
+            if len(eps) < 2:
+                time.sleep(1.0)
+                eps = discover_endpoints(root)
+            cli = FitClient(eps, seed=17, deadline_s=600.0,
+                            backoff_base_s=0.05)
+            calls = [((f"t{i}", panels[i], "arima"),
+                      dict(KW, request_id=f"req-{i}"))
+                     for i in range(N_REQS)]
+            tickets, errors = fi.request_storm(cli.submit, calls, threads=4)
+            bad = [e for e in errors if e is not None]
+            if bad:
+                sys.exit(f"storm submits failed: {bad!r}")
+            bt_got = run_backtest(bt_y, "arima", 4, server=cli, **bt_kw)
+            got = {f"req-{i}": tickets[i].result(timeout=600)
+                   for i in range(N_REQS)}
+            cli.close()
+        finally:
+            tracker.uninstall()
+        if tracker.violations:
+            sys.exit("orchestrator-side lock-discipline violations "
+                     f"(FitClient retry paths):\n{tracker.report()}")
+
+        # 3. the armed primary died by REAL SIGKILL mid-storm
+        a_out, a_err = a.communicate(timeout=600)
+        if a.returncode != -9:
+            sys.exit(f"expected replica a SIGKILLed (-9), got "
+                     f"rc={a.returncode}\nstdout:\n{a_out}\nstderr:\n{a_err}")
+        rec = read_lease(root)
+        if rec.get("owner") != "b" or rec["token"] <= tok_a:
+            sys.exit(f"survivor b did not take the lease over: {rec}")
+
+        # 4. conservation + bitwise: every in-flight request re-answered
+        #    by the survivor, byte-identical to the uninterrupted server
+        for rid, res in want.items():
+            for f in FIELDS:
+                if not np.array_equal(np.asarray(getattr(got[rid], f)),
+                                      np.asarray(getattr(res, f)),
+                                      equal_nan=True):
+                    sys.exit(f"{rid} field {f} differs after failover — "
+                             "takeover re-answer is NOT bitwise")
+        if (json.dumps(bt_ref.metrics, sort_keys=True)
+                != json.dumps(bt_got.metrics, sort_keys=True)):
+            sys.exit("backtest metrics through the fleet differ from the "
+                     "local campaign — the server= leg is NOT bitwise")
+
+        # 5. the restarted zombie (same owner, new pid) is FENCED to
+        #    standby by the survivor's higher token
+        a2 = _spawn_replica(root, "a", track_locks=True)
+        deadline = time.monotonic() + 120
+        roles = {}
+        while time.monotonic() < deadline:
+            roles = {}
+            for e in discover_endpoints(root):
+                try:
+                    roles[e] = _role_of(e, timeout_s=10.0)
+                except Exception:  # noqa: BLE001 - a stale advert
+                    roles[e] = "unreachable"
+            if ("primary" in roles.values()
+                    and "standby" in roles.values()):
+                break
+            time.sleep(0.2)
+        else:
+            sys.exit("restarted zombie never settled to standby beside "
+                     f"the surviving primary: {roles}")
+        if read_lease(root)["owner"] != "b":
+            sys.exit("the restarted zombie stole the lease back: "
+                     f"{read_lease(root)}")
+
+        # 6. orderly shutdown; both survivors exit clean with zero
+        #    lock-discipline violations on their recovery paths
+        for owner in ("a", "b"):
+            open(os.path.join(root, f"stop_{owner}"), "w").close()
+        b_out, b_err = b.communicate(timeout=600)
+        a2_out, a2_err = a2.communicate(timeout=600)
+        if b.returncode != 0:
+            sys.exit(f"replica b failed: rc={b.returncode}\n{b_out}\n{b_err}")
+        if a2.returncode != 0:
+            sys.exit(f"restarted replica a failed: rc={a2.returncode}\n"
+                     f"{a2_out}\n{a2_err}")
+        if "lock discipline OK" not in b_out:
+            sys.exit(f"replica b did not report lock coverage:\n{b_out}")
+        counters = json.dumps({"lease": read_lease(root)["token"]})
+        print("fleet failover smoke: PASS "
+              f"(primary SIGKILLed mid-commit after 3 commits, all "
+              f"{N_REQS} storm requests + the 2-window backtest leg "
+              "re-answered bitwise by the survivor, restarted "
+              f"zombie fenced to standby, {counters})")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replica", action="store_true")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--root")
+    ap.add_argument("--owner")
+    ap.add_argument("--ttl", type=float, default=TTL_S)
+    ap.add_argument("--kill-commits", type=int, default=None)
+    ap.add_argument("--retire-on-crash", action="store_true")
+    ap.add_argument("--track-locks", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        return smoke()
+    if not args.replica or not args.root or not args.owner:
+        ap.error("need --replica --root R --owner X, or --smoke")
+    replica(args.root, args.owner, args.ttl, args.kill_commits,
+            args.retire_on_crash, args.track_locks)
+
+
+if __name__ == "__main__":
+    main()

@@ -109,6 +109,12 @@ def run(root: str, kill_commits: int | None, out: str | None) -> None:
 def recover(root: str, out: str) -> None:
     import time
 
+    from tools.lint.runtime import LockDisciplineTracker
+
+    # the runtime lock tracker rides the WHOLE kill-and-resume recovery
+    # path (ISSUE 16 satellite): journal replay, re-admission, and the
+    # result-poll loop all run instrumented
+    tracker = LockDisciplineTracker().install()
     srv = build_server(root, None)
     srv.start()
     results = {}
@@ -124,10 +130,17 @@ def recover(root: str, out: str) -> None:
                 pass
         time.sleep(0.05)
     srv.stop()
+    tracker.uninstall()
+    if tracker.violations:
+        sys.exit("lock-discipline violations on the recovery path:\n"
+                 + tracker.report())
+    if tracker.checks_decided <= 0:
+        sys.exit("lock tracker decided no checks — instrumentation dead")
     if len(results) < N_REQS:
         sys.exit(f"recovery answered only {sorted(results)} of {N_REQS}")
     c = srv.health()["counters"]
-    print(f"recovered: {json.dumps({k: v for k, v in c.items() if v})}")
+    print(f"recovered: {json.dumps({k: v for k, v in c.items() if v})} "
+          f"(lock discipline OK, {tracker.checks_decided} checks)")
     save_results(out, results)
 
 

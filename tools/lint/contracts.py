@@ -350,6 +350,14 @@ FILE_WRITE_OWNERS = {
                         "committer calls INTO this owner)",
         "merge_job_manifest": "sole writer of the merged root "
                               "manifest.json after sharded lanes join",
+        "Lease": "writer of the root's lease.json heartbeat record "
+                 "(ISSUE 16): one holder per root by construction — the "
+                 "fencing token in the record is what every OTHER "
+                 "durable writer on the root checks before splicing",
+        "acquire_lease": "sole creator of lease_claims/ claim manifests "
+                         "(O_CREAT|O_EXCL: the filesystem arbitrates "
+                         "token allocation, so claims are never "
+                         "overwritten, only created)",
     },
     "spark_timeseries_tpu/reliability/source.py": {
         "write_npz_shards": "explicit export utility: creates a brand-new "
@@ -395,6 +403,42 @@ FILE_WRITE_OWNERS = {
     "spark_timeseries_tpu/serving/batcher.py": {
         "MicroBatch": "durable batch-membership records under the batch "
                       "journal directory it names (batch_id digest)",
+    },
+    "spark_timeseries_tpu/serving/transport.py": {
+        "TransportServer": "the socket front end performs NO durable "
+                           "writes of its own (ISSUE 16): request "
+                           "records land via FitRequest.save inside the "
+                           "backend's submit, results via the fenced "
+                           "FitServer._store_result — registered so the "
+                           "zero-direct-write contract of the wire "
+                           "layer is written down; a future handler "
+                           "that opens a file under the root fails the "
+                           "lint until routed through an owner",
+        "encode_request_blob": "np.savez into an in-memory BytesIO — "
+                               "wire encoding of the durable request "
+                               "spelling, never a filesystem write",
+        "encode_result_blob": "np.savez into an in-memory BytesIO — "
+                              "wire encoding of the stored-result "
+                              "spelling, never a filesystem write",
+    },
+    "spark_timeseries_tpu/serving/client.py": {
+        "FitClient.submit_forecast": "np.savez into an in-memory BytesIO "
+                                     "(the forecast submission blob: "
+                                     "values + fitted + status + meta) "
+                                     "— wire encoding only, the client "
+                                     "never touches the serving root",
+    },
+    "spark_timeseries_tpu/serving/fleet.py": {
+        "advertise_endpoint": "sole writer of the root's endpoints/ "
+                              "namespace (one advert per replica owner, "
+                              "atomic via the journal's byte-payload "
+                              "primitive so discovery never reads a "
+                              "torn advert)",
+        "FleetReplica": "performs no direct writes: primaries write "
+                        "through the fenced FitServer + Lease owners, "
+                        "standbys only READ results/ — registered so "
+                        "the single-writer story of a multi-replica "
+                        "root is written down",
     },
     "spark_timeseries_tpu/compat/sparkts.py": {
         "_ModelBase.save": "user-facing model save API: writes exactly "
@@ -442,6 +486,9 @@ LOCKMAP_RUNTIME_CLASSES = (
     "spark_timeseries_tpu.serving.admission:AdmissionQueue",
     "spark_timeseries_tpu.serving.session:FitTicket",
     "spark_timeseries_tpu.serving.server:FitServer",
+    "spark_timeseries_tpu.serving.transport:TransportServer",
+    "spark_timeseries_tpu.serving.client:FitClient",
+    "spark_timeseries_tpu.serving.fleet:FleetReplica",
     "spark_timeseries_tpu.obs.metrics:MetricsRegistry",
     "spark_timeseries_tpu.obs.recorder:FlightRecorder",
     "spark_timeseries_tpu.obs.promsink:PromTextfileSink",

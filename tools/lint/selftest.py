@@ -154,6 +154,69 @@ FIXTURES["journal-writer/backtest"] = (_FC, _fix("""
         os.replace(path, path)
     """), [functools.partial(journalwriter.check, owners=_FC_OWNERS)])
 
+# ISSUE 16: the fleet's socket plane joined the registries — seed a
+# violation of each NEW entry shape so a checker that stopped matching
+# them cannot pass vacuously.  (a) journal-writer: a rogue socket
+# handler writes an endpoint advert (the fleet discovery namespace)
+# directly instead of routing through the registered advertise_endpoint
+# owner; (b) lock-map: a transport-server-shaped class mutates its
+# connection registry outside the declared lock — the exact shape the
+# accept loop / stop() race would take.
+_FLEET = "spark_timeseries_tpu/serving/fixture_fleet.py"
+_FLEET_OWNERS = {_FLEET: {"advertise_endpoint":
+                          "sole writer of the endpoints/ namespace"}}
+
+FIXTURES["journal-writer/fleet"] = (_FLEET, _fix("""
+    import json
+    import os
+
+    def rogue_handler_advert(root, owner, port):
+        path = os.path.join(root, "endpoints", owner + ".json")
+        with open(path, "w") as f:     # unregistered writer
+            f.write(json.dumps({"port": port}))
+    """), _fix("""
+    import json
+    import os
+
+    def advertise_endpoint(root, owner, port):
+        path = os.path.join(root, "endpoints", owner + ".json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps({"port": port}))
+        os.replace(tmp, path)
+    """), [functools.partial(journalwriter.check, owners=_FLEET_OWNERS)])
+
+FIXTURES["lock-map/transport"] = (_FLEET, _fix("""
+    import threading
+
+    class WireServer:
+        _protected_by_ = {"_conns": "_conns_lock"}
+
+        def __init__(self):
+            self._conns_lock = threading.Lock()
+            self._conns = []
+
+        def _accept_loop(self, conn):
+            self._conns.append(conn)   # registration outside the lock
+    """), _fix("""
+    import threading
+
+    class WireServer:
+        _protected_by_ = {"_conns": "_conns_lock"}
+
+        def __init__(self):
+            self._conns_lock = threading.Lock()
+            self._conns = []
+
+        def _accept_loop(self, conn):
+            with self._conns_lock:
+                self._conns.append(conn)
+
+        def _drain_locked(self):
+            out, self._conns = self._conns, []
+            return out
+    """), [lockmap.check])
+
 _OWNERS = {HOT: {"Owner": "fixture namespace owner"}}
 
 FIXTURES["journal-writer"] = (HOT, _fix("""
