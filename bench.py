@@ -2197,6 +2197,162 @@ def _fleet_serving_northstar(jnp, quick, on_tpu):
     }
 
 
+def _chaos_northstar(jnp, quick, on_tpu):
+    """ISSUE 17 acceptance: graceful degradation under chaos.
+
+    Measures what the degradation ladder buys a fleet operator: **read
+    availability through a primary crash** and **degraded-read
+    throughput** off a standby that never holds the lease.  A 2-replica
+    fleet serves a committed result; a probe loop reads it continuously
+    while the primary is killed mid-request (``crash_after_commits``);
+    standby reads must keep the probes answering through the leaderless
+    window, so the longest unavailability window is the headline.  After
+    the takeover a THIRD replica joins as a standby and a client pinned
+    to it alone measures reads/sec from durable files — and must be
+    refused on a write.  ``chaos_gate_ok`` floors the availability bound
+    together with both bitwise contracts and the write refusal.
+    """
+    import tempfile
+    import threading
+
+    from spark_timeseries_tpu import serving
+    from spark_timeseries_tpu.reliability import chaos
+    from spark_timeseries_tpu.reliability import faultinject as fi
+    from spark_timeseries_tpu.reliability.journal import read_lease
+    from spark_timeseries_tpu.serving.client import FitClient
+    from spark_timeseries_tpu.serving.fleet import (FleetReplica,
+                                                    discover_endpoints)
+
+    if on_tpu and not quick:
+        rows, t_len, iters, n_reads = 1024, 500, 60, 200
+    elif quick:
+        rows, t_len, iters, n_reads = 16, 120, 15, 40
+    else:
+        rows, t_len, iters, n_reads = 64, 200, 25, 100
+    kw = dict(order=(1, 1, 1), max_iters=iters)
+    panel = gen_arima_panel(rows, t_len, seed=53)
+    srv_kw = dict(cell_rows=rows, batch_window_s=0.01, autotune=False)
+    fields = ("params", "neg_log_likelihood", "converged", "iters",
+              "status")
+    ttl = 1.0
+    probe_period_s = 0.05
+    max_unavailable_s = 5.0  # bound >> the longest expected leaderless gap
+
+    def _bitwise(got, want):
+        return all(
+            np.array_equal(np.asarray(getattr(got, f)),
+                           np.asarray(getattr(want, f)), equal_nan=True)
+            for f in fields)
+
+    # reference answers from an uninterrupted single server (also warms
+    # the cell program process-wide)
+    with serving.FitServer(tempfile.mkdtemp(prefix="chaosns_ref_"),
+                           **srv_kw) as ref:
+        want_seed = ref.submit("seed", panel, "arima", request_id="seed-0",
+                               **kw).result(timeout=1800)
+        want_kill = ref.submit("kill", panel, "arima", request_id="kill-1",
+                               **kw).result(timeout=1800)
+
+    root = tempfile.mkdtemp(prefix="chaosns_")
+    # commit 1 is seed-0 (survives durably); commit 2 is kill-1 — the
+    # primary crashes right after committing it, mid-reply
+    a = FleetReplica(root, owner="a", ttl_s=ttl, retire_on_crash=True,
+                     server_kwargs=dict(
+                         srv_kw, _commit_hook=fi.crash_after_commits(2)))
+    b = FleetReplica(root, owner="b", ttl_s=ttl, server_kwargs=srv_kw)
+    probes = []
+    with a, b:
+        a.wait_role("primary", 600)
+        cli = FitClient(discover_endpoints(root), seed=7,
+                        deadline_s=1800.0, failure_threshold=2,
+                        hedge_after_s=0.75)
+        got_seed = cli.submit("seed", panel, "arima", request_id="seed-0",
+                              **kw).result(timeout=1800)
+
+        stop = threading.Event()
+        t00 = time.perf_counter()
+
+        def _probe_loop():
+            while not stop.is_set():
+                try:
+                    r = cli.result_for("seed-0", timeout=2.0)
+                    ok = r is not None
+                except Exception:  # noqa: BLE001 - a probe miss IS the datum
+                    ok = False
+                probes.append((time.perf_counter() - t00, bool(ok)))
+                stop.wait(probe_period_s)
+
+        th = threading.Thread(target=_probe_loop, daemon=True)
+        th.start()
+        t0 = time.perf_counter()
+        got_kill = cli.submit("kill", panel, "arima", request_id="kill-1",
+                              **kw).result(timeout=1800)
+        failover_wall = time.perf_counter() - t0
+        took_over = b.wait_role("primary", 600)
+        stop.wait(2 * ttl)  # keep probing past the takeover
+        stop.set()
+        th.join(timeout=60)
+        survivor_holds = (read_lease(root) or {}).get("owner") == "b"
+        cli.close()
+
+        # degraded-read leg: a THIRD replica joins as a standby; a client
+        # pinned to it alone reads the committed result from durable
+        # files without the lease ever moving
+        with FleetReplica(root, owner="c", ttl_s=ttl,
+                          server_kwargs=srv_kw) as c:
+            c.wait_role("standby", 600)
+            rcli = FitClient([c.address], seed=8, deadline_s=1800.0,
+                             retries=2, backoff_base_s=0.01)
+            first = rcli.result_for("seed-0", timeout=60)
+            sb_bitwise = first is not None and _bitwise(first, want_seed)
+            td = time.perf_counter()
+            for _ in range(n_reads):
+                rcli.result_for("seed-0", timeout=60)
+            degraded_wall = time.perf_counter() - td
+            standby_reads = c.counters["standby_reads"]
+            try:
+                rcli.submit("nope", panel, "arima", request_id="nope-1",
+                            **kw)
+                write_refused = False
+            except Exception:  # noqa: BLE001 - the refusal IS the contract
+                write_refused = True
+            rcli.close()
+
+    windows = chaos.unavailability_windows(probes)
+    longest = max((e - s for s, e in windows), default=0.0)
+    ok_rate = (sum(1 for _, ok in probes if ok) / len(probes)
+               if probes else 0.0)
+    kill_bitwise = _bitwise(got_kill, want_kill)
+    gate_ok = bool(took_over and survivor_holds and kill_bitwise
+                   and _bitwise(got_seed, want_seed) and sb_bitwise
+                   and write_refused and longest <= max_unavailable_s
+                   and ok_rate >= 0.8)
+    return {
+        "replicas": 3,
+        "rows_per_request": rows,
+        "obs_per_series": t_len,
+        "probes": len(probes),
+        "probe_period_s": probe_period_s,
+        "probe_ok_rate": round(ok_rate, 4),
+        "longest_unavailable_s": round(longest, 3),
+        "unavailability_windows": len(windows),
+        "max_unavailable_s": max_unavailable_s,
+        "failover_request_wall_s": round(failover_wall, 3),
+        "failover_bitwise_identical": kill_bitwise,
+        "standby_read_bitwise": sb_bitwise,
+        "degraded_reads_per_sec": (round(n_reads / degraded_wall, 1)
+                                   if degraded_wall > 0 else None),
+        "standby_reads_served": standby_reads,
+        "write_refused_on_standby": write_refused,
+        "chaos_gate_ok": gate_ok,
+        "data": "2 FleetReplica + a late-joining standby on one "
+                "lease-fenced root; a committed result probed every "
+                f"{probe_period_s}s through a crash-mid-request primary "
+                "kill (standby reads cover the leaderless window), then "
+                f"{n_reads} reads off the standby alone",
+    }
+
+
 def _forecast_northstar(jnp, quick, on_tpu):
     """ISSUE 14 acceptance: the panel-scale forecast surface behind the
     long-dormant ``forecast_latency_s`` field.
@@ -2538,6 +2694,11 @@ def bench_arima_headline(jnp, quick, on_tpu, n_chips, platform, parity=None):
     _progress("config 3: fleet north-star (lease-fenced replicas)...")
     acct["fleet_serving_northstar"] = _fleet_serving_northstar(
         jnp, quick, on_tpu)
+    # ISSUE 17: graceful degradation — read availability through a
+    # primary kill (standby reads cover the leaderless window) and
+    # degraded-read throughput off a lease-less standby
+    _progress("config 3: chaos north-star (degradation ladder)...")
+    acct["chaos_northstar"] = _chaos_northstar(jnp, quick, on_tpu)
     # ISSUE 14: the panel forecast surface — journaled forecast walk
     # rows/sec, resume/from-journal bitwise, backtest campaign wall,
     # ensemble overhead
@@ -2674,6 +2835,20 @@ def _telemetry_regression_gate(headline):
             "fleet_failover_wall_s": fl.get("failover_request_wall_s"),
             "fleet_gate_ok": 1.0 if fl.get("fleet_gate_ok") else 0.0,
         }
+    # chaos gate inputs (ISSUE 17): the availability contract — probe ok
+    # rate through a primary kill, degraded-read throughput off a
+    # standby, and the composed gate — a degradation-ladder regression
+    # (standby reads silently off, refusal broken) hides behind every
+    # happy-path fleet number
+    ch = headline.get("chaos_northstar") or {}
+    if ch.get("probe_ok_rate") is not None:
+        inputs = {
+            **(inputs or {}),
+            "chaos_probe_ok_rate": ch.get("probe_ok_rate"),
+            "chaos_degraded_reads_per_sec":
+                ch.get("degraded_reads_per_sec"),
+            "chaos_gate_ok": 1.0 if ch.get("chaos_gate_ok") else 0.0,
+        }
     # forecast gate inputs (ISSUE 14): panel forecast throughput and the
     # composed bitwise contracts — a forecast-walk regression (resume
     # splicing, ensemble drift) hides behind every fit-side headline
@@ -2764,6 +2939,8 @@ def _telemetry_regression_gate(headline):
         "serving_rows_per_sec": ("rel", 0.5, "higher"),
         "serving_p99_latency_s": ("rel", 1.0, "lower"),
         "serving_batch_amplification": ("rel", 0.4, "higher"),
+        "chaos_probe_ok_rate": ("abs", 0.1, "higher"),
+        "chaos_degraded_reads_per_sec": ("rel", 0.5, "higher"),
         "forecast_rows_per_sec": ("rel", 0.5, "higher"),
         "delta_speedup": ("rel", 0.4, "higher"),
         "delta_warm_speedup": ("rel", 0.5, "higher"),
@@ -2827,6 +3004,18 @@ def _telemetry_regression_gate(headline):
             "tolerance": 0.0, "mode": "abs", "direction": "higher",
             "flagged": True}
         flagged.append("fleet_failover_floor")
+    # ABSOLUTE floor (ISSUE 17): degradation is the contract — standby
+    # reads must hold availability through a primary kill, the standby
+    # must serve durable bytes bitwise and refuse writes; a fleet that
+    # goes dark in the leaderless window is broken regardless of the
+    # previous run
+    cg = inputs.get("chaos_gate_ok")
+    if cg is not None and cg < 1.0:
+        drifts["chaos_availability_floor"] = {
+            "prev": 1.0, "cur": cg, "drift": 1.0,
+            "tolerance": 0.0, "mode": "abs", "direction": "higher",
+            "flagged": True}
+        flagged.append("chaos_availability_floor")
     # ABSOLUTE floor (ISSUE 14): the composed forecast contracts — resume
     # bitwise, from-journal bitwise, ensemble argmin/weights, the
     # campaign completing — are correctness, not perf: any miss is broken
@@ -2958,6 +3147,14 @@ def _summary_line(emitted):
                     "p99_request_latency_s", "failover_request_wall_s",
                     "failover_recovery_penalty_s",
                     "failover_bitwise_identical", "fleet_gate_ok")}
+            ch = obj.get("chaos_northstar")
+            if ch:
+                entry["chaos_northstar"] = {k: ch.get(k) for k in (
+                    "replicas", "probe_ok_rate", "longest_unavailable_s",
+                    "failover_request_wall_s",
+                    "failover_bitwise_identical", "standby_read_bitwise",
+                    "degraded_reads_per_sec", "write_refused_on_standby",
+                    "chaos_gate_ok")}
             fo = obj.get("forecast_northstar")
             if fo:
                 entry["forecast_northstar"] = {k: fo.get(k) for k in (

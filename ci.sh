@@ -215,6 +215,25 @@ python tests/_serving_worker.py --smoke
 # tracker rides the survivor and the orchestrator's client retry paths.
 python tests/_fleet_worker.py --smoke
 
+# chaos soak smoke (ISSUE 17): a SEEDED chaos schedule (pause + SIGKILL
+# the primary mid-storm) runs against a 2-replica fleet with write-ahead
+# disk faults armed on the survivor and HMAC wire auth on every frame;
+# the invariant checker must find conservation (every admitted request
+# answered), bitwise answers vs an uninterrupted reference (and on
+# re-poll), monotone lease fencing, and read availability within bound —
+# standby reads cover the leaderless window, a lease-less standby serves
+# durable + scratch reads bitwise, refuses writes, and the wrong wire
+# secret is refused terminally.  The survivor's obs stream must pass the
+# degradation-ladder telemetry gate, and the durable chaos manifest must
+# give the budget advisor enough to suggest the next soak's client knobs.
+CHAOS_SMOKE_DIR=$(mktemp -d -t chaos_smoke_XXXXXX)
+python tests/_chaos_worker.py --smoke --out "$CHAOS_SMOKE_DIR"
+python tools/obs_report.py --check --degradation "$CHAOS_SMOKE_DIR/obs_b.jsonl"
+python tools/advise_budget.py "$CHAOS_SMOKE_DIR" \
+  | grep -q "suggest for the next soak" \
+  || { echo "ci.sh: advise_budget did not read the chaos manifest" >&2; exit 1; }
+rm -rf "$CHAOS_SMOKE_DIR"
+
 # serving tooling smoke (ISSUE 12): a short server run with telemetry on
 # must leave (a) a prom textfile that passes the obs_report --prom gate —
 # exposition syntax + every registry metric present under its mapped name,

@@ -48,11 +48,19 @@ same guarantees at row granularity:
   of hanging past its SLO.
 - :mod:`.faultinject` — deterministic data, behavioral, and process
   faults (forced non-convergence, simulated OOM, SIGKILL-after-commit,
-  torn manifests) so every recovery path runs in tier-1 CPU tests.
+  torn manifests, disk EIO/ENOSPC/torn-write schedules) so every
+  recovery path runs in tier-1 CPU tests.
+- :mod:`.chaos` — seeded chaos scenarios (ISSUE 17): timed schedules
+  composing the fault primitives against a live fleet, the invariant
+  checker (conservation, bitwise re-answers, monotonic fencing, bounded
+  unavailability), and the durable ``chaos_manifest.json`` record.
 """
 
-from . import (chunked, committer, delta, faultinject, journal, plan, prefetcher,
-               runner, sanitize, source, status, watchdog)
+from . import (chaos, chunked, committer, delta, faultinject, journal, plan,
+               prefetcher, runner, sanitize, source, status, watchdog)
+from .chaos import (ChaosEvent, ChaosRunner, InvariantViolation,
+                    chaos_schedule, check_invariants, load_chaos_manifest,
+                    unavailability_windows, write_chaos_manifest)
 from .chunked import OOMBackoffExceeded, fit_chunked, is_resource_exhausted
 from .delta import (DeltaError, DeltaPlan, StalePriorError, WarmstartFit,
                     plan_delta)
@@ -75,6 +83,8 @@ from .status import FitStatus, merge_status, status_counts
 from .watchdog import Deadline, DeadlineExceeded, call_with_deadline
 
 __all__ = [
+    "ChaosEvent",
+    "ChaosRunner",
     "ChunkCommitter",
     "ChunkJournal",
     "ChunkPrefetcher",
@@ -113,9 +123,13 @@ __all__ = [
     "TornManifestError",
     "DeltaError",
     "DeltaPlan",
+    "InvariantViolation",
     "StalePriorError",
     "WarmstartFit",
     "call_with_deadline",
+    "chaos",
+    "chaos_schedule",
+    "check_invariants",
     "chunked",
     "committer",
     "config_hash",
@@ -126,6 +140,7 @@ __all__ = [
     "fit_chunked",
     "is_resource_exhausted",
     "journal",
+    "load_chaos_manifest",
     "merge_job_manifest",
     "merge_status",
     "panel_fingerprint",
@@ -138,5 +153,7 @@ __all__ = [
     "source",
     "status",
     "status_counts",
+    "unavailability_windows",
     "watchdog",
+    "write_chaos_manifest",
 ]

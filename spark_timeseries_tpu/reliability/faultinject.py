@@ -61,6 +61,16 @@ torn frames (a prefix followed by an abrupt reset) the CRC frame
 validation, and ``reset_after`` connection resets the mid-batch failover
 path.  Replica death mid-storm reuses :func:`server_kill` — the fleet
 primary is just a FitServer.
+
+**Disk faults** (ISSUE 17 — storage-fault tolerance):
+:func:`disk_fault_schedule` maps a seed to a deterministic per-write
+fault sequence (EIO / ENOSPC / torn-at-fsync / pass) and
+:class:`disk_faults` installs it as the journal's process-wide
+disk-fault hook (:func:`~.journal.set_disk_fault_hook`), so the REAL
+durable write paths — journal shards, serving write-ahead records,
+stored results — fail on cue: refusals must surface as typed
+``storage_degraded`` backpressure (never a crash), torn files must be
+rejected loudly by readers and recomputed by recovery.
 """
 
 from __future__ import annotations
@@ -81,6 +91,8 @@ __all__ = [
     "SimulatedCrash",
     "SimulatedLaneFailure",
     "SimulatedResourceExhausted",
+    "disk_fault_schedule",
+    "disk_faults",
     "frame_fault_schedule",
     "inject_nan_rows",
     "inject_inf_rows",
@@ -632,4 +644,106 @@ def tear_file(path: str, keep_frac: float = 0.5) -> None:
     keep = max(1, int(size * keep_frac))
     with open(path, "r+b") as f:
         f.truncate(keep)
+
+
+# ---------------------------------------------------------------------------
+# disk faults (ISSUE 17: storage-fault tolerance — the durable write paths
+# themselves fail, and the server must degrade, not crash)
+# ---------------------------------------------------------------------------
+
+
+def disk_fault_schedule(seed: int, n: int, *, eio_frac: float = 0.05,
+                        enospc_frac: float = 0.05,
+                        torn_frac: float = 0.05) -> list:
+    """A deterministic per-write disk-fault plan: ``n`` entries drawn
+    from ``{"pass", "eio", "enospc", "torn"}`` with the given rates —
+    the durable-write twin of :func:`frame_fault_schedule`.  ``eio`` and
+    ``enospc`` refuse the write before any bytes land (the server must
+    answer ``storage_degraded``, never crash); ``torn`` lets the replace
+    land then truncates the file (a lying fsync — readers must reject
+    the bytes loudly, recovery must recompute)."""
+    if eio_frac + enospc_frac + torn_frac > 1.0:
+        raise ValueError("fault fractions must sum to at most 1.0")
+    rng = np.random.default_rng(int(seed))
+    u = rng.random(int(n))
+    out = []
+    for x in u:
+        if x < eio_frac:
+            out.append("eio")
+        elif x < eio_frac + enospc_frac:
+            out.append("enospc")
+        elif x < eio_frac + enospc_frac + torn_frac:
+            out.append("torn")
+        else:
+            out.append("pass")
+    return out
+
+
+class disk_faults:
+    """Context manager installing a :func:`disk_fault_schedule` as the
+    process-wide journal disk-fault hook
+    (:func:`~.journal.set_disk_fault_hook`).
+
+    Each GUARDED durable write — journal shards/manifests
+    (``kind="durable"``), serving write-ahead records
+    (``kind="write_ahead"``), stored results (``kind="result"``) —
+    consumes the next schedule entry; past the end every write passes
+    (faults are a finite storm, not a dead disk).  ``kinds`` restricts
+    the fault to a write class and ``path_substr`` to matching paths;
+    filtered-out writes pass WITHOUT consuming schedule entries, so a
+    schedule's shape is independent of unrelated background writes.
+    ``log`` records ``(kind, path, verdict)`` per faulted consult for
+    the chaos invariant checker.
+
+    .. attribute:: _protected_by_
+
+        Lock-discipline contract (tools/lint lock-map): concurrent
+        durable writers (serve loop, committer thread, standby scratch)
+        all consult the one installed hook; the schedule cursor and the
+        fault log advance under the lock so each entry is consumed
+        exactly once.
+    """
+
+    _protected_by_ = {
+        "_i": "_lock",
+        "log": "_lock",
+    }
+
+    def __init__(self, schedule, *, kinds: Optional[tuple] = None,
+                 path_substr: Optional[str] = None):
+        self._schedule = list(schedule)
+        self._kinds = None if kinds is None else tuple(kinds)
+        self._path_substr = path_substr
+        self._i = 0
+        self._lock = None  # created on enter (threading import kept local)
+        self._prev = None
+        self.log: list = []
+
+    def _hook(self, path: str, kind: str) -> str:
+        if self._kinds is not None and kind not in self._kinds:
+            return "pass"
+        if self._path_substr is not None and self._path_substr not in path:
+            return "pass"
+        with self._lock:
+            i = self._i
+            self._i += 1
+            verdict = (self._schedule[i] if i < len(self._schedule)
+                       else "pass")
+            if verdict != "pass":
+                self.log.append((kind, path, verdict))
+        return verdict
+
+    def __enter__(self) -> "disk_faults":
+        import threading
+
+        from . import journal
+
+        self._lock = threading.Lock()
+        self._prev = journal.set_disk_fault_hook(self._hook)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        from . import journal
+
+        journal.set_disk_fault_hook(self._prev)
 

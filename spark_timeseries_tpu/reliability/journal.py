@@ -56,6 +56,7 @@ even by a later single-device walk of the same job.
 
 from __future__ import annotations
 
+import errno
 import functools
 import hashlib
 import json
@@ -87,9 +88,12 @@ __all__ = [
     "chunk_fingerprint",
     "chunk_sample_steps",
     "config_hash",
+    "consult_disk_fault",
     "merge_job_manifest",
     "panel_fingerprint",
     "read_lease",
+    "set_disk_fault_hook",
+    "tear_after_replace",
 ]
 
 # version 2 (ISSUE 15): manifest chunk entries gain a per-chunk content
@@ -251,15 +255,74 @@ def _git_commit(root: Optional[str] = None) -> Optional[str]:
         return None
 
 
+# -- disk-fault seam (ISSUE 17) ---------------------------------------------
+# reliability.faultinject installs a hook here so tier-1 CPU tests can
+# drive EIO / ENOSPC / torn-at-fsync faults through the real durable
+# write paths (journal shards, serving write-ahead records, stored
+# results) without a faulty device.  Production never sets a hook; the
+# consult is a single None check.
+
+_disk_fault_hook: Optional[Callable] = None
+
+
+def set_disk_fault_hook(hook: Optional[Callable]) -> Optional[Callable]:
+    """Install (or clear, with None) the process-wide disk-fault hook;
+    returns the previous hook so tests can restore it.  The hook is
+    called as ``hook(path, kind)`` before each guarded durable write and
+    answers ``None``/``"pass"`` (write normally), ``"eio"``/``"enospc"``
+    (raise the matching ``OSError`` before any bytes land), or
+    ``"torn"`` (write, then truncate the final file to a prefix — a
+    lying fsync)."""
+    global _disk_fault_hook
+    prev = _disk_fault_hook
+    _disk_fault_hook = hook
+    return prev
+
+
+def consult_disk_fault(path: str, kind: str) -> Optional[str]:
+    """Ask the installed hook about one durable write (see
+    :func:`set_disk_fault_hook`).  Raises the injected ``OSError`` for
+    ``eio``/``enospc``; returns ``"torn"`` when the caller must tear the
+    file AFTER its replace lands, else None."""
+    hook = _disk_fault_hook
+    if hook is None:
+        return None
+    verdict = hook(path, kind)
+    if verdict in (None, "pass"):
+        return None
+    if verdict == "eio":
+        raise OSError(errno.EIO,
+                      f"injected I/O error on {kind} write", path)
+    if verdict == "enospc":
+        raise OSError(errno.ENOSPC,
+                      f"injected no-space error on {kind} write", path)
+    if verdict == "torn":
+        return "torn"
+    raise ValueError(f"unknown disk-fault verdict {verdict!r}")
+
+
+def tear_after_replace(path: str) -> None:
+    """Truncate a just-replaced durable file to a half prefix — the
+    "fsync lied" fault: the rename landed but the device persisted only
+    part of the data.  Readers must treat the file as torn (CRC/npz
+    parse failure), never as silently shorter data."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, size // 2))
+
+
 def durable_replace(path: str, write: Callable, *,
-                    suffix: Optional[str] = None) -> None:
+                    suffix: Optional[str] = None,
+                    fault_kind: str = "durable") -> None:
     """The ONE durable-file primitive: ``write(f)`` into a hidden tmp in
     the target's directory, fsync, ``os.replace`` — the final path holds
     a whole file (or its previous content), never a torn write, and a
     crash leaves only a hidden ``.tmp-*`` orphan every reader ignores.
     Shared by the journal's shard/manifest writes, adoption's byte
     splices, and the npz append helpers, so the crash-safety sequence
-    lives in one place."""
+    lives in one place (which is also why the disk-fault seam guards
+    exactly here — ``fault_kind`` names the write class for the hook)."""
+    verdict = consult_disk_fault(path, fault_kind)
     d = os.path.dirname(path) or "."
     fd, tmp = tempfile.mkstemp(
         dir=d, prefix=".tmp-",
@@ -276,6 +339,8 @@ def durable_replace(path: str, write: Callable, *,
         except OSError:
             pass
         raise
+    if verdict == "torn":
+        tear_after_replace(path)
 
 
 def _atomic_write_bytes(path: str, data: bytes) -> None:
@@ -1243,7 +1308,7 @@ def acquire_lease(root: str, owner: str, *,
                   ttl_s: float = 5.0) -> Optional[Lease]:
     """Try to acquire the root's lease; None while another holder is live.
 
-    The claim write is the election: ``O_CREAT | O_EXCL`` on the next
+    The claim write is the election: an atomic hard link onto the next
     token's claim manifest means the filesystem picks exactly one winner
     per token, and a fresh claim counts as live (``lease_is_live``), so
     a racer that lost the claim sees the winner as the holder and backs
@@ -1261,16 +1326,30 @@ def acquire_lease(root: str, owner: str, *,
             "ttl_s": float(ttl_s),
             "claimed_at": time.time(),  # lint: nondet(lease liveness metadata; never fitted bytes)
         }
+        # the claim must be atomic AS WELL AS exclusive: a racer that
+        # lost this token re-checks liveness immediately, and a claim
+        # file it can see but not yet parse (created, bytes not landed)
+        # would read as dead — letting it claim token+1 and seat TWO
+        # winners.  So the bytes land in a hidden tmp first and a hard
+        # link performs the election: the link either publishes a whole
+        # claim or fails because someone else's whole claim is there.
+        fd, tmp = tempfile.mkstemp(dir=_claims_dir(root),
+                                   prefix=".tmp-claim-")
         try:
-            fd = os.open(_claim_path(root, token),
-                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        except FileExistsError:
-            continue  # lost the election for this token; re-evaluate
-        with os.fdopen(fd, "wb") as f:
-            f.write((json.dumps(claim, indent=1, sort_keys=True)
-                     + "\n").encode())
-            f.flush()
-            os.fsync(f.fileno())
+            with os.fdopen(fd, "wb") as f:
+                f.write((json.dumps(claim, indent=1, sort_keys=True)
+                         + "\n").encode())
+                f.flush()
+                os.fsync(f.fileno())
+            try:
+                os.link(tmp, _claim_path(root, token))
+            except FileExistsError:
+                continue  # lost the election for this token; re-evaluate
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
         lease = Lease(root, owner, token, ttl_s)
         lease._write_record()
         obs.event("lease.acquired", root=root, owner=str(owner),

@@ -39,28 +39,40 @@ Quickstart::
 - :mod:`.client` — :class:`FitClient`: kill-tolerant remote access with
   idempotent resubmit on existing request ids, bounded deterministic
   backoff, per-call deadlines, and reconnect-safe result polling.
+- :mod:`.health` — :class:`EndpointHealthCache` (ISSUE 17): the client's
+  per-endpoint circuit breaker / primary belief / latency EWMA; writes
+  prefer the believed primary, reads fan to healthy standbys, failing
+  endpoints cool down on a seeded deterministic schedule.
 - :mod:`.fleet` — :class:`FleetReplica`: N replicas on one checkpoint
   root under a lease/fencing protocol; a SIGKILLed primary's write-ahead
   requests are taken over and re-answered bitwise by a surviving peer,
-  and stale-token zombies lose loudly (:class:`FencedError`).
+  and stale-token zombies lose loudly (:class:`FencedError`).  ISSUE 17
+  adds the degradation ladder: standbys serve forecast READS from a
+  private scratch root, leaderless windows answer typed ``read_only``,
+  and a primary whose disk refuses writes steps down cleanly
+  (:class:`StorageError` backpressure, ``storage_degraded`` on the wire).
 """
 
-from . import admission, batcher, client, fleet, server, session, transport
+from . import (admission, batcher, client, fleet, health, server, session,
+               transport)
 from .admission import AdmissionQueue, TenantQuota
 from .batcher import MicroBatch, batch_key
 from .client import ClientDeadlineError, FitClient, RemoteTicket, backoff_schedule
 from .fleet import FleetReplica, discover_endpoints
+from .health import EndpointHealthCache, cooldown_schedule
 from .server import FORECAST_MODEL, FitServer
 from .session import (CancelledError, FitRequest, FitTicket, RejectedError,
-                      ServerClosedError, TenantFitResult)
-from .transport import (FrameError, NotLeaderError, TransportError,
-                        TransportServer)
+                      ServerClosedError, StorageError, TenantFitResult)
+from .transport import (FrameError, NotLeaderError, ReadOnlyError,
+                        TransportError, TransportServer, WireAuthError,
+                        resolve_wire_secret)
 
 __all__ = [
     "FORECAST_MODEL",
     "AdmissionQueue",
     "CancelledError",
     "ClientDeadlineError",
+    "EndpointHealthCache",
     "FitClient",
     "FitRequest",
     "FitServer",
@@ -69,20 +81,26 @@ __all__ = [
     "FrameError",
     "MicroBatch",
     "NotLeaderError",
+    "ReadOnlyError",
     "RejectedError",
     "RemoteTicket",
     "ServerClosedError",
+    "StorageError",
     "TenantFitResult",
     "TenantQuota",
     "TransportError",
     "TransportServer",
+    "WireAuthError",
     "admission",
     "backoff_schedule",
     "batch_key",
     "batcher",
     "client",
+    "cooldown_schedule",
     "discover_endpoints",
     "fleet",
+    "health",
+    "resolve_wire_secret",
     "server",
     "session",
     "transport",

@@ -29,6 +29,20 @@ exactly the way it storms an in-process server:
   connection currently works; a ticket survives any number of
   connection resets and replica failovers because the id, not the
   socket, is the request's identity.
+- **health-aware routing** (ISSUE 17): endpoint choice goes through an
+  :class:`~.health.EndpointHealthCache` — writes prefer the believed
+  primary, reads fan out to whatever is healthy (standbys serve reads),
+  a repeatedly-failing endpoint's circuit opens for a seeded
+  deterministic cooldown, and a ``not_leader`` redirect steers writes
+  away for a lease-TTL-ish memo window.  Slow result polls optionally
+  HEDGE to a second healthy endpoint (``hedge_after_s``): results are
+  durable bytes, identical from every replica, so hedging is
+  bitwise-neutral — first answer wins.
+- **typed degradation**: ``read_only`` (leaderless window — retry
+  later) and ``storage_degraded`` (this replica's disk refuses writes —
+  retry ELSEWHERE) replies are retried with their own policies;
+  ``auth_failed`` (shared-secret mismatch) is terminal
+  :class:`~.transport.WireAuthError` — retrying cannot help.
 """
 
 from __future__ import annotations
@@ -46,7 +60,9 @@ import numpy as np
 
 from .. import obs
 from . import transport
-from .session import RejectedError, ServerClosedError, TenantFitResult
+from .health import EndpointHealthCache
+from .session import (RejectedError, ServerClosedError, StorageError,
+                      TenantFitResult)
 
 __all__ = [
     "ClientDeadlineError",
@@ -143,7 +159,7 @@ class FitClient:
     _protected_by_ = {
         "_sock": "_io_lock",
         "_decoder": "_io_lock",
-        "_ep_idx": "_io_lock",
+        "_cur_ep": "_io_lock",
         "_msg_seq": "_io_lock",
     }
 
@@ -156,6 +172,9 @@ class FitClient:
                  poll_interval_s: float = 0.05,
                  connect_timeout_s: float = 5.0,
                  io_timeout_s: float = 60.0,
+                 failure_threshold: int = 3,
+                 hedge_after_s: Optional[float] = None,
+                 secret=None,
                  _wire_wrap: Optional[Callable] = None):
         eps = []
         for ep in endpoints:
@@ -175,13 +194,20 @@ class FitClient:
         self.poll_interval_s = float(poll_interval_s)
         self.connect_timeout_s = float(connect_timeout_s)
         self.io_timeout_s = float(io_timeout_s)
+        # hedged result polls: after this many seconds of pending, every
+        # poll ALSO asks the next-best healthy endpoint (None = off)
+        self.hedge_after_s = (None if hedge_after_s is None
+                              else float(hedge_after_s))
+        self._secret = transport.resolve_wire_secret(secret)
+        self.endpoint_health = EndpointHealthCache(
+            eps, seed=seed, failure_threshold=failure_threshold)
         # fault-injection seam: wraps each fresh connection in a lossy
         # wire (reliability.faultinject.FaultyWire) — tests only
         self._wire_wrap = _wire_wrap
         self._io_lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._decoder = transport.FrameDecoder()
-        self._ep_idx = 0
+        self._cur_ep: Optional[Tuple[str, int]] = None
         self._msg_seq = 0
 
     # -- lifecycle -----------------------------------------------------------
@@ -203,17 +229,25 @@ class FitClient:
             except OSError:
                 pass
             self._sock = None
+            self._cur_ep = None
         self._decoder = transport.FrameDecoder()
 
-    def _connect_locked(self) -> None:
+    def _connect_locked(self, write: bool = False) -> None:
+        if write and self._sock is not None:
+            # a write on a read connection: if the cache believes the
+            # primary is elsewhere, move there instead of bouncing off
+            # a standby's not_leader
+            want = self.endpoint_health.believed_primary()
+            if want is not None and self._cur_ep != want:
+                self._close_locked()
         if self._sock is not None:
             return
-        host, port = self.endpoints[self._ep_idx % len(self.endpoints)]
+        host, port = self.endpoint_health.order(write=write)[0]
         try:
             s = socket.create_connection((host, port),
                                          timeout=self.connect_timeout_s)
         except OSError as e:
-            self._ep_idx += 1  # next call knocks on the next replica
+            self.endpoint_health.record_failure((host, port))
             raise _ConnDropped(
                 f"connect to {host}:{port} failed: {e}") from None
         s.settimeout(self.io_timeout_s)
@@ -221,54 +255,86 @@ class FitClient:
         if self._wire_wrap is not None:
             s = self._wire_wrap(s)
         self._sock = s
+        self._cur_ep = (host, port)
         self._decoder = transport.FrameDecoder()
 
     def _rotate_locked(self) -> None:
+        # the health cache decides where the NEXT connect lands; the
+        # failure/redirect records made this endpoint sort later
         self._close_locked()
-        self._ep_idx += 1
 
     # -- one round trip ------------------------------------------------------
 
-    def _call_once(self, header: dict,
-                   blob: bytes = b"") -> Tuple[dict, bytes]:
+    def _call_once(self, header: dict, blob: bytes = b"",
+                   write: bool = False) -> Tuple[dict, bytes]:
         """One request/reply round trip on the current connection
         (raises :class:`_ConnDropped` on any transport-level failure,
-        leaving the connection closed)."""
+        leaving the connection closed).  Health recording happens here,
+        where the endpoint is known: any reply is a liveness success,
+        ``not_leader`` memos the redirect, ``storage_degraded`` counts
+        as a failure (prefer other replicas), a clean write ack marks
+        the believed primary."""
         with self._io_lock:
-            self._connect_locked()
+            self._connect_locked(write=write)
+            ep = self._cur_ep
             self._msg_seq += 1
             msg_id = f"m{self._msg_seq}"
+            t0 = time.monotonic()
             try:
                 transport.send_msg(self._sock, {**header, "msg_id": msg_id},
-                                   blob)
+                                   blob, self._secret)
                 while True:
-                    msg = transport.recv_msg(self._sock, self._decoder)
+                    msg = transport.recv_msg(self._sock, self._decoder,
+                                             secret=self._secret)
                     if msg is None:
                         raise transport.FrameError(
                             "connection closed before the reply")
                     reply, rblob = msg
                     # duplicated-frame faults can surface stale replies;
                     # the msg_id echo pairs replies with calls exactly
+                    if reply.get("error") == "auth_failed":
+                        # terminal: the server refused OUR bytes — a
+                        # shared-secret mismatch no retry can fix
+                        raise transport.WireAuthError(
+                            reply.get("message", "auth_failed"))
                     if reply.get("msg_id") in (None, msg_id):
+                        err = reply.get("error")
+                        if err == "storage_degraded":
+                            self.endpoint_health.record_failure(ep)
+                        elif err == "not_leader":
+                            self.endpoint_health.record_redirect(ep)
+                        else:
+                            self.endpoint_health.record_success(
+                                ep, time.monotonic() - t0)
+                            if write and err is None:
+                                self.endpoint_health.set_primary(ep)
                         return reply, rblob
+            except transport.WireAuthError:
+                self._close_locked()
+                raise
             except (transport.TransportError, OSError) as e:
+                self.endpoint_health.record_failure(ep)
                 self._rotate_locked()
                 raise _ConnDropped(f"call failed mid-flight: {e}") from None
 
     def _call(self, header: dict, blob: bytes = b"", *,
               what: str, deadline_s: Optional[float] = None,
-              resubmit_ok: bool = True) -> Tuple[dict, bytes]:
+              resubmit_ok: bool = True,
+              write: bool = False) -> Tuple[dict, bytes]:
         """A round trip under the retry/backoff/deadline policy.
 
         Retryable outcomes — dropped connections, ``not_leader`` (a
         standby answered; the new primary needs a lease TTL to take
-        over), ``closed`` (a draining replica), ``rejected``
+        over), ``read_only`` (leaderless window: retry LATER),
+        ``storage_degraded`` (this replica's disk refuses writes: retry
+        ELSEWHERE), ``closed`` (a draining replica), ``rejected``
         (backpressure: honors ``retry_after_s``) — burn one bounded
         retry each, sleeping the deterministic backoff schedule between
         attempts.  Typed terminal outcomes raise: bad requests
-        (``ValueError``), deadline expiry
-        (:class:`ClientDeadlineError`), retries exhausted (the last
-        error)."""
+        (``ValueError``), auth failures
+        (:class:`~.transport.WireAuthError` from the round trip),
+        deadline expiry (:class:`ClientDeadlineError`), retries
+        exhausted (the last error)."""
         budget = self.deadline_s if deadline_s is None else float(deadline_s)
         t0 = time.monotonic()
         schedule = backoff_schedule(self.seed, self.retries + 1,
@@ -279,7 +345,7 @@ class FitClient:
             if budget is not None and time.monotonic() - t0 >= budget:
                 raise ClientDeadlineError(what, budget)
             try:
-                reply, rblob = self._call_once(header, blob)
+                reply, rblob = self._call_once(header, blob, write=write)
             except _ConnDropped as e:
                 last = e
                 self._sleep_backoff(schedule[attempt], t0, budget, what)
@@ -296,6 +362,29 @@ class FitClient:
                     raise last
                 self._sleep_backoff(
                     max(schedule[attempt], last.retry_after_s),
+                    t0, budget, what)
+                continue
+            if err == "storage_degraded":
+                # _call_once already dinged the endpoint's health; the
+                # next connect prefers a replica whose disk works
+                last = StorageError(
+                    reply.get("message", "storage degraded"),
+                    retry_after_s=float(reply.get("retry_after_s") or 5.0))
+                if not resubmit_ok:
+                    raise last
+                with self._io_lock:
+                    self._rotate_locked()
+                self._sleep_backoff(schedule[attempt], t0, budget, what)
+                continue
+            if err == "read_only":
+                # leaderless window: nobody can admit writes anywhere —
+                # wait out the election rather than hammering peers
+                last = ServerClosedError(reply.get("message", err))
+                with self._io_lock:
+                    self._rotate_locked()
+                self._sleep_backoff(
+                    max(schedule[attempt],
+                        float(reply.get("retry_after_s") or 0.5)),
                     t0, budget, what)
                 continue
             if err in ("not_leader", "closed", "fenced"):
@@ -356,7 +445,7 @@ class FitClient:
         blob = transport.encode_request_blob(np.asarray(values), meta)
         header = {"op": "submit"}
         reply, _ = self._call(header, blob, what=f"submit({req_id})",
-                              deadline_s=call_deadline_s)
+                              deadline_s=call_deadline_s, write=True)
         got = reply.get("req_id")
         if got != req_id:
             raise transport.TransportError(
@@ -411,6 +500,10 @@ class FitClient:
             arrays["status"] = np.ascontiguousarray(np.asarray(status))
         np.savez(buf, **arrays)
         blob = buf.getvalue()
+        # deliberately a READ-class call: forecasts derive from journaled
+        # params with a content-derived base seed, so ANY replica (a
+        # standby included) answers them bitwise-identically — this is
+        # the read load the standbys exist to carry
         header = {"op": "submit_forecast"}
         reply, _ = self._call(header, blob,
                               what=f"submit_forecast({req_id})",
@@ -457,11 +550,71 @@ class FitClient:
                      timeout: Optional[float]) -> TenantFitResult:
         budget = self.deadline_s if timeout is None else float(timeout)
         t0 = time.monotonic()
+        hedging = False
         while True:
             res = self._poll_once(req_id, resubmit)
             if res is not None:
                 return res
+            if (self.hedge_after_s is not None
+                    and len(self.endpoints) > 1
+                    and time.monotonic() - t0 >= self.hedge_after_s):
+                if not hedging:
+                    hedging = True
+                    obs.counter("client.hedge_launched").inc()
+                    obs.event("client.hedge", req_id=req_id)
+                res = self._hedge_poll_once(req_id)
+                if res is not None:
+                    obs.counter("client.hedge_won").inc()
+                    return res
             if budget is not None and \
                     time.monotonic() - t0 + self.poll_interval_s > budget:
                 raise ClientDeadlineError(f"result({req_id})", budget)
             time.sleep(self.poll_interval_s)
+
+    def _hedge_poll_once(self, req_id: str) -> Optional[TenantFitResult]:
+        """One hedged result poll against the best endpoint OTHER than
+        the current connection's, over a throwaway connection.  Results
+        are durable bytes — identical from every replica — so whichever
+        side answers first is the answer.  Any failure just records
+        endpoint health and returns None; the main poll loop is the
+        arbiter of deadlines."""
+        with self._io_lock:
+            cur = self._cur_ep
+        alt = next((ep for ep in self.endpoint_health.order()
+                    if ep != cur), None)
+        if alt is None:
+            return None
+        try:
+            s = socket.create_connection(alt,
+                                         timeout=self.connect_timeout_s)
+        except OSError:
+            self.endpoint_health.record_failure(alt)
+            return None
+        try:
+            s.settimeout(self.io_timeout_s)
+            if self._wire_wrap is not None:
+                s = self._wire_wrap(s)
+            decoder = transport.FrameDecoder()
+            transport.send_msg(
+                s, {"op": "result", "req_id": req_id, "msg_id": "hedge"},
+                secret=self._secret)
+            while True:
+                msg = transport.recv_msg(s, decoder, secret=self._secret)
+                if msg is None:
+                    return None
+                reply, rblob = msg
+                if reply.get("msg_id") in (None, "hedge"):
+                    break
+            self.endpoint_health.record_success(alt)
+            if reply.get("done"):
+                return transport.decode_result_blob(rblob)
+            return None
+        except (transport.TransportError, transport.WireAuthError,
+                OSError):
+            self.endpoint_health.record_failure(alt)
+            return None
+        finally:
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -63,8 +63,9 @@ from ..reliability.status import FitStatus
 from ..utils import compile_cache
 from . import batcher
 from .admission import AdmissionQueue, TenantQuota
+from ..reliability.journal import consult_disk_fault, tear_after_replace
 from .session import (CancelledError, FitRequest, FitTicket, RejectedError,
-                      ServerClosedError, TenantFitResult)
+                      ServerClosedError, StorageError, TenantFitResult)
 
 __all__ = ["FORECAST_MODEL", "FitServer"]
 
@@ -240,6 +241,7 @@ class FitServer:
             "batches_run": 0, "batch_failures": 0, "solo_retries": 0,
             "rows_fitted": 0, "recovered_requests": 0,
             "recovered_batches": 0, "autotune_updates": 0,
+            "storage_errors": 0, "torn_results": 0,
         }
         self._counters_lock = threading.Lock()
 
@@ -358,8 +360,22 @@ class FitServer:
                 resilient=self.resilient, policy=self.policy)
             req.ticket._canceller = self._cancel
             # write-ahead: the request is durable BEFORE the caller holds
-            # a ticket for it — a crash after this line re-answers it
-            req.save(self._request_path(req_id))
+            # a ticket for it — a crash after this line re-answers it.
+            # A disk that refuses the record (EIO/ENOSPC) refuses the
+            # ADMISSION: an un-journaled acceptance would be silently
+            # lost by the next crash, so the typed StorageError (a
+            # RejectedError: the handlers below refund quota and count
+            # it) tells the client to retry on a replica whose disk works
+            try:
+                req.save(self._request_path(req_id))
+            except OSError as e:
+                with self._counters_lock:
+                    self.counters["storage_errors"] += 1
+                obs.counter("server.storage_errors").inc()
+                obs.event("server.storage_refusal", req_id=req_id,
+                          error=repr(e)[:200])
+                raise StorageError(
+                    f"write-ahead record refused: {e}") from e
             # live BEFORE the queue sees it: the moment offer() returns,
             # the serve loop (or a shedding offer on another thread) may
             # complete the request and call _forget — registering after
@@ -500,8 +516,15 @@ class FitServer:
         path = os.path.join(self._results_dir, f"{request_id}.npz")
         if not os.path.exists(path):
             return None
+        try:
+            res = self._load_result(path)
+        except Exception as e:  # noqa: BLE001 - torn bytes, not a bug
+            # a torn stored result must never be SERVED; discard it and
+            # fall through to a fresh admission (recompute)
+            self._discard_torn_result(path, e)
+            return None
         t = FitTicket(request_id)
-        t._resolve(self._load_result(path))
+        t._resolve(res)
         return t
 
     # -- results / durable paths ---------------------------------------------
@@ -517,6 +540,10 @@ class FitServer:
 
     def _store_result(self, req_id: str, res: TenantFitResult) -> None:
         path = os.path.join(self._results_dir, f"{req_id}.npz")
+        # disk-fault seam: a refused result store (EIO/ENOSPC) raises
+        # into the serve loop's crash path — the request record is still
+        # durable, so a takeover/restart on a WORKING disk re-answers it
+        verdict = consult_disk_fault(path, "result")
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             np.savez(f, params=res.params, nll=res.neg_log_likelihood,
@@ -526,6 +553,8 @@ class FitServer:
                          json.dumps(res.meta, default=repr).encode(),
                          dtype=np.uint8))
         os.replace(tmp, path)
+        if verdict == "torn":
+            tear_after_replace(path)
 
     def _load_result(self, path: str) -> TenantFitResult:
         with np.load(path) as z:
@@ -537,13 +566,37 @@ class FitServer:
                 status=np.array(z["status"]),
                 meta=json.loads(bytes(z["meta"].tobytes()).decode()))
 
+    def _discard_torn_result(self, path: str, err: BaseException) -> None:
+        """A stored result whose bytes do not parse (torn-at-fsync) is
+        worse than no result: remove it so recovery/resubmission
+        recomputes instead of any reader trusting half a file."""
+        with self._counters_lock:
+            self.counters["torn_results"] += 1
+        obs.counter("server.torn_results").inc()
+        obs.event("server.torn_result", path=os.path.basename(path),
+                  error=repr(err)[:200])
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
     def result_for(self, req_id: str) -> TenantFitResult:
         """Load a completed request's stored result — how a client
-        re-attaches after a server restart re-answered its request."""
+        re-attaches after a server restart re-answered its request.
+        A torn stored file downgrades to ``KeyError`` (recompute /
+        resubmit), never to serving corrupt bytes."""
         path = os.path.join(self._results_dir, f"{req_id}.npz")
         if not os.path.exists(path):
             raise KeyError(f"no stored result for request {req_id!r}")
-        return self._load_result(path)
+        try:
+            return self._load_result(path)
+        except KeyError:
+            raise
+        except Exception as e:  # noqa: BLE001 - torn bytes, not a bug
+            self._discard_torn_result(path, e)
+            raise KeyError(
+                f"stored result for {req_id!r} was torn and has been "
+                "discarded — resubmit (idempotent by request id)") from None
 
     def request_pending(self, req_id: str) -> bool:
         """Whether ``req_id`` is admitted and still in flight (live in

@@ -34,12 +34,15 @@ from typing import Callable, NamedTuple, Optional, Union
 
 import numpy as np
 
+from ..reliability.journal import consult_disk_fault, tear_after_replace
+
 __all__ = [
     "CancelledError",
     "FitRequest",
     "FitTicket",
     "RejectedError",
     "ServerClosedError",
+    "StorageError",
     "TenantFitResult",
 ]
 
@@ -65,6 +68,22 @@ class RejectedError(RuntimeError):
         self.reason = reason
         self.retry_after_s = float(retry_after_s)
         self.shed = bool(shed)
+
+
+class StorageError(RejectedError):
+    """The server's durable root refused a write (EIO / ENOSPC / a torn
+    fsync) so the request cannot be admitted SAFELY — an admission whose
+    write-ahead record did not land would be lost by the next crash,
+    which would break the re-answer contract.  Subclasses
+    :class:`RejectedError` so every quota-release / backpressure path
+    treats it as a refusal at the door; the wire serializes it as its
+    own ``storage_degraded`` kind so clients know to prefer OTHER
+    replicas rather than merely waiting out a queue."""
+
+    def __init__(self, reason: str, retry_after_s: float = 5.0):
+        super().__init__(f"storage degraded: {reason}",
+                         retry_after_s=retry_after_s, shed=False)
+        self.reason = reason
 
 
 class CancelledError(RuntimeError):
@@ -153,6 +172,11 @@ class FitRequest:
     # re-resolve them — an unnamed callable is refused at submit.
 
     def save(self, path: str) -> None:
+        # disk-fault seam: the write-ahead record is the admission
+        # contract's durability — an injected EIO/ENOSPC raises HERE,
+        # before the caller's ticket exists, so the server can refuse
+        # admission with a typed StorageError instead of losing work
+        verdict = consult_disk_fault(path, "write_ahead")
         meta = {
             "req_id": self.req_id, "seq": self.seq, "tenant": self.tenant,
             "model": self.model, "fit_kwargs": self.fit_kwargs,
@@ -166,6 +190,8 @@ class FitRequest:
                      meta=np.frombuffer(
                          json.dumps(meta).encode(), dtype=np.uint8))
         os.replace(tmp, path)
+        if verdict == "torn":
+            tear_after_replace(path)
 
     @classmethod
     def load(cls, path: str) -> "FitRequest":

@@ -36,8 +36,11 @@ in-process, serialized as ``{"error": "rejected", "retry_after_s": ...}``.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import io
 import json
+import os
 import socket
 import struct
 import threading
@@ -48,14 +51,17 @@ import numpy as np
 
 from .. import obs
 from ..reliability.journal import FencedError
-from .session import RejectedError, ServerClosedError, TenantFitResult
+from .session import (RejectedError, ServerClosedError, StorageError,
+                      TenantFitResult)
 
 __all__ = [
     "FrameDecoder",
     "FrameError",
     "NotLeaderError",
+    "ReadOnlyError",
     "TransportError",
     "TransportServer",
+    "WireAuthError",
     "decode_msg",
     "decode_request_blob",
     "encode_frame",
@@ -64,6 +70,7 @@ __all__ = [
     "encode_result_blob",
     "decode_result_blob",
     "recv_msg",
+    "resolve_wire_secret",
     "send_msg",
 ]
 
@@ -71,6 +78,10 @@ MAGIC = b"STSF"
 _FRAME_HDR = struct.Struct(">4sII")  # magic | payload_len | crc32
 _U32 = struct.Struct(">I")
 MAX_FRAME = 256 * 1024 * 1024  # a request panel, with headroom
+
+WIRE_SECRET_ENV = "STSTPU_WIRE_SECRET"
+WIRE_SECRET_FILE_ENV = "STSTPU_WIRE_SECRET_FILE"
+_TAG_LEN = hashlib.sha256().digest_size  # HMAC-SHA256 tag prefix
 
 
 class TransportError(RuntimeError):
@@ -82,9 +93,48 @@ class FrameError(TransportError):
     or truncated mid-frame) — the connection is poisoned; reconnect."""
 
 
+class WireAuthError(RuntimeError):
+    """A message failed HMAC verification (or the peer rejected ours).
+    Deliberately NOT a :class:`TransportError`: a CRC failure means a
+    flaky wire and retrying is right; an auth failure means the two
+    sides disagree on the shared secret and retrying can never help —
+    it is terminal, a configuration problem for the operator."""
+
+
 class NotLeaderError(RuntimeError):
     """The replica answering this connection does not hold the fleet
     lease — resubmit to (or wait for) the current primary."""
+
+
+class ReadOnlyError(RuntimeError):
+    """The fleet is in a leaderless window (no replica holds the lease)
+    — reads over durable state still work, but a write has nowhere safe
+    to land.  Distinct from :class:`NotLeaderError` ("retry ELSEWHERE:
+    a primary exists, it just is not me"): this says "retry LATER — an
+    election is in flight"."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.5):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+def resolve_wire_secret(secret=None) -> Optional[bytes]:
+    """The shared wire-auth secret, or None (auth disarmed).
+
+    Explicit ``secret`` (str/bytes) wins; else ``STSTPU_WIRE_SECRET``
+    (utf-8), else ``STSTPU_WIRE_SECRET_FILE`` (file bytes, stripped).
+    Server and every client must resolve the SAME bytes or every frame
+    between them dies with :class:`WireAuthError`."""
+    if secret is not None:
+        return secret.encode() if isinstance(secret, str) else bytes(secret)
+    env = os.environ.get(WIRE_SECRET_ENV)
+    if env:
+        return env.encode()
+    path = os.environ.get(WIRE_SECRET_FILE_ENV)
+    if path:
+        with open(path, "rb") as f:
+            return f.read().strip()
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -144,13 +194,33 @@ class FrameDecoder:
         return out
 
 
-def encode_msg(header: dict, blob: bytes = b"") -> bytes:
-    """A full message frame: canonical-JSON header + optional blob."""
+def encode_msg(header: dict, blob: bytes = b"",
+               secret: Optional[bytes] = None) -> bytes:
+    """A full message frame: canonical-JSON header + optional blob.
+
+    With a ``secret`` armed the payload is prefixed by a 32-byte
+    HMAC-SHA256 tag over the rest (header length + header + blob), so
+    every frame on the wire is authenticated — the CRC catches
+    accidents, the tag catches peers without the secret."""
     hdr = json.dumps(header, sort_keys=True).encode()
-    return encode_frame(_U32.pack(len(hdr)) + hdr + blob)
+    body = _U32.pack(len(hdr)) + hdr + blob
+    if secret is not None:
+        body = hmac.new(secret, body, hashlib.sha256).digest() + body
+    return encode_frame(body)
 
 
-def decode_msg(payload: bytes) -> Tuple[dict, bytes]:
+def decode_msg(payload: bytes,
+               secret: Optional[bytes] = None) -> Tuple[dict, bytes]:
+    if secret is not None:
+        if len(payload) < _TAG_LEN:
+            raise WireAuthError(
+                "frame too short to carry an auth tag — peer is not "
+                "speaking the authenticated protocol")
+        tag, payload = payload[:_TAG_LEN], payload[_TAG_LEN:]
+        want = hmac.new(secret, payload, hashlib.sha256).digest()
+        if not hmac.compare_digest(tag, want):  # constant-time
+            raise WireAuthError(
+                "frame HMAC verification failed — shared-secret mismatch")
     if len(payload) < _U32.size:
         raise FrameError("message payload shorter than its header length")
     (hlen,) = _U32.unpack_from(payload)
@@ -163,14 +233,16 @@ def decode_msg(payload: bytes) -> Tuple[dict, bytes]:
     return header, payload[_U32.size + hlen:]
 
 
-def send_msg(sock, header: dict, blob: bytes = b"") -> None:
+def send_msg(sock, header: dict, blob: bytes = b"",
+             secret: Optional[bytes] = None) -> None:
     """One message = one ``sendall`` — the unit the fault-injection
     wrappers (``reliability.faultinject``) drop/duplicate/tear."""
-    sock.sendall(encode_msg(header, blob))
+    sock.sendall(encode_msg(header, blob, secret))
 
 
-def recv_msg(sock, decoder: FrameDecoder,
-             bufsize: int = 1 << 16) -> Optional[Tuple[dict, bytes]]:
+def recv_msg(sock, decoder: FrameDecoder, bufsize: int = 1 << 16,
+             secret: Optional[bytes] = None
+             ) -> Optional[Tuple[dict, bytes]]:
     """Block for the next whole message on ``sock`` (None on clean EOF;
     :class:`FrameError` on EOF inside a frame)."""
     frames: list = []
@@ -186,7 +258,7 @@ def recv_msg(sock, decoder: FrameDecoder,
     first = frames[0]
     for extra in reversed(frames[1:]):
         decoder.requeue(extra)
-    return decode_msg(first)
+    return decode_msg(first, secret)
 
 
 # ---------------------------------------------------------------------------
@@ -259,11 +331,12 @@ class TransportServer:
     _protected_by_ = {"_conns": "_conns_lock"}
 
     def __init__(self, backend, host: str = "127.0.0.1", port: int = 0,
-                 *, max_frame: int = MAX_FRAME):
+                 *, max_frame: int = MAX_FRAME, secret=None):
         self.backend = backend
         self._host = host
         self._port = int(port)
         self._max_frame = int(max_frame)
+        self._secret = resolve_wire_secret(secret)
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: Dict[int, socket.socket] = {}
@@ -356,7 +429,20 @@ class TransportServer:
         try:
             while not self._stopped.is_set():
                 try:
-                    msg = recv_msg(conn, decoder)
+                    msg = recv_msg(conn, decoder, secret=self._secret)
+                except WireAuthError as e:
+                    # an unauthenticated peer: one typed refusal (so an
+                    # honest-but-misconfigured client fails LOUDLY, not
+                    # by timeout), then close — never dispatch the frame
+                    obs.event("transport.auth_failed", conn=cid,
+                              error=repr(e)[:200])
+                    try:
+                        send_msg(conn, {"error": "auth_failed",
+                                        "message": str(e)},
+                                 secret=self._secret)
+                    except OSError:
+                        pass
+                    return
                 except (FrameError, OSError) as e:
                     obs.event("transport.conn_poisoned", conn=cid,
                               error=repr(e)[:200])
@@ -368,7 +454,8 @@ class TransportServer:
                 if "msg_id" in header:
                     reply_hdr["msg_id"] = header["msg_id"]
                 try:
-                    send_msg(conn, reply_hdr, reply_blob)
+                    send_msg(conn, reply_hdr, reply_blob,
+                             secret=self._secret)
                 except OSError:
                     return  # peer went away mid-reply; it will retry
         finally:
@@ -401,8 +488,16 @@ class TransportServer:
                     "message": f"unknown op {op!r}"}, b""
         except NotLeaderError as e:
             return {"error": "not_leader", "message": str(e)}, b""
+        except ReadOnlyError as e:
+            return {"error": "read_only", "message": str(e),
+                    "retry_after_s": e.retry_after_s}, b""
         except FencedError as e:
             return {"error": "fenced", "message": str(e)}, b""
+        except StorageError as e:
+            # before RejectedError (its base): storage refusals carry a
+            # distinct kind so clients prefer OTHER replicas
+            return {"error": "storage_degraded", "message": str(e),
+                    "retry_after_s": e.retry_after_s}, b""
         except RejectedError as e:
             return {"error": "rejected", "message": str(e),
                     "retry_after_s": e.retry_after_s,

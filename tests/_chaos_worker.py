@@ -1,0 +1,506 @@
+"""Subprocess worker for the seeded chaos soak (ISSUE 17).
+
+Runs a multi-process :class:`serving.fleet.FleetReplica` fleet under a
+client storm while :class:`reliability.chaos.ChaosRunner` walks a SEEDED
+fault schedule against it — a SIGKILL of the live primary at a scheduled
+offset, write-ahead disk faults (EIO/ENOSPC) armed inside the standby
+that will inherit the lease, scheduled pauses — with HMAC wire auth
+armed fleet-wide via ``STSTPU_WIRE_SECRET``, and then checks the
+degraded-fleet invariants (:func:`reliability.chaos.check_invariants`):
+
+- **conservation**: every admitted request id answered exactly once;
+- **bitwise**: fleet answers equal an uninterrupted reference server's
+  byte for byte, and re-polls of durable results equal the first answer;
+- **fencing**: the lease token history only ever increases;
+- **bounded unavailability**: a read-probe timeline (polling a completed
+  result through the health-aware client) never goes dark longer than
+  the bound — standbys keep answering reads from durable files while
+  the lease re-elects.
+
+Plus the standby-read ladder itself: a fenced standby answers
+``result_for`` and completed-id ``submit_forecast`` from the shared
+durable root, computes NEW forecast ids on its private scratch server
+bitwise-identically, refuses writes with ``not_leader``, and a client
+with the wrong wire secret is refused with ``auth_failed`` (terminal).
+
+The scenario's record — schedule, probe timeline, lease history, hedge
+stats, invariant verdicts — lands in ``chaos_manifest.json`` at the
+fleet root for ``tools/advise_budget.py``.
+
+Modes:
+    --replica --root R --owner X [--ttl S] [--disk-fault SEED]
+              [--retire-on-crash] [--track-locks]
+        run one replica until ``<root>/stop_<owner>`` appears.
+    --smoke
+        full orchestration (used by ci.sh); prints PASS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+T = 96
+CELL = 8
+N_FITS = 3
+TTL_S = 1.0
+H = 4
+CHAOS_SEED = 23  # schedule: pause @0.34s, kill primary @1.32s, pause @1.42s
+CHAOS_DURATION_S = 2.0
+PROBE_PERIOD_S = 0.1
+MAX_UNAVAILABLE_S = 15.0
+SECRET = "chaos-smoke-secret"
+FIELDS = ("params", "neg_log_likelihood", "converged", "iters", "status")
+KW = dict(order=(1, 0, 0), max_iters=15)
+FC_KW = dict(model="arima", horizon=H, model_kwargs={"order": (1, 0, 0)},
+             intervals=True, n_samples=16, seed=5)
+SRV_KW = dict(cell_rows=CELL, batch_window_s=0.05, autotune=False)
+
+
+def make_panels():
+    rng = np.random.default_rng(37)
+    e = rng.normal(size=(N_FITS * CELL, T)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, T):
+        y[:, i] = 0.6 * y[:, i - 1] + e[:, i]
+    return [y[i * CELL:(i + 1) * CELL] for i in range(N_FITS)]
+
+
+def replica(root: str, owner: str, ttl_s: float,
+            disk_fault_seed: int | None, retire_on_crash: bool,
+            track_locks: bool) -> None:
+    from spark_timeseries_tpu import obs
+    from spark_timeseries_tpu.reliability import faultinject as fi
+    from spark_timeseries_tpu.serving.fleet import FleetReplica
+
+    tracker = None
+    if track_locks:
+        from tools.lint.runtime import LockDisciplineTracker
+
+        tracker = LockDisciplineTracker().install()
+    ctx = contextlib.nullcontext()
+    if disk_fault_seed is not None:
+        # write-ahead admissions only: a scheduled EIO/ENOSPC makes THIS
+        # replica (once primary) refuse admission with a typed
+        # StorageError instead of losing the request to the next crash
+        ctx = fi.disk_faults(
+            fi.disk_fault_schedule(disk_fault_seed, 64, eio_frac=0.2,
+                                   enospc_frac=0.05, torn_frac=0.0),
+            kinds=("write_ahead",))
+    with ctx:
+        # per-replica obs stream: the survivor's JSONL carries the
+        # degradation-ladder events + a final fleet.state snapshot, and
+        # ci gates it with `obs_report --check --degradation`
+        obs.enable(os.path.join(root, f"obs_{owner}.jsonl"))
+        rep = FleetReplica(root, owner=owner, ttl_s=ttl_s,
+                           server_kwargs=dict(SRV_KW),
+                           retire_on_crash=retire_on_crash)
+        rep.start()
+        stop_file = os.path.join(root, f"stop_{owner}")
+        while not os.path.exists(stop_file):
+            time.sleep(0.05)
+        rep.stop()
+        obs.disable()
+    if tracker is not None:
+        tracker.uninstall()
+        if tracker.violations:
+            sys.exit(f"replica {owner}: lock-discipline violations on the "
+                     f"degraded-serving path:\n{tracker.report()}")
+        print(f"replica {owner}: lock discipline OK "
+              f"({tracker.checks_decided} mutations checked)")
+    print(f"replica {owner}: stopped (final state {rep.state()})")
+
+
+def _spawn_replica(root: str, owner: str, *,
+                   disk_fault_seed: int | None = None,
+                   retire_on_crash: bool = False,
+                   track_locks: bool = False) -> subprocess.Popen:
+    args = [sys.executable, os.path.abspath(__file__), "--replica",
+            "--root", root, "--owner", owner, "--ttl", str(TTL_S)]
+    if disk_fault_seed is not None:
+        args += ["--disk-fault", str(disk_fault_seed)]
+    if retire_on_crash:
+        args += ["--retire-on-crash"]
+    if track_locks:
+        args += ["--track-locks"]
+    return subprocess.Popen(
+        args, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _wait_lease_owner(root: str, owner: str, timeout_s: float = 120.0) -> dict:
+    from spark_timeseries_tpu.reliability.journal import read_lease
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        rec = read_lease(root)
+        if rec and rec.get("owner") == owner and not rec.get("released"):
+            return rec
+        time.sleep(0.05)
+    sys.exit(f"lease never went to {owner!r}: {read_lease(root)}")
+
+
+class _ProbeLoop:
+    """Background read-availability probe: polls one COMPLETED request's
+    result through a health-aware client every tick, recording a
+    ``(t, ok)`` timeline plus the lease-token history — the evidence
+    :func:`chaos.check_invariants` judges availability and fencing on."""
+
+    def __init__(self, root: str, eps, ref_id: str):
+        from spark_timeseries_tpu.serving.client import FitClient
+
+        self.root = root
+        self.ref_id = ref_id
+        self.cli = FitClient(eps, seed=31, deadline_s=1.0, retries=2,
+                             backoff_base_s=0.02, failure_threshold=2)
+        self.probes: list[tuple[float, bool]] = []
+        self.lease_history: list[dict] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="chaos-probe")
+        self.t0 = time.monotonic()
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _run(self):
+        from spark_timeseries_tpu.reliability.journal import read_lease
+
+        last = None
+        while not self._stop.is_set():
+            try:
+                self.cli.result_for(self.ref_id, timeout=1.0)
+                ok = True
+            except Exception:  # noqa: BLE001 - any failure = unavailable
+                ok = False
+            self.probes.append(
+                (round(time.monotonic() - self.t0, 3), ok))
+            try:
+                rec = read_lease(self.root) or {}
+            except Exception:  # noqa: BLE001 - mid-rotation read
+                rec = {}
+            key = (rec.get("owner"), rec.get("token"))
+            if rec.get("token") is not None and key != last:
+                last = key
+                self.lease_history.append(
+                    {"t_s": round(time.monotonic() - self.t0, 3),
+                     "owner": rec.get("owner"), "token": rec.get("token")})
+            self._stop.wait(PROBE_PERIOD_S)
+
+    def stop(self):
+        from spark_timeseries_tpu.reliability.journal import read_lease
+
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+        self.cli.close()
+        # one final lease read: a takeover that landed between the last
+        # tick and stop() still belongs in the fencing evidence
+        try:
+            rec = read_lease(self.root) or {}
+        except Exception:  # noqa: BLE001 - mid-rotation read
+            rec = {}
+        hist = list(self.lease_history)
+        key = (rec.get("owner"), rec.get("token"))
+        if (rec.get("token") is not None
+                and (not hist or (hist[-1]["owner"],
+                                  hist[-1]["token"]) != key)):
+            hist.append({"t_s": round(time.monotonic() - self.t0, 3),
+                         "owner": rec.get("owner"),
+                         "token": rec.get("token")})
+        return list(self.probes), hist
+
+
+def smoke(out_dir: str | None = None) -> None:
+    from spark_timeseries_tpu import obs, serving
+    from spark_timeseries_tpu.reliability import chaos
+    from spark_timeseries_tpu.reliability import faultinject as fi
+    from spark_timeseries_tpu.reliability.journal import read_lease
+    from spark_timeseries_tpu.serving.client import FitClient
+    from spark_timeseries_tpu.serving.fleet import discover_endpoints
+    from spark_timeseries_tpu.serving.transport import WireAuthError
+
+    os.environ["STSTPU_WIRE_SECRET"] = SECRET  # replicas inherit; every
+    # frame in this smoke rides with an HMAC tag
+    panels = make_panels()
+
+    with tempfile.TemporaryDirectory() as td:
+        obs.enable(os.path.join(td, "client_obs.jsonl"))
+        # 0. uninterrupted reference: fits + forecasts on a fresh root
+        ref_root = os.path.join(td, "ref")
+        with serving.FitServer(ref_root, **SRV_KW) as ref:
+            want = {
+                f"fit-{i}": ref.submit(f"t{i}", panels[i], "arima",
+                                       request_id=f"fit-{i}",
+                                       **KW).result(timeout=600)
+                for i in range(N_FITS)}
+            for j in range(2):
+                want[f"fc-{j}"] = ref.submit_forecast(
+                    f"t{j}", panels[j], np.asarray(want[f"fit-{j}"].params),
+                    request_id=f"fc-{j}", **FC_KW).result(timeout=600)
+
+        # 1. the fleet: a (primary; the schedule will SIGKILL it) and b
+        #    (standby armed with write-ahead EIO/ENOSPC faults — the
+        #    storm continues across BOTH a failover and a degraded disk)
+        root = os.path.join(td, "fleet")
+        os.makedirs(root)
+        procs: dict[str, subprocess.Popen] = {}
+        procs["a"] = _spawn_replica(root, "a", retire_on_crash=True)
+        _wait_lease_owner(root, "a")
+        procs["b"] = _spawn_replica(root, "b", disk_fault_seed=101,
+                                    track_locks=True)
+        tok_a = read_lease(root)["token"]
+        eps = discover_endpoints(root)
+        if len(eps) < 2:
+            time.sleep(1.0)
+            eps = discover_endpoints(root)
+
+        # 2. pre-chaos: land one request so read probes have a durable
+        #    result to poll throughout the outage
+        cli = FitClient(eps, seed=17, deadline_s=600.0,
+                        backoff_base_s=0.05, failure_threshold=2,
+                        hedge_after_s=0.75)
+        got = {"fit-0": cli.submit("t0", panels[0], "arima",
+                                   request_id="fit-0",
+                                   **KW).result(timeout=600)}
+
+        # 3. the seeded scenario against the live fleet, under storm
+        sched = chaos.chaos_schedule(CHAOS_SEED, CHAOS_DURATION_S,
+                                     n_events=3, kinds=("kill", "pause"),
+                                     targets=("primary",))
+        if not any(e.kind == "kill" for e in sched):
+            sys.exit(f"seed {CHAOS_SEED} schedules no kill: {sched}")
+
+        def _kill_primary(ev):
+            rec = read_lease(root) or {}
+            victim = procs.get(rec.get("owner"))
+            live = sum(1 for p in procs.values() if p.poll() is None)
+            if victim is None or victim.poll() is not None or live < 2:
+                return  # nobody to kill, or killing would empty the fleet
+            os.kill(victim.pid, signal.SIGKILL)
+
+        runner = chaos.ChaosRunner(sched, {
+            "kill": _kill_primary,
+            "pause": lambda ev: time.sleep(
+                min(float(ev.params.get("pause_s", 0.1)), 0.5)),
+        }).start()
+        probe = _ProbeLoop(root, eps, "fit-0").start()
+
+        calls = [((f"t{i}", panels[i], "arima"),
+                  dict(KW, request_id=f"fit-{i}"))
+                 for i in range(1, N_FITS)]
+        tickets, errors = fi.request_storm(cli.submit, calls, threads=2)
+        bad = [e for e in errors if e is not None]
+        if bad:
+            sys.exit(f"storm submits failed: {bad!r}")
+        fc_tk = {f"fc-{j}": cli.submit_forecast(
+                    f"t{j}", panels[j], np.asarray(want[f"fit-{j}"].params),
+                    request_id=f"fc-{j}", **FC_KW) for j in range(2)}
+        for i in range(1, N_FITS):
+            got[f"fit-{i}"] = tickets[i - 1].result(timeout=600)
+        for j in range(2):
+            got[f"fc-{j}"] = fc_tk[f"fc-{j}"].result(timeout=600)
+        fired, handler_errors = runner.join(timeout_s=120.0)
+        if handler_errors:
+            sys.exit(f"chaos handlers errored: {handler_errors!r}")
+        if not any(r["kind"] == "kill" for r in fired):
+            sys.exit(f"the scheduled kill never fired: {fired!r}")
+
+        # 4. the schedule SIGKILLed a; b took the lease with a higher
+        #    token and the storm finished against the degraded survivor
+        a_out, a_err = procs["a"].communicate(timeout=600)
+        if procs["a"].returncode != -9:
+            sys.exit(f"expected replica a SIGKILLed (-9), got "
+                     f"rc={procs['a'].returncode}\n{a_out}\n{a_err}")
+        rec = _wait_lease_owner(root, "b")
+        if rec["token"] <= tok_a:
+            sys.exit(f"survivor b did not fence a's token out: {rec}")
+
+        # 5. re-polls through a FRESH client: the durable result is the
+        #    answer of record
+        with FitClient(eps, seed=19, deadline_s=600.0,
+                       backoff_base_s=0.05) as cli2:
+            reanswers = {rid: cli2.result_for(rid, timeout=600)
+                         for rid in got}
+        probes, lease_hist = probe.stop()
+
+        # 6. the invariants, judged on the collected evidence
+        ids = sorted(got)
+        violations = (
+            chaos.check_invariants(expected_ids=ids, answers=got)
+            + chaos.check_invariants(answers=want, reanswers=got)
+            + chaos.check_invariants(answers=got, reanswers=reanswers)
+            + chaos.check_invariants(lease_history=lease_hist)
+            + chaos.check_invariants(probes=probes,
+                                     max_unavailable_s=MAX_UNAVAILABLE_S))
+        if violations:
+            sys.exit("chaos invariants violated:\n" + "\n".join(
+                f"  [{v.invariant}] {v.detail}" for v in violations))
+
+        # 7. the standby-read ladder: restart a (fenced to standby by
+        #    b's higher token), then read THROUGH the standby only
+        procs["a2"] = _spawn_replica(root, "a", track_locks=True)
+        sb_ep = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and sb_ep is None:
+            for ep in discover_endpoints(root):
+                try:
+                    with FitClient([ep], deadline_s=10.0,
+                                   retries=2) as c:
+                        h = c.health()
+                    if h.get("role") == "standby" and h.get("owner") == "a":
+                        sb_ep = ep
+                except Exception:  # noqa: BLE001 - stale advert
+                    pass
+            if sb_ep is None:
+                time.sleep(0.2)
+        if sb_ep is None:
+            sys.exit("restarted replica a never came back as standby")
+        with FitClient([sb_ep], seed=7, deadline_s=600.0,
+                       backoff_base_s=0.05) as sb:
+            # durable reads answered WITHOUT the lease
+            sb_res = sb.result_for("fit-1", timeout=60)
+            sb_fc = sb.submit_forecast(
+                "t0", panels[0], np.asarray(want["fit-0"].params),
+                request_id="fc-0", **FC_KW).result(timeout=600)
+            # a NEW forecast id: computed on the standby's private
+            # scratch server, bitwise (content-derived base seed)
+            sb_new = sb.submit_forecast(
+                "t0", panels[0], np.asarray(want["fit-0"].params),
+                request_id="fc-standby", **FC_KW).result(timeout=600)
+        for name, got_r, want_r in (("result_for", sb_res, want["fit-1"]),
+                                    ("completed-id forecast", sb_fc,
+                                     want["fc-0"]),
+                                    ("scratch forecast", sb_new,
+                                     want["fc-0"])):
+            for f in FIELDS:
+                if not np.array_equal(np.asarray(getattr(got_r, f)),
+                                      np.asarray(getattr(want_r, f)),
+                                      equal_nan=True):
+                    sys.exit(f"standby {name}: field {f} differs — "
+                             "degraded reads are NOT bitwise")
+        # writes bounce off the standby (not_leader until retries run dry)
+        try:
+            with FitClient([sb_ep], seed=3, deadline_s=3.0, retries=2,
+                           backoff_base_s=0.05) as wr:
+                wr.submit("t9", panels[0], "arima", request_id="fit-w",
+                          **KW)
+        except Exception as e:  # noqa: BLE001 - the typed refusal
+            write_refused = type(e).__name__
+        else:
+            sys.exit("a lease-less standby accepted a WRITE")
+        # the wrong wire secret is refused, terminally
+        try:
+            with FitClient([sb_ep], deadline_s=5.0, retries=1,
+                           secret=b"not-the-secret") as bad_cli:
+                bad_cli.health()
+        except WireAuthError:
+            pass
+        else:
+            sys.exit("a client with the wrong wire secret was answered")
+
+        # 8. the durable scenario record for advise_budget / post-mortems
+        snap = obs.snapshot() or {"counters": {}}
+        hedge = {
+            "launched": int(snap["counters"].get("client.hedge_launched",
+                                                 0)),
+            "won": int(snap["counters"].get("client.hedge_won", 0)),
+        }
+        windows = chaos.unavailability_windows(probes)
+        manifest = {
+            "kind": "chaos_soak",
+            "seed": CHAOS_SEED,
+            "duration_s": CHAOS_DURATION_S,
+            "schedule": [e._asdict() for e in sched],
+            "fired": fired,
+            "probe_period_s": PROBE_PERIOD_S,
+            "probes": [[t, bool(ok)] for t, ok in probes],
+            "unavailability_windows": [[a, b] for a, b in windows],
+            "max_unavailable_s": MAX_UNAVAILABLE_S,
+            "lease_history": lease_hist,
+            "violations": [],
+            "requests": {"expected": ids, "answered": len(reanswers)},
+            "client": {"seed": 17, "failure_threshold": 2,
+                       "hedge_after_s": 0.75, "backoff_base_s": 0.05},
+            "hedge": hedge,
+            "endpoint_health": cli.endpoint_health.snapshot(),
+            "write_refused_as": write_refused,
+        }
+        chaos.write_chaos_manifest(root, manifest)
+        if out_dir is not None:
+            os.makedirs(out_dir, exist_ok=True)
+            chaos.write_chaos_manifest(out_dir, manifest)
+        cli.close()
+
+        # 9. orderly shutdown; the tracked replicas report clean lock
+        #    discipline across takeover + degraded serving
+        for owner in ("a", "b"):
+            open(os.path.join(root, f"stop_{owner}"), "w").close()
+        b_out, b_err = procs["b"].communicate(timeout=600)
+        a2_out, a2_err = procs["a2"].communicate(timeout=600)
+        if procs["b"].returncode != 0:
+            sys.exit(f"replica b failed: rc={procs['b'].returncode}\n"
+                     f"{b_out}\n{b_err}")
+        if procs["a2"].returncode != 0:
+            sys.exit(f"restarted replica a failed: "
+                     f"rc={procs['a2'].returncode}\n{a2_out}\n{a2_err}")
+        if "lock discipline OK" not in b_out:
+            sys.exit(f"replica b did not report lock coverage:\n{b_out}")
+        if out_dir is not None:
+            # the survivor's telemetry stream (elected -> step_down ->
+            # final fleet.state) outlives the tempdir for the ci
+            # degradation-ladder gate
+            shutil.copy(os.path.join(root, "obs_b.jsonl"),
+                        os.path.join(out_dir, "obs_b.jsonl"))
+        longest = max((b - a for a, b in windows), default=0.0)
+        print("chaos soak smoke: PASS "
+              f"(seeded kill of the primary mid-storm, all {len(ids)} "
+              "requests answered bitwise across failover + write-ahead "
+              f"disk faults, longest read outage {longest:.2f}s "
+              f"(bound {MAX_UNAVAILABLE_S:.0f}s), standby served "
+              "durable + scratch reads bitwise without the lease, "
+              f"writes refused ({write_refused}), wrong wire secret "
+              f"refused, hedges launched={hedge['launched']} "
+              f"won={hedge['won']})")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replica", action="store_true")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--root")
+    ap.add_argument("--owner")
+    ap.add_argument("--ttl", type=float, default=TTL_S)
+    ap.add_argument("--disk-fault", type=int, default=None)
+    ap.add_argument("--retire-on-crash", action="store_true")
+    ap.add_argument("--track-locks", action="store_true")
+    ap.add_argument("--out", default=None,
+                    help="also write chaos_manifest.json here (survives "
+                         "the smoke's tempdir; advise_budget reads it)")
+    args = ap.parse_args()
+    if args.smoke:
+        return smoke(args.out)
+    if not args.replica or not args.root or not args.owner:
+        ap.error("need --replica --root R --owner X, or --smoke")
+    replica(args.root, args.owner, args.ttl, args.disk_fault,
+            args.retire_on_crash, args.track_locks)
+
+
+if __name__ == "__main__":
+    main()

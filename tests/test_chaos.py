@@ -1,0 +1,239 @@
+"""Chaos library tests (ISSUE 17): the seeded scenario generator, the
+schedule runner, the degraded-fleet invariant checker, and the durable
+scenario record.
+
+The contracts under test:
+
+- a chaos schedule is a pure function of its seed — same seed, same
+  scenario (timing, kinds, victims, fault intensities) — with offsets
+  sorted inside ``(0.1, duration)`` and kind-appropriate params;
+- :class:`ChaosRunner` fires every scheduled event through its handler,
+  records handler exceptions instead of re-raising (chaos must never
+  kill the orchestrator), refuses schedules with unhandled kinds, and
+  stops early on request;
+- :func:`check_invariants` turns collected evidence into typed
+  violations — lost/extra answers (conservation), re-answers that drift
+  byte-wise (bitwise), lease tokens that regress or get shared
+  (fencing), probe outages past the bound (availability) — and returns
+  an EMPTY list on a clean scenario;
+- the chaos manifest round-trips atomically through the fleet root.
+
+The live-fleet composition (subprocess replicas, SIGKILL, wire auth) is
+``tests/_chaos_worker.py`` — here the library's semantics are pinned
+in-process with no sockets and no fits.
+"""
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.reliability import chaos
+from spark_timeseries_tpu.reliability.chaos import (ChaosEvent, ChaosRunner,
+                                                    chaos_schedule,
+                                                    check_invariants,
+                                                    unavailability_windows)
+
+
+class _Res:
+    def __init__(self, params, nll=None):
+        self.params = np.asarray(params)
+        self.neg_log_likelihood = (np.zeros(len(self.params), np.float32)
+                                   if nll is None else np.asarray(nll))
+        self.converged = np.ones(len(self.params), bool)
+        self.iters = np.full(len(self.params), 7, np.int32)
+        self.status = np.zeros(len(self.params), np.int8)
+
+
+class TestChaosSchedule:
+    def test_same_seed_same_scenario(self):
+        assert chaos_schedule(23, 5.0) == chaos_schedule(23, 5.0)
+        assert chaos_schedule(23, 5.0) != chaos_schedule(24, 5.0)
+
+    def test_offsets_sorted_inside_window(self):
+        sched = chaos_schedule(3, 4.0, n_events=8)
+        ts = [e.t_s for e in sched]
+        assert ts == sorted(ts)
+        assert all(0.1 <= t <= 4.0 for t in ts)
+        assert len(sched) == 8
+
+    def test_kinds_and_targets_respected(self):
+        sched = chaos_schedule(7, 3.0, n_events=16,
+                               kinds=("kill", "pause"),
+                               targets=("primary",))
+        assert {e.kind for e in sched} <= {"kill", "pause"}
+        assert {e.target for e in sched} == {"primary"}
+
+    def test_kind_specific_params(self):
+        sched = chaos_schedule(11, 6.0, n_events=24,
+                               kinds=("kill", "disk", "frames", "pause"))
+        for e in sched:
+            if e.kind == "kill":
+                assert 1 <= e.params["after_commits"] <= 3
+            elif e.kind == "disk":
+                assert 0.05 <= e.params["eio_frac"] <= 0.2
+                assert e.params["n"] == 32
+            elif e.kind == "frames":
+                assert 0.02 <= e.params["drop_frac"] <= 0.1
+            elif e.kind == "pause":
+                assert 0.1 <= e.params["pause_s"] <= 0.5
+
+    def test_events_are_json_serializable(self):
+        import json
+
+        sched = chaos_schedule(5, 2.0)
+        rt = json.loads(json.dumps([e._asdict() for e in sched]))
+        assert [ChaosEvent(**d) for d in rt] == sched
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            chaos_schedule(1, 2.0, kinds=("meteor",))
+        with pytest.raises(ValueError):
+            chaos_schedule(1, 2.0, targets=())
+
+
+class TestChaosRunner:
+    def test_fires_all_events_through_handlers(self):
+        hits = []
+        sched = [ChaosEvent(0.01, "pause", "primary", {"pause_s": 0.0}),
+                 ChaosEvent(0.02, "kill", "primary", {"after_commits": 1})]
+        runner = ChaosRunner(sched, {
+            "pause": lambda e: hits.append(("pause", e.t_s)),
+            "kill": lambda e: hits.append(("kill", e.t_s))})
+        fired, errors = runner.start().join(timeout_s=30)
+        assert hits == [("pause", 0.01), ("kill", 0.02)]
+        assert [f["kind"] for f in fired] == ["pause", "kill"]
+        assert errors == []
+        assert all(f["fired_at_s"] >= f["t_s"] for f in fired)
+
+    def test_handler_exception_is_recorded_not_raised(self):
+        def boom(e):
+            raise RuntimeError("victim already dead")
+
+        sched = [ChaosEvent(0.01, "kill", "primary", {}),
+                 ChaosEvent(0.02, "pause", "standby", {})]
+        runner = ChaosRunner(sched, {"kill": boom,
+                                     "pause": lambda e: None})
+        fired, errors = runner.start().join(timeout_s=30)
+        # the run CONTINUED past the error to the next event
+        assert [f["kind"] for f in fired] == ["pause"]
+        assert len(errors) == 1 and "victim already dead" in errors[0]["error"]
+
+    def test_unhandled_kind_refused_at_construction(self):
+        with pytest.raises(ValueError, match="kill"):
+            ChaosRunner([ChaosEvent(0.1, "kill", "primary", {})],
+                        {"pause": lambda e: None})
+
+    def test_stop_cancels_pending_events(self):
+        hits = []
+        runner = ChaosRunner(
+            [ChaosEvent(30.0, "pause", "primary", {})],
+            {"pause": lambda e: hits.append(e)}).start()
+        runner.stop()
+        fired, errors = runner.join(timeout_s=30)
+        assert fired == [] and errors == [] and hits == []
+
+    def test_schedule_is_replayed_in_time_order(self):
+        order = []
+        sched = [ChaosEvent(0.03, "pause", "b", {}),
+                 ChaosEvent(0.01, "pause", "a", {})]
+        runner = ChaosRunner(sched,
+                             {"pause": lambda e: order.append(e.target)})
+        runner.start().join(timeout_s=30)
+        assert order == ["a", "b"]
+
+
+class TestUnavailabilityWindows:
+    def test_no_probes_no_windows(self):
+        assert unavailability_windows([]) == []
+
+    def test_all_ok_no_windows(self):
+        assert unavailability_windows([(0.0, True), (1.0, True)]) == []
+
+    def test_window_opens_and_closes(self):
+        probes = [(0.0, True), (1.0, False), (2.0, False), (3.0, True)]
+        assert unavailability_windows(probes) == [(1.0, 3.0)]
+
+    def test_trailing_failure_run_closes_at_last_probe(self):
+        probes = [(0.0, True), (1.0, False), (2.5, False)]
+        assert unavailability_windows(probes) == [(1.0, 2.5)]
+
+    def test_single_trailing_failure_is_a_point(self):
+        assert unavailability_windows([(0.0, True), (1.0, False)]) \
+            == [(1.0, 1.0)]
+
+    def test_multiple_windows(self):
+        probes = [(0.0, False), (1.0, True), (2.0, False), (3.0, True)]
+        assert unavailability_windows(probes) == [(0.0, 1.0), (2.0, 3.0)]
+
+
+class TestCheckInvariants:
+    def test_clean_scenario_is_empty(self):
+        r = _Res([[1.0, 2.0]])
+        out = check_invariants(
+            expected_ids=["a"], answers={"a": r}, reanswers={"a": r},
+            lease_history=[{"token": 1, "owner": "p"},
+                           {"token": 1, "owner": "p"},  # heartbeat
+                           {"token": 2, "owner": "s"}],
+            probes=[(0.0, True), (1.0, False), (1.4, True)],
+            max_unavailable_s=1.0)
+        assert out == []
+
+    def test_lost_answer_is_conservation(self):
+        out = check_invariants(expected_ids=["a", "b"],
+                               answers={"a": _Res([[1.0]]), "b": None})
+        assert [v.invariant for v in out] == ["conservation"]
+        assert "'b'" in out[0].detail
+
+    def test_extra_answer_is_conservation(self):
+        out = check_invariants(expected_ids=["a"],
+                               answers={"a": _Res([[1.0]]),
+                                        "ghost": _Res([[2.0]])})
+        assert [v.invariant for v in out] == ["conservation"]
+        assert "ghost" in out[0].detail
+
+    def test_reanswer_drift_is_bitwise(self):
+        out = check_invariants(
+            answers={"a": _Res([[1.0, 2.0]])},
+            reanswers={"a": _Res([[1.0, 2.000001]])})
+        assert [v.invariant for v in out] == ["bitwise"]
+
+    def test_nan_equal_reanswer_is_clean(self):
+        out = check_invariants(
+            answers={"a": _Res([[np.nan]], nll=[np.nan])},
+            reanswers={"a": _Res([[np.nan]], nll=[np.nan])})
+        assert out == []
+
+    def test_token_regression_is_fencing(self):
+        out = check_invariants(lease_history=[{"token": 3, "owner": "a"},
+                                              {"token": 2, "owner": "b"}])
+        assert [v.invariant for v in out] == ["fencing"]
+
+    def test_shared_token_two_owners_is_fencing(self):
+        out = check_invariants(lease_history=[{"token": 2, "owner": "a"},
+                                              {"token": 2, "owner": "b"}])
+        assert [v.invariant for v in out] == ["fencing"]
+
+    def test_outage_past_bound_is_availability(self):
+        out = check_invariants(
+            probes=[(0.0, True), (1.0, False), (5.0, True)],
+            max_unavailable_s=2.0)
+        assert [v.invariant for v in out] == ["availability"]
+
+    def test_missing_evidence_checks_nothing(self):
+        assert check_invariants() == []
+
+
+class TestChaosManifest:
+    def test_round_trip(self, tmp_path):
+        manifest = {"kind": "chaos_soak", "seed": 23,
+                    "schedule": [e._asdict()
+                                 for e in chaos_schedule(23, 2.0)],
+                    "violations": []}
+        path = chaos.write_chaos_manifest(str(tmp_path), manifest)
+        assert path.endswith(chaos.CHAOS_MANIFEST)
+        assert chaos.load_chaos_manifest(str(tmp_path)) == manifest
+
+    def test_write_is_atomic_no_siblings(self, tmp_path):
+        chaos.write_chaos_manifest(str(tmp_path), {"kind": "chaos_soak"})
+        leftovers = [p for p in tmp_path.iterdir()
+                     if p.name != chaos.CHAOS_MANIFEST]
+        assert leftovers == []

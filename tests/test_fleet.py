@@ -3,9 +3,10 @@ election, and kill-tolerant takeover on one shared checkpoint root.
 
 The contracts under test:
 
-- lease acquisition is filesystem-arbitrated (``O_EXCL`` claim files,
-  strictly monotonic fencing tokens): one winner per root, a fresh
-  claim counts as live (no election race window), an expired holder is
+- lease acquisition is filesystem-arbitrated (atomic hard-link claim
+  files, strictly monotonic fencing tokens): one winner per root, a
+  fresh claim counts as live (no election race window — a racer can
+  never observe a half-written claim), an expired holder is
   superseded and every later write attempt by the stale token raises
   :class:`FencedError` — loudly, never silently;
 - a fleet primary's answers are bitwise a standalone FitServer's (the
@@ -43,7 +44,8 @@ from spark_timeseries_tpu.serving.fleet import (FleetReplica,
                                                 advertise_endpoint,
                                                 discover_endpoints,
                                                 withdraw_endpoint)
-from spark_timeseries_tpu.serving.transport import NotLeaderError
+from spark_timeseries_tpu.serving.transport import (NotLeaderError,
+                                                    ReadOnlyError)
 
 T = 96
 CELL = 8
@@ -115,24 +117,29 @@ class TestLease:
         assert acquire_lease(root, "b", ttl_s=0.4) is None
 
     def test_contended_acquire_one_winner(self, tmp_path):
-        root = str(tmp_path)
-        wins = []
-        barrier = threading.Barrier(8)
+        # several rounds: a loser re-checks liveness the instant its
+        # claim link fails, so a non-atomic claim write (the bytes
+        # landing after the file exists) would read as dead and seat a
+        # SECOND winner on the next token
+        for rnd in range(6):
+            root = str(tmp_path / f"round{rnd}")
+            wins = []
+            barrier = threading.Barrier(8)
 
-        def race(owner):
-            barrier.wait()
-            lease = acquire_lease(root, owner, ttl_s=5.0)
-            if lease is not None:
-                wins.append(lease)
+            def race(owner):
+                barrier.wait()
+                lease = acquire_lease(root, owner, ttl_s=5.0)
+                if lease is not None:
+                    wins.append(lease)
 
-        ts = [threading.Thread(target=race, args=(f"o{i}",))
-              for i in range(8)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
-        assert len(wins) == 1, [w.owner for w in wins]
-        wins[0].check()  # the winner is not fenced
+            ts = [threading.Thread(target=race, args=(f"o{i}",))
+                  for i in range(8)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert len(wins) == 1, [w.owner for w in wins]
+            wins[0].check()  # the winner is not fenced
 
     def test_fenced_store_refuses_to_splice(self, tmp_path):
         # a zombie server whose lease expired while it stalled must die
@@ -268,3 +275,97 @@ def test_fleet_sigkill_smoke_subprocess():
                        capture_output=True, text=True, timeout=900)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     assert "PASS" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# the degradation ladder (ISSUE 17): leaderless windows serve reads and
+# refuse writes with a typed retry hint, degraded disks sit out elections
+# ---------------------------------------------------------------------------
+
+
+class TestDegradationLadder:
+    def test_leaderless_window_serves_reads_refuses_writes(self, tmp_path):
+        y = _panel(seed=31)
+        root = str(tmp_path / "fleet")
+        with serving.FitServer(str(tmp_path / "ref"), **SRV_KW) as ref:
+            want = ref.submit("acme", y, "arima", request_id="ro-1",
+                              **KW).result(timeout=600)
+        with FleetReplica(root, owner="p", ttl_s=1.0,
+                          server_kwargs=SRV_KW) as p:
+            assert p.wait_role("primary", 60)
+            assert p.state() == "full"
+            got = p.submit("acme", y, "arima", request_id="ro-1",
+                           **KW).result(timeout=600)
+        _eq(got, want, "fleet primary vs standalone")
+        # the orderly stop released the lease and nobody is left: a
+        # replica on this root now sits in the LEADERLESS window
+        r = FleetReplica(root, owner="r", ttl_s=1.0, server_kwargs=SRV_KW)
+        assert r.state() == "read_only"
+        _eq(r.result_for("ro-1"), want, "leaderless durable read")
+        assert r.counters["standby_reads"] == 1
+        with pytest.raises(ReadOnlyError) as exc:
+            r.submit("acme", y, "arima", request_id="ro-2", **KW)
+        assert exc.value.retry_after_s > 0
+
+    def test_standby_under_live_leader_redirects_not_read_only(self,
+                                                               tmp_path):
+        root = str(tmp_path)
+        # a live foreign lease pins the replica below at "standby": the
+        # refusal must NAME the holder (redirect), not plead read_only
+        assert acquire_lease(root, "ghost", ttl_s=30.0) is not None
+        with FleetReplica(root, owner="s", ttl_s=30.0,
+                          server_kwargs=SRV_KW) as s:
+            assert s.wait_role("standby", 10)
+            assert s.state() == "standby"
+            with pytest.raises(NotLeaderError, match="ghost"):
+                s.submit("acme", _panel(seed=2), "arima",
+                         request_id="nl-1", **KW)
+
+    def test_storage_degraded_sits_out_elections_still_reads(self,
+                                                             tmp_path):
+        root = str(tmp_path)
+        a = FleetReplica(root, owner="a", ttl_s=0.5, server_kwargs=SRV_KW)
+        a.start()
+        with FleetReplica(root, owner="b", ttl_s=0.5,
+                          server_kwargs=SRV_KW,
+                          storage_cooldown_s=60.0) as b:
+            assert a.wait_role("primary", 60)
+            want = a.submit("acme", _panel(seed=3), "arima",
+                            request_id="sd-1", **KW).result(timeout=600)
+            b._note_storage_degraded("injected: EIO on shared root")
+            assert b.state() == "storage_degraded"
+            assert b.health()["storage_degraded"]
+            a.stop()
+            # the only candidate is sitting out its cooldown: the root
+            # STAYS leaderless instead of electing a suspect disk
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                assert b.role() == "standby", b.role()
+                time.sleep(0.05)
+            assert b.counters["elections"] == 0
+            assert not journal_mod.lease_is_live(root)
+            # ... but reads keep flowing through the degraded replica,
+            # and writes get the leaderless retry hint
+            _eq(b.result_for("sd-1"), want, "degraded standby read")
+            assert b.counters["standby_reads"] == 1
+            with pytest.raises(ReadOnlyError):
+                b.submit("acme", _panel(seed=3), "arima",
+                         request_id="sd-2", **KW)
+
+    def test_torn_durable_result_is_discarded_loudly(self, tmp_path):
+        root = str(tmp_path)
+        r = FleetReplica(root, owner="r", ttl_s=1.0, server_kwargs=SRV_KW)
+        os.makedirs(os.path.join(root, "results"), exist_ok=True)
+        path = os.path.join(root, "results", "torn-1.npz")
+        with open(path, "wb") as f:
+            f.write(b"\x00garbage, not an npz")
+        with pytest.raises(KeyError, match="torn"):
+            r.result_for("torn-1")
+        assert not os.path.exists(path)  # never served twice
+        assert r.counters["torn_results"] == 1
+
+    def test_state_codes_are_the_published_ladder(self):
+        from spark_timeseries_tpu.serving.fleet import STATE_CODES
+        assert STATE_CODES == {"full": 0, "recovering": 1, "standby": 2,
+                               "read_only": 3, "storage_degraded": 4,
+                               "retired": 5, "stopped": 6}

@@ -1,0 +1,213 @@
+"""Client-side endpoint health cache tests (ISSUE 17).
+
+The contracts under test:
+
+- the circuit-open cooldown schedule is a pure function of
+  ``(seed, endpoint, opening)`` — same seed, same schedule, every
+  process — with exponential caps and jitter in ``[0.5, 1.0)``;
+- ``failure_threshold`` consecutive failures open the circuit (the
+  endpoint sorts LAST), an elapsed cooldown half-opens it (exactly one
+  probe), and one success closes it again;
+- a ``not_leader`` redirect memoizes "not primary" for writes without
+  dinging the endpoint's health, and a successful write establishes the
+  primary belief that puts the endpoint first for writes only;
+- the EWMA latency is the tiebreak among equally-healthy endpoints,
+  rounded so measurement noise cannot flap the order;
+- :meth:`order` is deterministic under an injected clock and never
+  returns an empty list, even with every circuit open.
+
+Every mutating call takes an explicit ``now`` so no test sleeps.
+"""
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.serving.health import (EndpointHealthCache,
+                                                 cooldown_schedule)
+
+A = ("127.0.0.1", 9001)
+B = ("127.0.0.1", 9002)
+C = ("127.0.0.1", 9003)
+
+
+def _cache(**kw):
+    kw.setdefault("seed", 7)
+    kw.setdefault("failure_threshold", 3)
+    return EndpointHealthCache([A, B, C], **kw)
+
+
+class TestCooldownSchedule:
+    def test_same_seed_same_schedule(self):
+        s1 = cooldown_schedule(11, A, 6)
+        s2 = cooldown_schedule(11, A, 6)
+        assert s1 == s2
+        assert len(s1) == 6
+
+    def test_seed_and_endpoint_vary_jitter(self):
+        assert cooldown_schedule(11, A, 4) != cooldown_schedule(12, A, 4)
+        assert cooldown_schedule(11, A, 4) != cooldown_schedule(11, B, 4)
+
+    def test_exponential_caps_with_bounded_jitter(self):
+        base, cap = 0.25, 8.0
+        sched = cooldown_schedule(3, A, 8, base_s=base, max_s=cap)
+        for n, v in enumerate(sched):
+            hi = min(cap, base * 2.0 ** n)
+            assert hi * 0.5 <= v < hi
+
+    def test_zero_openings_empty(self):
+        assert cooldown_schedule(3, A, 0) == []
+
+
+class TestCircuitBreaker:
+    def test_threshold_failures_open_the_circuit(self):
+        h = _cache()
+        for _ in range(2):
+            h.record_failure(A, now=10.0)
+        assert not h.snapshot(now=10.0)["endpoints"]["127.0.0.1:9001"]["open"]
+        h.record_failure(A, now=10.0)
+        snap = h.snapshot(now=10.0)["endpoints"]["127.0.0.1:9001"]
+        assert snap["open"] and snap["openings"] == 1
+        # an open circuit sorts last
+        assert h.order(now=10.0)[-1] == A
+
+    def test_cooldown_is_the_seeded_schedule(self):
+        h = _cache(seed=21)
+        for _ in range(3):
+            h.record_failure(A, now=100.0)
+        want = cooldown_schedule(21, A, 1)[0]
+        # still open just before the scheduled instant, probe-due after
+        eps = 1e-6
+        assert h.snapshot(now=100.0 + want - eps)[
+            "endpoints"]["127.0.0.1:9001"]["open"]
+        assert not h.snapshot(now=100.0 + want + eps)[
+            "endpoints"]["127.0.0.1:9001"]["open"]
+
+    def test_half_open_probe_then_recovery(self):
+        h = _cache(failure_threshold=1)
+        h.record_failure(A, now=0.0)
+        elapsed = cooldown_schedule(7, A, 1)[0] + 0.01
+        # cooldown elapsed: A is probe-due — it sorts after the healthy
+        # endpoints but before any still-open circuit
+        order = h.order(now=elapsed)
+        assert order[-1] == A
+        h.record_success(A, 0.01, now=elapsed)
+        snap = h.snapshot(now=elapsed)["endpoints"]["127.0.0.1:9001"]
+        assert not snap["open"] and snap["openings"] == 0
+
+    def test_consecutive_openings_back_off_exponentially(self):
+        h = _cache(seed=5, failure_threshold=1)
+        h.record_failure(A, now=0.0)
+        first = cooldown_schedule(5, A, 2)[0]
+        h.record_failure(A, now=first + 1.0)
+        second = cooldown_schedule(5, A, 2)[1]
+        snap = h.snapshot(now=first + 1.0 + second - 1e-6)
+        assert snap["endpoints"]["127.0.0.1:9001"]["open"]
+        assert snap["endpoints"]["127.0.0.1:9001"]["openings"] == 2
+
+    def test_success_resets_consecutive_failures(self):
+        h = _cache(failure_threshold=3)
+        h.record_failure(A, now=0.0)
+        h.record_failure(A, now=0.0)
+        h.record_success(A, 0.01, now=0.0)
+        h.record_failure(A, now=0.0)
+        assert not h.snapshot(now=0.0)["endpoints"]["127.0.0.1:9001"]["open"]
+
+    def test_all_open_still_returns_everything(self):
+        h = _cache(failure_threshold=1)
+        for ep in (A, B, C):
+            h.record_failure(ep, now=0.0)
+        order = h.order(now=0.0)
+        assert sorted(order) == sorted([A, B, C])
+
+
+class TestPrimaryBelief:
+    def test_write_order_puts_believed_primary_first(self):
+        h = _cache()
+        h.set_primary(B)
+        assert h.order(write=True, now=0.0)[0] == B
+        assert h.believed_primary() == B
+        # reads are indifferent to the belief: index order wins when
+        # everything is equally healthy
+        assert h.order(write=False, now=0.0)[0] == A
+
+    def test_failure_clears_primary_belief(self):
+        h = _cache()
+        h.set_primary(B)
+        h.record_failure(B, now=0.0)
+        assert h.believed_primary() is None
+
+    def test_redirect_clears_belief_and_memoizes_for_writes(self):
+        h = _cache(redirect_memo_s=1.0)
+        h.set_primary(A)
+        h.record_redirect(A, now=0.0)
+        assert h.believed_primary() is None
+        # inside the memo window writes avoid A; reads do not care
+        assert h.order(write=True, now=0.5)[0] != A
+        assert h.order(write=False, now=0.5)[0] == A
+        # memo expires on the lease-TTL scale: A is eligible again
+        assert h.order(write=True, now=1.5)[0] == A
+
+    def test_redirect_does_not_ding_health(self):
+        h = _cache(failure_threshold=1)
+        h.record_redirect(A, now=0.0)
+        snap = h.snapshot(now=0.0)["endpoints"]["127.0.0.1:9001"]
+        assert not snap["open"] and snap["failures"] == 0
+
+
+class TestLatencyTiebreak:
+    def test_lower_ewma_sorts_first_among_healthy(self):
+        h = _cache()
+        h.record_success(A, 0.5, now=0.0)
+        h.record_success(B, 0.05, now=0.0)
+        h.record_success(C, 0.2, now=0.0)
+        assert h.order(now=0.0) == [B, C, A]
+
+    def test_ewma_folds_with_alpha(self):
+        h = _cache(ewma_alpha=0.5)
+        h.record_success(A, 0.4, now=0.0)
+        h.record_success(A, 0.2, now=0.0)
+        got = h.snapshot(now=0.0)["endpoints"]["127.0.0.1:9001"]["ewma_s"]
+        assert got == pytest.approx(0.3)
+
+    def test_rounding_suppresses_noise_flap(self):
+        h = _cache()
+        # 1 ms apart rounds to the same 10 ms bucket: index breaks the tie
+        h.record_success(B, 0.101, now=0.0)
+        h.record_success(A, 0.102, now=0.0)
+        assert h.order(now=0.0)[0] == A
+
+
+class TestDeterminismAndShape:
+    def test_order_is_deterministic_under_fixed_clock(self):
+        def build():
+            h = _cache(seed=13, failure_threshold=2)
+            h.record_success(B, 0.05, now=0.0)
+            h.record_failure(C, now=1.0)
+            h.record_failure(C, now=1.0)
+            h.set_primary(B)
+            return h
+
+        o1 = [build().order(write=w, now=2.0) for w in (False, True)]
+        o2 = [build().order(write=w, now=2.0) for w in (False, True)]
+        assert o1 == o2
+
+    def test_snapshot_is_json_safe(self):
+        import json
+
+        h = _cache()
+        h.record_success(A, 0.25, now=0.0)
+        h.record_failure(B, now=0.0)
+        h.set_primary(A)
+        snap = h.snapshot(now=0.0)
+        assert json.loads(json.dumps(snap)) == snap
+        assert snap["primary"] == list(A)
+
+    def test_unknown_endpoint_outcomes_are_ignored(self):
+        h = _cache()
+        h.record_success(("10.0.0.9", 1), 0.1, now=0.0)
+        h.record_failure(("10.0.0.9", 1), now=0.0)
+        assert sorted(h.order(now=0.0)) == sorted([A, B, C])
+
+    def test_needs_at_least_one_endpoint(self):
+        with pytest.raises(ValueError):
+            EndpointHealthCache([])

@@ -304,6 +304,8 @@ class TestWatchdog:
 
 
 class TestKillResumeSubprocess:
+    @pytest.mark.slow  # tier-1 budget: runs in ci.sh's unfiltered pass,
+    # which also real-SIGKILLs every serving/fleet/backtest/delta worker
     def test_sigkill_then_resume_bitwise(self, tmp_path):
         worker = os.path.join(_ROOT, "tests", "_journal_worker.py")
         env = {**os.environ, "JAX_PLATFORMS": "cpu"}

@@ -202,6 +202,8 @@ class TestHoltWinters:
         truth = 10.0 + 0.05 * t + 2.0 * np.sin(2 * np.pi * t / 12)
         assert np.abs(np.asarray(fc) - truth).mean() < 1.0
 
+    @pytest.mark.slow  # tier-1 budget: runs in ci.sh's unfiltered pass;
+    # multiplicative HW parity also rides test_journal's multi-start suite
     def test_multiplicative_runs(self):
         y = gen_seasonal(11, 6 * 12, multiplicative=True)
         res = holtwinters.fit(jnp.asarray(y), period=12, model_type="multiplicative")

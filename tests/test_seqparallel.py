@@ -199,6 +199,8 @@ class TestTimeShardedFits:
         )(params, jnp.asarray(yd)))
         np.testing.assert_allclose(got, ref, rtol=1e-9)
 
+    @pytest.mark.slow  # tier-1 budget: the general-order variant below
+    # keeps the contract in tier-1; this one runs in ci.sh's unfiltered pass
     def test_sp_arima_fit_matches_unsharded(self, mesh2d):
         from spark_timeseries_tpu.models import arima
 

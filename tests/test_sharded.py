@@ -189,6 +189,8 @@ class TestShardedJournal:
         return rel.fit_chunked(arima.fit, y, checkpoint_dir=d,
                                order=(1, 0, 0), **kw)
 
+    @pytest.mark.slow  # tier-1 budget: runs in ci.sh's unfiltered pass;
+    # sibling sharded-bitwise tests keep the walk itself in tier-1
     def test_merged_manifest_structure(self, lane_mesh, tmp_path):
         y = _ar_panel(b=32)  # 8 chunks over 8 lanes
         d = str(tmp_path / "j")

@@ -126,6 +126,8 @@ class TestBitwise:
         assert_bitwise(dev_result, res, "device-source")
         assert "source" not in res.meta  # today's path, byte-identical
 
+    @pytest.mark.slow  # tier-1 budget: runs in ci.sh's unfiltered pass;
+    # the resilient ladder stays tier-1 via test_pipeline's resilient leg
     def test_resilient_host_walk(self, panel):
         y = panel.copy()
         y[3, :10] = np.nan  # leading NaNs: sanitizer/ladder territory

@@ -36,6 +36,8 @@ from spark_timeseries_tpu.serving import transport
 from spark_timeseries_tpu.serving.client import (ClientDeadlineError,
                                                  FitClient, backoff_schedule)
 from spark_timeseries_tpu.serving.session import (RejectedError,
+                                                  ServerClosedError,
+                                                  StorageError,
                                                   TenantFitResult)
 
 
@@ -414,3 +416,169 @@ class TestTransportServerDispatch:
             assert hdr["msg_id"] == "m-42"
         finally:
             s.close()
+
+
+# ---------------------------------------------------------------------------
+# wire auth (ISSUE 17): HMAC-tagged frames, terminal refusal on mismatch
+# ---------------------------------------------------------------------------
+
+
+class TestWireAuth:
+    def test_matching_secret_round_trips(self):
+        backend = StubBackend()
+        with transport.TransportServer(backend, secret=b"s3cret") as ts:
+            with FitClient([ts.address], seed=11, deadline_s=10.0,
+                           secret=b"s3cret") as cli:
+                assert cli.ping() is True
+                res = cli.submit("t", np.ones((3, 8), np.float32),
+                                 request_id="auth-1").result(timeout=30)
+        assert res.params.tobytes() == \
+            backend.results["auth-1"].params.tobytes()
+
+    def test_wrong_secret_is_terminal_not_retried(self):
+        backend = StubBackend()
+        with transport.TransportServer(backend, secret=b"right") as ts:
+            t0 = time.monotonic()
+            with FitClient([ts.address], seed=12, deadline_s=30.0,
+                           retries=8, secret=b"wrong") as cli:
+                with pytest.raises(transport.WireAuthError):
+                    cli.ping()
+            # terminal: no 8-retry backoff ladder was burned
+            assert time.monotonic() - t0 < 10.0
+        assert backend.submits == []
+
+    def test_unauthenticated_client_refused_by_armed_server(self):
+        backend = StubBackend()
+        with transport.TransportServer(backend, secret=b"armed") as ts:
+            s = socket.create_connection(ts.address)
+            try:
+                dec = transport.FrameDecoder()
+                transport.send_msg(s, {"op": "ping", "msg_id": "m"})
+                # the reply IS tagged (the server never disarms); decode
+                # with the server's secret to read the typed refusal
+                reply, _ = transport.recv_msg(s, dec, secret=b"armed")
+            finally:
+                s.close()
+        assert reply["error"] == "auth_failed"
+        assert backend.submits == []
+
+    def test_env_secret_arms_both_ends(self, monkeypatch):
+        monkeypatch.setenv("STSTPU_WIRE_SECRET", "from-env")
+        assert transport.resolve_wire_secret() == b"from-env"
+        backend = StubBackend()
+        with transport.TransportServer(backend) as ts:
+            with FitClient([ts.address], seed=14, deadline_s=10.0) as cli:
+                assert cli.ping() is True
+            with FitClient([ts.address], seed=15, deadline_s=10.0,
+                           secret=b"not-from-env") as bad:
+                with pytest.raises(transport.WireAuthError):
+                    bad.ping()
+
+    def test_codec_tags_and_verifies(self):
+        hdr = {"op": "ping", "msg_id": "m"}
+        framed = transport.encode_msg(hdr, b"payload", secret=b"k")
+        payload = transport.FrameDecoder().feed(framed)[0]
+        got_hdr, got_blob = transport.decode_msg(payload, secret=b"k")
+        assert got_hdr["op"] == "ping" and got_blob == b"payload"
+        # a tagged frame does NOT decode with the wrong secret
+        with pytest.raises(transport.WireAuthError):
+            transport.decode_msg(payload, secret=b"other")
+
+
+# ---------------------------------------------------------------------------
+# degraded-fleet error kinds (ISSUE 17): read_only + storage_degraded
+# ---------------------------------------------------------------------------
+
+
+class _ReadOnlyBackend(StubBackend):
+    """A replica in the leaderless window: reads answer from the durable
+    store, writes bounce with the typed read_only kind."""
+
+    def submit(self, *a, **kw):
+        raise transport.ReadOnlyError("leaderless window",
+                                      retry_after_s=0.02)
+
+
+class _DegradedBackend(StubBackend):
+    """A primary whose write-ahead disk refuses admissions."""
+
+    def __init__(self, fail_first_n):
+        super().__init__()
+        self.refusals = fail_first_n
+
+    def submit(self, *a, **kw):
+        with self.lock:
+            if self.refusals > 0:
+                self.refusals -= 1
+                raise StorageError("EIO on write-ahead",
+                                   retry_after_s=0.02)
+        return super().submit(*a, **kw)
+
+
+class TestDegradedErrorKinds:
+    @staticmethod
+    def _submit_blob(req_id):
+        meta = {"req_id": req_id, "tenant": "t", "model": "arima",
+                "fit_kwargs": {}, "priority": 0, "deadline_s": None}
+        return transport.encode_request_blob(
+            np.ones((2, 4), np.float32), meta)
+
+    def test_read_only_kind_reaches_the_wire(self):
+        backend = _ReadOnlyBackend()
+        with transport.TransportServer(backend) as ts:
+            s = socket.create_connection(ts.address)
+            try:
+                dec = transport.FrameDecoder()
+                transport.send_msg(s, {"op": "submit", "msg_id": "m-1"},
+                                   self._submit_blob("ro-1"))
+                reply, _ = transport.recv_msg(s, dec)
+            finally:
+                s.close()
+        assert reply["error"] == "read_only"
+        assert reply["retry_after_s"] == pytest.approx(0.02)
+
+    def test_reads_still_work_while_writes_bounce(self):
+        backend = _ReadOnlyBackend()
+        backend.results["done-1"] = _result_for("done-1")
+        with transport.TransportServer(backend) as ts:
+            with FitClient([ts.address], seed=16, deadline_s=10.0,
+                           retries=2, backoff_base_s=0.01) as cli:
+                res = cli.result_for("done-1", timeout=10)
+                assert res.params.tobytes() == \
+                    backend.results["done-1"].params.tobytes()
+                with pytest.raises(ServerClosedError):
+                    cli.submit("t", np.ones((2, 4), np.float32),
+                               request_id="ro-2").result(timeout=10)
+
+    def test_storage_degraded_retries_then_lands(self):
+        backend = _DegradedBackend(fail_first_n=2)
+        with transport.TransportServer(backend) as ts:
+            with FitClient([ts.address], seed=17, deadline_s=30.0,
+                           backoff_base_s=0.01) as cli:
+                res = cli.submit("t", np.ones((3, 8), np.float32),
+                                 request_id="sd-1").result(timeout=30)
+        assert backend.refusals == 0
+        assert res.params.tobytes() == \
+            backend.results["sd-1"].params.tobytes()
+
+    def test_storage_degraded_is_typed_when_not_retryable(self):
+        backend = _DegradedBackend(fail_first_n=99)
+        with transport.TransportServer(backend) as ts:
+            with FitClient([ts.address], seed=18, deadline_s=30.0,
+                           retries=1, backoff_base_s=0.01) as cli:
+                with pytest.raises(StorageError):
+                    cli._call({"op": "submit"},
+                              self._submit_blob("sd-typed"), what="probe",
+                              resubmit_ok=False)
+
+    def test_storage_degraded_dings_endpoint_health(self):
+        backend = _DegradedBackend(fail_first_n=3)
+        with transport.TransportServer(backend) as ts:
+            addr_key = f"{ts.address[0]}:{ts.address[1]}"
+            with FitClient([ts.address], seed=19, deadline_s=30.0,
+                           backoff_base_s=0.01, failure_threshold=3) as cli:
+                cli.submit("t", np.ones((3, 8), np.float32),
+                           request_id="sd-2").result(timeout=30)
+                snap = cli.endpoint_health.snapshot()
+        rec = snap["endpoints"][addr_key]
+        assert rec["failures"] >= 3

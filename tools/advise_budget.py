@@ -722,6 +722,147 @@ def _render_auto(root: str, a: dict) -> None:
               f"{p.get('prefetch_depth')}")
 
 
+def advise_chaos(root: str) -> dict:
+    """Advice from a chaos/soak manifest (ISSUE 17): read the scenario
+    record ``reliability.chaos.write_chaos_manifest`` left at the fleet
+    root and turn its evidence — the read-probe timeline, hedge win
+    rate, endpoint-health counters, lease transitions — into the
+    client-tuning knobs for the next run:
+
+    - ``failure_threshold`` — how many consecutive failures should open
+      the client's circuit: when the fleet went dark longer than a few
+      probe periods but no circuit ever opened, the breaker was too
+      patient (lower by one, floor 2); when circuits opened but the
+      longest outage stayed under one probe period, it was too jumpy;
+    - ``cooldown_base_s`` — the deterministic probe-backoff base:
+      roughly half the observed takeover gap (lease transition to
+      first healthy probe), so a cooled endpoint is re-probed about
+      when the fleet has actually recovered;
+    - ``hedge_after_s`` — hedged polls that never win are pure load
+      (double it); a majority win rate means the primary poll is the
+      slow path (halve it);
+    - ``max_unavailable_s`` — the next soak's availability floor:
+      the longest observed outage with 4x headroom, so the gate trips
+      on regression, not on noise.
+    """
+    path = os.path.join(root, "chaos_manifest.json") if os.path.isdir(root) \
+        else root
+    try:
+        with open(path, "rb") as f:
+            m = json.loads(f.read().decode())
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        sys.exit(f"advise_budget: chaos manifest {path} unreadable ({e})")
+    probes = m.get("probes") or []
+    ok = sum(1 for _, p_ok in probes if p_ok)
+    windows = m.get("unavailability_windows") or []
+    longest = max((b - a for a, b in windows), default=0.0)
+    total_dark = sum(b - a for a, b in windows)
+    period = float(m.get("probe_period_s") or 0.1)
+    hedge = m.get("hedge") or {}
+    launched = int(hedge.get("launched") or 0)
+    won = int(hedge.get("won") or 0)
+    win_rate = round(won / launched, 3) if launched else None
+    client = m.get("client") or {}
+    cur_threshold = int(client.get("failure_threshold") or 3)
+    cur_hedge = client.get("hedge_after_s")
+    eh = (m.get("endpoint_health") or {}).get("endpoints") or {}
+    openings = sum(int(r.get("openings") or 0) for r in eh.values())
+    failures = sum(int(r.get("failures") or 0) for r in eh.values())
+    lease = m.get("lease_history") or []
+
+    # breaker: dark fleet + a breaker that never opened = too patient;
+    # opened breakers with sub-probe-period outages = too jumpy
+    threshold = cur_threshold
+    if longest > 3 * period and openings == 0:
+        threshold = max(2, cur_threshold - 1)
+    elif openings > 0 and longest < period:
+        threshold = cur_threshold + 1
+
+    # cooldown: half the takeover gap (lease flip to recovery), so the
+    # first deterministic re-probe lands about when the fleet is back
+    takeover_gap = None
+    if len(lease) >= 2:
+        takeover_gap = round(lease[-1]["t_s"] - lease[0]["t_s"], 3)
+    cooldown = None
+    if longest > 0:
+        cooldown = round(min(max(longest / 2.0, 0.1), 2.0), 3)
+    elif takeover_gap:
+        cooldown = round(min(max(takeover_gap / 2.0, 0.1), 2.0), 3)
+
+    hedge_after = cur_hedge
+    if launched and cur_hedge is not None:
+        if won == 0:
+            hedge_after = round(float(cur_hedge) * 2.0, 3)
+        elif win_rate is not None and win_rate > 0.5:
+            hedge_after = round(float(cur_hedge) / 2.0, 3)
+
+    return {
+        "chaos": True,
+        "observed": {
+            "seed": m.get("seed"),
+            "events_fired": len(m.get("fired") or []),
+            "requests_expected": len((m.get("requests") or {})
+                                     .get("expected") or []),
+            "requests_answered": (m.get("requests") or {}).get("answered"),
+            "violations": len(m.get("violations") or []),
+            "probes": len(probes),
+            "probe_ok_rate": round(ok / len(probes), 3) if probes else None,
+            "longest_unavailable_s": round(longest, 3),
+            "total_unavailable_s": round(total_dark, 3),
+            "availability_bound_s": m.get("max_unavailable_s"),
+            "hedges_launched": launched,
+            "hedges_won": won,
+            "hedge_win_rate": win_rate,
+            "circuit_openings": openings,
+            "endpoint_failures": failures,
+            "lease_transitions": len(lease),
+            "takeover_gap_s": takeover_gap,
+            "write_refused_as": m.get("write_refused_as"),
+        },
+        "suggest": {
+            "failure_threshold": threshold,
+            "cooldown_base_s": cooldown,
+            "hedge_after_s": hedge_after,
+            "max_unavailable_s": (round(max(longest * 4.0, 1.0), 3)
+                                  if probes else None),
+        },
+    }
+
+
+def _render_chaos(root: str, a: dict) -> None:
+    o, s = a["observed"], a["suggest"]
+    print(f"chaos soak {root}")
+    print(f"  scenario: seed {o['seed']}, {o['events_fired']} fault "
+          f"event(s) fired, {o['lease_transitions']} lease "
+          f"transition(s)"
+          + (f" (takeover gap {o['takeover_gap_s']}s)"
+             if o["takeover_gap_s"] is not None else ""))
+    print(f"  requests: {o['requests_answered']}/"
+          f"{o['requests_expected']} answered, "
+          f"{o['violations']} invariant violation(s)")
+    print(f"  availability: {o['probes']} read probes, ok rate "
+          f"{o['probe_ok_rate']}; unavailable longest "
+          f"{o['longest_unavailable_s']}s / total "
+          f"{o['total_unavailable_s']}s (bound "
+          f"{o['availability_bound_s']}s)")
+    print(f"  client: hedges launched {o['hedges_launched']} won "
+          f"{o['hedges_won']} (win rate {o['hedge_win_rate']}); "
+          f"circuit openings {o['circuit_openings']}, endpoint "
+          f"failures {o['endpoint_failures']}"
+          + (f"; writes refused as {o['write_refused_as']}"
+             if o.get("write_refused_as") else ""))
+    print("  suggest for the next soak / client config:")
+    print(f"    failure_threshold = {s['failure_threshold']}")
+    if s["cooldown_base_s"] is not None:
+        print(f"    cooldown_base_s   = {s['cooldown_base_s']}  "
+              "(first re-probe lands about when the fleet recovers)")
+    if s["hedge_after_s"] is not None:
+        print(f"    hedge_after_s     = {s['hedge_after_s']}")
+    if s["max_unavailable_s"] is not None:
+        print(f"    max_unavailable_s = {s['max_unavailable_s']}  "
+              "(longest observed outage x4 headroom)")
+
+
 def _device_budget_bytes():
     """The local device allocator's budget (``memory_stats()['bytes_limit']``)
     when the backend reports one; None on CPU-only hosts (the advice then
@@ -746,6 +887,16 @@ def main():
                          "root (server.json + per-batch journals); "
                          "auto-detected when server.json is present")
     args = ap.parse_args()
+    # a chaos/soak root (ISSUE 17) is identified by its scenario record
+    if ((os.path.isdir(args.path)
+         and os.path.exists(os.path.join(args.path, "chaos_manifest.json")))
+            or args.path.endswith("chaos_manifest.json")):
+        a = advise_chaos(args.path)
+        if args.json:
+            print(json.dumps(a, indent=1, sort_keys=True))
+        else:
+            _render_chaos(args.path, a)
+        return
     # a serving root (ISSUE 12) is a server.json plus one journal per
     # micro-batch under batches/<id>/journal
     if args.serving or (

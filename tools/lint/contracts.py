@@ -358,6 +358,12 @@ FILE_WRITE_OWNERS = {
                          "(O_CREAT|O_EXCL: the filesystem arbitrates "
                          "token allocation, so claims are never "
                          "overwritten, only created)",
+        "tear_after_replace": "the disk-fault seam's torn-fsync "
+                              "primitive (ISSUE 17): DELIBERATELY "
+                              "truncates a just-replaced file to "
+                              "simulate a lying fsync — invoked only "
+                              "when an injected fault schedule says "
+                              "'torn', never on an unfaulted root",
     },
     "spark_timeseries_tpu/reliability/source.py": {
         "write_npz_shards": "explicit export utility: creates a brand-new "
@@ -381,6 +387,14 @@ FILE_WRITE_OWNERS = {
         "tear_file": "the fault harness DELIBERATELY corrupts a named "
                      "file to simulate a torn write — test-only, "
                      "operator-invoked, never on a live namespace",
+    },
+    "spark_timeseries_tpu/reliability/chaos.py": {
+        "write_chaos_manifest": "sole writer of chaos_manifest.json at "
+                                "the fleet root (via the journal's "
+                                "atomic byte-payload primitive): the "
+                                "scenario's durable record — schedule, "
+                                "probe timeline, invariant verdicts — "
+                                "for advise_budget and post-mortems",
     },
     "spark_timeseries_tpu/obs/promsink.py": {
         "PromTextfileSink": "sole writer of its textfile path (atomic "
@@ -488,7 +502,9 @@ LOCKMAP_RUNTIME_CLASSES = (
     "spark_timeseries_tpu.serving.server:FitServer",
     "spark_timeseries_tpu.serving.transport:TransportServer",
     "spark_timeseries_tpu.serving.client:FitClient",
+    "spark_timeseries_tpu.serving.health:EndpointHealthCache",
     "spark_timeseries_tpu.serving.fleet:FleetReplica",
+    "spark_timeseries_tpu.reliability.chaos:ChaosRunner",
     "spark_timeseries_tpu.obs.metrics:MetricsRegistry",
     "spark_timeseries_tpu.obs.recorder:FlightRecorder",
     "spark_timeseries_tpu.obs.promsink:PromTextfileSink",

@@ -217,6 +217,72 @@ FIXTURES["lock-map/transport"] = (_FLEET, _fix("""
             return out
     """), [lockmap.check])
 
+# ISSUE 17: the chaos plane and the client's endpoint-health cache
+# joined the registries — seed a violation of each NEW entry shape so a
+# checker that stopped matching them cannot pass vacuously.  (a)
+# journal-writer: a rogue reporter writes chaos_manifest.json (the
+# scenario record namespace) directly instead of routing through the
+# registered write_chaos_manifest owner; (b) lock-map: a health-cache-
+# shaped class mutates its per-endpoint records outside the declared
+# lock — the exact shape the reply-site recording / hedge-thread race
+# would take.
+_CHAOS = "spark_timeseries_tpu/reliability/fixture_chaos.py"
+_CHAOS_OWNERS = {_CHAOS: {"write_chaos_manifest":
+                          "sole writer of chaos_manifest.json"}}
+
+FIXTURES["journal-writer/chaos"] = (_CHAOS, _fix("""
+    import json
+    import os
+
+    def rogue_scenario_note(root, manifest):
+        path = os.path.join(root, "chaos_manifest.json")
+        with open(path, "w") as f:     # unregistered writer
+            f.write(json.dumps(manifest, sort_keys=True))
+    """), _fix("""
+    import json
+    import os
+
+    def write_chaos_manifest(root, manifest):
+        path = os.path.join(root, "chaos_manifest.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(manifest, sort_keys=True))
+        os.replace(tmp, path)
+    """), [functools.partial(journalwriter.check, owners=_CHAOS_OWNERS)])
+
+_HEALTH = "spark_timeseries_tpu/serving/fixture_health.py"
+
+FIXTURES["lock-map/health"] = (_HEALTH, _fix("""
+    import threading
+
+    class HealthCache:
+        _protected_by_ = {"_records": "_lock", "_primary": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._records = {}
+            self._primary = None
+
+        def record_failure(self, ep):
+            self._records[ep] = "open"   # mutation outside the lock
+            self._primary = None
+    """), _fix("""
+    import threading
+
+    class HealthCache:
+        _protected_by_ = {"_records": "_lock", "_primary": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._records = {}
+            self._primary = None
+
+        def record_failure(self, ep):
+            with self._lock:
+                self._records[ep] = "open"
+                self._primary = None
+    """), [lockmap.check])
+
 _OWNERS = {HOT: {"Owner": "fixture namespace owner"}}
 
 FIXTURES["journal-writer"] = (HOT, _fix("""

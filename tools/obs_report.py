@@ -101,6 +101,64 @@ def validate_events(events, errors) -> list:
     return errors
 
 
+DEGRADATION_EVENT_FIELDS = {
+    # ISSUE 17 degradation-ladder telemetry: event name -> required
+    # fields.  A renamed or stripped field here silently breaks the
+    # chaos post-mortem story, so the shapes are pinned.
+    "fleet.step_down": ("owner", "reason"),
+    "fleet.elected": ("owner", "token"),
+    "fleet.fenced": ("owner", "token"),
+    "fleet.standby_read": ("owner",),
+    "fleet.torn_result": ("owner", "file"),
+    "client.endpoint_circuit_open": ("endpoint",),
+    "client.endpoint_recovered": ("endpoint",),
+    "client.primary_learned": ("endpoint",),
+    "client.hedge": ("req_id",),
+    "transport.auth_failed": ("conn",),
+    "server.storage_refusal": ("req_id",),
+    "server.torn_result": ("path",),
+}
+
+FLEET_STATE_CODES = (0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0)
+
+
+def validate_degradation(events) -> list:
+    """Validate the degradation-ladder telemetry (ISSUE 17): the stream's
+    final metrics snapshot must publish the ``fleet.state`` gauge with a
+    value from the ladder's code table (full=0 … stopped=6), and every
+    degradation event present must carry its pinned fields — the chaos
+    soak and its ``advise_budget`` post-mortem read exactly these."""
+    errors = []
+    last_metrics = None
+    for i, ev in events:
+        if ev.get("kind") == "metrics":
+            last_metrics = (i, ev)
+        if ev.get("kind") != "event":
+            continue
+        need = DEGRADATION_EVENT_FIELDS.get(ev.get("name"))
+        if not need:
+            continue
+        attrs = ev.get("attrs") or {}
+        for f in need:
+            if attrs.get(f) in (None, ""):
+                errors.append(f"line {i}: degradation event "
+                              f"{ev['name']} missing field {f!r}")
+    if last_metrics is None:
+        errors.append("degradation check: no metrics snapshot in stream")
+        return errors
+    i, m = last_metrics
+    gauges = m.get("gauges") or {}
+    state = gauges.get("fleet.state")
+    if state is None:
+        errors.append(f"line {i}: final metrics snapshot has no "
+                      "fleet.state gauge (the degradation ladder is "
+                      "not being published)")
+    elif float(state) not in FLEET_STATE_CODES:
+        errors.append(f"line {i}: fleet.state gauge {state!r} is not a "
+                      f"ladder code {FLEET_STATE_CODES}")
+    return errors
+
+
 def validate_manifest_telemetry(ckpt_dir: str) -> list:
     """Validate the journal manifest's embedded ``telemetry`` block.
 
@@ -921,6 +979,11 @@ def main():
                          "plus name/label agreement with the event "
                          "stream's final metrics snapshot, so a renamed "
                          "counter cannot silently vanish from dashboards")
+    ap.add_argument("--degradation", action="store_true",
+                    help="with --check: validate the degradation-ladder "
+                         "telemetry (ISSUE 17) — the fleet.state gauge "
+                         "in the final metrics snapshot and the pinned "
+                         "fields of step-down/circuit/hedge/auth events")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable summary instead of the report")
     args = ap.parse_args()
@@ -932,6 +995,8 @@ def main():
             errors += validate_manifest_telemetry(args.manifest)
         if args.prom:
             errors += validate_prom_sink(args.prom, events)
+        if args.degradation:
+            errors += validate_degradation(events)
         if errors:
             for e in errors:
                 print(f"obs_report: FAIL {e}", file=sys.stderr)
@@ -940,6 +1005,8 @@ def main():
         extra = f" + manifest {args.manifest}" if args.manifest else ""
         if args.prom:
             extra += f" + prom textfile {args.prom}"
+        if args.degradation:
+            extra += " + degradation-ladder telemetry"
         print(f"obs_report: OK — {n} events valid{extra}")
         return
     if errors:
