@@ -2071,6 +2071,7 @@ def _fleet_serving_northstar(jnp, quick, on_tpu):
     import tempfile
     import threading
 
+    from spark_timeseries_tpu import obs as _obs
     from spark_timeseries_tpu import serving
     from spark_timeseries_tpu.reliability import faultinject as fi
     from spark_timeseries_tpu.reliability.journal import read_lease
@@ -2134,9 +2135,30 @@ def _fleet_serving_northstar(jnp, quick, on_tpu):
                             deadline_s=1800.0)
             wall_b, lat_b, errs_b = _storm(cli, panels, "req")
             cli.close()
+            # obs_overhead leg (ISSUE 18): the same storm with the
+            # telemetry plane ON — recorder stream + trace stamping on
+            # every event, client and in-process replicas alike.  Fresh
+            # request ids so the idempotent cache does not short-circuit
+            # the work; the traced/untraced throughput ratio is the
+            # price of fleet-wide tracing, floor-gated so it can never
+            # silently eat half the throughput.
+            obs_was_on = _obs.enabled()
+            if not obs_was_on:
+                _obs.enable(os.path.join(root, "obs_client.jsonl"))
+            try:
+                cli_t = FitClient(discover_endpoints(root), seed=5,
+                                  deadline_s=1800.0)
+                wall_t, lat_t, errs_t = _storm(cli_t, panels, "treq")
+                cli_t.close()
+            finally:
+                if not obs_was_on:
+                    _obs.disable()
     lats = sorted(v for v in lat_b if v is not None)
     storm_ok = not any(errs_b) and len(lats) == n_reqs
     p50 = float(np.percentile(lats, 50)) if lats else None
+    traced_ok = not any(errs_t) and all(v is not None for v in lat_t)
+    obs_ratio = (round(wall_b / wall_t, 3)
+                 if traced_ok and wall_b > 0 and wall_t > 0 else None)
 
     # 2. failover-recovery latency: primary crashes mid-batch after its
     #    first durable commit; the standby takes over and re-answers
@@ -2188,6 +2210,10 @@ def _fleet_serving_northstar(jnp, quick, on_tpu):
                                         if p50 is not None else None),
         "failover_bitwise_identical": bitwise,
         "failover_elections": elections,
+        # traced-storm throughput over untraced (ISSUE 18): < 1 means
+        # tracing costs; the regression gate floors it at 0.5
+        "obs_overhead_ratio": obs_ratio,
+        "obs_overhead_wall_s": round(wall_t, 3),
         "fleet_gate_ok": gate_ok,
         "data": "2 FleetReplica on one lease-fenced root; socket storm "
                 f"of {n_reqs} tenant requests x {rows} rows through "
@@ -2834,6 +2860,9 @@ def _telemetry_regression_gate(headline):
             "fleet_rows_per_sec": fl.get("rows_per_sec"),
             "fleet_failover_wall_s": fl.get("failover_request_wall_s"),
             "fleet_gate_ok": 1.0 if fl.get("fleet_gate_ok") else 0.0,
+            # ISSUE 18: the traced/untraced storm-throughput ratio — the
+            # price of fleet-wide tracing, drift- and floor-gated
+            "fleet_obs_overhead_ratio": fl.get("obs_overhead_ratio"),
         }
     # chaos gate inputs (ISSUE 17): the availability contract — probe ok
     # rate through a primary kill, degraded-read throughput off a
@@ -2941,6 +2970,7 @@ def _telemetry_regression_gate(headline):
         "serving_batch_amplification": ("rel", 0.4, "higher"),
         "chaos_probe_ok_rate": ("abs", 0.1, "higher"),
         "chaos_degraded_reads_per_sec": ("rel", 0.5, "higher"),
+        "fleet_obs_overhead_ratio": ("abs", 0.3, "higher"),
         "forecast_rows_per_sec": ("rel", 0.5, "higher"),
         "delta_speedup": ("rel", 0.4, "higher"),
         "delta_warm_speedup": ("rel", 0.5, "higher"),
@@ -3004,6 +3034,17 @@ def _telemetry_regression_gate(headline):
             "tolerance": 0.0, "mode": "abs", "direction": "higher",
             "flagged": True}
         flagged.append("fleet_failover_floor")
+    # ABSOLUTE floor (ISSUE 18): observability must stay cheap — a
+    # traced storm running at less than half the untraced throughput
+    # means the trace/recorder path regressed into the hot loop,
+    # regardless of the previous run
+    oor = inputs.get("fleet_obs_overhead_ratio")
+    if oor is not None and oor < 0.5:
+        drifts["fleet_obs_overhead_floor"] = {
+            "prev": 0.5, "cur": oor, "drift": round(0.5 - oor, 4),
+            "tolerance": 0.0, "mode": "abs", "direction": "higher",
+            "flagged": True}
+        flagged.append("fleet_obs_overhead_floor")
     # ABSOLUTE floor (ISSUE 17): degradation is the contract — standby
     # reads must hold availability through a primary kill, the standby
     # must serve durable bytes bitwise and refuse writes; a fleet that

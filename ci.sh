@@ -226,9 +226,14 @@ python tests/_fleet_worker.py --smoke
 # secret is refused terminally.  The survivor's obs stream must pass the
 # degradation-ladder telemetry gate, and the durable chaos manifest must
 # give the budget advisor enough to suggest the next soak's client knobs.
+# With tracing on (ISSUE 18), obs_report --fleet must merge the copied
+# per-process streams + clock sidecars + chaos manifest and reconstruct
+# fit-1 — a request whose primary was SIGKILLed mid-commit — into one
+# cross-process causal timeline with exactly one completed terminal.
 CHAOS_SMOKE_DIR=$(mktemp -d -t chaos_smoke_XXXXXX)
 python tests/_chaos_worker.py --smoke --out "$CHAOS_SMOKE_DIR"
 python tools/obs_report.py --check --degradation "$CHAOS_SMOKE_DIR/obs_b.jsonl"
+python tools/obs_report.py --fleet "$CHAOS_SMOKE_DIR" --check --trace fit-1
 python tools/advise_budget.py "$CHAOS_SMOKE_DIR" \
   | grep -q "suggest for the next soak" \
   || { echo "ci.sh: advise_budget did not read the chaos manifest" >&2; exit 1; }

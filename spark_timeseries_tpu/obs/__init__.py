@@ -25,6 +25,13 @@ and leaves fit results bitwise-identical to the uninstrumented code:
   dumps the tail for post-mortems.
 - :mod:`.memory` — peak-memory probe: device ``memory_stats()`` with a
   host peak-RSS fallback, so the reading is never null on CPU.
+- :mod:`.tracing` — fleet-wide distributed tracing (ISSUE 18): trace
+  contexts derived DETERMINISTICALLY from content-derived request ids
+  (never uuid4), carried on a thread-local, ridden across the wire in
+  the serving header, and stamped onto every recorder line as a
+  top-level ``trace`` object (schema v2) so
+  ``tools/obs_report.py --fleet/--trace`` reassembles one causal
+  timeline per request across replicas, retries, and failovers.
 - :mod:`.promsink` — streaming Prometheus-textfile sink (ISSUE 12): the
   registry snapshot (+ caller gauges) rendered to the node-exporter
   textfile-collector format with atomic replace, so a RESIDENT serving
@@ -61,15 +68,18 @@ inside each lane's timeline row (with a degraded-run total in the
 header).
 """
 
-from . import core, memory, metrics, promsink, recorder
+from . import core, memory, metrics, promsink, recorder, tracing
 from .core import (NULL_SPAN, Span, counter, disable, dump_failure,
                    dump_on_failure, emit_metrics, enable, enable_from_env,
                    enabled, event, first_dispatch, gauge, histogram,
-                   last_crash_dump, snapshot, span, summary)
+                   last_crash_dump, snapshot, span, stream_path, summary)
 from .memory import PeakMemory, peak_memory, register_staging_pool
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .promsink import PromTextfileSink
 from .recorder import SCHEMA_VERSION, FlightRecorder
+from .tracing import (TraceContext, trace_for_request, trace_from_wire,
+                      trace_scope, trace_to_wire)
+from .tracing import current as current_trace
 
 __all__ = [
     "Counter",
@@ -82,8 +92,10 @@ __all__ = [
     "PromTextfileSink",
     "SCHEMA_VERSION",
     "Span",
+    "TraceContext",
     "core",
     "counter",
+    "current_trace",
     "disable",
     "dump_failure",
     "dump_on_failure",
@@ -104,7 +116,13 @@ __all__ = [
     "register_staging_pool",
     "snapshot",
     "span",
+    "stream_path",
     "summary",
+    "trace_for_request",
+    "trace_from_wire",
+    "trace_scope",
+    "trace_to_wire",
+    "tracing",
 ]
 
 # bench / CI opt-in without code changes (no-op unless STSTPU_OBS=1)

@@ -34,6 +34,7 @@ import time
 import uuid
 from typing import Optional
 
+from . import tracing
 from .memory import peak_memory
 from .metrics import NULL_METRIC, MetricsRegistry
 from .recorder import SCHEMA_VERSION, FlightRecorder
@@ -55,6 +56,7 @@ __all__ = [
     "last_crash_dump",
     "snapshot",
     "span",
+    "stream_path",
     "summary",
 ]
 
@@ -115,6 +117,7 @@ def enable(jsonl_path: Optional[str] = None, *, ring_size: int = 4096,
         _STATE.last_crash = None
         _STATE.last_dumped_error = None
         _STATE.enabled = True
+        tracing.set_plane(True)
         return _STATE.run_id
 
 
@@ -127,6 +130,7 @@ def disable() -> None:
             return
         rec = _STATE.recorder
         _STATE.enabled = False  # stop new events before the final snapshot
+        tracing.set_plane(False)
         if rec is not None:
             rec.emit({"kind": "metrics", **_STATE.metrics.snapshot()})
             rec.close()
@@ -153,6 +157,7 @@ def enable_from_env() -> None:
         import warnings
 
         _STATE.enabled = False
+        tracing.set_plane(False)
         warnings.warn(f"STSTPU_OBS=1 but enabling telemetry failed "
                       f"({type(e).__name__}: {e}); continuing with the "
                       "plane disabled", stacklevel=2)
@@ -184,6 +189,9 @@ def event(name: str, **attrs) -> None:
         ev = {"kind": "event", "name": name}
         if attrs:
             ev["attrs"] = attrs
+        ctx = tracing.current()
+        if ctx is not None:
+            ev["trace"] = ctx.to_dict()
         rec.emit(ev)
 
 
@@ -199,6 +207,17 @@ def emit_metrics() -> None:
     rec = st.recorder
     if st.enabled and rec is not None:
         rec.emit({"kind": "metrics", **st.metrics.snapshot()})
+
+
+def stream_path() -> Optional[str]:
+    """The enabled run's JSONL stream path (None when disabled or when
+    the recorder is ring-only) — sidecar artifacts (the client's clock
+    journal) land NEXT TO the stream, and this is how they find it."""
+    st = _STATE
+    rec = st.recorder  # local capture vs a concurrent disable()
+    if not st.enabled or rec is None:
+        return None
+    return rec.jsonl_path
 
 
 def first_dispatch(key) -> bool:
@@ -282,6 +301,9 @@ class Span:
                 ev["attrs"] = self.attrs
             if exc_type is not None:
                 ev["error"] = exc_type.__name__
+            ctx = tracing.current()
+            if ctx is not None:
+                ev["trace"] = ctx.to_dict()
             rec.emit(ev)
             st.metrics.histogram(f"span.{self.name}").observe(self.wall_s)
         return False

@@ -12,7 +12,7 @@ rebuild's analog is two-layered:
   path, every event is also appended (and flushed — a SIGKILL loses at most
   the current line) to a file ``tools/obs_report.py`` renders.
 
-One event = one flat JSON object.  Schema (``SCHEMA_VERSION``):
+One event = one flat JSON object.  Schema (``SCHEMA_VERSION``, v2):
 
 - every line: ``ts`` (epoch seconds) and ``kind`` in
   ``meta | span | event | metrics``;
@@ -24,6 +24,23 @@ One event = one flat JSON object.  Schema (``SCHEMA_VERSION``):
 - ``metrics``: a full registry snapshot (``counters`` / ``gauges`` /
   ``histograms``), emitted at the end of an instrumented fit and on
   disable/dump.
+
+Schema v2 (ISSUE 18) adds an OPTIONAL top-level ``trace`` object on
+``span`` and ``event`` lines, stamped by :mod:`.core` whenever a
+:mod:`.tracing` context is active on the emitting thread:
+
+- ``trace.trace_id``: 16 lowercase hex chars —
+  ``sha256("ststpu-trace:" + request_id)[:16]``, identical in every
+  process that handles the request (derivation, not propagation);
+- ``trace.span_id``: 16 lowercase hex chars —
+  ``sha256(trace_id + ":" + site)[:16]`` for the causal segment
+  ("client", "server", "server.batch", ...) the line belongs to;
+- ``trace.parent_id`` (optional): the caller segment's ``span_id``
+  (the wire header carried it across the hop).
+
+v1 streams (no ``trace`` anywhere) remain readable by every consumer;
+``tools/obs_report.py --check`` accepts an absent ``trace`` and FAILS a
+malformed one (wrong type, bad id shape) instead of letting it vanish.
 """
 
 from __future__ import annotations
@@ -37,7 +54,7 @@ from typing import Optional
 
 __all__ = ["SCHEMA_VERSION", "FlightRecorder"]
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 
 class FlightRecorder:

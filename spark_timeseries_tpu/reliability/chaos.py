@@ -26,6 +26,10 @@ must survive them:
   (schedule, probe timeline, invariant verdicts, counters) written
   atomically at the fleet root; ``tools/advise_budget.py`` turns it
   into circuit-breaker and hedge advice for the next run.
+- :func:`join_injections` — the manifest's injections joined to their
+  observed consequences in the merged fleet event timeline (injection
+  -> victim's last heartbeat -> survivor's election -> takeover
+  latency); ``tools/obs_report.py --fleet`` renders the result.
 
 The orchestration of real subprocess replicas lives in
 ``tests/_chaos_worker.py`` (the ci smoke); this module is the library
@@ -52,6 +56,7 @@ __all__ = [
     "InvariantViolation",
     "chaos_schedule",
     "check_invariants",
+    "join_injections",
     "load_chaos_manifest",
     "unavailability_windows",
     "write_chaos_manifest",
@@ -311,6 +316,71 @@ def check_invariants(*, expected_ids: Optional[Sequence[str]] = None,
                     "availability", f"fleet unavailable for "
                     f"{end - start:.2f}s (bound "
                     f"{float(max_unavailable_s):.2f}s) from t={start:.2f}"))
+    return out
+
+
+def join_injections(fired: Sequence[dict],
+                    events: Sequence[dict]) -> List[dict]:
+    """Join the manifest's ``kill`` injections to their observed fleet
+    consequences, from recorder evidence alone (ISSUE 18).
+
+    ``fired`` is the chaos manifest's fired-injection list (each record
+    carries at least ``kind``; kills are the ones joined).  ``events``
+    is the merged fleet event timeline: recorder event lines as dicts,
+    each carrying its recorder ``ts`` and tagged by the caller with the
+    ``stream`` it came from (the replica owner, or ``"client"``).
+
+    Injection offsets (monotonic, scenario-relative) and recorder
+    timestamps (wall clock) share no common zero, so the join is
+    ORDINAL: the N-th kill pairs with the N-th ownership CHANGE — a
+    ``fleet.elected`` naming a different owner than the previous
+    holder (the fleet's initial election is not a consequence).  Each
+    consequence record names the victim and survivor, the victim
+    stream's last event before the takeover, and the takeover latency
+    (survivor's election ts minus the victim's last ts — a wall-clock
+    delta across same-host replica processes, see the clock-offset
+    caveats in ``tools/obs_report.py``).  A kill with no matching
+    election reports ``observed=False`` (e.g. the handler declined to
+    fire because the fleet was already down to one replica).
+
+    Pure function: no clocks, no I/O — callers feed it loaded streams.
+    """
+    def _attr(e: dict, key: str):
+        # recorder event lines nest attributes under "attrs"; accept
+        # pre-flattened dicts too so callers need not reshape
+        return e[key] if key in e else (e.get("attrs") or {}).get(key)
+
+    kills = [r for r in fired if r.get("kind") == "kill"]
+    elected = sorted(
+        (e for e in events
+         if e.get("name") == "fleet.elected" and e.get("ts") is not None),
+        key=lambda e: float(e["ts"]))
+    changes: List[Tuple[str, dict]] = []
+    holder: Optional[str] = None
+    for e in elected:
+        who = _attr(e, "owner")
+        if holder is not None and who != holder:
+            changes.append((holder, e))
+        holder = who
+    out: List[dict] = []
+    for i, kill in enumerate(kills):
+        rec: dict = {"injection": dict(kill), "observed": i < len(changes)}
+        if i < len(changes):
+            victim, e = changes[i]
+            t_elect = float(e["ts"])
+            last = max((float(v["ts"]) for v in events
+                        if v.get("stream") == victim
+                        and v.get("ts") is not None
+                        and float(v["ts"]) <= t_elect), default=None)
+            rec.update({
+                "victim": victim,
+                "survivor": _attr(e, "owner"),
+                "elected_token": _attr(e, "token"),
+                "victim_last_ts": last,
+                "takeover_latency_s": (None if last is None
+                                       else round(t_elect - last, 3)),
+            })
+        out.append(rec)
     return out
 
 

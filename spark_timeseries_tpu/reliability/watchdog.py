@@ -152,6 +152,7 @@ def call_with_deadline(fn: Callable, budget_s: Optional[float] = None,
     if lane is None:
         lane = current_lane()
     req = current_request()  # serving request tag survives the hop too
+    tctx = obs.current_trace()  # and so does the trace context (ISSUE 18)
     if budget_s is None:
         with lane_context(lane):
             return fn()
@@ -160,7 +161,8 @@ def call_with_deadline(fn: Callable, budget_s: Optional[float] = None,
 
     def worker():
         try:
-            with lane_context(lane), request_context(req):
+            with lane_context(lane), request_context(req), \
+                    obs.trace_scope(tctx):
                 box["result"] = fn()
         except BaseException as e:  # noqa: BLE001 - re-raised in the caller
             box["error"] = e

@@ -161,6 +161,7 @@ class FitClient:
         "_decoder": "_io_lock",
         "_cur_ep": "_io_lock",
         "_msg_seq": "_io_lock",
+        "_clock": "_io_lock",
     }
 
     def __init__(self, endpoints: Sequence[Union[str, Tuple[str, int]]], *,
@@ -209,12 +210,42 @@ class FitClient:
         self._decoder = transport.FrameDecoder()
         self._cur_ep: Optional[Tuple[str, int]] = None
         self._msg_seq = 0
+        # per-endpoint monotonic-clock offset estimates (ISSUE 18): when
+        # tracing is on, each reply carries the replica's time.monotonic
+        # and the midpoint estimate with the SMALLEST observed rtt wins —
+        # journaled next to the obs stream at close() so merged fleet
+        # timelines are orderable without trusting wall clocks
+        self._clock: dict = {}
 
     # -- lifecycle -----------------------------------------------------------
 
     def close(self) -> None:
+        self._write_clock_journal()
         with self._io_lock:
             self._close_locked()
+
+    def _write_clock_journal(self) -> None:
+        """Journal the per-endpoint clock-offset estimates as a sidecar
+        JSON next to the obs JSONL stream (``<stream>.clock.json``) —
+        the artifact ``obs_report --fleet`` reads to order cross-process
+        timelines.  Structurally a no-op unless the obs plane is on
+        with a stream AND at least one estimate exists, so a disabled
+        run writes nothing (bitwise-inert contract)."""
+        path = obs.stream_path()
+        with self._io_lock:
+            clock = dict(self._clock)
+        if path is None or not clock:
+            return
+        record = {
+            "kind": "clock_offsets",
+            "endpoints": {f"{h}:{p}": est for (h, p), est in
+                          sorted(clock.items())},
+        }
+        try:
+            with open(path + ".clock.json", "w", encoding="utf-8") as f:
+                f.write(json.dumps(record, indent=1, sort_keys=True))
+        except OSError:
+            pass  # telemetry sidecar: never let it break close()
 
     def __enter__(self) -> "FitClient":
         return self
@@ -261,6 +292,8 @@ class FitClient:
     def _rotate_locked(self) -> None:
         # the health cache decides where the NEXT connect lands; the
         # failure/redirect records made this endpoint sort later
+        if self._cur_ep is not None:
+            obs.event("client.rotate", endpoint=list(self._cur_ep))
         self._close_locked()
 
     # -- one round trip ------------------------------------------------------
@@ -279,10 +312,13 @@ class FitClient:
             ep = self._cur_ep
             self._msg_seq += 1
             msg_id = f"m{self._msg_seq}"
+            hdr = {**header, "msg_id": msg_id}
+            tctx = obs.current_trace()
+            if tctx is not None:  # trace rides the wire (ISSUE 18)
+                hdr["trace"] = obs.trace_to_wire(tctx)
             t0 = time.monotonic()
             try:
-                transport.send_msg(self._sock, {**header, "msg_id": msg_id},
-                                   blob, self._secret)
+                transport.send_msg(self._sock, hdr, blob, self._secret)
                 while True:
                     msg = transport.recv_msg(self._sock, self._decoder,
                                              secret=self._secret)
@@ -304,10 +340,11 @@ class FitClient:
                         elif err == "not_leader":
                             self.endpoint_health.record_redirect(ep)
                         else:
-                            self.endpoint_health.record_success(
-                                ep, time.monotonic() - t0)
+                            t1 = time.monotonic()
+                            self.endpoint_health.record_success(ep, t1 - t0)
                             if write and err is None:
                                 self.endpoint_health.set_primary(ep)
+                            self._update_clock_locked(ep, reply, t0, t1)
                         return reply, rblob
             except transport.WireAuthError:
                 self._close_locked()
@@ -316,6 +353,26 @@ class FitClient:
                 self.endpoint_health.record_failure(ep)
                 self._rotate_locked()
                 raise _ConnDropped(f"call failed mid-flight: {e}") from None
+
+    def _update_clock_locked(self, ep, reply: dict, t0: float,
+                             t1: float) -> None:
+        """Fold a reply's replica-monotonic timestamp into this
+        endpoint's clock-offset estimate (caller holds ``_io_lock``).
+        NTP-style midpoint: ``offset = ts_mono - (t0 + t1) / 2``; the
+        estimate with the smallest round trip wins (least midpoint
+        slack).  Replies without ``ts_mono`` — tracing off, old servers
+        — leave the table untouched."""
+        ts_mono = reply.get("ts_mono")
+        if not isinstance(ts_mono, (int, float)) or ep is None:
+            return
+        rtt = t1 - t0
+        offset = float(ts_mono) - (t0 + t1) / 2.0
+        prev = self._clock.get(ep)
+        if prev is None or rtt < prev["rtt_s"]:
+            self._clock[ep] = {"offset_s": round(offset, 6),
+                               "rtt_s": round(rtt, 6)}
+            obs.event("client.clock_offset", endpoint=list(ep),
+                      offset_s=round(offset, 6), rtt_s=round(rtt, 6))
 
     def _call(self, header: dict, blob: bytes = b"", *,
               what: str, deadline_s: Optional[float] = None,
@@ -411,6 +468,7 @@ class FitClient:
                 raise ClientDeadlineError(what, budget)
             delay = min(delay, remaining)
         if delay > 0:
+            obs.event("client.backoff", what=what, delay_s=round(delay, 6))
             time.sleep(delay)
 
     # -- public API ----------------------------------------------------------
@@ -444,8 +502,11 @@ class FitClient:
         }
         blob = transport.encode_request_blob(np.asarray(values), meta)
         header = {"op": "submit"}
-        reply, _ = self._call(header, blob, what=f"submit({req_id})",
-                              deadline_s=call_deadline_s, write=True)
+        with obs.trace_scope(obs.trace_for_request(req_id, "client")):
+            obs.event("client.submit", req_id=req_id, tenant=str(tenant),
+                      op="submit")
+            reply, _ = self._call(header, blob, what=f"submit({req_id})",
+                                  deadline_s=call_deadline_s, write=True)
         got = reply.get("req_id")
         if got != req_id:
             raise transport.TransportError(
@@ -505,9 +566,12 @@ class FitClient:
         # standby included) answers them bitwise-identically — this is
         # the read load the standbys exist to carry
         header = {"op": "submit_forecast"}
-        reply, _ = self._call(header, blob,
-                              what=f"submit_forecast({req_id})",
-                              deadline_s=call_deadline_s)
+        with obs.trace_scope(obs.trace_for_request(req_id, "client")):
+            obs.event("client.submit", req_id=req_id, tenant=str(tenant),
+                      op="submit_forecast")
+            reply, _ = self._call(header, blob,
+                                  what=f"submit_forecast({req_id})",
+                                  deadline_s=call_deadline_s)
         got = reply.get("req_id")
         if got != req_id:
             raise transport.TransportError(
@@ -531,19 +595,31 @@ class FitClient:
         ``unknown_request`` reply means the admitting replica died
         before its write-ahead record landed — resubmit the identical
         bytes (idempotent) and report pending."""
-        try:
-            reply, rblob = self._call({"op": "result", "req_id": req_id},
-                                      what=f"result({req_id})")
-        except KeyError:
-            if resubmit is None:
-                raise
-            header, blob = resubmit
-            self._call(header, blob, what=f"resubmit({req_id})")
-            obs.counter("client.resubmitted").inc()
+        with obs.trace_scope(obs.trace_for_request(req_id, "client")):
+            try:
+                reply, rblob = self._call({"op": "result", "req_id": req_id},
+                                          what=f"result({req_id})")
+            except KeyError:
+                if resubmit is None:
+                    raise
+                header, blob = resubmit
+                obs.event("client.resubmit", req_id=req_id)
+                self._call(header, blob, what=f"resubmit({req_id})")
+                obs.counter("client.resubmitted").inc()
+                return None
+            if reply.get("done"):
+                res = transport.decode_result_blob(rblob)
+                if resubmit is not None:
+                    # THE terminal of the request's causal timeline: a
+                    # submitted ticket observed the durable answer
+                    # (obs_report --trace gates on exactly one of these
+                    # per stormed request).  result_for() re-reads pass
+                    # resubmit=None and stay terminal-silent — a probe
+                    # loop re-polling a done id is a READ, not the
+                    # request completing again.
+                    obs.event("client.result", req_id=req_id)
+                return res
             return None
-        if reply.get("done"):
-            return transport.decode_result_blob(rblob)
-        return None
 
     def _poll_result(self, req_id: str,
                      resubmit: Optional[Tuple[dict, bytes]],
@@ -551,25 +627,29 @@ class FitClient:
         budget = self.deadline_s if timeout is None else float(timeout)
         t0 = time.monotonic()
         hedging = False
-        while True:
-            res = self._poll_once(req_id, resubmit)
-            if res is not None:
-                return res
-            if (self.hedge_after_s is not None
-                    and len(self.endpoints) > 1
-                    and time.monotonic() - t0 >= self.hedge_after_s):
-                if not hedging:
-                    hedging = True
-                    obs.counter("client.hedge_launched").inc()
-                    obs.event("client.hedge", req_id=req_id)
-                res = self._hedge_poll_once(req_id)
+        with obs.trace_scope(obs.trace_for_request(req_id, "client")):
+            while True:
+                res = self._poll_once(req_id, resubmit)
                 if res is not None:
-                    obs.counter("client.hedge_won").inc()
                     return res
-            if budget is not None and \
-                    time.monotonic() - t0 + self.poll_interval_s > budget:
-                raise ClientDeadlineError(f"result({req_id})", budget)
-            time.sleep(self.poll_interval_s)
+                if (self.hedge_after_s is not None
+                        and len(self.endpoints) > 1
+                        and time.monotonic() - t0 >= self.hedge_after_s):
+                    if not hedging:
+                        hedging = True
+                        obs.counter("client.hedge_launched").inc()
+                        obs.event("client.hedge", req_id=req_id)
+                    res = self._hedge_poll_once(req_id)
+                    if res is not None:
+                        obs.counter("client.hedge_won").inc()
+                        if resubmit is not None:  # same terminal contract
+                            obs.event("client.result", req_id=req_id,
+                                      hedged=True)
+                        return res
+                if budget is not None and \
+                        time.monotonic() - t0 + self.poll_interval_s > budget:
+                    raise ClientDeadlineError(f"result({req_id})", budget)
+                time.sleep(self.poll_interval_s)
 
     def _hedge_poll_once(self, req_id: str) -> Optional[TenantFitResult]:
         """One hedged result poll against the best endpoint OTHER than

@@ -161,10 +161,13 @@ class EndpointHealthCache:
             ranked = sorted(enumerate(self.endpoints), key=key)
             first = ranked[0][1]
             rec = self._records[first]
-            if rec.open_until is not None and t >= rec.open_until:
+            probing = (rec.open_until is not None and t >= rec.open_until)
+            if probing:
                 rec.probing = True
                 obs.counter("client.endpoint_health.probes").inc()
-            return [ep for _, ep in ranked]
+        if probing:
+            obs.event("client.endpoint_half_open", endpoint=list(first))
+        return [ep for _, ep in ranked]
 
     def believed_primary(self) -> Optional[Endpoint]:
         with self._lock:
@@ -201,6 +204,7 @@ class EndpointHealthCache:
             rec = self._records.get(ep)
             if rec is None:
                 return
+            was_probing = rec.probing
             rec.failures += 1
             rec.consec_failures += 1
             rec.probing = False
@@ -216,6 +220,9 @@ class EndpointHealthCache:
                 rec.consec_failures = 0
                 opened = True
         obs.counter("client.endpoint_health.failures").inc()
+        if was_probing:
+            # a half-open probe that failed: the cooldown re-arms below
+            obs.event("client.endpoint_probe_failed", endpoint=list(ep))
         if opened:
             obs.counter("client.endpoint_health.opened").inc()
             obs.event("client.endpoint_circuit_open", endpoint=list(ep))
@@ -235,6 +242,7 @@ class EndpointHealthCache:
             if self._primary == ep:
                 self._primary = None
         obs.counter("client.endpoint_health.redirects").inc()
+        obs.event("client.endpoint_redirected", endpoint=list(ep))
 
     def set_primary(self, ep: Endpoint) -> None:
         with self._lock:

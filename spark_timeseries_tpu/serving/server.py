@@ -396,6 +396,13 @@ class FitServer:
         with self._counters_lock:
             self.counters["admitted"] += 1
         obs.counter("server.admitted").inc()
+        # the server-side hop of the request's causal timeline: a
+        # transport dispatch establishes the trace scope, so a traced
+        # admission is stamped with the fleet-wide trace id (a resubmit
+        # after failover emits this again on the survivor — expected:
+        # the timeline shows BOTH admissions, one terminal)
+        obs.event("server.admit", req_id=req.req_id, tenant=str(tenant),
+                  seq=seq)
         return req.ticket
 
     def submit_forecast(self, tenant: str, values, fitted, *,
@@ -704,7 +711,20 @@ class FitServer:
         # "repairing" them would corrupt the forecast inputs (the walk's
         # own status propagation is the forecast-side resilience)
         resilient = head.resilient and head.model != FORECAST_MODEL
-        with watchdog_mod.request_context(batch.tenants):
+        # the batch walk gets its OWN trace keyed on the content-derived
+        # batch_id (recovery re-forms the identical batch on a survivor,
+        # so the post-failover walk CONTINUES the same batch trace); the
+        # join back to each member request's trace is the
+        # server.batch_member event below, stamped per-request with the
+        # batch_id attr — obs_report --trace follows that link
+        for req in batch.members:
+            with obs.trace_scope(
+                    obs.trace_for_request(req.req_id, "server")):
+                obs.event("server.batch_member", req_id=req.req_id,
+                          batch_id=batch.batch_id, tenant=req.tenant)
+        bctx = obs.trace_for_request(batch.batch_id, "server.batch")
+        with watchdog_mod.request_context(batch.tenants), \
+                obs.trace_scope(bctx):
             with obs.span("server.batch", batch_id=batch.batch_id,
                           members=len(batch.members), rows=batch.rows):
                 return fit_chunked(
@@ -780,6 +800,14 @@ class FitServer:
             if int((tres.status == FitStatus.TIMEOUT).sum()):
                 self.counters["timeout_requests"] += 1
         obs.counter("server.completed").inc()
+        # server-side completion marker on the request's own trace.  NOT
+        # the timeline's uniqueness terminal: a SIGKILL can land between
+        # the durable os.replace and this flush, and the survivor skips
+        # re-finalizing stored ids — the client's client.result event is
+        # the exactly-once terminal obs_report gates on
+        with obs.trace_scope(obs.trace_for_request(req.req_id, "server")):
+            obs.event("server.result_stored", req_id=req.req_id,
+                      tenant=req.tenant)
         req.ticket._resolve(tres)  # last: the caller may read health() now
 
     def _forget(self, req: FitRequest) -> None:

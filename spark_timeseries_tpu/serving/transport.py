@@ -44,6 +44,7 @@ import os
 import socket
 import struct
 import threading
+import time
 import zlib
 from typing import Callable, Dict, Optional, Tuple
 
@@ -453,6 +454,12 @@ class TransportServer:
                 reply_hdr, reply_blob = self._dispatch(header, blob)
                 if "msg_id" in header:
                     reply_hdr["msg_id"] = header["msg_id"]
+                if "trace" in header:
+                    # a tracing client gets this replica's monotonic
+                    # clock on every reply — the raw material for the
+                    # client's per-endpoint offset estimates (ISSUE 18);
+                    # non-tracing requests get byte-identical replies
+                    reply_hdr["ts_mono"] = time.monotonic()
                 try:
                     send_msg(conn, reply_hdr, reply_blob,
                              secret=self._secret)
@@ -469,6 +476,15 @@ class TransportServer:
     # -- dispatch ------------------------------------------------------------
 
     def _dispatch(self, header: dict, blob: bytes) -> Tuple[dict, bytes]:
+        # continue the wire-carried trace (ISSUE 18): every event/span
+        # the backend emits while handling this frame — admission, batch
+        # membership, result store — lands in THIS replica's stream
+        # stamped with the request's fleet-wide trace id
+        with obs.trace_scope(obs.trace_from_wire(header, site="server")):
+            return self._dispatch_traced(header, blob)
+
+    def _dispatch_traced(self, header: dict,
+                         blob: bytes) -> Tuple[dict, bytes]:
         op = header.get("op")
         try:
             if op == "ping":

@@ -241,7 +241,13 @@ def smoke(out_dir: str | None = None) -> None:
     panels = make_panels()
 
     with tempfile.TemporaryDirectory() as td:
-        obs.enable(os.path.join(td, "client_obs.jsonl"))
+        # the fleet root is created FIRST so every process's obs stream
+        # lands inside it under the obs_<name>.jsonl convention —
+        # `obs_report --fleet <root>` then merges the client's stream
+        # with the replicas' into one causal story (ISSUE 18)
+        root = os.path.join(td, "fleet")
+        os.makedirs(root)
+        obs.enable(os.path.join(root, "obs_client.jsonl"))
         # 0. uninterrupted reference: fits + forecasts on a fresh root
         ref_root = os.path.join(td, "ref")
         with serving.FitServer(ref_root, **SRV_KW) as ref:
@@ -258,8 +264,6 @@ def smoke(out_dir: str | None = None) -> None:
         # 1. the fleet: a (primary; the schedule will SIGKILL it) and b
         #    (standby armed with write-ahead EIO/ENOSPC faults — the
         #    storm continues across BOTH a failover and a degraded disk)
-        root = os.path.join(td, "fleet")
-        os.makedirs(root)
         procs: dict[str, subprocess.Popen] = {}
         procs["a"] = _spawn_replica(root, "a", retire_on_crash=True)
         _wait_lease_owner(root, "a")
@@ -462,12 +466,45 @@ def smoke(out_dir: str | None = None) -> None:
                      f"rc={procs['a2'].returncode}\n{a2_out}\n{a2_err}")
         if "lock discipline OK" not in b_out:
             sys.exit(f"replica b did not report lock coverage:\n{b_out}")
+
+        # 10. the fleet trace gate (ISSUE 18): the merged streams tell
+        #     ONE causal story per stormed request — the kill produced
+        #     a second ADMISSION on the survivor, never a second
+        #     terminal.  (fc-0 is deliberately resubmitted as a fresh
+        #     ticket on the standby ladder above, so only the fit ids
+        #     carry the exactly-once contract here.)
+        terminals: dict[str, int] = {}
+        with open(os.path.join(root, "obs_client.jsonl")) as f:
+            for line in f:
+                ev = json.loads(line)
+                if (ev.get("kind") == "event"
+                        and ev.get("name") == "client.result"):
+                    rid = (ev.get("attrs") or {}).get("req_id")
+                    terminals[rid] = terminals.get(rid, 0) + 1
+        for i in range(N_FITS):
+            n = terminals.get(f"fit-{i}", 0)
+            if n != 1:
+                sys.exit(f"request fit-{i}: {n} client.result terminals "
+                         "across the storm + failover (want exactly 1)")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        gate = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "obs_report.py"),
+             "--fleet", root, "--check", "--trace", "fit-1"],
+            capture_output=True, text=True)
+        if gate.returncode != 0:
+            sys.exit("fleet trace reconstruction gate failed:\n"
+                     f"{gate.stdout}\n{gate.stderr}")
+
         if out_dir is not None:
-            # the survivor's telemetry stream (elected -> step_down ->
-            # final fleet.state) outlives the tempdir for the ci
-            # degradation-ladder gate
-            shutil.copy(os.path.join(root, "obs_b.jsonl"),
-                        os.path.join(out_dir, "obs_b.jsonl"))
+            # every process's telemetry stream + the client's clock
+            # sidecar outlive the tempdir, so ci can re-run the fleet /
+            # trace / degradation gates on the persisted root
+            for fn in os.listdir(root):
+                if fn.startswith("obs_") and (
+                        fn.endswith(".jsonl")
+                        or fn.endswith(".clock.json")):
+                    shutil.copy(os.path.join(root, fn),
+                                os.path.join(out_dir, fn))
         longest = max((b - a for a, b in windows), default=0.0)
         print("chaos soak smoke: PASS "
               f"(seeded kill of the primary mid-storm, all {len(ids)} "

@@ -20,7 +20,13 @@ death with leased write-ahead requests in flight), and verifies
   replica and around the orchestrator's storm, observes ZERO violations
   of the declared ``_protected_by_`` maps on the takeover/recovery and
   client retry paths (satellite of ISSUE 16: recovery paths get runtime
-  lock coverage, not just lexical).
+  lock coverage, not just lexical);
+- with tracing on (ISSUE 18), every process streams to
+  ``obs_<name>.jsonl`` at the fleet root and every stormed request
+  reconstructs to exactly ONE ``client.result`` terminal across the
+  merged streams — the SIGKILL produced a second admission on the
+  survivor, never a second completion — gated in-smoke by
+  ``tools/obs_report.py --fleet <root> --check --trace req-1``.
 
 Modes:
     --replica --root R --owner X [--ttl S] [--kill-commits N]
@@ -79,6 +85,7 @@ SRV_KW = dict(cell_rows=CELL, batch_window_s=0.05, autotune=False)
 def replica(root: str, owner: str, ttl_s: float,
             kill_commits: int | None, retire_on_crash: bool,
             track_locks: bool) -> None:
+    from spark_timeseries_tpu import obs
     from spark_timeseries_tpu.reliability import faultinject as fi
     from spark_timeseries_tpu.serving.fleet import FleetReplica
 
@@ -87,6 +94,12 @@ def replica(root: str, owner: str, ttl_s: float,
         from tools.lint.runtime import LockDisciplineTracker
 
         tracker = LockDisciplineTracker().install()
+    # every replica streams its recorder to <root>/obs_<owner>.jsonl so
+    # obs_report --fleet can merge one causal timeline per request
+    # across the failover (ISSUE 18); the SIGKILLed run of "a" leaves a
+    # valid prefix (the recorder flushes per line), and the restarted
+    # "a" appends a second run to the same stream.
+    obs.enable(os.path.join(root, f"obs_{owner}.jsonl"))
     server_kwargs = dict(SRV_KW)
     if kill_commits is not None:
         server_kwargs["_commit_hook"] = fi.server_kill(kill_commits,
@@ -99,6 +112,7 @@ def replica(root: str, owner: str, ttl_s: float,
     while not os.path.exists(stop_file):
         time.sleep(0.05)
     rep.stop()
+    obs.disable()
     if tracker is not None:
         tracker.uninstall()
         if tracker.violations:
@@ -147,7 +161,7 @@ def _role_of(addr, timeout_s: float = 60.0) -> str:
 
 def smoke() -> None:
     from tools.lint.runtime import LockDisciplineTracker
-    from spark_timeseries_tpu import serving
+    from spark_timeseries_tpu import obs, serving
     from spark_timeseries_tpu.forecasting import run_backtest
     from spark_timeseries_tpu.reliability import faultinject as fi
     from spark_timeseries_tpu.reliability.journal import read_lease
@@ -161,6 +175,13 @@ def smoke() -> None:
                  chunk_rows=CELL, intervals=True, n_samples=32, seed=7)
 
     with tempfile.TemporaryDirectory() as td:
+        # fleet root first: every process in this smoke streams its
+        # recorder to <root>/obs_<name>.jsonl (ISSUE 18) — the
+        # orchestrator takes the "client" lane
+        root = os.path.join(td, "fleet")
+        os.makedirs(root)
+        obs.enable(os.path.join(root, "obs_client.jsonl"))
+
         # 0. uninterrupted references: a standalone server on a fresh
         #    root (per-request results) + a serverless local backtest
         ref_root = os.path.join(td, "ref")
@@ -174,8 +195,6 @@ def smoke() -> None:
 
         # 1. two replicas, one root; A (armed to die after 3 durable
         #    commits, mid-commit) must win the election before B starts
-        root = os.path.join(td, "fleet")
-        os.makedirs(root)
         a = _spawn_replica(root, "a", kill_commits=3, retire_on_crash=True)
         _wait_lease_owner(root, "a")
         b = _spawn_replica(root, "b", track_locks=True)
@@ -268,12 +287,45 @@ def smoke() -> None:
                      f"{a2_out}\n{a2_err}")
         if "lock discipline OK" not in b_out:
             sys.exit(f"replica b did not report lock coverage:\n{b_out}")
+
+        # 7. trace continuity (ISSUE 18): every stormed request resolved
+        #    to exactly ONE client.result terminal across the whole
+        #    fleet — the SIGKILL re-admitted work on the survivor but
+        #    never double-completed it — and obs_report reconstructs
+        #    req-1's cross-process causal timeline from the merged
+        #    per-process streams
+        obs.disable()
+        terminals: dict = {}
+        with open(os.path.join(root, "obs_client.jsonl")) as fh:
+            for line in fh:
+                ev = json.loads(line)
+                if ev.get("name") == "client.result":
+                    rid = (ev.get("attrs") or {}).get("req_id")
+                    terminals[rid] = terminals.get(rid, 0) + 1
+        for i in range(N_REQS):
+            n = terminals.get(f"req-{i}", 0)
+            if n != 1:
+                sys.exit(f"req-{i}: expected exactly 1 client.result "
+                         f"terminal across the fleet, saw {n}")
+        report = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "obs_report.py")
+        gate = subprocess.run(
+            [sys.executable, report, "--fleet", root, "--check",
+             "--trace", "req-1"],
+            capture_output=True, text=True, timeout=600)
+        if gate.returncode != 0:
+            sys.exit("obs_report fleet/trace gate failed:\n"
+                     f"{gate.stdout}\n{gate.stderr}")
+
         counters = json.dumps({"lease": read_lease(root)["token"]})
         print("fleet failover smoke: PASS "
               f"(primary SIGKILLed mid-commit after 3 commits, all "
               f"{N_REQS} storm requests + the 2-window backtest leg "
               "re-answered bitwise by the survivor, restarted "
-              f"zombie fenced to standby, {counters})")
+              f"zombie fenced to standby, every storm request traced to "
+              f"exactly one terminal across the merged streams, "
+              f"{counters})")
 
 
 def main():

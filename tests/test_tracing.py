@@ -1,0 +1,399 @@
+"""Fleet-wide distributed tracing tests (ISSUE 18, tier-1 CPU).
+
+Three contracts: (1) **determinism** — trace/span ids are pure functions
+of content-derived request ids (never random), so every process that
+knows a request id derives the SAME trace and a failover resumes the
+same segment id by construction; (2) **inertness** — with the obs plane
+off, every tracing helper returns None, no ``trace`` key reaches a wire
+header or a recorder line, no clock sidecar is written, and a fit is
+bitwise-identical with zero extra meta keys; (3) **reconstruction** —
+``tools/obs_report.py --fleet`` merges per-process streams into one
+causal timeline per request, gates exactly-once terminals, validates
+the schema-v2 trace stamp, computes fleet SLOs, and joins seeded chaos
+injections to their observed ownership changes.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu import obs
+from spark_timeseries_tpu import reliability as rel
+from spark_timeseries_tpu.models import arima
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPORT = os.path.join(_ROOT, "tools", "obs_report.py")
+
+
+@pytest.fixture(autouse=True)
+def _plane_off():
+    """Every test starts and ends with the plane disabled."""
+    obs.disable()
+    yield
+    obs.disable()
+
+
+def _ar_panel(b=8, t=96, seed=7, phi=0.6):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(b, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, t):
+        y[:, i] = phi * y[:, i - 1] + e[:, i]
+    return y
+
+
+def _fit(y):
+    return rel.fit_chunked(arima.fit, y, chunk_rows=4, order=(1, 0, 0),
+                           max_iters=15)
+
+
+def _report(*args):
+    return subprocess.run([sys.executable, _REPORT, *args],
+                          capture_output=True, text=True, timeout=300)
+
+
+# ---------------------------------------------------------------------------
+# derivation: deterministic, content-derived, failover-stable
+# ---------------------------------------------------------------------------
+
+
+class TestDerivation:
+    def test_ids_are_content_derived_not_random(self):
+        obs.enable()
+        tid = hashlib.sha256(b"ststpu-trace:fit-1").hexdigest()[:16]
+        sid = hashlib.sha256(f"{tid}:client".encode()).hexdigest()[:16]
+        ctx = obs.trace_for_request("fit-1")
+        assert (ctx.trace_id, ctx.span_id, ctx.parent_id) == (tid, sid, None)
+        # derive again: identical — there is no randomness anywhere
+        assert obs.trace_for_request("fit-1") == ctx
+
+    def test_wire_roundtrip_links_parent(self):
+        obs.enable()
+        client = obs.trace_for_request("r")
+        hdr = {"trace": obs.trace_to_wire(client)}
+        server = obs.trace_from_wire(hdr)
+        assert server.trace_id == client.trace_id
+        assert server.parent_id == client.span_id
+        assert server.span_id != client.span_id
+
+    def test_failover_resumes_the_same_segment_id(self):
+        # two replicas deriving the server segment for one wire-carried
+        # request share ONE span id: the re-dispatch IS the same causal
+        # segment, resumed elsewhere
+        obs.enable()
+        hdr = {"trace": obs.trace_to_wire(obs.trace_for_request("req-9"))}
+        assert obs.trace_from_wire(hdr) == obs.trace_from_wire(hdr)
+
+    def test_malformed_wire_trace_is_ignored(self):
+        obs.enable()
+        assert obs.trace_from_wire({}) is None
+        assert obs.trace_from_wire({"trace": "nope"}) is None
+        assert obs.trace_from_wire({"trace": {"span_id": "x"}}) is None
+
+
+# ---------------------------------------------------------------------------
+# inertness: plane off == structurally no trace anywhere
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledPinning:
+    def test_helpers_are_none_with_plane_off(self):
+        assert obs.trace_for_request("fit-1") is None
+        assert obs.trace_to_wire(None) is None
+        assert obs.trace_from_wire(
+            {"trace": {"trace_id": "a" * 16, "span_id": "b" * 16}}) is None
+        with obs.trace_scope(obs.trace_for_request("fit-1")):
+            assert obs.current_trace() is None
+
+    def test_disable_clears_any_open_context(self):
+        obs.enable()
+        ctx = obs.trace_for_request("fit-1")
+        with obs.trace_scope(ctx):
+            assert obs.current_trace() == ctx
+            obs.disable()
+            assert obs.current_trace() is None
+
+    def test_disabled_fit_is_bitwise_with_zero_extra_keys(self):
+        y = _ar_panel()
+        r_off = _fit(y)
+        obs.enable()
+        with obs.trace_scope(obs.trace_for_request("pin-1")):
+            r_on = _fit(y)
+        obs.disable()
+        r_off2 = _fit(y)
+        for f in ("params", "neg_log_likelihood", "converged", "iters",
+                  "status"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(r_off, f)), np.asarray(getattr(r_on, f)),
+                err_msg=f"field {f!r} differs with tracing on")
+            np.testing.assert_array_equal(
+                np.asarray(getattr(r_off, f)),
+                np.asarray(getattr(r_off2, f)),
+                err_msg=f"field {f!r} differs after an enabled run")
+        # tracing adds ZERO result-meta keys: the only enabled-run delta
+        # stays the pre-existing telemetry block (ISSUE 3)
+        assert set(r_on.meta) - set(r_off.meta) <= {"telemetry"}
+        assert "trace" not in r_off.meta and "trace" not in r_off2.meta
+
+
+# ---------------------------------------------------------------------------
+# scoping: thread-local, composes with the watchdog hop
+# ---------------------------------------------------------------------------
+
+
+class TestScopes:
+    def test_scope_is_thread_local_and_hops_explicitly(self):
+        obs.enable()
+        ctx = obs.trace_for_request("r2")
+        seen = {}
+        with obs.trace_scope(ctx):
+            assert obs.current_trace() == ctx
+
+            def worker(tctx=obs.current_trace()):
+                seen["bare"] = obs.current_trace()
+                with obs.trace_scope(tctx):
+                    seen["hopped"] = obs.current_trace()
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert obs.current_trace() is None
+        assert seen["bare"] is None  # a fresh thread has no context
+        assert seen["hopped"] == ctx  # the documented hop re-establishes
+
+    def test_watchdog_worker_inherits_the_callers_trace(self):
+        from spark_timeseries_tpu.reliability.watchdog import \
+            call_with_deadline
+
+        obs.enable()
+        ctx = obs.trace_for_request("r3")
+        with obs.trace_scope(ctx):
+            got = call_with_deadline(obs.current_trace, 30.0, label="t")
+        assert got == ctx
+
+    def test_scope_restores_the_previous_context(self):
+        obs.enable()
+        outer = obs.trace_for_request("outer")
+        inner = obs.trace_for_request("inner")
+        with obs.trace_scope(outer):
+            with obs.trace_scope(inner):
+                assert obs.current_trace() == inner
+            assert obs.current_trace() == outer
+
+
+# ---------------------------------------------------------------------------
+# stamping + schema v2 validation
+# ---------------------------------------------------------------------------
+
+
+class TestStamping:
+    def _stream(self, tmp_path):
+        p = str(tmp_path / "obs_client.jsonl")
+        obs.enable(p)
+        ctx = obs.trace_for_request("rid-1")
+        with obs.trace_scope(ctx):
+            obs.event("client.submit", req_id="rid-1")
+            with obs.span("client.poll"):
+                pass
+        obs.event("unscoped")
+        obs.disable()
+        with open(p) as fh:
+            return p, ctx, [json.loads(ln) for ln in fh]
+
+    def test_events_and_spans_carry_the_trace_stamp(self, tmp_path):
+        _, ctx, lines = self._stream(tmp_path)
+        by = {e.get("name"): e for e in lines if "name" in e}
+        want = {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
+        assert by["client.submit"]["trace"] == want
+        assert by["client.poll"]["trace"] == want
+        assert "trace" not in by["unscoped"]
+
+    def test_stamped_stream_passes_check_and_malformed_fails(self, tmp_path):
+        p, _, lines = self._stream(tmp_path)
+        ok = _report("--check", p)
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        # corrupt ONE stamp: --check must fail loudly, naming the trace
+        for e in lines:
+            if e.get("name") == "client.submit":
+                e["trace"] = {"trace_id": "NOT-HEX!", "span_id": "b" * 16}
+        bad = str(tmp_path / "bad.jsonl")
+        with open(bad, "w") as fh:
+            fh.writelines(json.dumps(e) + "\n" for e in lines)
+        r = _report("--check", bad)
+        assert r.returncode == 1
+        assert "trace" in r.stderr
+
+    def test_old_v1_streams_without_stamps_stay_readable(self, tmp_path):
+        p = str(tmp_path / "v1.jsonl")
+        obs.enable(p)
+        obs.event("chunk.done", idx=0)  # no scope → no trace key: v1 shape
+        obs.disable()
+        r = _report("--check", p)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# fleet reconstruction: merge N streams, gate exactly-once, SLOs
+# ---------------------------------------------------------------------------
+
+
+def _synthesize_fleet(root):
+    """A minimal 3-process fleet history for request rid-1: the client
+    submits, replica a admits and dies, replica b is elected, re-admits
+    the SAME segment, stores the result, and the client completes."""
+    obs.enable(os.path.join(root, "obs_client.jsonl"))
+    c = obs.trace_for_request("rid-1")
+    with obs.trace_scope(c):
+        obs.event("client.submit", req_id="rid-1")
+    hdr = {"trace": obs.trace_to_wire(c)}
+    obs.disable()
+
+    obs.enable(os.path.join(root, "obs_a.jsonl"))
+    obs.event("fleet.elected", owner="a", token=1)
+    with obs.trace_scope(obs.trace_from_wire(hdr)):
+        obs.event("server.admit", req_id="rid-1")
+    obs.disable()  # a is SIGKILLed here in the real smoke
+
+    obs.enable(os.path.join(root, "obs_b.jsonl"))
+    obs.event("fleet.elected", owner="b", token=2)
+    with obs.trace_scope(obs.trace_from_wire(hdr)):
+        obs.event("server.admit", req_id="rid-1")
+        obs.event("server.result_stored", req_id="rid-1")
+    obs.disable()
+
+    obs.enable(os.path.join(root, "obs_client.jsonl"))  # appended run
+    with obs.trace_scope(c):
+        obs.event("client.result", req_id="rid-1")
+    obs.disable()
+    return c
+
+
+class TestFleetReport:
+    def test_trace_reconstructs_across_processes(self, tmp_path):
+        root = str(tmp_path)
+        _synthesize_fleet(root)
+        gate = _report("--fleet", root, "--check", "--trace", "rid-1")
+        assert gate.returncode == 0, gate.stdout + gate.stderr
+        assert "reconstructed" in gate.stdout
+
+    def test_duplicate_terminal_breaks_the_exactly_once_gate(self, tmp_path):
+        root = str(tmp_path)
+        c = _synthesize_fleet(root)
+        dup = {"kind": "event", "name": "client.result", "ts": 1.0,
+               "attrs": {"req_id": "rid-1"}, "trace": c.to_dict()}
+        with open(os.path.join(root, "obs_client.jsonl"), "a") as fh:
+            fh.write(json.dumps(dup) + "\n")
+        r = _report("--fleet", root, "--check", "--trace", "rid-1")
+        assert r.returncode == 1
+        assert "client.result" in r.stderr
+
+    def test_single_stream_trace_fails_the_cross_process_gate(self, tmp_path):
+        root = str(tmp_path)
+        obs.enable(os.path.join(root, "obs_client.jsonl"))
+        with obs.trace_scope(obs.trace_for_request("lone-1")):
+            obs.event("client.submit", req_id="lone-1")
+            obs.event("server.admit", req_id="lone-1")
+            obs.event("client.result", req_id="lone-1")
+        obs.disable()
+        r = _report("--fleet", root, "--check", "--trace", "lone-1")
+        assert r.returncode == 1
+        assert "cross" in r.stderr
+
+    def test_fleet_json_reports_streams_and_slo(self, tmp_path):
+        root = str(tmp_path)
+        _synthesize_fleet(root)
+        r = _report("--fleet", root, "--json", "--trace", "rid-1")
+        assert r.returncode == 0, r.stdout + r.stderr
+        out = json.loads(r.stdout)
+        assert set(out["streams"]) == {"client", "a", "b"}
+        assert out["trace_errors"] == []
+        slo = out["slo"]
+        assert slo["requests_submitted"] == 1
+        assert slo["requests_completed"] == 1
+        assert slo["availability"] == 1.0
+        assert slo["elections"] == 2
+        assert slo["latency_p99_s"] is not None
+
+    def test_render_fleet_and_trace_are_printable(self, tmp_path):
+        root = str(tmp_path)
+        _synthesize_fleet(root)
+        r = _report("--fleet", root)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "client" in r.stdout and "fleet.elected" in r.stdout
+        t = _report("--fleet", root, "--trace", "rid-1", "--slo")
+        assert t.returncode == 0, t.stdout + t.stderr
+        assert "client.submit" in t.stdout
+
+
+# ---------------------------------------------------------------------------
+# chaos joins + clock sidecar
+# ---------------------------------------------------------------------------
+
+
+class TestJoinsAndClocks:
+    def test_join_injections_pairs_kills_to_ownership_changes(self):
+        from spark_timeseries_tpu.reliability.chaos import join_injections
+
+        fired = [{"kind": "kill", "at_s": 1.0},
+                 {"kind": "pause", "at_s": 0.5},
+                 {"kind": "kill", "at_s": 3.0}]
+        events = [
+            {"name": "fleet.elected", "ts": 10.0, "stream": "a",
+             "attrs": {"owner": "a", "token": 1}},
+            {"name": "server.admit", "ts": 10.5, "stream": "a"},
+            {"name": "fleet.elected", "ts": 12.0, "stream": "b",
+             "attrs": {"owner": "b", "token": 2}},
+        ]
+        joins = join_injections(fired, events)
+        assert len(joins) == 2  # kills only; the pause is not joined
+        first = joins[0]
+        assert first["observed"]
+        assert (first["victim"], first["survivor"]) == ("a", "b")
+        assert first["victim_last_ts"] == 10.5
+        assert first["takeover_latency_s"] == 1.5
+        # the second kill saw no further ownership change
+        assert joins[1]["observed"] is False
+
+    def test_clock_sidecar_written_only_with_the_plane_on(self, tmp_path):
+        from spark_timeseries_tpu.serving.client import FitClient
+
+        # never connects: only the journal path is exercised
+        cli = FitClient(["127.0.0.1:9"], deadline_s=1.0)
+        with cli._io_lock:
+            cli._clock[("127.0.0.1", 9)] = {"offset_s": 0.001,
+                                            "rtt_s": 0.002}
+        cli._write_clock_journal()  # plane off → no stream → no sidecar
+        assert list(tmp_path.iterdir()) == []
+        stream = str(tmp_path / "obs_client.jsonl")
+        obs.enable(stream)
+        cli._write_clock_journal()
+        obs.disable()
+        with open(stream + ".clock.json") as fh:
+            rec = json.load(fh)
+        assert rec["kind"] == "clock_offsets"
+        assert rec["endpoints"]["127.0.0.1:9"]["offset_s"] == 0.001
+        cli.close()
+
+    def test_reply_ts_mono_updates_only_the_min_rtt_estimate(self):
+        from spark_timeseries_tpu.serving.client import FitClient
+
+        cli = FitClient(["127.0.0.1:9"], deadline_s=1.0)
+        ep = ("127.0.0.1", 9)
+        with cli._io_lock:
+            cli._update_clock_locked(ep, {"ts_mono": 100.0}, 10.0, 10.2)
+            first = dict(cli._clock[ep])
+            # a slower round trip must NOT displace the estimate
+            cli._update_clock_locked(ep, {"ts_mono": 200.0}, 20.0, 21.0)
+            assert cli._clock[ep] == first
+            # tracing off / old server: no ts_mono → untouched
+            cli._update_clock_locked(ep, {"ok": True}, 30.0, 30.1)
+            assert cli._clock[ep] == first
+        assert first["offset_s"] == round(100.0 - 10.1, 6)
+        cli.close()

@@ -7,7 +7,8 @@ holds only while library code goes through the facade
 (``from .. import obs`` + ``obs.span`` / ``obs.counter`` / ... — every
 name ``obs/__init__`` exports).  Reaching into submodules
 (``obs.core``, ``obs.metrics``, ``obs.memory``, ``obs.promsink``,
-``obs.recorder``) bypasses the enabled() guard and couples the library
+``obs.recorder``, ``obs.tracing``) bypasses the enabled() guard and
+couples the library
 to internals; calling ``obs.enable`` / ``obs.disable`` /
 ``obs.enable_from_env`` from library code mutates global telemetry
 state that belongs to the application.  Flagged:
@@ -32,7 +33,8 @@ from ..engine import Finding, LintModule
 
 RULE = "obs-inert"
 
-_SUBMODULES = {"core", "memory", "metrics", "promsink", "recorder"}
+_SUBMODULES = {"core", "memory", "metrics", "promsink", "recorder",
+               "tracing"}
 _STATE_CALLS = {"enable", "disable", "enable_from_env"}
 
 

@@ -441,6 +441,13 @@ FILE_WRITE_OWNERS = {
                                      "values + fitted + status + meta) "
                                      "— wire encoding only, the client "
                                      "never touches the serving root",
+        "FitClient._write_clock_journal": "sole writer of the client's "
+                                          "<obs stream>.clock.json "
+                                          "sidecar (per-endpoint "
+                                          "monotonic-offset estimates, "
+                                          "ISSUE 18) — next to its own "
+                                          "telemetry stream, never under "
+                                          "a serving or journal root",
     },
     "spark_timeseries_tpu/serving/fleet.py": {
         "advertise_endpoint": "sole writer of the root's endpoints/ "

@@ -283,6 +283,50 @@ FIXTURES["lock-map/health"] = (_HEALTH, _fix("""
                 self._primary = None
     """), [lockmap.check])
 
+# ISSUE 18: the tracing plane joined the registries — seed a violation
+# of each NEW entry shape so a checker that stopped matching them
+# cannot pass vacuously.  (a) obs-inert: library code reaching the new
+# obs.tracing submodule directly (deriving ids / toggling the plane)
+# instead of the facade names obs/__init__ exports; (b) journal-writer:
+# a rogue helper writes the client's <stream>.clock.json offset sidecar
+# outside the registered FitClient._write_clock_journal owner.
+FIXTURES["obs-inert/tracing"] = (LIB, _fix("""
+    from .obs import tracing
+
+    def stamp(req_id):
+        ctx = obs.tracing.trace_for_request(req_id)
+        tracing.set_plane(True)
+        return ctx
+    """), _fix("""
+    from . import obs
+
+    def stamp(req_id):
+        with obs.trace_scope(obs.trace_for_request(req_id, "client")):
+            obs.event("client.submit", req_id=req_id)
+        return obs.current_trace()
+    """), [obsinert.check])
+
+_CLOCK = "spark_timeseries_tpu/serving/fixture_clock.py"
+_CLOCK_OWNERS = {_CLOCK: {"FitClient._write_clock_journal":
+                          "sole writer of the clock-offset sidecar"}}
+
+FIXTURES["journal-writer/clock"] = (_CLOCK, _fix("""
+    import json
+
+    def rogue_offset_note(stream_path, clock):
+        path = stream_path + ".clock.json"
+        with open(path, "w") as f:     # unregistered writer
+            f.write(json.dumps(clock, sort_keys=True))
+    """), _fix("""
+    import json
+
+    class FitClient:
+        def _write_clock_journal(self, stream_path, clock):
+            path = stream_path + ".clock.json"
+            with open(path, "w") as f:
+                f.write(json.dumps(clock, sort_keys=True))
+    """), [functools.partial(journalwriter.check, owners=_CLOCK_OWNERS)])
+
 _OWNERS = {HOT: {"Owner": "fixture namespace owner"}}
 
 FIXTURES["journal-writer"] = (HOT, _fix("""

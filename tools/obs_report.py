@@ -29,6 +29,21 @@ ORDER; ``--check --manifest`` pointed at the search root validates the
 ``auto_manifest.json`` block (orders, stage-2 spend, selection counts)
 and recurses into every per-order journal, and a per-order manifest's
 ``extra.auto_fit`` block is checked for grid coherence.
+
+Fleets (ISSUE 18): every process in a serving fleet — N replicas plus
+the storming client — streams to its own ``obs_<name>.jsonl`` at the
+fleet root.  ``--fleet ROOT`` merges them into one view: per-process
+lanes, elections / step-downs / degradation transitions as
+annotations, chaos-manifest injections joined to their observed
+consequences (injection -> victim silent -> survivor elected ->
+takeover latency).  ``--trace REQUEST_ID`` renders one request's
+cross-process causal timeline from its deterministic trace ids (and,
+with ``--check``, GATES its reconstruction: a submit origin, a server
+admission, exactly one ``client.result`` terminal, more than one
+process).  ``--slo`` summarizes availability, client-observed latency
+percentiles, and failover recovery.  Merged ordering trusts same-host
+wall clocks; the client's ``*.clock.json`` sidecars carry per-endpoint
+monotonic-clock offsets for the cross-host story.
 """
 
 from __future__ import annotations
@@ -66,6 +81,37 @@ def load_events(path: str):
     return events, errors
 
 
+_TRACE_HEX = set("0123456789abcdef")
+
+
+def _trace_field_ok(v) -> bool:
+    return (isinstance(v, str) and len(v) == 16
+            and all(c in _TRACE_HEX for c in v))
+
+
+def validate_trace_stamp(i: int, ev: dict, errors: list) -> None:
+    """Schema v2 (ISSUE 18): a span/event line MAY carry a top-level
+    ``trace`` object — absent is fine (tracing off, schema-v1 streams),
+    present-but-malformed fails the gate."""
+    if "trace" not in ev:
+        return
+    tr = ev["trace"]
+    if not isinstance(tr, dict):
+        errors.append(f"line {i}: trace is not an object: {tr!r}")
+        return
+    for f in ("trace_id", "span_id"):
+        if not _trace_field_ok(tr.get(f)):
+            errors.append(f"line {i}: trace.{f} is not 16 lowercase hex "
+                          f"chars: {tr.get(f)!r}")
+    if "parent_id" in tr and not _trace_field_ok(tr["parent_id"]):
+        errors.append(f"line {i}: trace.parent_id invalid: "
+                      f"{tr['parent_id']!r}")
+    extra = set(tr) - {"trace_id", "span_id", "parent_id"}
+    if extra:
+        errors.append(f"line {i}: trace carries unknown keys "
+                      f"{sorted(extra)}")
+
+
 def validate_events(events, errors) -> list:
     """Schema check (see obs.recorder docstring); appends to ``errors``."""
     if not events and not errors:
@@ -80,6 +126,8 @@ def validate_events(events, errors) -> list:
             continue
         if not isinstance(ev.get("ts"), (int, float)):
             errors.append(f"line {i}: missing/non-numeric ts")
+        if kind in ("span", "event"):
+            validate_trace_stamp(i, ev, errors)
         if kind == "meta":
             if not ev.get("run_id") or not isinstance(ev.get("schema"), int):
                 errors.append(f"line {i}: meta missing run_id/schema")
@@ -762,6 +810,285 @@ def validate_prom_sink(prom_path: str, events) -> list:
                                                 snapshot=snapshot)]
 
 
+# ---------------------------------------------------------------------------
+# fleet view (ISSUE 18): N replica streams + the client stream, one story
+# ---------------------------------------------------------------------------
+
+FLEET_ANNOTATIONS = (
+    "fleet.elected", "fleet.step_down", "fleet.fenced",
+    "fleet.standby_read", "fleet.torn_result", "server.storage_refusal",
+    "client.endpoint_circuit_open", "client.endpoint_half_open",
+    "client.endpoint_probe_failed", "client.endpoint_recovered",
+    "client.endpoint_redirected", "client.primary_learned",
+)
+
+
+def _import_pkg():
+    """Make the package importable from the repo checkout (the
+    validate_prom_sink pattern)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def load_fleet(root: str):
+    """Load every per-process stream at a fleet root.
+
+    The convention (tests/_chaos_worker.py, tests/_fleet_worker.py):
+    each process streams to ``obs_<name>.jsonl`` — replicas under their
+    owner name, the storming client as ``obs_client.jsonl`` — next to
+    the optional ``chaos_manifest.json`` and the client's
+    ``*.clock.json`` offset sidecars.
+
+    Returns ``(streams, merged, clocks, manifest, errors)``:
+    ``streams`` maps stream name to the ``(line_no, event)`` list from
+    :func:`load_events`; ``merged`` is every line across streams,
+    tagged with its ``stream`` name and sorted by ``ts`` (wall clock —
+    a same-host ordering; the clock sidecars carry the per-endpoint
+    monotonic offsets a cross-host merge would need).
+    """
+    import glob
+
+    streams, errors = {}, []
+    for p in sorted(glob.glob(os.path.join(root, "obs_*.jsonl"))):
+        name = os.path.basename(p)[len("obs_"):-len(".jsonl")]
+        evs, errs = load_events(p)
+        streams[name] = evs
+        errors += [f"[{name}] {e}" for e in errs]
+    if not streams:
+        errors.append(f"fleet root {root}: no obs_*.jsonl streams")
+    merged = []
+    for name, evs in streams.items():
+        for _, ev in evs:
+            merged.append({**ev, "stream": name})
+    merged.sort(key=lambda ev: (ev["ts"] if isinstance(
+        ev.get("ts"), (int, float)) else 0.0))
+    clocks = {}
+    for p in sorted(glob.glob(os.path.join(root, "*.clock.json"))):
+        try:
+            with open(p, encoding="utf-8") as f:
+                clocks[os.path.basename(p)] = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            errors.append(f"clock sidecar {p}: unreadable ({e})")
+    manifest = None
+    mp = os.path.join(root, "chaos_manifest.json")
+    if os.path.exists(mp):
+        try:
+            with open(mp, encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            errors.append(f"chaos manifest {mp}: unreadable ({e})")
+    return streams, merged, clocks, manifest, errors
+
+
+def _derive_trace(request_id: str):
+    """Re-derive ``(trace_id, tracing_module)`` for a request id via
+    ``obs.tracing`` — the package is the single source of truth for the
+    deterministic derivation, so the tool cannot drift from it."""
+    _import_pkg()
+    from spark_timeseries_tpu.obs import tracing
+
+    return tracing.derive_trace_id(str(request_id)), tracing
+
+
+def check_trace(merged, request_id: str) -> list:
+    """The ci reconstruction gate (ISSUE 18): one stormed request's
+    causal timeline must exist, cross processes, and terminate exactly
+    once — a submit origin on the client stream, a server admission on
+    a replica stream, and exactly one ``client.result`` terminal (a
+    SIGKILLed primary shows a SECOND admission on the survivor, never a
+    second terminal)."""
+    try:
+        tid, _ = _derive_trace(request_id)
+    except Exception as e:  # noqa: BLE001 - tooling degrades loudly
+        return [f"cannot import obs.tracing to derive trace ids: {e}"]
+    mine = [ev for ev in merged
+            if (ev.get("trace") or {}).get("trace_id") == tid]
+    if not mine:
+        return [f"trace {request_id}: no lines carry trace_id {tid}"]
+    errors = []
+    names = [ev.get("name") for ev in mine]
+    streams = sorted({ev["stream"] for ev in mine})
+    if "client.submit" not in names:
+        errors.append(f"trace {request_id}: no client.submit origin")
+    if "server.admit" not in names:
+        errors.append(f"trace {request_id}: no server.admit — the "
+                      "request never shows up on a replica's timeline")
+    n_results = names.count("client.result")
+    if n_results != 1:
+        errors.append(f"trace {request_id}: {n_results} client.result "
+                      "terminals (the contract is exactly one)")
+    if len(streams) < 2:
+        errors.append(f"trace {request_id}: confined to {streams} — a "
+                      "fleet trace must cross processes")
+    return errors
+
+
+def render_trace(merged, request_id: str) -> list:
+    """Render one request's causal story across every stream (the
+    request-level timeline, then each joined batch trace); returns the
+    :func:`check_trace` errors so the render and the gate agree."""
+    try:
+        tid, tracing = _derive_trace(request_id)
+    except Exception as e:  # noqa: BLE001 - tooling degrades loudly
+        print(f"cannot derive trace ids: {e}", file=sys.stderr)
+        return [str(e)]
+    mine = [ev for ev in merged
+            if (ev.get("trace") or {}).get("trace_id") == tid]
+    print(f"trace {request_id}  trace_id={tid}  ({len(mine)} lines, "
+          f"streams {sorted({ev['stream'] for ev in mine})})")
+
+    def _line(ev, t0, pad="  "):
+        attrs = ev.get("attrs") or {}
+        attrs_s = " ".join(f"{k}={v}" for k, v in attrs.items())
+        tail = (f"wall {ev.get('wall_s', 0.0):.4f}s"
+                if ev.get("kind") == "span" else "*")
+        ts = ev.get("ts") if isinstance(ev.get("ts"), (int, float)) else t0
+        print(f"{pad}{ts - t0:9.3f}  [{ev['stream']:<8}] "
+              f"{ev.get('name', ''):<24} {tail:<16} {attrs_s}")
+
+    if mine:
+        t0 = min(ev["ts"] for ev in mine
+                 if isinstance(ev.get("ts"), (int, float)))
+        for ev in mine:
+            _line(ev, t0)
+        # the batch level: the fit work itself runs under the BATCH's
+        # content-derived trace; server.batch_member joins the two
+        bids = sorted({(ev.get("attrs") or {}).get("batch_id")
+                       for ev in mine
+                       if ev.get("name") == "server.batch_member"
+                       and (ev.get("attrs") or {}).get("batch_id")})
+        for bid in bids:
+            btid = tracing.derive_trace_id(str(bid))
+            bmine = [ev for ev in merged
+                     if (ev.get("trace") or {}).get("trace_id") == btid]
+            print(f"  batch {bid}  trace_id={btid}  "
+                  f"({len(bmine)} lines):")
+            for ev in bmine:
+                _line(ev, t0, pad="    ")
+    return check_trace(merged, request_id)
+
+
+def _join_chaos(manifest: dict, merged):
+    """Join the manifest's injections to their observed consequences
+    via ``reliability.chaos.join_injections`` (package import — single
+    source of truth for the ordinal-join semantics); None when the
+    package is unimportable."""
+    _import_pkg()
+    try:
+        from spark_timeseries_tpu.reliability import chaos
+    except Exception as e:  # noqa: BLE001 - tooling degrades loudly
+        print(f"cannot import reliability.chaos for the injection join: "
+              f"{e}", file=sys.stderr)
+        return None
+    return chaos.join_injections(manifest.get("fired") or [], merged)
+
+
+def compute_slo(merged, manifest=None) -> dict:
+    """Fleet SLO summary from the merged timeline: availability (the
+    share of submitted requests that reached their exactly-once
+    terminal), client-observed latency percentiles (first
+    ``client.submit`` to first ``client.result`` per request id), and
+    failover recovery (takeover latencies from the injection join when
+    a chaos manifest rode along)."""
+    submits, results = {}, {}
+    for ev in merged:
+        if ev.get("kind") != "event":
+            continue
+        rid = (ev.get("attrs") or {}).get("req_id")
+        ts = ev.get("ts")
+        if rid is None or not isinstance(ts, (int, float)):
+            continue
+        if ev.get("name") == "client.submit":
+            submits.setdefault(rid, ts)
+        elif ev.get("name") == "client.result":
+            results.setdefault(rid, ts)
+    lat = sorted(results[r] - submits[r] for r in results if r in submits)
+
+    def pct(p):
+        if not lat:
+            return None
+        k = max(0, min(len(lat) - 1,
+                       int(round(p / 100.0 * (len(lat) - 1)))))
+        return round(lat[k], 6)
+
+    takeovers = []
+    if manifest:
+        joins = _join_chaos(manifest, merged) or []
+        takeovers = [j["takeover_latency_s"] for j in joins
+                     if j.get("takeover_latency_s") is not None]
+    done = sum(1 for r in results if r in submits)
+    return {
+        "requests_submitted": len(submits),
+        "requests_completed": done,
+        "availability": round(done / len(submits), 4) if submits else None,
+        "latency_p50_s": pct(50),
+        "latency_p99_s": pct(99),
+        "elections": sum(1 for ev in merged
+                         if ev.get("name") == "fleet.elected"),
+        "takeover_latencies_s": takeovers,
+    }
+
+
+def render_fleet(streams, merged, clocks, manifest) -> None:
+    """The merged fleet view: one lane per process, then the fleet
+    annotations (elections, step-downs, circuit transitions), the
+    injection-consequence join, and the clock-offset sidecars."""
+    stamps = [ev["ts"] for ev in merged
+              if isinstance(ev.get("ts"), (int, float))]
+    t0 = min(stamps) if stamps else 0.0
+    print(f"fleet telemetry: {len(streams)} streams, "
+          f"{len(merged)} lines")
+    for name in sorted(streams):
+        mine = [ev for ev in merged if ev["stream"] == name
+                and ev.get("kind") in ("span", "event")]
+        n_spans = sum(1 for ev in mine if ev["kind"] == "span")
+        print(f"\n  lane {name}  ({n_spans} spans, "
+              f"{len(mine) - n_spans} events):")
+        for ev in mine:
+            attrs = ev.get("attrs") or {}
+            attrs_s = " ".join(f"{k}={v}" for k, v in attrs.items())
+            mark = " " if ev["kind"] == "span" else "*"
+            tr = ev.get("trace") or {}
+            tid = f"  [{tr['trace_id']}]" if tr.get("trace_id") else ""
+            ts = ev.get("ts") if isinstance(ev.get("ts"),
+                                            (int, float)) else t0
+            print(f"    {ts - t0:9.3f}  {mark} {ev.get('name', ''):<26} "
+                  f"{attrs_s}{tid}")
+    ann = [ev for ev in merged if ev.get("kind") == "event"
+           and ev.get("name") in FLEET_ANNOTATIONS]
+    if ann:
+        print(f"\n  fleet annotations ({len(ann)}):")
+        for ev in ann:
+            attrs = ev.get("attrs") or {}
+            attrs_s = " ".join(f"{k}={v}" for k, v in attrs.items())
+            ts = ev.get("ts") if isinstance(ev.get("ts"),
+                                            (int, float)) else t0
+            print(f"    {ts - t0:9.3f}  [{ev['stream']}] "
+                  f"{ev['name']} {attrs_s}")
+    if manifest:
+        joins = _join_chaos(manifest, merged)
+        if joins:
+            print("\n  chaos injections -> consequences:")
+            for j in joins:
+                inj = j.get("injection") or {}
+                line = (f"    t={inj.get('fired_at_s')}s "
+                        f"{inj.get('kind')} {inj.get('target')}")
+                if j.get("observed"):
+                    line += (f" -> victim {j.get('victim')} fell silent; "
+                             f"{j.get('survivor')} elected with token "
+                             f"{j.get('elected_token')} (takeover "
+                             f"{j.get('takeover_latency_s')}s)")
+                else:
+                    line += " -> no ownership change observed"
+                print(line)
+    if clocks:
+        print("\n  clock-offset sidecars (endpoint monotonic vs client):")
+        for name, rec in sorted(clocks.items()):
+            for ep, est in sorted((rec.get("endpoints") or {}).items()):
+                print(f"    {name}: {ep} offset "
+                      f"{est.get('offset_s')}s (rtt {est.get('rtt_s')}s)")
+
+
 def summarize(events) -> dict:
     """Timeline + final metrics snapshot of the LATEST run in the stream.
 
@@ -967,9 +1294,25 @@ def _render(s: dict) -> None:
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("events", help="telemetry JSONL path (obs.enable(path))")
+    ap.add_argument("events", nargs="?", default=None,
+                    help="telemetry JSONL path (obs.enable(path)); "
+                         "omitted in --fleet mode")
     ap.add_argument("--check", action="store_true",
                     help="validate the event schema and exit 0/1")
+    ap.add_argument("--fleet", default=None, metavar="ROOT",
+                    help="fleet mode (ISSUE 18): merge every "
+                         "obs_*.jsonl stream at ROOT (+ *.clock.json "
+                         "sidecars + chaos_manifest.json) into one "
+                         "view; composes with --check/--trace/--slo")
+    ap.add_argument("--trace", default=None, metavar="REQUEST_ID",
+                    help="with --fleet: render REQUEST_ID's causal "
+                         "timeline across every process; with --check, "
+                         "gate its reconstruction (submit origin, "
+                         "server admission, exactly one terminal, "
+                         "more than one process)")
+    ap.add_argument("--slo", action="store_true",
+                    help="with --fleet: availability / latency "
+                         "percentiles / failover-recovery summary")
     ap.add_argument("--manifest", default=None, metavar="CKPT_DIR",
                     help="with --check: also validate the journal "
                          "manifest's embedded telemetry block")
@@ -987,6 +1330,59 @@ def main():
     ap.add_argument("--json", action="store_true",
                     help="machine-readable summary instead of the report")
     args = ap.parse_args()
+    if args.events is None and args.fleet is None:
+        ap.error("need a telemetry JSONL path (or --fleet ROOT)")
+    if args.trace is not None and args.fleet is None:
+        ap.error("--trace needs --fleet ROOT (the causal timeline "
+                 "spans every process's stream)")
+
+    if args.fleet is not None:
+        streams, merged, clocks, manifest, errors = load_fleet(args.fleet)
+        if args.check:
+            for name, evs in sorted(streams.items()):
+                errors += [f"[{name}] {e}"
+                           for e in validate_events(evs, [])]
+            if args.trace is not None:
+                errors += check_trace(merged, args.trace)
+            if errors:
+                for e in errors:
+                    print(f"obs_report: FAIL {e}", file=sys.stderr)
+                sys.exit(1)
+            n = sum(len(v) for v in streams.values())
+            extra = ""
+            if args.trace is not None:
+                tid, _ = _derive_trace(args.trace)
+                mine = [ev for ev in merged
+                        if (ev.get("trace") or {}).get("trace_id") == tid]
+                extra = (f" + trace {args.trace} reconstructed "
+                         f"({len(mine)} lines across "
+                         f"{len({ev['stream'] for ev in mine})} streams, "
+                         "1 terminal)")
+            print(f"obs_report: OK — fleet {args.fleet}: "
+                  f"{len(streams)} streams, {n} events valid{extra}")
+            return
+        for e in errors:
+            print(f"obs_report: WARNING {e}", file=sys.stderr)
+        if args.json:
+            out = {"streams": {n: len(v) for n, v in streams.items()},
+                   "slo": compute_slo(merged, manifest)}
+            if args.trace is not None:
+                out["trace_errors"] = check_trace(merged, args.trace)
+            print(json.dumps(out, indent=1, sort_keys=True, default=repr))
+            return
+        shown = False
+        if args.trace is not None:
+            shown = True
+            for e in render_trace(merged, args.trace):
+                print(f"obs_report: WARNING {e}", file=sys.stderr)
+        if args.slo:
+            shown = True
+            print("\nfleet SLO:" if args.trace else "fleet SLO:")
+            for k, v in compute_slo(merged, manifest).items():
+                print(f"  {k:<24} {v}")
+        if not shown:
+            render_fleet(streams, merged, clocks, manifest)
+        return
 
     events, errors = load_events(args.events)
     if args.check:
