@@ -215,6 +215,62 @@ python tests/_serving_worker.py --smoke
 # tracker rides the survivor and the orchestrator's client retry paths.
 python tests/_fleet_worker.py --smoke
 
+# fleet warm-routing smoke (ISSUE 19): tenant auto-fit profiles live on
+# the SHARED fleet root, so a failover continues WARM — a tenant's first
+# submit routes "new" (full stepwise search) on the primary and lands a
+# durable profile; the primary is REALLY SIGKILLed; the surviving
+# standby classifies the identical resubmit "stable" off the dead
+# primary's profile (stage 1 skipped entirely) with bitwise-equal
+# per-row winning orders, and a stale-token holder is refused the
+# profile write path (FencedError BEFORE bytes land — the zombie cannot
+# clobber the survivor's warm state)
+python tests/_fleet_worker.py --warm-smoke
+
+# warm-routing tooling smoke (ISSUE 19): two identical auto-fit submits
+# on one serving root must route new -> stable with an unchanged
+# selection, leave a stepwise search journal that passes the obs_report
+# manifest gate (per-pass partition of the trial walk), and give the
+# budget advisor a tenant-profile table (stepwise seed sizing + the
+# stable tenant's cell_rows advice) — note a warm AUTO root has ZERO
+# batch journals (auto submits bypass the micro-batcher), which is
+# exactly the path the advisor's profile rendering must survive
+WARM_SMOKE_DIR=$(python - <<'EOF'
+import os, tempfile
+import numpy as np
+from spark_timeseries_tpu import obs, serving
+
+root = tempfile.mkdtemp(prefix="warm_smoke_")
+rng = np.random.default_rng(11)
+e = rng.normal(size=(8, 96)).astype(np.float32)
+y = np.zeros_like(e)
+for t in range(1, y.shape[1]):
+    y[:, t] = 0.6 * y[:, t - 1] + e[:, t]
+kw = dict(max_iters=25, stepwise_max_passes=2, stepwise_max_order=1)
+obs.enable(os.path.join(root, "events.jsonl"))
+with serving.FitServer(root, cell_rows=8) as srv:
+    r1 = srv.submit("acme", y, "panel_auto", request_id="auto-1",
+                    warm_routing=True, **kw).result(timeout=600)
+    r2 = srv.submit("acme", y, "panel_auto", request_id="auto-2",
+                    warm_routing=True, **kw).result(timeout=600)
+obs.disable()
+assert r1.meta["auto"]["route"] == "new", r1.meta["auto"]
+assert r2.meta["auto"]["route"] == "stable", r2.meta["auto"]
+assert r1.meta["auto"]["order_index"] == r2.meta["auto"]["order_index"]
+h = srv.health()["counters"]
+assert h["route_new"] == 1 and h["route_stable"] == 1 \
+    and h["profile_updates"] == 2, h
+print(root)
+EOF
+)
+python tools/obs_report.py --check "$WARM_SMOKE_DIR/events.jsonl" \
+  --manifest "$WARM_SMOKE_DIR/auto/auto-1"
+python tools/advise_budget.py "$WARM_SMOKE_DIR" > /tmp/ci_warm_advise.txt
+grep -q "tenant profiles" /tmp/ci_warm_advise.txt \
+  || { echo "ci.sh: advise_budget did not render the tenant profiles" >&2; exit 1; }
+grep -q "stepwise seeds" /tmp/ci_warm_advise.txt \
+  || { echo "ci.sh: advise_budget did not size the stepwise seeds" >&2; exit 1; }
+rm -rf "$WARM_SMOKE_DIR"
+
 # chaos soak smoke (ISSUE 17): a SEEDED chaos schedule (pause + SIGKILL
 # the primary mid-storm) runs against a 2-replica fleet with write-ahead
 # disk faults armed on the survivor and HMAC wire auth on every frame;
@@ -298,6 +354,15 @@ python tests/_hostwalk_worker.py --smoke
 # per-group journals replay only uncommitted chunks, the demuxed
 # selection argmin is recomputed from the full grid
 python tests/_autofit_worker.py --smoke
+
+# stepwise kill-and-resume smoke (ISSUE 19): the stepwise
+# Hyndman–Khandakar search is SIGKILLed MID-EXPANSION — the 4-order seed
+# pass fully durable, the expansion pass's fused walk torn after 2 of 3
+# chunk commits — resumed, and the resumed search must replay the
+# completed passes from their journals, recompute the IDENTICAL
+# expansion, and select bitwise vs an uninterrupted stepwise run, with
+# the per-pass manifest partitioning the trial walk
+python tests/_autofit_worker.py --stepwise-smoke
 
 # auto-fit tooling smoke (ISSUE 9/10): a short journaled FUSED order
 # search with telemetry on must leave a group manifest carrying its grid

@@ -74,6 +74,7 @@ __all__ = [
     "AutoFitResult",
     "DEFAULT_ORDERS",
     "OrderSpec",
+    "STEPWISE_SEED_ORDERS",
     "auto_fit",
     "criterion_matrix",
     "fusion_groups",
@@ -89,6 +90,14 @@ CRITERIA = ("aicc", "aic", "bic")
 DEFAULT_ORDERS = (
     (1, 0, 0), (0, 0, 1), (1, 0, 1),
     (0, 1, 1), (1, 1, 0), (1, 1, 1),
+)
+
+# default seed neighborhood for the stepwise search (ISSUE 19): the four
+# cheapest workhorses spanning both differencing tiers — two fused pass-0
+# walks — with everything richer reached by expansion only when a row's
+# winner asks for it
+STEPWISE_SEED_ORDERS = (
+    (1, 0, 0), (0, 0, 1), (1, 1, 0), (0, 1, 1),
 )
 
 
@@ -532,6 +541,9 @@ def auto_fit(
     stage2: str = "full",
     stage1_iters: int = 12,
     fuse="auto",
+    stepwise: bool = False,
+    stepwise_max_passes: int = 8,
+    stepwise_max_order: int = 3,
     return_criteria: bool = False,
     chunk_rows: Optional[int] = None,
     resilient: bool = False,
@@ -598,6 +610,25 @@ def auto_fit(
     resumed search recomputes them identically (they are not separately
     journaled).  ``fuse=1`` keeps PR 8's journaled refit walks bitwise.
 
+    **Stepwise search** (``stepwise=True``, ISSUE 19): instead of
+    fitting a static grid exhaustively, run the Hyndman–Khandakar
+    expansion — fit a small seed neighborhood (``orders``, default
+    :data:`STEPWISE_SEED_ORDERS`) as fused full-budget walks, expand
+    ``p``/``q`` by ±1 (``d`` fixed, capped at ``stepwise_max_order``)
+    around the per-row winners, and repeat until a pass's new orders win
+    zero rows or ``stepwise_max_passes`` is reached.  Each pass is an
+    ordinary journaled campaign under ``checkpoint_dir/stepwise_%02d/``:
+    SIGKILL anywhere and a re-run resumes — completed passes load from
+    their journals bitwise, the expansion (a deterministic function of
+    the journaled results) replays identically, and the torn pass
+    continues mid-walk.  Selection runs over ALL orders tried, with grid
+    indices in global trial order, so agreement with the exhaustive
+    search on the union grid is exact whenever the expansion visited
+    every row's exhaustive winner (tested on well-separated panels).
+    Requires ``stage2="full"`` and non-seasonal candidates; the
+    exhaustive path (``stepwise=False``) is untouched as the reference
+    implementation.
+
     Durable: SIGKILL anywhere — mid-chunk, mid-group, between groups —
     and a re-run with the same panel/grid/config resumes from the
     per-group journals, replaying only uncommitted chunks, with selection
@@ -605,6 +636,8 @@ def auto_fit(
     search.  A root ``auto_manifest.json`` records orders tried, fusion
     groups, per-order spend, and the selection histogram for the tools.
     """
+    if orders is None and stepwise:
+        orders = STEPWISE_SEED_ORDERS
     specs = normalize_orders(orders)
     if criterion not in CRITERIA:
         raise ValueError(f"unknown criterion {criterion!r} "
@@ -614,8 +647,28 @@ def auto_fit(
                          f"{stage2!r}")
     if stage2 == "winners" and int(stage1_iters) < 1:
         raise ValueError("stage1_iters must be >= 1")
+    if stepwise:
+        if stage2 != "full":
+            raise ValueError(
+                "stepwise search requires stage2='full' — the restricted "
+                "grid IS its economy; the winners split composes with "
+                "exhaustive grids only")
+        if int(stepwise_max_passes) < 1:
+            raise ValueError("stepwise_max_passes must be >= 1")
+        if int(stepwise_max_order) < 0:
+            raise ValueError("stepwise_max_order must be >= 0")
+        if any(s.seasonal is not None for s in specs):
+            raise ValueError(
+                "stepwise expansion is defined on plain (p, d, q) orders; "
+                "pass seasonal candidates on an explicit exhaustive grid")
+        bad = [s.label for s in specs
+               if max(s.order[0], s.order[2]) > int(stepwise_max_order)]
+        if bad:
+            raise ValueError(
+                f"seed orders {bad} exceed stepwise_max_order="
+                f"{int(stepwise_max_order)}")
     groups = fusion_groups(specs, fuse)
-    if any(len(m) > 1 for m in groups):
+    if any(len(m) > 1 for m in groups) or (stepwise and fuse != 1):
         bad = sorted(set(fit_kwargs) - {"max_iters", "tol", "backend",
                                         "method"})
         if bad:
@@ -740,7 +793,30 @@ def auto_fit(
         return entry
 
     order_meta = []
-    if stage2 == "full":
+    stepwise_meta = None
+    sw_groups = ()
+    if stepwise:
+        seed_labels = [s.label for s in specs]
+        (sel, specs, order_meta, passes_meta, stage1_wall, sw_groups,
+         sw_diff_hits, sw_converged) = _stepwise_search(
+            specs, values, nv0, b, criterion, include_intercept, fuse,
+            checkpoint_dir, stepwise_max_passes, stepwise_max_order,
+            fit_kwargs, walk_knobs,
+            budget_left=(None if job_budget_s is None else
+                         lambda: job_budget_s
+                         - (time.perf_counter() - t0)))
+        g_total = len(specs)
+        stage2_wall = 0.0
+        diff_cache_hits = sw_diff_hits
+        stepwise_meta = {
+            "passes": passes_meta,
+            "max_passes": int(stepwise_max_passes),
+            "max_order": int(stepwise_max_order),
+            "seed": seed_labels,
+            "converged": sw_converged,
+            "orders_tried": g_total,
+        }
+    elif stage2 == "full":
         results = [None] * g_total
         for members in groups:
             if len(members) == 1:
@@ -799,14 +875,22 @@ def auto_fit(
     cc_misses = cc1["misses"] - cc0["misses"]
     total_wall = time.perf_counter() - t0
     stage_suffix = "" if stage2 == "full" else "_s1"
+    if stepwise:
+        fusion_meta = [
+            {"dir": f"stepwise_{p:02d}/grid_{m[0]:05d}", "orders": list(m),
+             "stepwise_pass": p}
+            for p, m in sw_groups]
+    else:
+        fusion_meta = [
+            {"dir": f"grid_{m[0]:05d}{stage_suffix}", "orders": list(m)}
+            for m in groups]
     auto_meta = {
         "criterion": criterion,
         "stage2": stage2,
         "stage1_iters": stage1_iters if stage2 == "winners" else None,
         "fuse": fuse if fuse == "auto" else int(fuse),
-        "fusion_groups": [
-            {"dir": f"grid_{m[0]:05d}{stage_suffix}", "orders": list(m)}
-            for m in groups],
+        "stepwise": stepwise_meta,
+        "fusion_groups": fusion_meta,
         "diff_cache_hits": diff_cache_hits,
         "n_rows": b,
         "orders": order_meta,
@@ -832,12 +916,16 @@ def auto_fit(
         # fused search walks one dir per fusion GROUP, named by the
         # group's first grid index; fused winners refits are warm-started
         # recomputations of the journaled stage-1 sweeps, so only fuse=1
-        # leaves grid_*_winners journals behind.
-        grid_dirs = [f"grid_{m[0]:05d}{stage_suffix}" for m in groups]
-        if stage2 == "winners" and fuse == 1:
-            grid_dirs += [f"grid_{m['grid_index']:05d}_winners"
-                          for m in order_meta
-                          if m.get("stage2_rows")]
+        # leaves grid_*_winners journals behind.  A stepwise search walks
+        # one dir per (pass, group) under stepwise_%02d/ namespaces.
+        if stepwise:
+            grid_dirs = [fm["dir"] for fm in fusion_meta]
+        else:
+            grid_dirs = [f"grid_{m[0]:05d}{stage_suffix}" for m in groups]
+            if stage2 == "winners" and fuse == 1:
+                grid_dirs += [f"grid_{m['grid_index']:05d}_winners"
+                              for m in order_meta
+                              if m.get("stage2_rows")]
         _write_auto_manifest(checkpoint_dir, auto_meta, sorted(grid_dirs))
         meta["auto_manifest"] = os.path.join(checkpoint_dir,
                                              "auto_manifest.json")
@@ -1147,6 +1235,187 @@ def _gather_rows(values, idx: np.ndarray):
     if isinstance(values, source_mod.ChunkSource):
         return source_mod.HostChunkSource(_read_rows_host(values, idx))
     return jnp.asarray(values)[jnp.asarray(idx)]
+
+
+def _stepwise_neighbors(order, max_order: int):
+    """Hyndman–Khandakar expansion moves around one winning order: vary
+    ``p`` and ``q`` by ±1 (including the joint ±1 diagonal) with ``d``
+    FIXED — differencing is a property of the series, not a search move —
+    and both coefficients capped at ``max_order``.  Deterministic
+    ascending output order."""
+    p, d, q = order
+    out = []
+    for dp, dq in ((-1, -1), (-1, 0), (0, -1), (0, 1), (1, 0), (1, 1)):
+        p2, q2 = p + dp, q + dq
+        if 0 <= p2 <= max_order and 0 <= q2 <= max_order:
+            out.append((p2, d, q2))
+    return out
+
+
+def _stepwise_search(seed_specs, values, nv0, b, criterion,
+                     include_intercept, fuse, checkpoint_dir, max_passes,
+                     max_order, fit_kwargs, walk_knobs, *, budget_left=None):
+    """The stepwise Hyndman–Khandakar driver (ISSUE 19).
+
+    Fits the seed neighborhood as pass 0 (fused same-``d`` groups, full
+    budget), arg-selects over everything tried so far, expands ``p``/``q``
+    around the distinct per-row winners, and repeats until a pass's new
+    orders win zero rows, the expansion is exhausted, or ``max_passes``
+    is reached.  Every pass is an ordinary journaled campaign under
+    ``checkpoint_dir/stepwise_%02d/grid_%05d`` (grid dirs named by GLOBAL
+    trial index): SIGKILL anywhere and a re-run replays the same pass
+    sequence — completed walks load from their journals bitwise, so the
+    recomputed selections and expansions are identical, and the torn walk
+    resumes mid-chunk.  The selection tie-break prefers earlier-TRIED
+    orders, exactly as the exhaustive search prefers earlier grid
+    entries.
+    """
+    from ..reliability import fit_chunked
+
+    max_passes = int(max_passes)
+    max_order = int(max_order)
+    specs: list = []
+    results: list = []
+    order_meta: list = []
+    passes_meta: list = []
+    sw_groups: list = []  # (pass_idx, global member tuple) in walk order
+    diff_hits = 0
+    frontier = list(seed_specs)
+    sel = None
+    wall_total = 0.0
+    converged = False
+
+    def _entry(g, wall, res, pass_idx, fused_with=None):
+        spec = specs[g]
+        entry = {
+            "grid_index": g,
+            "order": list(spec.order),
+            "seasonal": None,
+            "label": spec.label,
+            "k": spec.n_params(include_intercept),
+            "wall_s": round(wall, 4),
+            "chunks_run": res.meta.get("chunks_run"),
+            "rows_fit": b,
+            "stage2_traces": None,
+            "timeouts": res.meta.get("timeouts", 0),
+            "stepwise_pass": pass_idx,
+        }
+        if fused_with is not None:
+            entry["fused_group"] = fused_with[0]
+            entry["fused_width"] = len(fused_with)
+        return entry
+
+    for pass_idx in range(max_passes):
+        if not frontier:
+            converged = True
+            break
+        pass_dir = (None if checkpoint_dir is None else
+                    os.path.join(checkpoint_dir,
+                                 f"stepwise_{pass_idx:02d}"))
+        base_g = len(specs)
+        specs.extend(frontier)
+        g_total = len(specs)
+        local_groups = fusion_groups(tuple(frontier), fuse)
+        diff_hits += _grid_diff_cache_hits(tuple(frontier), local_groups)
+        pass_results = [None] * len(frontier)
+        pass_wall = 0.0
+        for local in local_groups:
+            members = tuple(base_g + j for j in local)
+            sw_groups.append((pass_idx, members))
+            budget = (None if budget_left is None
+                      else max(1e-6, budget_left()))
+            if len(members) == 1:
+                g = members[0]
+                spec = specs[g]
+                fit_fn = _order_fit_fn(spec, include_intercept,
+                                       dict(fit_kwargs))
+                extra = {"auto_fit": {
+                    "grid_index": g, "grid_total": g_total,
+                    "order": list(spec.order), "seasonal": None,
+                    "criterion": criterion, "stage": "stepwise",
+                    "stepwise_pass": pass_idx,
+                }}
+                with obs.span("auto_fit.order", grid=g, order=spec.label,
+                              stage="stepwise", sw_pass=pass_idx):
+                    t_g = time.perf_counter()
+                    res = fit_chunked(
+                        fit_fn, values,
+                        checkpoint_dir=_grid_dir(pass_dir, g),
+                        grid=(g, g_total), job_budget_s=budget,
+                        journal_extra=extra, **walk_knobs)
+                    wall = time.perf_counter() - t_g
+                pass_results[local[0]] = res
+                order_meta.append(_entry(g, wall, res, pass_idx))
+            else:
+                gspecs = tuple((specs[g].order, specs[g].seasonal)
+                               for g in members)
+                fit_fn = functools.partial(
+                    arima.fit_grid, specs=gspecs,
+                    include_intercept=include_intercept,
+                    **dict(fit_kwargs))
+                extra = {"auto_fit": {
+                    "grid_index": members[0], "grid_total": g_total,
+                    "fused_orders": list(members),
+                    "orders": [list(specs[g].order) for g in members],
+                    "seasonals": [None for _ in members],
+                    "criterion": criterion, "stage": "stepwise",
+                    "fuse": len(members), "stepwise_pass": pass_idx,
+                }}
+                label = "+".join(specs[g].label for g in members)
+                with obs.span("auto_fit.order", grid=members[0],
+                              order=label, stage="stepwise",
+                              fused=len(members), sw_pass=pass_idx):
+                    t_g = time.perf_counter()
+                    res = fit_chunked(
+                        fit_fn, values,
+                        checkpoint_dir=_grid_dir(pass_dir, members[0]),
+                        grid=(members[0], g_total, tuple(members)),
+                        job_budget_s=budget,
+                        journal_extra=extra, **walk_knobs)
+                    wall = time.perf_counter() - t_g
+                per = _demux_fused(res, [specs[g] for g in members],
+                                   include_intercept)
+                for pos, (j, g) in enumerate(zip(local, members)):
+                    pass_results[j] = per[pos]
+                    order_meta.append(_entry(g, wall / len(members), res,
+                                             pass_idx, fused_with=members))
+            pass_wall += wall
+        results.extend(pass_results)
+        wall_total += pass_wall
+        sel = select_orders(tuple(specs), results, nv0, criterion=criterion,
+                            include_intercept=include_intercept)
+        order_idx = np.asarray(sel["order_index"])
+        new_rows_won = int(np.sum(order_idx >= base_g))
+        passes_meta.append({
+            "pass": pass_idx,
+            "dir": f"stepwise_{pass_idx:02d}",
+            "orders": list(range(base_g, g_total)),
+            "new_rows_won": new_rows_won,
+            "wall_s": round(pass_wall, 4),
+        })
+        obs.event("auto_fit.stepwise_pass", sw_pass=pass_idx,
+                  orders=g_total - base_g, new_rows_won=new_rows_won)
+        if pass_idx > 0 and new_rows_won == 0:
+            converged = True
+            break
+        # expand around the distinct winning orders: every untried p/q
+        # neighbor, collected in ascending order so global trial indices
+        # are a deterministic function of the journaled results
+        tried = {(s.order, s.seasonal) for s in specs}
+        winner_orders = sorted({specs[int(g)].order
+                                for g in np.unique(order_idx) if g >= 0})
+        cand = []
+        for o in winner_orders:
+            for nb in _stepwise_neighbors(o, max_order):
+                if (nb, None) not in tried:
+                    tried.add((nb, None))
+                    cand.append(nb)
+        cand.sort()
+        frontier = [OrderSpec(o) for o in cand]
+    converged = converged or not frontier
+    order_meta.sort(key=lambda m: m["grid_index"])
+    return (sel, tuple(specs), order_meta, passes_meta, wall_total,
+            tuple(sw_groups), diff_hits, converged)
 
 
 def _write_auto_manifest(checkpoint_dir: str, auto_meta: dict,

@@ -57,6 +57,7 @@ shrunk time axes, or a same-shape prior fitted under a different config.
 
 from __future__ import annotations
 
+import inspect
 import os
 import zipfile
 from typing import List, NamedTuple, Optional
@@ -64,6 +65,7 @@ from typing import List, NamedTuple, Optional
 import numpy as np
 
 from . import source as source_mod
+from ..utils import optim
 from .journal import (JournalError, TornManifestError, chunk_fingerprint,
                       chunk_sample_steps)
 
@@ -124,6 +126,35 @@ class DeltaPlan(NamedTuple):
     prior_config_hash: Optional[str]
 
 
+# probe-and-compact engagement gates (module-level so tests can
+# monkeypatch them): a warm chunk below _PROBE_MIN_ROWS is too small for
+# the two-dispatch overhead to pay off, and a probe below _PROBE_MIN_ITERS
+# would flag healthy warm rows as stragglers
+_PROBE_MIN_ROWS = 64
+_PROBE_MIN_ITERS = 4
+
+
+def _probe_plan(fit_fn, rows: int, kw: dict):
+    """``(full_iters, probe_iters)`` when the probe-and-compact economy
+    can engage for this dispatch, else ``None`` (plain single-dispatch
+    path).  Requires the inner fit to expose ``max_iters`` (with a
+    concrete default — ``functools.partial`` bindings surface here) and
+    ``init_params``, and enough rows/budget for the split to pay."""
+    if rows < _PROBE_MIN_ROWS or "max_iters" in kw:
+        return None
+    try:
+        sig = inspect.signature(fit_fn)
+    except (TypeError, ValueError):
+        return None
+    p_mi = sig.parameters.get("max_iters")
+    if p_mi is None or "init_params" not in sig.parameters:
+        return None
+    full = p_mi.default
+    if not isinstance(full, int) or full < 2 * _PROBE_MIN_ITERS:
+        return None
+    return int(full), max(_PROBE_MIN_ITERS, int(full) // 8)
+
+
 class WarmstartFit:
     """Chunk fit function for a warm-started delta refit.
 
@@ -136,20 +167,43 @@ class WarmstartFit:
     winners refit.  Run with ``resilient=False``: the sanitizer must
     never "repair" init-param columns.
 
+    **Probe-and-compact** (ISSUE 19): a warm start converges most rows
+    in a handful of iterations, but a lockstep batched optimizer still
+    streams the WHOLE panel until its slowest row terminates.  Large
+    dispatches therefore run in two stages: a full-width probe at
+    ``max_iters // 8``, then the straggler rows (still running when the
+    probe budget lapsed) gathered into a ``optim.retry_cap``-aligned
+    sub-batch and refit at the full budget FROM THE ORIGINAL INIT (pad
+    tail drops on scatter).  The composite is *equivalent* to the
+    single full-budget dispatch — identical convergence/status maps,
+    params to optimizer tolerance — but NOT bitwise: the compacted
+    refit is a different compiled program (the ``retry_cap`` shape
+    bucket), and cross-program trajectories are out of scope exactly as
+    on the pallas backends.  What resume leans on instead is
+    DETERMINISM: the same dispatch replays the same bytes.  Pinned by
+    the warm-routing tests; ``compact=False`` forces the exact
+    single-dispatch path.
+
     The instance carries a stable ``__qualname__`` naming the inner fit
     and the column split, so ``journal.config_hash`` hashes the warm
     configuration deterministically across runs (a bare callable's repr
-    would embed a memory address and break resume).
+    would embed a memory address and break resume).  Because compaction
+    changes the bytes a chunk commits, ``compact=False`` is part of the
+    qualname: journals written in one mode must not silently adopt the
+    other's chunks on resume.
     """
 
-    def __init__(self, fit_fn, n_time: int, k: int):
+    def __init__(self, fit_fn, n_time: int, k: int, *, compact: bool = True):
         self.fit_fn = fit_fn
         self.n_time = int(n_time)
         self.k = int(k)
+        self.compact = bool(compact)
         inner = (getattr(fit_fn, "__module__", "?") + "."
                  + getattr(fit_fn, "__qualname__", repr(fit_fn)))
         self.__qualname__ = (f"WarmstartFit({inner}, "
-                             f"n_time={self.n_time}, k={self.k})")
+                             f"n_time={self.n_time}, k={self.k}"
+                             + ("" if self.compact else ", compact=False")
+                             + ")")
 
     def __call__(self, aug, *, align_mode=None, **kw):
         import jax.numpy as jnp
@@ -160,7 +214,38 @@ class WarmstartFit:
         init = jnp.where(jnp.isfinite(init), init, 0.0)
         if align_mode is not None:
             kw["align_mode"] = align_mode
-        return self.fit_fn(y, init_params=init, **kw)
+        plan = (_probe_plan(self.fit_fn, int(y.shape[0]), kw)
+                if self.compact else None)
+        if plan is None:
+            return self.fit_fn(y, init_params=init, **kw)
+        _, probe_iters = plan
+        probe = self.fit_fn(y, init_params=init, max_iters=probe_iters,
+                            **kw)
+        # the straggler set gates the second dispatch — a host decision
+        # by design, exactly like the resilient ladder's retry gather
+        iters = np.asarray(probe.iters)
+        conv = np.asarray(probe.converged)
+        stragglers = np.nonzero((iters >= probe_iters) & ~conv)[0]
+        if stragglers.size == 0:
+            return probe
+        cap = optim.retry_cap(int(stragglers.size))
+        if 2 * cap > int(y.shape[0]):
+            # too many stragglers for the compacted shape to pay: eat the
+            # probe and run the plain full-budget dispatch
+            return self.fit_fn(y, init_params=init, **kw)
+        gi = jnp.asarray(optim.gather_pad_indices(stragglers, cap))
+        sub = self.fit_fn(y[gi], init_params=init[gi], **kw)
+        rows = jnp.asarray(stragglers)
+        n = int(stragglers.size)
+        out = []
+        for field in probe._fields:
+            pv, sv = getattr(probe, field), getattr(sub, field)
+            if pv is None or sv is None:
+                out.append(pv)
+                continue
+            out.append(jnp.asarray(pv).at[rows].set(
+                jnp.asarray(sv)[:n]))
+        return type(probe)(*out)
 
     def __repr__(self):
         return self.__qualname__

@@ -172,6 +172,10 @@ class _FencedFitServer(FitServer):
                 user_hook(event, lo)
 
         super().__init__(root, _commit_hook=fenced_hook, **kwargs)
+        # third fence (ISSUE 19): tenant profiles are warm-start state on
+        # the SHARED root — a zombie's late profile write would poison
+        # the survivor's routing, so it obeys the same token discipline
+        self.profiles.fence = lease.check
 
     def _store_result(self, req_id: str, res) -> None:
         self._fleet_lease.check()

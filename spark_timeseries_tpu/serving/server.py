@@ -67,11 +67,22 @@ from ..reliability.journal import consult_disk_fault, tear_after_replace
 from .session import (CancelledError, FitRequest, FitTicket, RejectedError,
                       ServerClosedError, StorageError, TenantFitResult)
 
-__all__ = ["FORECAST_MODEL", "FitServer"]
+__all__ = ["AUTO_MODEL", "FORECAST_MODEL", "FitServer"]
 
 # registry name of the chunked forecast walk's fit function — forecast
 # requests reference it BY NAME so they survive restarts like model fits
 FORECAST_MODEL = "panel_forecast"
+
+# registry name of the auto order-search workload (ISSUE 19): requests
+# run models.auto.auto_fit per tenant instead of a micro-batched single-
+# order walk, warm-routed through the tenant's durable profile — see
+# _run_auto_request
+AUTO_MODEL = "panel_auto"
+
+# fit_kwargs of an AUTO request that only steer the fit itself (ride to
+# auto_fit / the warm refit); everything routes through config_key so a
+# changed knob re-searches instead of trusting a stale profile
+_AUTO_FIT_KNOBS = ("max_iters", "tol", "backend", "method")
 
 
 def _align_mode_host(values: np.ndarray) -> str:
@@ -109,6 +120,53 @@ def _load_online_advisor() -> Optional[Callable]:
         return mod.advise
     except Exception:  # noqa: BLE001 - advisory only
         return None
+
+
+def _profile_winner_specs(prof: dict) -> list:
+    """Distinct winning ``(p, d, q)`` tuples recorded in a tenant profile
+    (sorted — the drifted route's stepwise seed neighborhood)."""
+    orders = np.asarray(prof["orders"], np.int64).reshape(-1, 3)
+    idx = np.asarray(prof["order_index"], np.int64)
+    seen = {tuple(int(v) for v in orders[g]) for g in idx if g >= 0}
+    return sorted(seen)
+
+
+def _auto_result(req: FitRequest, route: str, *, stability, orders,
+                 order_index, criterion, params, nll, converged, iters,
+                 status, criterion_name, include_intercept,
+                 selection_counts, stepwise) -> TenantFitResult:
+    """Assemble the AUTO_MODEL :class:`TenantFitResult` — one meta shape
+    for all three route legs, so clients and the failover smoke compare
+    results without caring which leg produced them."""
+    from ..reliability.status import status_counts
+
+    status = np.asarray(status, np.int8)
+    meta = {
+        "model": AUTO_MODEL,
+        "req_id": req.req_id,
+        "tenant": req.tenant,
+        "status_counts": status_counts(status),
+        "auto": {
+            "route": str(route),
+            "stability": int(stability),
+            "orders": [[int(v) for v in o]
+                       for o in np.asarray(orders).reshape(-1, 3)],
+            "order_index": [int(v) for v in np.asarray(order_index)],
+            "criterion": [float(v) for v in np.asarray(criterion, float)],
+            "criterion_name": str(criterion_name),
+            "include_intercept": bool(include_intercept),
+            "selection_counts": dict(selection_counts),
+        },
+    }
+    if stepwise is not None:
+        meta["auto"]["stepwise"] = stepwise
+    return TenantFitResult(
+        params=np.asarray(params),
+        neg_log_likelihood=np.asarray(nll),
+        converged=np.asarray(converged, bool),
+        iters=np.asarray(iters, np.int32),
+        status=status,
+        meta=meta)
 
 
 class FitServer:
@@ -160,6 +218,7 @@ class FitServer:
                  default_deadline_s: Optional[float] = None,
                  resilient: bool = False,
                  policy: str = "impute",
+                 warm_routing: bool = True,
                  autotune: bool = True,
                  prom_path: Optional[str] = None,
                  prom_interval_s: float = 2.0,
@@ -171,8 +230,18 @@ class FitServer:
         self._requests_dir = os.path.join(self.root, "requests")
         self._results_dir = os.path.join(self.root, "results")
         self._batches_dir = os.path.join(self.root, "batches")
+        # per-request auto-search journals: <root>/auto/<req_id>/ — a
+        # deterministic dir, so a recovered AUTO request resumes its
+        # own stepwise/grid journals mid-walk
+        self._auto_dir = os.path.join(self.root, "auto")
         for d in (self._requests_dir, self._results_dir, self._batches_dir):
             os.makedirs(d, exist_ok=True)
+        from .profiles import TenantProfileStore
+
+        # tenant profiles on the (possibly fleet-shared) root; the fleet's
+        # fenced server subclass points .fence at its lease check
+        self.profiles = TenantProfileStore(
+            os.path.join(self.root, "profiles"))
         self._models = dict(models or {})
         self.batch_window_s = float(batch_window_s)
         self.max_batch_rows = int(max_batch_rows)
@@ -180,6 +249,7 @@ class FitServer:
         self.default_deadline_s = default_deadline_s
         self.resilient = bool(resilient)
         self.policy = str(policy)
+        self.warm_routing = bool(warm_routing)
         self.autotune = bool(autotune)
         self.degraded_window_s = float(degraded_window_s)
         self.walk_kwargs = dict(walk_kwargs or {})
@@ -242,6 +312,8 @@ class FitServer:
             "rows_fitted": 0, "recovered_requests": 0,
             "recovered_batches": 0, "autotune_updates": 0,
             "storage_errors": 0, "torn_results": 0,
+            "auto_requests": 0, "route_stable": 0, "route_drifted": 0,
+            "route_new": 0, "route_cold": 0, "profile_updates": 0,
         }
         self._counters_lock = threading.Lock()
 
@@ -298,7 +370,9 @@ class FitServer:
 
     def submit(self, tenant: str, values, model: Union[str, Callable] = "arima",
                *, priority: int = 0, deadline_s: Optional[float] = None,
-               request_id: Optional[str] = None, **fit_kwargs) -> FitTicket:
+               request_id: Optional[str] = None,
+               warm_routing: Optional[bool] = None,
+               **fit_kwargs) -> FitTicket:
         """Admit one tenant panel fit; returns a :class:`FitTicket`.
 
         ``values`` is a host ``[rows, T]`` array (copied to the durable
@@ -311,11 +385,28 @@ class FitServer:
         idempotent: re-submitting a completed id returns its stored
         result instantly.
 
+        ``model="panel_auto"`` runs a per-tenant order SEARCH
+        (``models.auto.auto_fit``) instead of a micro-batched
+        single-order walk: remaining ``fit_kwargs`` ride to ``auto_fit``
+        (``orders``, ``stepwise``, ``criterion``, ...), and
+        ``warm_routing`` selects the routing mode — ``True`` classifies
+        the panel against the tenant's durable profile (stable submits
+        skip stage 1 entirely), ``False`` is EXACT mode (bitwise the
+        plain exhaustive search, no profile reads), ``None`` (default)
+        uses the server's ``warm_routing`` setting.  The knob rides the
+        durable request record, so recovery re-routes identically.
+
         Raises :class:`RejectedError` (queue full / quota — carries
         ``retry_after_s``) or :class:`ServerClosedError`.
         """
         if self._state in ("draining", "stopping", "stopped", "crashed"):
             raise ServerClosedError(f"server is {self._state}")
+        if warm_routing is not None:
+            if model != AUTO_MODEL:
+                raise ValueError(
+                    "warm_routing only applies to model="
+                    f"{AUTO_MODEL!r} submits, got model={model!r}")
+            fit_kwargs["warm_routing"] = bool(warm_routing)
         if callable(model):
             name = next((k for k, v in self._models.items() if v is model),
                         None)
@@ -674,6 +765,16 @@ class FitServer:
             ready.append(req)
         if not ready:
             return
+        if ready[0].model == AUTO_MODEL:
+            # AUTO requests never micro-batch: each is a whole SEARCH
+            # (per-tenant result layouts differ by winning order), run
+            # solo under its own deterministic journal dir — the durable
+            # request record plus journal resume is its crash recovery,
+            # no batch membership record needed (batch_key groups only
+            # same-model requests, so a mixed `ready` cannot occur)
+            for req in ready:
+                self._run_auto_request(req)
+            return
         self._batch_seq += 1
         knobs = dict(self._knobs)
         batch = batcher.pack(ready, self._batch_seq,
@@ -790,6 +891,241 @@ class FitServer:
                 req.ticket._reject(e)
                 continue
             self._deliver(solo, res)
+
+    # -- the auto order search (ISSUE 19) ------------------------------------
+
+    def _run_auto_request(self, req: FitRequest) -> None:
+        """One tenant's auto-fit search, warm-routed through its durable
+        profile.
+
+        The ladder: **cold** (``warm_routing=False`` — exact mode, the
+        plain search with no profile reads, bitwise today's behavior),
+        **stable** (fingerprint/config match — skip stage 1 entirely: a
+        warm-started refit of each row's known winning order), **drifted**
+        (content moved — stepwise expansion seeded from the profile's
+        winners), **new** (full stepwise).  The decision lands on the
+        request's trace (``server.route``) and in the result meta; the
+        profile update after completion is FENCED on a fleet root, so a
+        zombie primary dies loudly instead of clobbering warm state.
+        """
+        from ..reliability.journal import FencedError
+        from . import profiles as profiles_mod
+
+        fk = dict(req.fit_kwargs)
+        warm = bool(fk.pop("warm_routing", self.warm_routing))
+        cfg_key = profiles_mod.config_key(fk)
+        route, prof = "cold", None
+        if warm:
+            route, prof = self.profiles.classify(req.tenant, req.values,
+                                                 cfg_key)
+        stability = int(prof.get("stability", 0)) if prof else 0
+        with self._counters_lock:
+            self.counters["auto_requests"] += 1
+            self.counters[f"route_{route}"] += 1
+        obs.counter(f"server.route_{route}").inc()
+        t0 = time.perf_counter()
+        try:
+            with obs.trace_scope(
+                    obs.trace_for_request(req.req_id, "server")):
+                # the routing decision is a first-class hop on the
+                # request's causal timeline — obs_report --trace renders
+                # the attrs, and the fleet smoke asserts a takeover
+                # continues warm from the dead primary's profile
+                obs.event("server.route", req_id=req.req_id,
+                          tenant=req.tenant, route=route, warm=warm,
+                          stability=stability)
+                with obs.span("server.route", req_id=req.req_id,
+                              tenant=req.tenant, route=route,
+                              stability=stability):
+                    if route == "stable":
+                        tres = self._auto_warm_refit(req, prof, fk)
+                    else:
+                        tres = self._auto_search(req, fk, route, prof)
+        except FencedError:
+            # zombie primary: the fencing contract says die loudly — the
+            # serve loop's crash path rejects live tickets and the
+            # surviving primary re-answers from the durable records
+            raise
+        except Exception as e:  # noqa: BLE001 - per-request terminal
+            with self._counters_lock:
+                self.counters["batch_failures"] += 1
+            self._note_degraded()
+            obs.event("server.auto_failed", req_id=req.req_id,
+                      route=route, error=repr(e)[:200])
+            self._forget(req)
+            req.ticket._reject(e)
+            return
+        wall = time.perf_counter() - t0
+        with self._counters_lock:
+            self.counters["rows_fitted"] += req.rows
+        obs.counter("server.rows_fitted").add(req.rows)
+        self._finalize(req, tres)
+        if warm:
+            # AFTER the result is durable: the profile is warm-start
+            # state, so losing an update costs the next pass a search,
+            # never an answer.  The write is fenced (FencedError
+            # propagates — see above); a refused disk degrades to a cold
+            # next pass.
+            try:
+                self._update_profile(req, tres, cfg_key, route)
+                with self._counters_lock:
+                    self.counters["profile_updates"] += 1
+                obs.counter("server.profile_updates").inc()
+            except FencedError:
+                raise
+            except OSError as e:
+                with self._counters_lock:
+                    self.counters["storage_errors"] += 1
+                obs.event("server.profile_refused", req_id=req.req_id,
+                          error=repr(e)[:200])
+        self.queue.record_drain(req.rows, wall)
+        self._write_server_state()
+        self._write_prom()
+
+    def _auto_search(self, req: FitRequest, fk: dict, route: str,
+                     prof) -> TenantFitResult:
+        """The search leg of the ladder: exhaustive for exact/cold mode
+        (bitwise the direct ``auto_fit`` call), stepwise for new tenants,
+        stepwise seeded from the profile's distinct winners for drifted
+        ones.  Journals under ``<root>/auto/<req_id>/`` — deterministic,
+        so a recovered request resumes mid-search."""
+        from ..models import auto as auto_mod
+
+        kw = dict(fk)
+        if route == "new":
+            # default to the stepwise economy unless the caller pinned
+            # the mode or passed a seasonal grid (stepwise is (p, d, q)
+            # only — seasonal grids keep the exhaustive sweep)
+            seasonal = any(len(tuple(o)) == 4
+                           for o in (kw.get("orders") or ()))
+            if not seasonal:
+                kw.setdefault("stepwise", True)
+        elif route == "drifted":
+            seeds = _profile_winner_specs(prof)
+            if seeds:
+                kw["stepwise"] = True
+                kw["orders"] = seeds
+            else:
+                kw.setdefault("stepwise", True)
+        if kw.get("stepwise"):
+            # the seed neighborhood must fit under the expansion cap —
+            # profile winners (or caller seeds) can sit at the cap edge
+            span = max((max(o[0], o[2]) for o in
+                        (kw.get("orders") or ((0, 0, 0),))), default=0)
+            kw["stepwise_max_order"] = max(
+                int(kw.get("stepwise_max_order", 3)), int(span))
+        kw.setdefault("chunk_rows", self._knobs["cell_rows"])
+        kw.setdefault("resilient", req.resilient)
+        kw.setdefault("policy", req.policy)
+        kw.setdefault("align_mode", req.align_mode)
+        res = auto_mod.auto_fit(
+            req.values,
+            checkpoint_dir=os.path.join(self._auto_dir, req.req_id),
+            job_budget_s=req.remaining_s(),
+            _journal_commit_hook=self._commit_hook, **kw)
+        return _auto_result(req, route,
+                            stability=(int(prof.get("stability", 0))
+                                       if prof else 0),
+                            orders=[list(s.order) for s in res.orders],
+                            order_index=res.order_index,
+                            criterion=res.criterion,
+                            params=res.params,
+                            nll=res.neg_log_likelihood,
+                            converged=res.converged, iters=res.iters,
+                            status=res.status,
+                            criterion_name=kw.get("criterion", "aicc"),
+                            include_intercept=kw.get("include_intercept",
+                                                     True),
+                            selection_counts=res.meta["auto_fit"]
+                            ["selection_counts"],
+                            stepwise=res.meta["auto_fit"].get("stepwise"))
+
+    def _auto_warm_refit(self, req: FitRequest, prof: dict,
+                         fk: dict) -> TenantFitResult:
+        """The stable leg: skip stage 1 entirely — refit each row's KNOWN
+        winning order, warm-started from the profile's params
+        (``reliability.delta.WarmstartFit``, one compacted dispatch per
+        winning-order basin).  Deterministic in (panel, profile), so a
+        takeover re-answers it bitwise from the shared root."""
+        import functools as _ft
+
+        import jax.numpy as jnp
+
+        from ..models import arima as arima_mod
+        from ..models import auto as auto_mod
+        from ..reliability import delta as delta_mod
+
+        y = np.asarray(req.values)
+        b, t = y.shape
+        orders = np.asarray(prof["orders"], np.int32).reshape(-1, 3)
+        order_index = np.asarray(prof["order_index"], np.int32)
+        p_params = np.asarray(prof["params"])
+        include_intercept = bool(fk.get("include_intercept", True))
+        criterion = str(fk.get("criterion", "aicc"))
+        fit_kw = {k: fk[k] for k in _AUTO_FIT_KNOBS
+                  if fk.get(k) is not None}
+        nv0 = auto_mod.panel_n_valid(y)
+        dtype = p_params.dtype if p_params.dtype.kind == "f" else y.dtype
+        out_params = np.full((b, p_params.shape[1]), np.nan, dtype)
+        out_nll = np.full(b, np.nan, dtype)
+        out_conv = np.zeros(b, bool)
+        out_iters = np.zeros(b, np.int32)
+        # rows no candidate ever fit keep the profile's recorded status
+        out_status = np.asarray(prof["status"], np.int8).copy()
+        out_crit = np.full(b, np.nan, dtype)
+        for g in sorted(int(v) for v in np.unique(order_index) if v >= 0):
+            rows = np.nonzero(order_index == g)[0]
+            spec = auto_mod.OrderSpec(tuple(int(v) for v in orders[g]))
+            k = spec.n_params(include_intercept)
+            init = p_params[rows, :k].astype(y.dtype, copy=False)
+            aug = np.concatenate([y[rows], init], axis=1)
+            fit_fn = _ft.partial(
+                arima_mod.fit, order=spec.order,
+                include_intercept=include_intercept, **fit_kw)
+            wf = delta_mod.WarmstartFit(fit_fn, n_time=t, k=k)
+            with obs.span("server.warm_basin", order=spec.label,
+                          rows=int(rows.size)):
+                r = wf(aug, align_mode=req.align_mode)
+            out_params[rows, :k] = np.asarray(r.params)[:, :k]
+            out_nll[rows] = np.asarray(r.neg_log_likelihood)
+            out_conv[rows] = np.asarray(r.converged)
+            out_iters[rows] = np.asarray(r.iters, np.int32)
+            out_status[rows] = np.asarray(r.status, np.int8)
+            p_full, _, d_full = spec.lag_span()
+            crit = np.asarray(auto_mod._criterion_one(
+                jnp.asarray(out_nll[rows]),
+                jnp.asarray(np.asarray(nv0)[rows].astype(out_nll.dtype)),
+                k, p_full, d_full, criterion))
+            out_crit[rows] = np.where(np.isfinite(crit), crit, np.nan)
+        counts = {auto_mod.OrderSpec(tuple(int(v) for v in o)).label:
+                  int(np.sum(order_index == g))
+                  for g, o in enumerate(orders)}
+        counts["none"] = int(np.sum(order_index < 0))
+        return _auto_result(req, "stable",
+                            stability=int(prof.get("stability", 0)),
+                            orders=orders.tolist(),
+                            order_index=order_index,
+                            criterion=out_crit, params=out_params,
+                            nll=out_nll, converged=out_conv,
+                            iters=out_iters, status=out_status,
+                            criterion_name=criterion,
+                            include_intercept=include_intercept,
+                            selection_counts=counts, stepwise=None)
+
+    def _update_profile(self, req: FitRequest, tres: TenantFitResult,
+                        cfg_key: str, route: str) -> None:
+        a = tres.meta.get("auto") or {}
+        self.profiles.update(
+            req.tenant, values=req.values,
+            orders=a["orders"],
+            order_index=np.asarray(a["order_index"], np.int32),
+            params=np.asarray(tres.params),
+            criterion=np.asarray(a["criterion"], float),
+            status=np.asarray(tres.status, np.int8),
+            cfg_key=cfg_key,
+            criterion_name=str(a.get("criterion_name", "aicc")),
+            include_intercept=bool(a.get("include_intercept", True)),
+            route=route)
 
     def _finalize(self, req: FitRequest, tres: TenantFitResult) -> None:
         self._store_result(req.req_id, tres)
@@ -1011,6 +1347,13 @@ class FitServer:
             from ..forecasting import walk as _fwalk
 
             return _fwalk.forecast_fit
+        if model == AUTO_MODEL:
+            # the auto order search: resolvable at the door like any
+            # model, but executed per request by _run_auto_request (the
+            # serve loop intercepts AUTO batches before packing)
+            from ..models import auto as _auto
+
+            return _auto.auto_fit
         from .. import models as _models
 
         mod = getattr(_models, model, None)

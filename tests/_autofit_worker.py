@@ -12,8 +12,17 @@ chunks and produce a selection bitwise-identical to an uninterrupted
 fused search: the acceptance smoke both ``ci.sh`` and the slow-marked
 ``tests/test_auto.py`` subprocess test run.
 
+The STEPWISE variant (ISSUE 19) runs the same orchestration against the
+stepwise Hyndman–Khandakar search: pass 0 fits the four-order seed
+neighborhood (two fused same-``d`` walks, 3 chunks each), the expansion
+pass opens ``stepwise_01`` — and the kill lands MID-EXPANSION, with pass
+0 fully durable and the expansion walk torn.  The resumed search must
+replay the completed passes from their journals, recompute the IDENTICAL
+expansion, finish the torn walk, and select bitwise vs an uninterrupted
+stepwise run.
+
 Modes:
-    --run --dir D [--kill-after N] [--out F]
+    --run --dir D [--kill-after N] [--out F] [--stepwise]
         one journaled auto_fit; with --kill-after the process dies
         mid-run (exit by SIGKILL), else the selection is saved to F.
     --smoke
@@ -21,6 +30,10 @@ Modes:
         verify the torn fused journal, resume, compare bitwise against
         an uninterrupted fused search, validate the auto manifest with
         tools/obs_report.py, and print PASS.
+    --stepwise-smoke
+        same orchestration for the stepwise search: kill a child after 8
+        commits (MID-EXPANSION), verify pass 0 durable + the expansion
+        torn, resume, compare bitwise, validate, print PASS.
 """
 
 from __future__ import annotations
@@ -54,17 +67,22 @@ def make_panel() -> np.ndarray:
     return y
 
 
-def run_search(directory: str, kill_after: int | None, out: str | None
-               ) -> None:
+def run_search(directory: str, kill_after: int | None, out: str | None,
+               stepwise: bool = False) -> None:
     from spark_timeseries_tpu.models import auto
     from spark_timeseries_tpu.reliability import faultinject as fi
 
     hook = None
     if kill_after is not None:
         hook = fi.kill_after_commits(kill_after)
+    if stepwise:
+        grid_kw = dict(stepwise=True, stepwise_max_passes=3,
+                       stepwise_max_order=2)
+    else:
+        grid_kw = dict(orders=ORDERS)
     res = auto.auto_fit(
-        make_panel(), ORDERS, chunk_rows=CHUNK_ROWS, max_iters=20,
-        checkpoint_dir=directory, _journal_commit_hook=hook,
+        make_panel(), chunk_rows=CHUNK_ROWS, max_iters=20,
+        checkpoint_dir=directory, _journal_commit_hook=hook, **grid_kw,
     )
     if kill_after is not None:
         sys.exit(f"kill_after={kill_after} but the search finished — the "
@@ -74,8 +92,12 @@ def run_search(directory: str, kill_after: int | None, out: str | None
                  converged=res.converged, iters=res.iters,
                  status=res.status, order_index=res.order_index,
                  criterion=res.criterion,
+                 orders=np.asarray([s.order for s in res.orders],
+                                   np.int64),
                  counts=json.dumps(
-                     res.meta["auto_fit"]["selection_counts"]))
+                     res.meta["auto_fit"]["selection_counts"]),
+                 stepwise=json.dumps(
+                     res.meta["auto_fit"].get("stepwise")))
 
 
 def _child(args: list) -> subprocess.CompletedProcess:
@@ -168,20 +190,113 @@ def smoke() -> None:
               "histogram stable, manifests validate)")
 
 
+def _committed(manifest_path: str) -> int:
+    m = json.load(open(manifest_path))
+    return len([c for c in m["chunks"] if c["status"] == "committed"])
+
+
+def stepwise_smoke() -> None:
+    with tempfile.TemporaryDirectory() as td:
+        jdir = os.path.join(td, "search")
+        # 1. child SIGKILLed after 8 chunk commits: pass 0 (the 4-order
+        # seed neighborhood — two fused same-d walks of 3 chunks each, 6
+        # commits) is fully durable, and the kill lands MID-EXPANSION
+        # with pass 1's walk torn at 2 of its 3 chunks
+        r = _child(["--run", "--stepwise", "--dir", jdir,
+                    "--kill-after", "8"])
+        if r.returncode != -9:
+            sys.exit(f"expected SIGKILL (-9), got rc={r.returncode}\n"
+                     f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}")
+        p0 = os.path.join(jdir, "stepwise_00")
+        p0_grids = sorted(d for d in os.listdir(p0)
+                          if d.startswith("grid_"))
+        if p0_grids != ["grid_00000", "grid_00002"]:
+            sys.exit(f"pass 0 should hold the two fused seed walks, got "
+                     f"{p0_grids}")
+        for g in p0_grids:
+            n = _committed(os.path.join(p0, g, "manifest.json"))
+            if n != 3:
+                sys.exit(f"pass 0 {g} should be fully durable, got "
+                         f"{n} committed chunks")
+        p1 = os.path.join(jdir, "stepwise_01")
+        if not os.path.isdir(p1):
+            sys.exit("the kill should land inside the expansion pass")
+        torn = sum(_committed(os.path.join(p1, g, "manifest.json"))
+                   for g in os.listdir(p1) if g.startswith("grid_"))
+        if torn != 2:
+            sys.exit(f"expansion pass should be torn at 2 committed "
+                     f"chunks, got {torn}")
+        if os.path.exists(os.path.join(jdir, "auto_manifest.json")):
+            sys.exit("auto manifest should only be written after selection")
+        # 2. resume: completed passes replay from their journals, the
+        # expansion is recomputed identically, the torn walk finishes
+        resumed_out = os.path.join(td, "resumed.npz")
+        r = _child(["--run", "--stepwise", "--dir", jdir, "--out",
+                    resumed_out])
+        if r.returncode != 0:
+            sys.exit(f"resume failed rc={r.returncode}\nstderr:\n{r.stderr}")
+        # 3. uninterrupted stepwise reference in a fresh directory
+        full_out = os.path.join(td, "full.npz")
+        r = _child(["--run", "--stepwise", "--dir",
+                    os.path.join(td, "fresh"), "--out", full_out])
+        if r.returncode != 0:
+            sys.exit(f"reference run failed rc={r.returncode}\n{r.stderr}")
+        a, b = np.load(resumed_out), np.load(full_out)
+        for k in FIELDS + ("orders",):
+            if not np.array_equal(a[k], b[k], equal_nan=True):
+                sys.exit(f"resumed stepwise search differs from "
+                         f"uninterrupted on {k!r} — mid-expansion resume "
+                         "is NOT bitwise-identical")
+        if json.loads(str(a["counts"])) != json.loads(str(b["counts"])):
+            sys.exit("selection histograms differ")
+        def _norm_sw(raw):
+            # per-pass wall_s is a wall-clock measurement: drop it before
+            # demanding the decision record be identical
+            s = json.loads(str(raw))
+            for p in s["passes"]:
+                p.pop("wall_s", None)
+            return s
+
+        sa = _norm_sw(a["stepwise"])
+        if sa != _norm_sw(b["stepwise"]):
+            sys.exit("stepwise pass manifests differ across the resume")
+        cat = [g for p in sa["passes"] for g in p["orders"]]
+        if cat != list(range(len(a["orders"]))):
+            sys.exit(f"stepwise passes do not partition the trial walk: "
+                     f"{cat}")
+        # 4. the tools gate the resumed search's manifests
+        sys.path.insert(0, os.path.join(ROOT, "tools"))
+        import obs_report
+
+        errs = [e for e in obs_report.validate_auto_manifest(jdir)
+                if "no telemetry block" not in e]
+        if errs:
+            sys.exit(f"auto manifest failed validation: {errs}")
+        print("stepwise kill-and-resume smoke: PASS "
+              "(SIGKILL MID-EXPANSION after 8 commits — seed pass "
+              "durable, expansion walk torn — resumed search recomputed "
+              "the identical expansion and selected bitwise vs the "
+              "uninterrupted stepwise run, manifests validate)")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--run", action="store_true")
     ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--stepwise-smoke", action="store_true")
+    ap.add_argument("--stepwise", action="store_true")
     ap.add_argument("--dir")
     ap.add_argument("--kill-after", type=int, default=None)
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     if args.smoke:
         smoke()
+    elif args.stepwise_smoke:
+        stepwise_smoke()
     elif args.run:
-        run_search(args.dir, args.kill_after, args.out)
+        run_search(args.dir, args.kill_after, args.out, args.stepwise)
     else:
-        ap.error("pass --run or --smoke")
+        ap.error("pass --run, --smoke, or --stepwise-smoke")
 
 
 if __name__ == "__main__":

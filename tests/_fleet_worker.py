@@ -34,6 +34,10 @@ Modes:
         run one replica until ``<root>/stop_<owner>`` appears.
     --smoke
         full orchestration (used by ci.sh); prints PASS.
+    --warm-smoke
+        warm-routing failover orchestration (ISSUE 19, used by ci.sh):
+        a tenant's auto-fit profile on the shared root keeps the tenant
+        warm across a primary SIGKILL; prints PASS.
 """
 
 from __future__ import annotations
@@ -328,10 +332,163 @@ def smoke() -> None:
               f"{counters})")
 
 
+AUTO_KW = dict(max_iters=25, stepwise_max_passes=2, stepwise_max_order=1)
+
+
+def warm_smoke() -> None:
+    """Warm-routing failover smoke (ISSUE 19): the fleet stays WARM
+    across a primary SIGKILL because tenant profiles live on the shared
+    root, not in the process —
+
+    - pass 1 through the fleet routes ``new`` (full stepwise search) on
+      the primary and lands the tenant's durable profile;
+    - the primary is SIGKILLed for real; the standby takes the lease;
+    - the SAME tenant's identical resubmit through the survivor routes
+      ``stable`` off the dead primary's profile (stage 1 skipped
+      entirely) and selects the SAME per-row winning orders — the
+      selection survives the failover bitwise — with the routing
+      decision on the survivor's trace stream;
+    - a stale-token holder (the dead primary's fencing token) is REFUSED
+      the profile write path: ``FencedError`` BEFORE bytes land, so the
+      zombie cannot clobber the survivor's warm state.
+    """
+    from spark_timeseries_tpu.reliability.journal import (FencedError,
+                                                          Lease, read_lease)
+    from spark_timeseries_tpu.serving.client import FitClient
+    from spark_timeseries_tpu.serving.fleet import discover_endpoints
+    from spark_timeseries_tpu.serving.profiles import TenantProfileStore
+
+    rng = np.random.default_rng(31)
+    e = rng.normal(size=(CELL, T)).astype(np.float32)
+    y = np.zeros_like(e)
+    y[:, 0] = e[:, 0]
+    for i in range(1, T):
+        y[:, i] = 0.6 * y[:, i - 1] + e[:, i]
+
+    with tempfile.TemporaryDirectory() as td:
+        root = os.path.join(td, "fleet")
+        os.makedirs(root)
+        # standby-readable by design: the orchestrator watches the shared
+        # profile dir without any lease, like tools/advise_budget does
+        profiles = TenantProfileStore(os.path.join(root, "profiles"))
+
+        # 1. primary a + standby b on one shared root
+        a = _spawn_replica(root, "a")
+        _wait_lease_owner(root, "a")
+        b = _spawn_replica(root, "b")
+        tok_a = read_lease(root)["token"]
+
+        eps = discover_endpoints(root)
+        if len(eps) < 2:
+            time.sleep(1.0)
+            eps = discover_endpoints(root)
+        cli = FitClient(eps, seed=19, deadline_s=600.0, backoff_base_s=0.05)
+
+        # 2. pass 1: the tenant is NEW — full stepwise search on the
+        #    primary; wait for the fenced profile write to land durably
+        #    (it follows the result store, so the ticket resolving does
+        #    not yet prove the profile is on disk)
+        r1 = cli.submit("acme", y, "panel_auto", request_id="warm-1",
+                        warm_routing=True, **AUTO_KW).result(timeout=600)
+        if r1.meta["auto"]["route"] != "new":
+            sys.exit(f"pass 1 should route 'new', got "
+                     f"{r1.meta['auto']['route']!r}")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if profiles.load("acme") is not None:
+                break
+            time.sleep(0.05)
+        else:
+            sys.exit("pass 1's profile never landed on the shared root")
+
+        # 3. SIGKILL the primary; the standby takes the lease over
+        a.kill()
+        a.communicate(timeout=600)
+        if a.returncode != -9:
+            sys.exit(f"expected replica a SIGKILLed (-9), got "
+                     f"rc={a.returncode}")
+        _wait_lease_owner(root, "b")
+
+        # 4. failover continues WARM: the identical resubmit through the
+        #    survivor classifies stable off the dead primary's profile
+        #    and keeps every row's winning order
+        r2 = cli.submit("acme", y, "panel_auto", request_id="warm-2",
+                        warm_routing=True, **AUTO_KW).result(timeout=600)
+        cli.close()
+        a1, a2 = r1.meta["auto"], r2.meta["auto"]
+        if a2["route"] != "stable":
+            sys.exit(f"post-failover resubmit should route 'stable' off "
+                     f"the shared profile, got {a2['route']!r}")
+        w1 = [a1["orders"][g] if g >= 0 else [-1, -1, -1]
+              for g in a1["order_index"]]
+        w2 = [a2["orders"][g] if g >= 0 else [-1, -1, -1]
+              for g in a2["order_index"]]
+        if w1 != w2:
+            sys.exit(f"per-row winning orders changed across the "
+                     f"failover: {w1} vs {w2}")
+
+        # 5. the routing decision is on the SURVIVOR's trace stream
+        routed = False
+        with open(os.path.join(root, "obs_b.jsonl")) as fh:
+            for line in fh:
+                ev = json.loads(line)
+                if (ev.get("name") == "server.route"
+                        and (ev.get("attrs") or {}).get("route")
+                        == "stable"):
+                    routed = True
+        if not routed:
+            sys.exit("survivor b never traced a server.route "
+                     "route=stable event")
+
+        # 6. the dead primary's token is a ZOMBIE: its profile write is
+        #    refused before bytes land, and the survivor's warm state is
+        #    byte-identical after the attempt
+        prof_path = profiles.path("acme")
+        with open(prof_path, "rb") as fh:
+            before = fh.read()
+        stale = Lease(root, "a", tok_a, TTL_S)
+        zombie = TenantProfileStore(os.path.join(root, "profiles"),
+                                    fence=stale.check)
+        try:
+            zombie.update(
+                "acme", values=y, orders=a2["orders"],
+                order_index=np.asarray(a2["order_index"], np.int32),
+                params=np.asarray(r2.params),
+                criterion=np.asarray(a2["criterion"], float),
+                status=np.asarray(r2.status, np.int8),
+                cfg_key="poison", criterion_name="aicc",
+                include_intercept=True, route="stable")
+        except FencedError:
+            pass
+        else:
+            sys.exit("stale-token profile write was NOT fenced")
+        with open(prof_path, "rb") as fh:
+            after = fh.read()
+        if after != before:
+            sys.exit("fenced profile write still changed bytes on disk")
+
+        # 7. orderly shutdown of the survivor
+        open(os.path.join(root, "stop_b"), "w").close()
+        b_out, b_err = b.communicate(timeout=600)
+        if b.returncode != 0:
+            sys.exit(f"replica b failed: rc={b.returncode}\n{b_out}\n"
+                     f"{b_err}")
+
+        prof = profiles.load("acme")
+        print("fleet warm-routing smoke: PASS "
+              "(pass 1 routed new on the primary, primary SIGKILLed, "
+              "survivor classified the identical resubmit stable off the "
+              "shared durable profile with bitwise-equal winning orders, "
+              "stale-token profile write fenced before bytes landed; "
+              f"profile passes={prof['passes']} "
+              f"stability={prof['stability']})")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--replica", action="store_true")
     ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--warm-smoke", action="store_true")
     ap.add_argument("--root")
     ap.add_argument("--owner")
     ap.add_argument("--ttl", type=float, default=TTL_S)
@@ -341,6 +498,8 @@ def main():
     args = ap.parse_args()
     if args.smoke:
         return smoke()
+    if args.warm_smoke:
+        return warm_smoke()
     if not args.replica or not args.root or not args.owner:
         ap.error("need --replica --root R --owner X, or --smoke")
     replica(args.root, args.owner, args.ttl, args.kill_commits,

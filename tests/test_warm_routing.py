@@ -1,0 +1,483 @@
+"""Warm per-tenant auto-fit (ISSUE 19): durable tenant profiles +
+stepwise Hyndman–Khandakar search.
+
+Covers the acceptance contracts:
+- the stepwise search agrees BITWISE with an exhaustive sweep over the
+  neighborhood it visited (selection, scores, criterion) — the economy
+  changes which orders are tried, never what a tried order scores;
+- stepwise passes are journaled per pass and a crash MID-EXPANSION
+  resumes bitwise (a real-SIGKILL variant lives in
+  ``tests/_autofit_worker.py --stepwise-smoke``, run by ci.sh and the
+  slow-marked subprocess test here);
+- the stepwise block of ``auto_manifest.json`` passes the obs_report
+  schema gate, and a scrambled pass partition is caught;
+- :class:`serving.TenantProfileStore` classifies repeat submits
+  stable / drifted / new, counts stability in grid-independent order
+  tuples, and REFUSES fenced writes before bytes land;
+- the server's routing ladder: new -> stable -> drifted, exact mode
+  (``warm_routing=False``) bitwise the plain ``auto_fit`` call, and the
+  profile surviving a server restart on the same root;
+- ``WarmstartFit`` probe-and-compact is deterministic and equivalent to
+  the single full-budget dispatch — identical convergence/status maps,
+  params to optimizer tolerance; bitwise is out of scope across the two
+  compiled programs, and the two modes carry DISTINCT journal
+  identities (ISSUE 19 satellite).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu import serving
+from spark_timeseries_tpu.models import arima, auto
+from spark_timeseries_tpu.reliability import delta as delta_mod
+from spark_timeseries_tpu.reliability import faultinject as fi
+from spark_timeseries_tpu.reliability.journal import FencedError
+from spark_timeseries_tpu.serving.profiles import (TenantProfileStore,
+                                                   config_key)
+from spark_timeseries_tpu.serving.server import _align_mode_host
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+sys.path.insert(0, TOOLS)
+
+FIELDS = ("params", "neg_log_likelihood", "converged", "iters", "status",
+          "order_index", "criterion")
+
+# one shape + budget for every search in this file, so the per-order
+# programs compile once per pytest process
+SW_KW = dict(chunk_rows=8, max_iters=20)
+
+
+def _eq(a, b):
+    a = np.asarray(a)
+    return np.array_equal(a, np.asarray(b), equal_nan=a.dtype.kind == "f")
+
+
+def assert_results_equal(r1, r2, fields=FIELDS):
+    for f in fields:
+        assert _eq(getattr(r1, f), getattr(r2, f)), f
+
+
+def make_ar_panel(b=16, t=96, seed=5, phi=0.6):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(b, t)).astype(np.float32)
+    y = np.zeros_like(e)
+    for i in range(1, t):
+        y[:, i] = phi * y[:, i - 1] + e[:, i]
+    return y
+
+
+@pytest.fixture(scope="module")
+def stepwise_run(tmp_path_factory):
+    """One journaled stepwise search shared by the agreement, manifest,
+    and resume tests (it doubles as the uninterrupted reference)."""
+    d = tmp_path_factory.mktemp("sw") / "search"
+    y = make_ar_panel()
+    res = auto.auto_fit(y, stepwise=True, stepwise_max_passes=3,
+                        stepwise_max_order=2, checkpoint_dir=str(d),
+                        **SW_KW)
+    return y, res, str(d)
+
+
+# ---------------------------------------------------------------------------
+# stepwise search (models/auto.py)
+# ---------------------------------------------------------------------------
+
+
+class TestStepwise:
+    def test_agreement_with_exhaustive_over_visited_neighborhood(
+            self, stepwise_run):
+        # THE pinned agreement contract: an exhaustive sweep over exactly
+        # the orders the stepwise walk visited (in trial order, so the
+        # tie-break ranks identically) selects the same winner for every
+        # row — scores, selection, and criterion BITWISE.  Params are
+        # pinned to a ULP, not bitwise: the two searches pack the same
+        # orders into different fused walks and fit_grid's padded
+        # rounding depends on group composition; params-bitwise is only
+        # a contract on the fuse=1 per-order path (see test_auto.py::
+        # TestSelection::test_fuse1_bitwise_vs_exhaustive_argmin)
+        y, sw, _ = stepwise_run
+        visited = [s.order for s in sw.orders]
+        assert len(visited) == len(set(visited))  # no order tried twice
+        ex = auto.auto_fit(y, visited, **SW_KW)
+        assert_results_equal(sw, ex, fields=(
+            "neg_log_likelihood", "converged", "iters", "status",
+            "order_index", "criterion"))
+        assert np.allclose(sw.params, ex.params, rtol=0, atol=1e-6,
+                           equal_nan=True)
+        assert (sw.meta["auto_fit"]["selection_counts"]
+                == ex.meta["auto_fit"]["selection_counts"])
+
+    def test_stepwise_meta_contracts(self, stepwise_run):
+        y, sw, _ = stepwise_run
+        m = sw.meta["auto_fit"]
+        swm = m["stepwise"]
+        # the per-pass trial lists PARTITION the global trial walk — the
+        # invariant the resume path and the budget advisor both lean on
+        cat = [g for p in swm["passes"] for g in p["orders"]]
+        assert cat == list(range(len(sw.orders)))
+        assert swm["orders_tried"] == len(sw.orders)
+        assert swm["seed"] == [auto.OrderSpec(o).label
+                               for o in auto.STEPWISE_SEED_ORDERS]
+        assert swm["converged"] is True
+        assert swm["passes"][-1]["new_rows_won"] == 0
+        for i, p in enumerate(swm["passes"]):
+            assert p["pass"] == i and p["dir"] == f"stepwise_{i:02d}"
+            assert p["wall_s"] >= 0
+        # every per-order entry names the pass that ran it
+        assert [e["stepwise_pass"] for e in m["orders"]] \
+            == sorted(e["stepwise_pass"] for e in m["orders"])
+
+    def test_exhaustive_path_has_no_stepwise_block(self):
+        y = make_ar_panel(b=8)
+        res = auto.auto_fit(y, [(1, 0, 0), (0, 0, 1)], **SW_KW)
+        # the key is always present so downstream readers never branch
+        # on its existence; None is the exhaustive-path marker
+        assert res.meta["auto_fit"]["stepwise"] is None
+
+    def test_caller_orders_seed_the_walk(self):
+        y = make_ar_panel(b=8, seed=7)
+        res = auto.auto_fit(y, [(1, 0, 0), (0, 0, 1)], stepwise=True,
+                            stepwise_max_passes=2, stepwise_max_order=1,
+                            **SW_KW)
+        labels = [s.label for s in res.orders]
+        assert labels[:2] == ["(1, 0, 0)", "(0, 0, 1)"]
+        assert res.meta["auto_fit"]["stepwise"]["seed"] == labels[:2]
+
+    def test_seasonal_grid_rejects_stepwise(self):
+        y = make_ar_panel(b=8)
+        with pytest.raises(ValueError, match="seasonal"):
+            auto.auto_fit(y, [(1, 0, 0, (1, 0, 0, 4))], stepwise=True,
+                          **SW_KW)
+
+    def test_resume_mid_expansion_bitwise(self, stepwise_run, tmp_path):
+        # crash INSIDE the expansion: pass 0 (two fused seed walks, 2
+        # chunks each) is durable, pass 1's walk is torn after its first
+        # chunk — the resume must replay the completed passes from their
+        # journals, recompute the identical expansion, and finish the
+        # torn walk, bitwise vs the uninterrupted search
+        y, ref, _ = stepwise_run
+        kw = dict(stepwise=True, stepwise_max_passes=3,
+                  stepwise_max_order=2, **SW_KW)
+        b = tmp_path / "crash"
+        with pytest.raises(fi.SimulatedCrash):
+            auto.auto_fit(y, checkpoint_dir=str(b),
+                          _journal_commit_hook=fi.crash_after_commits(5),
+                          **kw)
+        m0 = json.load(open(b / "stepwise_00" / "grid_00000"
+                            / "manifest.json"))
+        assert len([c for c in m0["chunks"]
+                    if c["status"] == "committed"]) == 2
+        assert m0["extra"]["auto_fit"]["stage"] == "stepwise"
+        assert m0["extra"]["auto_fit"]["stepwise_pass"] == 0
+        assert (b / "stepwise_01").exists()
+        assert not (b / "auto_manifest.json").exists()
+        res = auto.auto_fit(y, checkpoint_dir=str(b), **kw)
+        assert_results_equal(ref, res)
+
+    def test_auto_manifest_stepwise_block_gates(self, stepwise_run):
+        import obs_report
+
+        _, _, d = stepwise_run
+        errs = [e for e in obs_report.validate_auto_manifest(d)
+                if "no telemetry block" not in e]  # obs was off here
+        assert errs == [], errs
+        # a scrambled pass partition must be CAUGHT, not rendered over
+        mp = os.path.join(d, "auto_manifest.json")
+        man = json.load(open(mp))
+        good = json.dumps(man)
+        man["auto_fit"]["stepwise"]["passes"][0]["orders"] = [1, 0, 2, 3]
+        with open(mp, "w") as f:
+            json.dump(man, f)
+        try:
+            errs = obs_report.validate_auto_manifest(d)
+            assert any("partition" in e for e in errs), errs
+        finally:
+            with open(mp, "w") as f:
+                f.write(good)
+
+
+# ---------------------------------------------------------------------------
+# tenant profile store
+# ---------------------------------------------------------------------------
+
+
+def _store_update(store, tenant, y, cfg, *, winner=(1, 0, 0),
+                  route="new"):
+    b = y.shape[0]
+    return store.update(
+        tenant, values=y, orders=[list(winner), [0, 0, 1]],
+        order_index=np.zeros(b, np.int32),
+        params=np.full((b, 3), 0.5, np.float32),
+        criterion=np.full(b, 1.0), status=np.zeros(b, np.int8),
+        cfg_key=cfg, criterion_name="aicc", include_intercept=True,
+        route=route)
+
+
+class TestProfileStore:
+    def test_new_without_profile(self, tmp_path):
+        store = TenantProfileStore(str(tmp_path))
+        assert store.classify("t", np.zeros((4, 8), np.float32),
+                              "cfg") == ("new", None)
+
+    def test_classification_matrix(self, tmp_path):
+        store = TenantProfileStore(str(tmp_path))
+        y = make_ar_panel(b=4, t=32)
+        _store_update(store, "t", y, "cfg")
+        # exact repeat -> stable
+        route, prof = store.classify("t", y, "cfg")
+        assert route == "stable" and prof["passes"] == 1
+        # appended ticks (same prefix, longer panel) -> still stable
+        y_more = np.concatenate([y, y[:, -4:]], axis=1)
+        assert store.classify("t", y_more, "cfg")[0] == "stable"
+        # content moved, same shape/config -> drifted
+        y2 = y + np.float32(0.25)
+        assert store.classify("t", y2, "cfg")[0] == "drifted"
+        # row count changed -> new (profile still returned as context)
+        route, prof = store.classify("t", y[:2], "cfg")
+        assert route == "new" and prof is not None
+        # fit config changed -> new
+        assert store.classify("t", y, "other-cfg")[0] == "new"
+        # shorter panel than the recorded prefix -> new
+        assert store.classify("t", y[:, :16], "cfg")[0] == "new"
+        # a different tenant never sees this profile
+        assert store.classify("u", y, "cfg") == ("new", None)
+
+    def test_stability_counts_order_tuples_not_grid_indices(self,
+                                                            tmp_path):
+        store = TenantProfileStore(str(tmp_path))
+        y = make_ar_panel(b=4, t=32)
+        assert _store_update(store, "t", y, "cfg")["stability"] == 0
+        # same winner map -> stability increments, passes accumulate
+        p = _store_update(store, "t", y, "cfg", route="stable")
+        assert p["stability"] == 1 and p["passes"] == 2
+        # winners move -> reset to 0
+        p = _store_update(store, "t", y, "cfg", winner=(2, 0, 0))
+        assert p["stability"] == 0 and p["passes"] == 3
+        # config change -> no continuity
+        assert _store_update(store, "t", y, "cfg2",
+                             winner=(2, 0, 0))["stability"] == 0
+
+    def test_version_or_torn_bytes_read_as_absent(self, tmp_path):
+        store = TenantProfileStore(str(tmp_path))
+        y = make_ar_panel(b=4, t=32)
+        _store_update(store, "t", y, "cfg")
+        with open(store.path("t"), "wb") as f:
+            f.write(b"not an npz")
+        assert store.load("t") is None
+        assert store.classify("t", y, "cfg") == ("new", None)
+        assert store.tenants() == []
+
+    def test_fenced_write_refused_before_bytes_land(self, tmp_path):
+        y = make_ar_panel(b=4, t=32)
+        store = TenantProfileStore(str(tmp_path))
+        _store_update(store, "t", y, "cfg")
+        with open(store.path("t"), "rb") as f:
+            before = f.read()
+
+        def fence():
+            raise FencedError("stale token")
+
+        zombie = TenantProfileStore(str(tmp_path), fence=fence)
+        with pytest.raises(FencedError):
+            _store_update(zombie, "t", y, "cfg", winner=(2, 0, 0))
+        with open(store.path("t"), "rb") as f:
+            assert f.read() == before
+        # and a fenced FIRST write leaves no file at all
+        with pytest.raises(FencedError):
+            _store_update(zombie, "u", y, "cfg")
+        assert not os.path.exists(zombie.path("u"))
+
+    def test_config_key_is_routing_blind_and_order_stable(self):
+        assert config_key({"max_iters": 20, "criterion": "aicc"}) \
+            == config_key({"criterion": "aicc", "max_iters": 20})
+        assert config_key({"max_iters": 20}) \
+            != config_key({"max_iters": 25})
+
+
+# ---------------------------------------------------------------------------
+# serving route ladder
+# ---------------------------------------------------------------------------
+
+
+AUTO_KW = dict(max_iters=20, stepwise_max_passes=2, stepwise_max_order=1)
+
+
+class TestServingWarmRouting:
+    def test_route_ladder_and_exact_mode(self, tmp_path):
+        y = make_ar_panel(b=8, seed=9)
+        y2 = y + np.float32(0.5)
+        root = str(tmp_path / "srv")
+        with serving.FitServer(root, cell_rows=8) as srv:
+            r1 = srv.submit("acme", y, "panel_auto", warm_routing=True,
+                            **AUTO_KW).result(timeout=600)
+            r2 = srv.submit("acme", y, "panel_auto", warm_routing=True,
+                            **AUTO_KW).result(timeout=600)
+            r3 = srv.submit("acme", y2, "panel_auto", warm_routing=True,
+                            **AUTO_KW).result(timeout=600)
+            cold = srv.submit("acme", y, "panel_auto", warm_routing=False,
+                              orders=[(1, 0, 0), (0, 0, 1)],
+                              max_iters=20).result(timeout=600)
+            h = srv.health()["counters"]
+        a1, a2, a3 = (r.meta["auto"] for r in (r1, r2, r3))
+        assert [a1["route"], a2["route"], a3["route"]] \
+            == ["new", "stable", "drifted"]
+        assert a1["stability"] == 0 and a2["stability"] == 0
+        # the stable leg reuses pass 1's selection verbatim
+        assert a2["orders"] == a1["orders"]
+        assert a2["order_index"] == a1["order_index"]
+        # the stable refit re-optimises the winner basins from the
+        # STORED params (that is the point: skip stage 1, converge in a
+        # few iters) — it must match the cold fit's quality, not its
+        # bits
+        assert np.allclose(r2.neg_log_likelihood, r1.neg_log_likelihood,
+                           rtol=1e-4, atol=1e-3)
+        assert np.allclose(r2.params, r1.params, rtol=0, atol=1e-2,
+                           equal_nan=True)
+        # the drifted leg seeds its stepwise walk from the profile's
+        # distinct winners
+        w1 = sorted({tuple(a1["orders"][g])
+                     for g in a1["order_index"] if g >= 0})
+        assert [tuple(o) for o in a3["orders"][:len(w1)]] == w1
+        assert h["route_new"] == 1 and h["route_stable"] == 1 \
+            and h["route_drifted"] == 1 and h["route_cold"] == 1
+        assert h["profile_updates"] == 3  # cold submits never write
+        # EXACT mode: bitwise the direct auto_fit call with the server's
+        # walk knobs pinned (the AUTO path setdefaults them)
+        ref = auto.auto_fit(y, [(1, 0, 0), (0, 0, 1)], max_iters=20,
+                            chunk_rows=8, resilient=False,
+                            policy="impute",
+                            align_mode=_align_mode_host(y))
+        assert a1["route"] == "new"
+        for f in ("params", "neg_log_likelihood", "converged", "iters",
+                  "status"):
+            assert _eq(getattr(cold, f), getattr(ref, f)), f
+        ca = cold.meta["auto"]
+        assert ca["route"] == "cold"
+        assert ca["order_index"] == [int(v) for v in ref.order_index]
+        assert "stepwise" not in ca
+
+    def test_profile_survives_server_restart(self, tmp_path):
+        y = make_ar_panel(b=8, seed=13)
+        root = str(tmp_path / "srv")
+        with serving.FitServer(root, cell_rows=8) as srv:
+            r1 = srv.submit("acme", y, "panel_auto", warm_routing=True,
+                            **AUTO_KW).result(timeout=600)
+        # a NEW server process-equivalent on the same root reads the
+        # durable profile: the identical resubmit skips stage 1
+        with serving.FitServer(root, cell_rows=8) as srv:
+            r2 = srv.submit("acme", y, "panel_auto", warm_routing=True,
+                            **AUTO_KW).result(timeout=600)
+            assert srv.health()["counters"]["route_stable"] == 1
+        assert r1.meta["auto"]["route"] == "new"
+        assert r2.meta["auto"]["route"] == "stable"
+        assert r2.meta["auto"]["order_index"] \
+            == r1.meta["auto"]["order_index"]
+
+    def test_warm_routing_rejected_off_the_auto_model(self, tmp_path):
+        with serving.FitServer(str(tmp_path), cell_rows=8) as srv:
+            with pytest.raises(ValueError, match="warm_routing"):
+                srv.submit("t", make_ar_panel(b=8), "arima",
+                           warm_routing=True, order=(1, 0, 0))
+
+
+# ---------------------------------------------------------------------------
+# WarmstartFit probe-and-compact (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestProbeCompact:
+    def test_probe_and_compact_equivalence(self, monkeypatch):
+        import functools
+
+        monkeypatch.setattr(delta_mod, "_PROBE_MIN_ROWS", 8)
+        y = make_ar_panel(b=16, t=96, seed=21)
+        fit_fn = functools.partial(arima.fit, order=(2, 0, 2))
+        k = 5
+        # warm inits must actually be WARM for the probe to engage its
+        # fast path: seed 12 rows from a converged fit's own params and
+        # leave 4 NaN (zeroed by WarmstartFit -> genuine stragglers)
+        ref = fit_fn(y)
+        init = np.full((16, k), np.nan, np.float32)
+        init[:12] = np.asarray(ref.params)[:12, :k]
+        aug = np.concatenate([y, init], axis=1)
+        # the engagement plan must fire for this shape (max_iters=60
+        # default, init_params exposed)
+        full, probe_iters = delta_mod._probe_plan(fit_fn, 16, {})
+        assert full == 60 and probe_iters == 7
+        # and there must be real stragglers at the probe budget, else
+        # this test pins nothing
+        pr = fit_fn(y, init_params=np.where(np.isfinite(init), init, 0.0))
+        n_slow = int(np.sum(np.asarray(pr.iters) > probe_iters))
+        assert 0 < n_slow <= 8, n_slow
+        probe = delta_mod.WarmstartFit(fit_fn, n_time=96, k=k)
+        plain = delta_mod.WarmstartFit(fit_fn, n_time=96, k=k,
+                                       compact=False)
+        rp, rn = probe(aug), plain(aug)
+        # equivalence, not bitwise: the compacted straggler refit is a
+        # different compiled program (the retry_cap shape bucket), and
+        # cross-program bitwise is out of scope — same contract as the
+        # pallas backends.  Convergence and status maps ARE pinned.
+        assert _eq(rp.converged, rn.converged)
+        assert _eq(rp.status, rn.status)
+        assert bool(np.all(np.asarray(rp.converged)))
+        # a straggler may terminate a couple of iterations apart across
+        # the two programs (flat optimum), so params carry optimizer
+        # tolerance, not ULPs
+        assert np.allclose(rp.params, rn.params, rtol=0, atol=5e-2,
+                           equal_nan=True)
+        assert np.allclose(rp.neg_log_likelihood, rn.neg_log_likelihood,
+                           rtol=1e-4, atol=5e-3)
+        # rows that converged under the probe keep their probe state:
+        # only straggler rows were re-dispatched
+        fast = np.asarray(pr.iters) <= probe_iters
+        assert _eq(np.asarray(rp.iters)[fast], np.asarray(rn.iters)[fast])
+        # what resume leans on is DETERMINISM, not cross-mode identity
+        rp2 = probe(aug)
+        for f in ("params", "neg_log_likelihood", "converged", "iters",
+                  "status"):
+            assert _eq(getattr(rp, f), getattr(rp2, f)), f
+        # and because the two modes commit different bytes, they must
+        # NOT share a journal identity
+        assert probe.__qualname__ != plain.__qualname__
+        assert "compact=False" in plain.__qualname__
+
+    def test_explicit_max_iters_disables_the_probe(self):
+        import functools
+
+        fit_fn = functools.partial(arima.fit, order=(1, 0, 0))
+        assert delta_mod._probe_plan(fit_fn, 128,
+                                     {"max_iters": 20}) is None
+        assert delta_mod._probe_plan(fit_fn, 4, {}) is None
+
+
+# ---------------------------------------------------------------------------
+# real-SIGKILL smoke (subprocess; ci.sh runs the same orchestration)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_stepwise_sigkill_resume_smoke():
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_autofit_worker.py")
+    r = subprocess.run([sys.executable, worker, "--stepwise-smoke"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "PASS" in r.stdout
+
+
+@pytest.mark.slow
+def test_fleet_warm_failover_smoke():
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_fleet_worker.py")
+    r = subprocess.run([sys.executable, worker, "--warm-smoke"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "PASS" in r.stdout

@@ -418,6 +418,92 @@ def advise(m: dict) -> dict:
     }
 
 
+def advise_profiles(root: str):
+    """Warm-routing advice from a serving root's tenant profiles
+    (ISSUE 19: ``serving.TenantProfileStore`` — one npz per tenant under
+    ``<root>/profiles/``).
+
+    Reads are unfenced by design (the store's read side is the standby/
+    tooling surface), so this advisor can run against a LIVE fleet root.
+    Per tenant it turns the profile's evidence into the next search's
+    knobs:
+
+    - ``stepwise_seed_orders`` / ``stepwise_max_order`` — a drifted
+      re-search seeds from the profile's distinct winning orders; the
+      expansion cap goes one step past their largest ``p``/``q`` so the
+      first stepwise pass still has somewhere to move;
+    - ``cell_rows`` — a tenant whose winner map has held for two or more
+      passes (``stability >= 2``) takes the warm path on its next
+      submit: stage 1 is skipped and every row refits its known winning
+      order in per-basin warm walks, so the panel can walk as one cell —
+      chunking for search-budget control buys nothing there.
+
+    Returns ``None`` when the root has no ``profiles/`` namespace (the
+    server never saw an auto-fit submit), an ``error`` dict when the
+    package is unimportable, else the per-tenant advice table.
+    """
+    pdir = os.path.join(root, "profiles")
+    if not os.path.isdir(pdir):
+        return None
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        import numpy as np
+
+        from spark_timeseries_tpu.serving.profiles import TenantProfileStore
+    except Exception as e:  # noqa: BLE001 - tooling must degrade loudly
+        return {"error": f"cannot import serving.profiles ({e})"}
+    store = TenantProfileStore(pdir)
+    per_tenant = []
+    for tenant in store.tenants():
+        prof = store.load(tenant)
+        if prof is None:
+            continue
+        idx = np.asarray(prof["order_index"], np.int64)
+        orders = np.asarray(prof["orders"], np.int64).reshape(-1, 3)
+        winners = sorted({tuple(int(v) for v in orders[g])
+                          for g in idx[idx >= 0]})
+        span = max((max(o[0], o[2]) for o in winners), default=0)
+        stability = int(prof.get("stability", 0))
+        rows = int(prof.get("rows", idx.shape[0]))
+        per_tenant.append({
+            "tenant": prof["tenant"],
+            "rows": rows,
+            "passes": int(prof.get("passes", 0)),
+            "stability": stability,
+            "last_route": prof.get("route"),
+            "winners": [list(o) for o in winners],
+            "suggest": {
+                "stepwise_seed_orders": len(winners),
+                "stepwise_max_order": span + 1,
+                "cell_rows": rows if stability >= 2 else None,
+            },
+        })
+    return {
+        "profiled": len(per_tenant),
+        "stable": sum(1 for t in per_tenant if t["stability"] >= 2),
+        "per_tenant": per_tenant,
+    }
+
+
+def _render_profiles(p: dict) -> None:
+    print(f"  tenant profiles: {p['profiled']} profiled, {p['stable']} "
+          "stable (warm-path candidates on their next submit)")
+    for t in p["per_tenant"]:
+        s = t["suggest"]
+        winners = ", ".join("(%d,%d,%d)" % tuple(o) for o in t["winners"])
+        print(f"    {t['tenant']}: rows {t['rows']}, passes {t['passes']}, "
+              f"stability {t['stability']}, last route {t['last_route']}; "
+              f"winners {winners or '-'}")
+        line = (f"      suggest: stepwise seeds = "
+                f"{s['stepwise_seed_orders']} order(s), stepwise_max_order"
+                f" = {s['stepwise_max_order']}")
+        if s["cell_rows"]:
+            line += (f", cell_rows = {s['cell_rows']} (stable tenant: the"
+                     " warm refit walks the panel as one cell)")
+        print(line)
+
+
 def advise_serving(root: str) -> dict:
     """Serving-mode advice (ISSUE 12): a :class:`serving.FitServer`
     checkpoint root — ``server.json`` + one journal per micro-batch under
@@ -457,10 +543,18 @@ def advise_serving(root: str) -> dict:
                 per_batch.append(a)
     counters = server.get("counters") or {}
     knobs = server.get("knobs") or {}
+    # tenant profiles (ISSUE 19) ride along whenever the root has a
+    # profiles/ namespace — auto-fit submits bypass the micro-batcher,
+    # so a warm serving root can have profile evidence with ZERO batch
+    # journals and the advice must not vanish behind the batch gate
+    profiles = advise_profiles(root)
     if not per_batch:
-        return {"error": "no committed batch journals to learn from",
-                "serving": {"server_state": server.get("state"),
-                            "counters": counters}}
+        out = {"error": "no committed batch journals to learn from",
+               "serving": {"server_state": server.get("state"),
+                           "counters": counters}}
+        if profiles is not None:
+            out["profiles"] = profiles
+        return out
 
     def _vals(path):
         out = []
@@ -501,7 +595,7 @@ def advise_serving(root: str) -> dict:
         # bottleneck surface — either raise it (more RAM) or add capacity
         "raise_queue_or_capacity": pressure > 0.05,
     }
-    return {
+    out = {
         "serving": {
             "server_state": server.get("state"),
             "batches_advised": len(per_batch),
@@ -519,6 +613,9 @@ def advise_serving(root: str) -> dict:
         },
         "suggest": suggest,
     }
+    if profiles is not None:
+        out["profiles"] = profiles
+    return out
 
 
 def _render_serving(root: str, a: dict) -> None:
@@ -551,6 +648,8 @@ def _render_serving(root: str, a: dict) -> None:
     if s["raise_queue_or_capacity"]:
         print("    overload: sustained shedding — raise max_queue_rows "
               "(more RAM) or add serving capacity")
+    if a.get("profiles") and "error" not in a["profiles"]:
+        _render_profiles(a["profiles"])
 
 
 def advise_auto(root: str) -> dict:
@@ -907,7 +1006,14 @@ def main():
             print(json.dumps(a, indent=1, sort_keys=True))
             return
         if "error" in a:
-            sys.exit(f"advise_budget: {a['error']}")
+            prof = a.get("profiles")
+            if not prof or "error" in prof:
+                sys.exit(f"advise_budget: {a['error']}")
+            # a warm root whose traffic was all auto-fit submits: no
+            # batch journals, but the profile evidence still advises
+            print(f"serving root {args.path}  ({a['error']})")
+            _render_profiles(prof)
+            return
         _render_serving(args.path, a)
         return
     # an auto-fit search root (ISSUE 9) has no root manifest.json — the

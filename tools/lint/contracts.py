@@ -323,6 +323,78 @@ CONFIG_HASH_SURFACES = {
                           "contract: TIMEOUT rows, recomputed on "
                           "re-answer)",
             "request_id": "idempotency identity for the durable record",
+            "warm_routing": "routing-mode selection for panel_auto "
+                            "(ISSUE 19): rides the durable request "
+                            "record (injected into fit_kwargs) so "
+                            "recovery re-routes identically, and is "
+                            "POPPED before the search — each route "
+                            "leg's walks hash their own fit configs, "
+                            "exact mode (False) is bitwise the plain "
+                            "exhaustive search, and the decision is "
+                            "recorded in the result meta + trace, "
+                            "never silent",
+        },
+    },
+    "spark_timeseries_tpu/models/auto.py::auto_fit": {
+        "kwargs_param": "fit_kwargs",  # rides every order walk's
+        # fit partial, hashed wholesale by each walk's config_hash
+        "hashed": {
+            "y": "panel fingerprint of every per-order / fused walk",
+            "orders": "the candidate grid: each order resolves into its "
+                      "walk's fit_fn identity (order= partial kwarg) and "
+                      "grid coordinate",
+            "include_intercept": "rides every order walk's fit partial "
+                                 "(hashed there); also sets k",
+            "stage1_iters": "stage-1 sweeps run max_iters=stage1_iters "
+                            "through the walk's fit kwargs (hashed "
+                            "there)",
+            "chunk_rows": "forwarded to fit_chunked (hashed there)",
+            "resilient": "forwarded to fit_chunked (hashed there)",
+            "policy": "forwarded to fit_chunked (hashed there)",
+            "align_mode": "forwarded to fit_chunked (hashed there)",
+        },
+        "excluded": {
+            "criterion": "selection-time ranking over journaled "
+                         "per-order results, recomputed on resume — a "
+                         "changed criterion re-selects (and, stepwise, "
+                         "re-expands) from the SAME journaled walks; "
+                         "per-order walk identity is unchanged",
+            "stage2": "selects the walk PLAN (full sweeps vs stage-1 "
+                      "sweeps + basin refits); each walk hashes its own "
+                      "config and journals under a distinct namespace "
+                      "(grid_*_s1), so mixed modes never collide",
+            "fuse": "fusion grouping moves orders between dispatches "
+                    "without changing per-(row, order) trajectories — "
+                    "the fused demux is pinned bitwise against per-order "
+                    "walks; groups journal under the leader's grid dir",
+            "stepwise": "selects the Hyndman-Khandakar expansion plan "
+                        "(ISSUE 19): passes journal under their own "
+                        "stepwise_%02d namespaces (never colliding with "
+                        "an exhaustive search in the same root), each "
+                        "trial order's walk hashes its own config, and "
+                        "the searched grid is recorded in the auto "
+                        "manifest's stepwise block",
+            "stepwise_max_passes": "bounds expansion rounds; journaled "
+                                   "passes replay deterministically on "
+                                   "resume and a raised cap only "
+                                   "appends passes",
+            "stepwise_max_order": "bounds the expansion neighborhood; "
+                                  "the frontier is a deterministic "
+                                  "function of the journaled results "
+                                  "under the cap, recorded per pass in "
+                                  "the auto manifest",
+            "return_criteria": "host-side return shape only",
+            "checkpoint_dir": "see fit_chunked",
+            "resume": "see fit_chunked",
+            "chunk_budget_s": "see fit_chunked",
+            "job_budget_s": "see fit_chunked",
+            "pipeline": "see fit_chunked",
+            "pipeline_depth": "see fit_chunked",
+            "prefetch_depth": "see fit_chunked",
+            "shard": "see fit_chunked",
+            "mesh": "see fit_chunked",
+            "_journal_commit_hook": "fault-injection instrumentation "
+                                    "(tests only)",
         },
     },
 }
@@ -413,6 +485,14 @@ FILE_WRITE_OWNERS = {
         "FitServer": "owner of the serving root's results/, knobs.json "
                      "and server.json; batch WALK journals under "
                      "batches/ are written by ChunkJournal, never here",
+    },
+    "spark_timeseries_tpu/serving/profiles.py": {
+        "TenantProfileStore": "sole writer of the serving root's "
+                              "profiles/ namespace (ISSUE 19): one npz "
+                              "per tenant via journal.durable_replace, "
+                              "fenced on fleet roots exactly like the "
+                              "result store — standbys and tools only "
+                              "READ profiles",
     },
     "spark_timeseries_tpu/serving/batcher.py": {
         "MicroBatch": "durable batch-membership records under the batch "
@@ -507,6 +587,7 @@ LOCKMAP_RUNTIME_CLASSES = (
     "spark_timeseries_tpu.serving.admission:AdmissionQueue",
     "spark_timeseries_tpu.serving.session:FitTicket",
     "spark_timeseries_tpu.serving.server:FitServer",
+    "spark_timeseries_tpu.serving.profiles:TenantProfileStore",
     "spark_timeseries_tpu.serving.transport:TransportServer",
     "spark_timeseries_tpu.serving.client:FitClient",
     "spark_timeseries_tpu.serving.health:EndpointHealthCache",

@@ -327,6 +327,90 @@ FIXTURES["journal-writer/clock"] = (_CLOCK, _fix("""
                 f.write(json.dumps(clock, sort_keys=True))
     """), [functools.partial(journalwriter.check, owners=_CLOCK_OWNERS)])
 
+# ISSUE 19: the warm auto-fit plane joined the registries — seed a
+# violation of each NEW entry shape so a checker that stopped matching
+# them cannot pass vacuously.  (a) config-hash: an auto-search-shaped
+# surface grows an unregistered stepwise knob; (b) journal-writer: a
+# rogue helper writes a tenant profile npz (the profiles/ namespace)
+# directly instead of routing through the registered TenantProfileStore
+# owner; (c) lock-map: a profile-store-shaped class mutates its read
+# cache outside the declared lock — the exact shape the serve-loop
+# update / caller-thread classify race would take.
+_AUTO = "spark_timeseries_tpu/models/fixture_auto.py"
+_AUTO_SURFACES = {
+    f"{_AUTO}::auto_fixture": {
+        "kwargs_param": "fit_kwargs",
+        "hashed": {"orders": "each order's walk fit_fn identity"},
+        "excluded": {"stepwise": "expansion-plan selection; passes "
+                                 "journal under their own namespaces",
+                     "stepwise_max_passes": "bounds expansion rounds "
+                                            "(deterministic replay)"},
+    },
+}
+
+FIXTURES["config-hash/stepwise"] = (_AUTO, _fix("""
+    def auto_fixture(*, orders=None, stepwise=False,
+                     stepwise_max_passes=8, stepwise_seed_jitter=0,
+                     **fit_kwargs):
+        return orders, stepwise, stepwise_max_passes, stepwise_seed_jitter
+    """), _fix("""
+    def auto_fixture(*, orders=None, stepwise=False,
+                     stepwise_max_passes=8, **fit_kwargs):
+        return orders, stepwise, stepwise_max_passes
+    """), [functools.partial(confighash.check, surfaces=_AUTO_SURFACES)])
+
+_PROFILES = "spark_timeseries_tpu/serving/fixture_profiles.py"
+_PROFILES_OWNERS = {_PROFILES: {"TenantProfileStore":
+                                "sole writer of the profiles/ namespace"}}
+
+FIXTURES["journal-writer/profiles"] = (_PROFILES, _fix("""
+    import json
+    import os
+
+    def rogue_profile_note(root, tenant, arrays):
+        path = os.path.join(root, "profiles", tenant + ".npz")
+        with open(path, "wb") as f:     # unregistered writer
+            f.write(json.dumps(arrays).encode())
+    """), _fix("""
+    import os
+
+    class TenantProfileStore:
+        def update(self, root, tenant, write):
+            path = os.path.join(root, "profiles", tenant + ".npz")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                write(f)
+            os.replace(tmp, path)
+    """), [functools.partial(journalwriter.check,
+                             owners=_PROFILES_OWNERS)])
+
+FIXTURES["lock-map/profiles"] = (_PROFILES, _fix("""
+    import threading
+
+    class ProfileStore:
+        _protected_by_ = {"_cache": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cache = {}
+
+        def load(self, tenant, key, prof):
+            self._cache[tenant] = (key, prof)   # mutation outside lock
+    """), _fix("""
+    import threading
+
+    class ProfileStore:
+        _protected_by_ = {"_cache": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cache = {}
+
+        def load(self, tenant, key, prof):
+            with self._lock:
+                self._cache[tenant] = (key, prof)
+    """), [lockmap.check])
+
 _OWNERS = {HOT: {"Owner": "fixture namespace owner"}}
 
 FIXTURES["journal-writer"] = (HOT, _fix("""

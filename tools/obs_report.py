@@ -419,8 +419,13 @@ def validate_manifest_auto_extra(m: dict, path: str) -> list:
                 isinstance(seasonal, list) and len(seasonal) == 4
                 and all(isinstance(v, int) for v in seasonal)):
             errors.append(f"extra.auto_fit.seasonal invalid: {seasonal!r}")
-    if a.get("stage") not in ("full", "stage1", "winners"):
+    if a.get("stage") not in ("full", "stage1", "winners", "stepwise"):
         errors.append(f"extra.auto_fit.stage invalid: {a.get('stage')!r}")
+    if a.get("stage") == "stepwise" and not (
+            isinstance(a.get("stepwise_pass"), int)
+            and a["stepwise_pass"] >= 0):
+        errors.append(f"extra.auto_fit.stepwise_pass invalid for a "
+                      f"stepwise walk: {a.get('stepwise_pass')!r}")
     grid = (m.get("extra") or {}).get("grid") or {}
     if isinstance(gi, int) and grid.get("index") != gi:
         errors.append(f"extra.grid.index {grid.get('index')!r} disagrees "
@@ -502,6 +507,67 @@ def validate_auto_manifest(root: str) -> list:
                 and a["diff_cache_hits"] >= 0):
             errors.append(f"auto_fit.diff_cache_hits invalid: "
                           f"{a.get('diff_cache_hits')!r}")
+    # stepwise accounting (ISSUE 19): the pass manifests must partition
+    # the trial list in walk order — a SIGKILL'd search resumes by
+    # replaying the pass sequence against these journals, and the budget
+    # advisor reads the seed/convergence evidence
+    sw = a.get("stepwise")
+    if sw is not None:
+        if not isinstance(sw, dict):
+            errors.append(f"auto_fit.stepwise invalid: {sw!r}")
+        else:
+            passes = sw.get("passes")
+            if not (isinstance(passes, list) and passes
+                    and all(isinstance(p, dict) for p in passes)):
+                errors.append(f"auto_fit.stepwise.passes missing/invalid: "
+                              f"{passes!r}")
+            else:
+                covered = []
+                for i, p in enumerate(passes):
+                    if p.get("pass") != i:
+                        errors.append(f"auto_fit.stepwise.passes[{i}].pass "
+                                      f"is {p.get('pass')!r}")
+                    if p.get("dir") != f"stepwise_{i:02d}":
+                        errors.append(f"auto_fit.stepwise.passes[{i}].dir "
+                                      f"is {p.get('dir')!r}, expected "
+                                      f"'stepwise_{i:02d}'")
+                    po = p.get("orders")
+                    if not (isinstance(po, list) and po
+                            and all(isinstance(v, int) for v in po)):
+                        errors.append(f"auto_fit.stepwise.passes[{i}]"
+                                      f".orders invalid: {po!r}")
+                    else:
+                        covered += po
+                    if not isinstance(p.get("new_rows_won"), int) or \
+                            p["new_rows_won"] < 0:
+                        errors.append(f"auto_fit.stepwise.passes[{i}]"
+                                      ".new_rows_won invalid: "
+                                      f"{p.get('new_rows_won')!r}")
+                    if not isinstance(p.get("wall_s"), (int, float)):
+                        errors.append(f"auto_fit.stepwise.passes[{i}]"
+                                      f".wall_s invalid: {p.get('wall_s')!r}")
+                if covered and covered != list(range(len(orders))):
+                    errors.append(
+                        "auto_fit.stepwise passes do not partition the "
+                        f"{len(orders)}-order trial list in walk order: "
+                        f"{covered}")
+            if not isinstance(sw.get("converged"), bool):
+                errors.append(f"auto_fit.stepwise.converged invalid: "
+                              f"{sw.get('converged')!r}")
+            if sw.get("orders_tried") != len(orders):
+                errors.append(f"auto_fit.stepwise.orders_tried "
+                              f"{sw.get('orders_tried')!r} != "
+                              f"{len(orders)} recorded orders")
+            if not (isinstance(sw.get("seed"), list) and sw.get("seed")):
+                errors.append(f"auto_fit.stepwise.seed missing/empty: "
+                              f"{sw.get('seed')!r}")
+        # every trial must say which pass walked it — the per-order
+        # journal dirs live under stepwise_%02d/ namespaces keyed on it
+        for i, o in enumerate(orders):
+            if isinstance(o, dict) and not isinstance(
+                    o.get("stepwise_pass"), int):
+                errors.append(f"auto_fit.orders[{i}].stepwise_pass missing "
+                              "for a stepwise search")
     # recurse into every per-order journal the search left on disk: each
     # is an ordinary chunk-walk manifest and must pass the same gate
     if os.path.isdir(root):
@@ -820,6 +886,10 @@ FLEET_ANNOTATIONS = (
     "client.endpoint_circuit_open", "client.endpoint_half_open",
     "client.endpoint_probe_failed", "client.endpoint_recovered",
     "client.endpoint_redirected", "client.primary_learned",
+    # warm routing (ISSUE 19): which leg each auto-fit submit took —
+    # across a failover these show whether the survivor stayed warm —
+    # and any fenced/failed profile write that forced a cold next pass
+    "server.route", "server.profile_refused",
 )
 
 
