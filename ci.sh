@@ -579,6 +579,71 @@ python tools/advise_budget.py "$SHARDED_SMOKE_DIR/journal" \
   || { echo "ci.sh: advise_budget did not suggest a shard count" >&2; exit 1; }
 rm -rf "$SHARDED_SMOKE_DIR"
 
+# tick-loop kill-and-resume smoke (ISSUE 20): one cycle is SIGKILLed
+# TWICE — first inside the delta-warm fit walk, then (after a resume
+# from the recorded ticks) inside the publish walk with output shards
+# already durable — and the second resume must finish the cycle and the
+# next one bitwise-identical to an uninterrupted loop on a pristine copy
+# of the data dir, with the twice-replayed append staying idempotent
+python tests/_tickloop_worker.py --smoke
+
+# streaming tooling smoke (ISSUE 20): a 2-cycle tick loop and a
+# delta-adopting backtest campaign with telemetry on must (a) pass the
+# obs_report schema gates — the tickloop root's stage/t_before chain +
+# per-cycle published sink dirs, and the campaign manifest's
+# window_class + delta block — and (b) give the budget advisor enough
+# to print the across-cycle dirty fraction, a min_tick_interval_s
+# feed-rate floor, and the delta=True adoption suggestion
+TICK_SMOKE_DIR=$(python - <<'EOF'
+import json, os, tempfile
+import numpy as np
+from spark_timeseries_tpu import obs
+from spark_timeseries_tpu.forecasting import backtest as bt
+from spark_timeseries_tpu.reliability import source as source_mod
+from spark_timeseries_tpu.serving import tickloop as tl
+
+root = tempfile.mkdtemp(prefix="tick_smoke_")
+rng = np.random.default_rng(7)
+y = np.empty((24, 64), np.float32)
+y[:, 0] = rng.normal(size=24)
+for t in range(1, 64):
+    y[:, t] = 0.6 * y[:, t - 1] + 0.5 * rng.normal(size=24).astype(np.float32)
+obs.enable(os.path.join(root, "events.jsonl"))
+data = os.path.join(root, "data")
+source_mod.write_npz_shards(data, y, 12)
+loop = tl.TickLoop(os.path.join(root, "loop"), data, model="arima",
+                   model_kwargs={"order": (1, 0, 0)},
+                   fit_kwargs={"max_iters": 15}, horizon=4, chunk_rows=8,
+                   seed=11)
+for c in range(2):
+    r = loop.run_cycle(0.1 * rng.normal(size=(24, 2)).astype(np.float32))
+assert r.meta["stage"] == "published", r.meta
+assert r.meta["delta_counts"]["adopted"] == 0, r.meta  # ticks dirty tails
+kw = dict(model_kwargs={"order": (1, 0, 0)}, fit_kwargs={"max_iters": 15},
+          chunk_rows=8)
+bt.run_backtest(y[:, :60], "arima", 4, origins=[40, 48, 56],
+                checkpoint_dir=os.path.join(root, "bt"), **kw)
+d = bt.run_backtest(y, "arima", 4, origins=[40, 48, 56, 60], delta=True,
+                    checkpoint_dir=os.path.join(root, "bt"), **kw)
+obs.disable()
+assert d.meta["delta"] == {**d.meta["delta"], "adopted": 3, "recomputed": 1}
+print(root)
+EOF
+)
+python tools/obs_report.py --check "$TICK_SMOKE_DIR/events.jsonl" \
+  --manifest "$TICK_SMOKE_DIR/loop"
+python tools/obs_report.py --check "$TICK_SMOKE_DIR/events.jsonl" \
+  --manifest "$TICK_SMOKE_DIR/bt"
+python tools/advise_budget.py "$TICK_SMOKE_DIR/loop" > /tmp/ci_tick_advise.txt
+grep -q "dirty fraction" /tmp/ci_tick_advise.txt \
+  || { echo "ci.sh: advise_budget did not report the tick-loop dirty fraction" >&2; exit 1; }
+grep -q "min_tick_interval_s" /tmp/ci_tick_advise.txt \
+  || { echo "ci.sh: advise_budget did not floor the feed rate" >&2; exit 1; }
+python tools/advise_budget.py "$TICK_SMOKE_DIR/bt" \
+  | grep -q "delta = True" \
+  || { echo "ci.sh: advise_budget did not suggest backtest delta adoption" >&2; exit 1; }
+rm -rf "$TICK_SMOKE_DIR"
+
 # the driver's multi-chip artifact, same environment (now includes the
 # sharded journaled chunk walk next to the SPMD mesh paths)
 python - <<'EOF'

@@ -37,6 +37,8 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
+import shutil
 import tempfile
 import time
 from typing import List, NamedTuple, Optional, Sequence
@@ -185,6 +187,124 @@ def _metrics_digest(arrays: dict) -> str:
     return h.hexdigest()[:16]
 
 
+def _panel_prefix_digest(y, t_cols: int) -> str:
+    """Residency-independent content digest of ``y[:, :t_cols]``.
+
+    Streams sources chunk-by-chunk, so the same bytes hash identically
+    whether the panel lives in RAM, on device, or in npz/parquet shards.
+    This is what lets a grown campaign prove its prefix IS the prior
+    campaign's panel (``delta=True`` window adoption): the prior
+    manifest records the digest of its full panel, and the grown run
+    recomputes the digest of its first ``t_prior`` columns.
+    """
+    h = hashlib.sha256()
+    t_cols = int(t_cols)
+    if isinstance(y, source_mod.ChunkSource):
+        b, t = int(y.shape[0]), int(y.shape[1])
+        h.update(f"panel:{b}:{t_cols}:{np.dtype(y.dtype)}".encode())
+        step = max(1, int(y.default_chunk_rows or 4096))
+        buf = np.empty((step, t), y.dtype)
+        for lo in range(0, b, step):
+            hi = min(lo + step, b)
+            y.read_rows(lo, hi, buf[: hi - lo])
+            h.update(np.ascontiguousarray(
+                buf[: hi - lo, :t_cols]).tobytes())
+    else:
+        a = np.asarray(y)
+        h.update(f"panel:{a.shape[0]}:{t_cols}:{a.dtype}".encode())
+        h.update(np.ascontiguousarray(a[:, :t_cols]).tobytes())
+    return h.hexdigest()[:16]
+
+
+_WINDOW_DIR_RE = re.compile(r"^window_(\d{5})$")
+_METRICS_FILE_RE = re.compile(r"^metrics_(\d{5})\.npz$")
+
+
+def _adopt_prior_campaign(prior: dict, *, mp: str, root: str, y,
+                          n_rows: int, n_time: int, horizon: int,
+                          origins: Sequence[int],
+                          window_config_hash: str):
+    """Adopt a grown campaign's committed windows from a prior manifest.
+
+    A committed window is adopted verbatim (zero fit compute) when the
+    new campaign would reproduce it byte-for-byte: same window identity
+    (``window_config_hash`` — everything but the origin grid), same row
+    count, the new panel's first ``t_prior`` columns bitwise-equal to
+    the prior panel, and the window placed at the SAME (index, origin)
+    so its training prefix, held-out actuals, and forecast seed are all
+    unchanged.  Every prior origin satisfied ``origin + horizon <=
+    t_prior``, so a matching (index, origin) is always fully scoreable
+    against the unchanged prefix.
+
+    Non-adopted indices get their prior window dirs / metrics shards
+    removed: those fit journals were written under a different training
+    prefix and would be rejected as stale by the chunk journal anyway.
+
+    Returns ``(adopted_windows, delta_info)`` or raises
+    :class:`StaleBacktestError` when the prior campaign is ineligible.
+    """
+
+    def _reject(why: str):
+        raise StaleBacktestError(
+            f"{mp} cannot seed a delta campaign: {why}. Use a fresh "
+            "directory or remove the stale manifest explicitly.")
+
+    if prior.get("window_config_hash") != window_config_hash:
+        _reject("window_config_hash mismatch — the per-window config "
+                "(model/knobs/horizon/chunk grid) changed, so no prior "
+                "window is reproducible")
+    if int(prior.get("n_rows", -1)) != n_rows:
+        _reject(f"row count changed ({prior.get('n_rows')} != {n_rows})")
+    t_prior = int(prior.get("n_time", -1))
+    if not 0 < t_prior <= n_time:
+        _reject(f"prior n_time {t_prior} is not a prefix of {n_time}")
+    prior_digest = prior.get("panel_digest")
+    if prior_digest is None:
+        _reject("prior manifest has no panel_digest (written before "
+                "delta-eligible campaigns)")
+    got = _panel_prefix_digest(y, t_prior)
+    if got != prior_digest:
+        _reject(f"the new panel's first {t_prior} columns differ from "
+                f"the prior panel (digest {got} != {prior_digest}) — "
+                "history was revised, not appended")
+
+    adopted: List[dict] = []
+    keep = set()
+    for w in prior.get("windows", []):
+        i, origin = int(w.get("index", -1)), int(w.get("origin", -1))
+        if (w.get("status") == "committed" and 0 <= i < len(origins)
+                and int(origins[i]) == origin
+                and origin + horizon <= t_prior):
+            entry = dict(w)
+            entry["window_class"] = "adopted"
+            adopted.append(entry)
+            keep.add(i)
+    adopted.sort(key=lambda w: int(w["index"]))
+    # sweep artifacts of non-adopted indices: their journals belong to
+    # the superseded origin grid and would be rejected as stale
+    for name in sorted(os.listdir(root)):
+        m = _WINDOW_DIR_RE.match(name) or _METRICS_FILE_RE.match(name)
+        if m is None or int(m.group(1)) in keep:
+            continue
+        path = os.path.join(root, name)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    delta_info = {
+        "prior_campaign_hash": prior.get("campaign_hash"),
+        "prior_n_time": t_prior,
+        "adopted": len(adopted),
+        "recomputed": len(origins) - len(adopted),
+    }
+    obs.event("backtest.delta_adopted", adopted=len(adopted),
+              recomputed=len(origins) - len(adopted), prior_n_time=t_prior)
+    return adopted, delta_info
+
+
 def _write_metrics_npz(path: str, arrays: dict) -> None:
     """Atomic npz write of one window's metrics shard (tmp -> fsync ->
     replace, the journal's own durability primitive)."""
@@ -235,6 +355,7 @@ def run_backtest(
     seed: Optional[int] = None,
     checkpoint_dir: Optional[str] = None,
     resume: str = "auto",
+    delta: bool = False,
     chunk_rows: Optional[int] = None,
     pipeline: bool = True,
     pipeline_depth: int = 2,
@@ -264,6 +385,19 @@ def run_backtest(
     them).  ``server=`` routes each window's forecast through a resident
     ``FitServer`` (micro-batched, journaled under the server's root) —
     the backtest doubling as the serving layer's stress client.
+
+    ``delta=True`` makes a GROWN panel adopt the prior campaign in the
+    same ``checkpoint_dir``: when the new panel's first ``t_prior``
+    columns are bitwise the prior panel (``panel_digest``) and the
+    per-window config matches (``window_config_hash``), every committed
+    window that lands at the same (index, origin) is adopted verbatim —
+    zero fit compute — and only windows whose origins moved or whose
+    actuals extend into the appended ticks are refit (warm-started as
+    usual).  The completed campaign is bitwise-identical to a fresh
+    run on the grown panel; per-class window counts and walls are
+    reported in ``meta["window_classes"]``.  ``delta`` changes WHICH
+    work is redone, never the bytes, so it is excluded from the
+    campaign identity.
     """
     horizon = int(horizon)
     if horizon < 1:
@@ -300,44 +434,70 @@ def run_backtest(
                "level": float(level) if intervals else None,
                "n_samples": int(n_samples) if intervals else None,
                "seed": seed, "chunk_rows": chunk_rows})
+    # window-level identity: everything that pins ONE window's bytes
+    # except the origin grid — two campaigns sharing it produce
+    # bitwise-identical windows wherever their (index, origin) pairs
+    # coincide, which is exactly what ``delta=True`` adoption relies on
+    window_config_hash = journal_mod.config_hash(
+        fit_fn_cold, {"fit_kwargs": fkw},
+        extra={"backtest_version": BACKTEST_VERSION, "model": model,
+               "model_kwargs": repr(mk), "horizon": horizon,
+               "warm_start": bool(warm_capable),
+               "intervals": bool(intervals),
+               "level": float(level) if intervals else None,
+               "n_samples": int(n_samples) if intervals else None,
+               "seed": seed, "chunk_rows": chunk_rows})
     fp = (y.fingerprint() if isinstance(y, source_mod.ChunkSource)
           else journal_mod.panel_fingerprint(y))
 
     root = None
     manifest = None
+    delta_info = None
     if checkpoint_dir is not None:
         root = os.path.abspath(checkpoint_dir)
         os.makedirs(root, exist_ok=True)
         mp = os.path.join(root, BACKTEST_MANIFEST)
+        adopted_windows: List[dict] = []
         if os.path.exists(mp):
             try:
                 with open(mp, "rb") as f:
-                    manifest = json.loads(f.read().decode())
+                    prior = json.loads(f.read().decode())
             except (json.JSONDecodeError, UnicodeDecodeError) as e:
                 raise StaleBacktestError(
                     f"{mp} does not parse ({e}); a crash tore the write "
                     "— inspect/remove the campaign directory explicitly."
                 ) from e
             mismatches = []
-            if manifest.get("campaign_hash") != campaign_hash:
+            if prior.get("campaign_hash") != campaign_hash:
                 mismatches.append("campaign_hash")
-            if manifest.get("panel_fingerprint") != fp:
+            if prior.get("panel_fingerprint") != fp:
                 mismatches.append("panel_fingerprint")
-            if int(manifest.get("n_rows", -1)) != b:
+            if int(prior.get("n_rows", -1)) != b:
                 mismatches.append("n_rows")
-            if mismatches:
+            if mismatches and delta:
+                adopted_windows, delta_info = _adopt_prior_campaign(
+                    prior, mp=mp, root=root, y=y, n_rows=b, n_time=t,
+                    horizon=horizon, origins=origins,
+                    window_config_hash=window_config_hash)
+            elif mismatches:
                 raise StaleBacktestError(
                     f"{mp} was written by a different campaign "
                     f"({', '.join(mismatches)} mismatch); resuming would "
-                    "splice foreign metrics — use a fresh directory or "
-                    "remove the stale one explicitly.")
+                    "splice foreign metrics — use a fresh directory, "
+                    "remove the stale one explicitly, or pass delta=True "
+                    "to adopt a prior campaign's windows on a grown "
+                    "panel.")
+            else:
+                manifest = prior
         if manifest is None:
             manifest = {
                 "kind": "backtest",
                 "backtest_version": BACKTEST_VERSION,
                 "created_at": time.time(),  # lint: nondet(manifest wall-clock metadata; never in metric bytes)
                 "campaign_hash": campaign_hash,
+                "window_config_hash": window_config_hash,
                 "panel_fingerprint": fp,
+                "panel_digest": _panel_prefix_digest(y, t),
                 "n_rows": b,
                 "n_time": t,
                 "model": model,
@@ -349,7 +509,8 @@ def run_backtest(
                 "intervals": bool(intervals),
                 "level": float(level) if intervals else None,
                 "n_samples": int(n_samples) if intervals else None,
-                "windows": [],
+                "windows": adopted_windows,
+                **({"delta": delta_info} if delta_info else {}),
             }
             _write_backtest_manifest(root, manifest)
 
@@ -369,6 +530,8 @@ def run_backtest(
 
     windows_out: List[dict] = []
     metric_arrays: List[dict] = []
+    class_counts = {"adopted": 0, "warm": 0, "cold": 0}
+    class_wall_s = {"adopted": 0.0, "warm": 0.0, "cold": 0.0}
     prev_res = None  # previous window's fit result (warm-start source)
     for i, origin in enumerate(origins):
         fit_dir = (os.path.join(root, f"window_{i:05d}")
@@ -376,6 +539,7 @@ def run_backtest(
         metrics_name = f"metrics_{i:05d}.npz"
         committed = by_index.get(i)
         if committed is not None and committed.get("status") == "committed":
+            t_skip = time.perf_counter()
             mpath = os.path.join(root, metrics_name)
             try:
                 with np.load(mpath, allow_pickle=False) as z:
@@ -384,11 +548,18 @@ def run_backtest(
                 arrays = None
             if arrays is not None and \
                     _metrics_digest(arrays) == committed.get("digest"):
+                cls = committed.get("window_class") or (
+                    "warm" if committed.get("warm_start") else "cold")
+                entry = dict(committed)
+                entry["window_class"] = cls
+                class_counts[cls] = class_counts.get(cls, 0) + 1
+                class_wall_s[cls] = (class_wall_s.get(cls, 0.0)
+                                     + time.perf_counter() - t_skip)
                 metric_arrays.append(arrays)
-                windows_out.append(dict(committed))
+                windows_out.append(entry)
                 prev_res = None  # reload lazily only if a later window fits
                 obs.event("backtest.window_skipped", window=i,
-                          origin=origin)
+                          origin=origin, window_class=cls)
                 continue
             # torn/missing metrics shard: recompute the window (the fit
             # journal makes that cheap — committed chunks replay)
@@ -445,10 +616,14 @@ def run_backtest(
             arrays["window"] = np.int64(i)
             wall = time.perf_counter() - t_w
         digest = _metrics_digest(arrays)
+        cls = "warm" if warm else "cold"
+        class_counts[cls] += 1
+        class_wall_s[cls] += wall
         entry = {
             "index": i, "origin": int(origin), "status": "committed",
             "rows": b, "horizon": horizon,
             "warm_start": bool(warm),
+            "window_class": cls,
             "fit_dir": (f"window_{i:05d}" if root is not None else None),
             "metrics_file": metrics_name if root is not None else None,
             "digest": digest,
@@ -483,7 +658,13 @@ def run_backtest(
                                  if w.get("status") == "committed"),
         "windows_timeout": sum(1 for w in windows_out
                                if w.get("status") == "timeout"),
+        "window_classes": {
+            "counts": class_counts,
+            "wall_s": {key: round(v, 4)
+                       for key, v in class_wall_s.items()},
+        },
         "wall_s": round(time.perf_counter() - t0, 4),
+        **({"delta": delta_info} if delta_info else {}),
     }
     return BacktestResult(windows_out, agg,
                           (os.path.join(root, BACKTEST_MANIFEST)

@@ -210,6 +210,7 @@ def forecast_chunked(
     prefetch_depth: int = 1,
     shard: bool = False,
     mesh=None,
+    sink=None,
     _journal_commit_hook=None,
 ) -> ForecastResult:
     """Forecast ``horizon`` steps for every row of ``y [B, T]``.
@@ -306,7 +307,7 @@ def forecast_chunked(
             chunk_budget_s=chunk_budget_s, job_budget_s=job_budget_s,
             pipeline=pipeline, pipeline_depth=pipeline_depth,
             prefetch_depth=prefetch_depth,
-            shard=shard, mesh=mesh,
+            shard=shard, mesh=mesh, sink=sink,
             journal_extra=journal_extra,
             _journal_commit_hook=_journal_commit_hook,
             # -- the forecast config (all hashed into the journal id) --
@@ -315,6 +316,17 @@ def forecast_chunked(
             intervals=bool(intervals), level=float(level),
             n_samples=int(n_samples), base_seed=int(base_seed),
         )
+    if res.params is None:
+        # write-back mode (ISSUE 20): the packed forecasts streamed out
+        # as durable output shards under key "params"; read them back at
+        # O(chunk) footprint with NpzShardSource(sink_dir, key="params")
+        # and split_forecast.  meta["sink"] carries the accounting and
+        # meta["status_counts"] the per-row outcome totals.
+        meta = dict(res.meta)
+        meta["forecast"] = {**journal_extra["forecast"],
+                            "status_counts": res.meta["status_counts"]}
+        obs.counter("forecast.walks").inc()
+        return ForecastResult(None, None, None, None, meta)
     point, lo, hi = split_forecast(res.params, int(horizon),
                                    bool(intervals))
     out_status = np.asarray(res.status, np.int8)

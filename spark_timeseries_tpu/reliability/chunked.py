@@ -84,6 +84,7 @@ from .. import obs
 from . import delta as delta_mod
 from . import journal as journal_mod
 from . import plan as plan_mod
+from . import sink as sink_mod
 from . import source as source_mod
 from . import watchdog as watchdog_mod
 from .plan import (ExecutionPlan, LaneRunner, LaneSpec, OOMBackoffExceeded,
@@ -122,6 +123,7 @@ def fit_chunked(
     grid: Optional[tuple] = None,
     delta_from: Optional[str] = None,
     delta_warmstart: bool = True,
+    sink=None,
     journal_extra: Optional[dict] = None,
     _journal_commit_hook=None,
     **fit_kwargs,
@@ -645,6 +647,29 @@ def fit_chunked(
         journal_extra = {**(journal_extra or {}),
                          "source": {"kind": src.kind,
                                     "panel_bytes": int(src.nbytes)}}
+    # -- write-back sink (ISSUE 20) ------------------------------------------
+    # results stream OUT as durable output shards instead of concatenating
+    # in host RAM: every committed chunk's arrays are handed to the sink's
+    # background writer (the committer's on_commit hook), the walk keeps
+    # boundary-only placeholders, and assembly finalizes the sink instead
+    # of materializing the panel-sized result.  The sink moves I/O only —
+    # like the pipeline knobs it is NOT part of the journal's config hash,
+    # so a sink walk resumes an in-RAM journal and vice versa.
+    if sink is not None:
+        if checkpoint_dir is None:
+            raise ValueError(
+                "sink= streams committed chunks out, so it requires a "
+                "journaled walk: pass checkpoint_dir= as well")
+        if sharded:
+            raise ValueError(
+                "sink= is not supported with shard=True/mesh=: output "
+                "shards are named by global row span and a merged "
+                "multi-lane sink is not implemented")
+        if isinstance(sink, (str, os.PathLike)):
+            sink = sink_mod.WritableChunkSource(sink)
+        journal_extra = {**(journal_extra or {}),
+                         "sink": {"directory": sink.directory,
+                                  "depth": sink.depth}}
     journals = None
     cfg = fp = None
     if checkpoint_dir is not None:
@@ -850,7 +875,8 @@ def fit_chunked(
         LaneRunner(plan, spec, fit_fn, fit_kwargs, vals,
                    journal=(lane_journals[i] if lane_journals is not None
                             else None),
-                   deadline=deadline, tele=tele, fit_key=fit_key)
+                   deadline=deadline, tele=tele, fit_key=fit_key,
+                   sink=sink)
         for i, (spec, (_sid, _lo, _hi, _dev, vals))
         in enumerate(zip(lane_specs, lanes))
     ] if not elastic else None
@@ -941,40 +967,67 @@ def fit_chunked(
         tele_chunks = [row for r in results for row in (r.tele_chunks or [])]
         tele_chunks.sort(key=lambda c: c["lo"])
 
-    # parameter width for synthesized TIMEOUT rows comes from any finished
-    # chunk; an all-TIMEOUT job degenerates to a single NaN column
-    k = next((int(np.asarray(p.params).shape[-1]) for _, _, p in pieces
-              if not isinstance(p, _TimeoutChunk)), 1)
     dtype = panel_dtype
-
-    def _mat(p):
-        if isinstance(p, _TimeoutChunk):
-            n = p.hi - p.lo
-            return (np.full((n, k), np.nan, dtype),
-                    np.full(n, np.nan, dtype),
-                    np.zeros(n, bool),
-                    np.zeros(n, np.int32),
-                    np.full(n, FitStatus.TIMEOUT, STATUS_DTYPE))
-        return (np.asarray(p.params), np.asarray(p.neg_log_likelihood),
-                np.asarray(p.converged), np.asarray(p.iters),
-                _piece_status(p))
-
-    mats = [_mat(p) for _, _, p in pieces]
-    if mats:
-        params = np.concatenate([m[0] for m in mats])
-        nll = np.concatenate([m[1] for m in mats])
-        conv = np.concatenate([m[2] for m in mats])
-        iters = np.concatenate([m[3] for m in mats])
-        status = np.concatenate([m[4] for m in mats])
+    sink_acct = None
+    if sink is not None:
+        # write-back assembly (ISSUE 20): every computed/resumed chunk
+        # already streamed out through the sink — only TIMEOUT spans are
+        # materialized here (as the NaN/TIMEOUT rows the in-RAM assembly
+        # would synthesize), then the sink verifies its spans tile
+        # [0, n_rows) and writes the durable sink manifest.  The result
+        # arrays stay None: the caller reads the output shards back at
+        # O(chunk) footprint (NpzShardSource over the sink directory).
+        sink.barrier()  # every queued write durable; param width known
+        k = sink.param_width or 1
+        for plo, phi, p in pieces:
+            if isinstance(p, _TimeoutChunk):
+                n = phi - plo
+                sink.write(plo, phi, {
+                    "params": np.full((n, k), np.nan, dtype),
+                    "nll": np.full(n, np.nan, dtype),
+                    "converged": np.zeros(n, bool),
+                    "iters": np.zeros(n, np.int32),
+                    "status": np.full(n, FitStatus.TIMEOUT, STATUS_DTYPE),
+                })
+        sink_acct = sink.finalize(b)
+        params = nll = conv = iters = status = None
+        counts = {m.name: int(sink_acct["status_counts"].get(
+            str(m.value), 0)) for m in FitStatus}
     else:
-        # a jax.distributed process whose addressable devices own no lane
-        # (fewer local spans than mesh devices): its LOCAL result is
-        # legitimately empty — it still joins the manifest barrier below
-        params = np.zeros((0, k), dtype)
-        nll = np.zeros(0, dtype)
-        conv = np.zeros(0, bool)
-        iters = np.zeros(0, np.int32)
-        status = np.zeros(0, STATUS_DTYPE)
+        # parameter width for synthesized TIMEOUT rows comes from any
+        # finished chunk; an all-TIMEOUT job degenerates to one NaN column
+        k = next((int(np.asarray(p.params).shape[-1]) for _, _, p in pieces
+                  if not isinstance(p, _TimeoutChunk)), 1)
+
+        def _mat(p):
+            if isinstance(p, _TimeoutChunk):
+                n = p.hi - p.lo
+                return (np.full((n, k), np.nan, dtype),
+                        np.full(n, np.nan, dtype),
+                        np.zeros(n, bool),
+                        np.zeros(n, np.int32),
+                        np.full(n, FitStatus.TIMEOUT, STATUS_DTYPE))
+            return (np.asarray(p.params), np.asarray(p.neg_log_likelihood),
+                    np.asarray(p.converged), np.asarray(p.iters),
+                    _piece_status(p))
+
+        mats = [_mat(p) for _, _, p in pieces]
+        if mats:
+            params = np.concatenate([m[0] for m in mats])
+            nll = np.concatenate([m[1] for m in mats])
+            conv = np.concatenate([m[2] for m in mats])
+            iters = np.concatenate([m[3] for m in mats])
+            status = np.concatenate([m[4] for m in mats])
+        else:
+            # a jax.distributed process whose addressable devices own no
+            # lane (fewer local spans than mesh devices): its LOCAL result
+            # is legitimately empty — it still joins the barrier below
+            params = np.zeros((0, k), dtype)
+            nll = np.zeros(0, dtype)
+            conv = np.zeros(0, bool)
+            iters = np.zeros(0, np.int32)
+            status = np.zeros(0, STATUS_DTYPE)
+        counts = status_counts(status)
 
     meta = {
         "chunk_rows_initial": chunk0,
@@ -985,8 +1038,10 @@ def fit_chunked(
         "timeouts": len(timeout_events),
         "timeout_events": timeout_events,
         "degraded": bool(oom_events or timeout_events),
-        "status_counts": status_counts(status),
+        "status_counts": counts,
     }
+    if sink_acct is not None:
+        meta["sink"] = sink_acct
     if sharded:
         meta["shards"] = {
             "n_shards": len(spans),

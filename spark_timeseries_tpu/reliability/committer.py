@@ -102,11 +102,16 @@ class ChunkCommitter:
 
     def __init__(self, journal, fetch: Callable[[object], dict], *,
                  depth: int = 2, probe: Optional[Callable] = None,
-                 status_counts: Optional[Callable] = None):
+                 status_counts: Optional[Callable] = None,
+                 on_commit: Optional[Callable] = None):
         self._journal = journal
         self._fetch = fetch
         self._probe = probe
         self._status_counts = status_counts
+        # write-back sink hook (ISSUE 20): called AFTER the journal commit
+        # is durable, with the fetched host arrays — the sink's own write
+        # failure surfaces through the same worker-error machinery
+        self._on_commit = on_commit
         self.depth = max(1, int(depth))
         self._q: queue.Queue = queue.Queue(maxsize=self.depth)
         self._lock = threading.Lock()
@@ -155,6 +160,8 @@ class ChunkCommitter:
                                 self._status_counts(arrays["status"]))
             self._journal.commit_chunk(item.lo, item.hi, arrays,
                                        wall_s=item.wall_s, **info)
+            if self._on_commit is not None:
+                self._on_commit(item.lo, item.hi, arrays)
         with self._lock:
             self._commits += 1
             self._commit_wall_s += time.perf_counter() - t0

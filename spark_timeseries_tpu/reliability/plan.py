@@ -233,6 +233,18 @@ class _TimeoutChunk:
         self.lo, self.hi = lo, hi
 
 
+class _SunkChunk:
+    """Placeholder for a chunk whose result already streamed out through
+    the write-back sink (ISSUE 20): the walk keeps only its boundaries,
+    so a sink-mode walk's host footprint stays O(chunk) instead of
+    accumulating every chunk's arrays for the final concatenate."""
+
+    __slots__ = ("lo", "hi")
+
+    def __init__(self, lo: int, hi: int):
+        self.lo, self.hi = lo, hi
+
+
 def _piece_status(p) -> np.ndarray:
     """Status of one chunk result; synthesized when the fit has none."""
     status = getattr(p, "status", None)
@@ -346,13 +358,17 @@ class LaneRunner:
 
     def __init__(self, plan: ExecutionPlan, spec: LaneSpec, fit_fn: Callable,
                  fit_kwargs: dict, values, *, journal=None, deadline=None,
-                 tele: bool = False, fit_key=None):
+                 tele: bool = False, fit_key=None, sink=None):
         self.plan = plan
         self.spec = spec
         self.fit_fn = fit_fn
         self.fit_kwargs = fit_kwargs
         self.values = values
         self.journal = journal
+        # write-back sink (ISSUE 20): every committed chunk's host arrays
+        # stream out through it, and the pieces list keeps boundary-only
+        # placeholders — the walk never accumulates result arrays
+        self.sink = sink
         self.deadline = deadline or watchdog_mod.Deadline(plan.job_budget_s)
         self.tele = tele
         self.fit_key = fit_key
@@ -395,7 +411,8 @@ class LaneRunner:
         if journal is not None and plan.pipeline:
             self.committer = committer_mod.ChunkCommitter(
                 journal, _commit_arrays, depth=plan.pipeline_depth,
-                probe=obs.peak_memory, status_counts=status_counts)
+                probe=obs.peak_memory, status_counts=status_counts,
+                on_commit=(sink.write if sink is not None else None))
         # input-side pipeline: stage chunk N+1's slice while chunk N
         # computes.  Only sliced walks stage (a whole-span chunk has no
         # next slice), and pipeline=False stays the fully serial escape
@@ -541,6 +558,10 @@ class LaneRunner:
             raise e
         new_chunk = self._record_oom(flo, fhi - flo, e)
         self.pieces[:] = [p for p in self.pieces if p[0] < flo]
+        if self.sink is not None:
+            # defensive: in-order commits mean spans >= flo never reached
+            # the sink, but the rolled-back grid must not leave any behind
+            self.sink.discard_from(flo)
         if self.tele:
             self.tele_chunks[:] = [r for r in self.tele_chunks
                                    if r["lo"] < flo]
@@ -637,6 +658,15 @@ class LaneRunner:
                     piece = journal.load_chunk(entry)
                     if piece is not None:
                         self._note_busy(int(entry["hi"]))  # not stealable
+                        if self.sink is not None:
+                            # resume re-emits the chunk through the sink:
+                            # the durable re-write replaces any torn or
+                            # missing output shard with the same bytes,
+                            # which is what makes a killed-and-resumed
+                            # sink directory finalize bitwise-identical
+                            self.sink.write(lo, int(entry["hi"]),
+                                            _commit_arrays(piece))
+                            piece = _SunkChunk(lo, int(entry["hi"]))
                         self.pieces.append((lo, int(entry["hi"]), piece))
                         if tele:
                             self.tele_chunks.append(
@@ -876,7 +906,15 @@ class LaneRunner:
                            if pm.staging_pool_bytes is not None else {}),
                         **owner,
                     )
-            self.pieces.append((lo, hi, piece))
+                    if self.sink is not None:
+                        self.sink.write(lo, hi, arrays)
+            if self.sink is not None:
+                # the committer (or the serial path above) owns the real
+                # piece until its arrays are durable in the sink; the walk
+                # keeps only the boundaries
+                self.pieces.append((lo, hi, _SunkChunk(lo, hi)))
+            else:
+                self.pieces.append((lo, hi, piece))
             with self._mu:
                 self._rows_done += hi - lo
             lo = hi

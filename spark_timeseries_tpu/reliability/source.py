@@ -715,12 +715,350 @@ class NpzShardSource(ChunkSource):
             return self._fingerprint
 
 
+def _pyarrow():
+    """Import pyarrow lazily; parquet support is optional and the error
+    must say so instead of an ImportError from the middle of a walk."""
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+    except Exception as e:  # pragma: no cover - environment-dependent
+        raise SourceError(
+            "parquet shard support requires pyarrow, which is not "
+            f"available here ({e}); write npz shards instead or install "
+            "pyarrow") from e
+    return pa, pq
+
+
+_PARQUET_DIGEST_KEY = b"spark_ts_sha256"
+
+
+def _parquet_shard_header(path: str):
+    """(rows, n_cols, dtype, digest) of one parquet shard from its footer
+    METADATA only — no row groups are decoded.  ``digest`` is the content
+    sha256 our writer stamps into the file's key-value metadata; ``None``
+    for foreign files (fingerprinting then hashes the file bytes)."""
+    _pa, pq = _pyarrow()
+    pf = pq.ParquetFile(path)
+    meta = pf.metadata
+    schema = pf.schema_arrow
+    if len(schema) != 1:
+        raise SourceError(
+            f"parquet shard {path} has {len(schema)} columns "
+            f"({schema.names}); expected one fixed_size_list column")
+    field = schema.field(0)
+    import pyarrow as pa
+    if not pa.types.is_fixed_size_list(field.type):
+        raise SourceError(
+            f"parquet shard {path} column {field.name!r} is {field.type}; "
+            "expected fixed_size_list<value_type>[n_time]")
+    n_cols = int(field.type.list_size)
+    dtype = np.dtype(field.type.value_type.to_pandas_dtype())
+    digest = None
+    kv = meta.metadata or {}
+    raw = kv.get(_PARQUET_DIGEST_KEY)
+    if raw is not None:
+        digest = raw.decode("ascii", errors="replace")
+    return int(meta.num_rows), n_cols, dtype, digest, field.name
+
+
+class ParquetShardSource(ChunkSource):
+    """A panel stored as a directory of row-partitioned ``.parquet``
+    shards — the arrow sibling of :class:`NpzShardSource`.
+
+    Each shard holds one ``fixed_size_list<dtype>[n_time]`` column (one
+    list per series row).  Files matching ``*.parquet`` are taken in
+    sorted name order; footer METADATA is read at construction — row
+    counts, list width, value dtype, no row-group decode — and a shard
+    whose layout disagrees with the first is rejected there, before any
+    compute.  Zero-row shards are tolerated and skipped; hidden
+    ``.tmp-*`` orphans from a crashed append are excluded, so a torn
+    writer can never shift row offsets.  A shard whose footer is
+    damaged/torn raises :class:`SourceError` naming the file.
+
+    Reads go through the same staging-pool machinery as every other
+    residency, with a 2-shard decompression cache; the float bytes a
+    walk stages are identical to the npz spelling of the same panel, so
+    journals, delta plans, and forecasts are bitwise-interchangeable
+    across the two on-disk layouts.
+    """
+
+    kind = "parquet_dir"
+
+    def __init__(self, directory, key: Optional[str] = None,
+                 cache_shards: int = 2):
+        self.directory = os.path.abspath(os.fspath(directory))
+        self.key = key
+        names = sorted(n for n in os.listdir(self.directory)
+                       if n.endswith(".parquet") and not n.startswith("."))
+        if not names:
+            raise SourceError(f"no .parquet shards in {self.directory}")
+        self._shards: list = []  # (path, column, row_lo, row_hi, digest)
+        n_cols = dtype = column = None
+        row = 0
+        for fname in names:
+            path = os.path.join(self.directory, fname)
+            try:
+                rows, cols, dt, digest, col = _parquet_shard_header(path)
+            except SourceError:
+                raise
+            except Exception as e:
+                raise SourceError(
+                    f"input shard {path} is unreadable/torn ({e}); input "
+                    "data cannot be recomputed — restore the shard or "
+                    "rebuild the source directory") from e
+            if key is not None and col != key:
+                raise SourceError(
+                    f"shard {path} holds column {col!r}, not {key!r}")
+            if rows == 0:
+                continue  # empty trailing shard: legal, no rows to serve
+            if n_cols is None:
+                n_cols, dtype, column = cols, dt, col
+            elif cols != n_cols or dt != dtype or col != column:
+                raise SourceError(
+                    f"shard {path} is [{rows}, {cols}] {dt} column "
+                    f"{col!r}, but the panel is [*, {n_cols}] {dtype} "
+                    f"column {column!r}; mixed shard layouts are rejected "
+                    "before compute")
+            self._shards.append((path, col, row, row + rows, digest))
+            row += rows
+        if n_cols is None:
+            raise SourceError(
+                f"{self.directory} holds only zero-row shards")
+        super().__init__((row, n_cols), dtype)
+        self.default_chunk_rows = self._shards[0][3] - self._shards[0][2]
+        self._cache_n = max(1, int(cache_shards))
+        self._cache: dict = {}  # path -> (tick, array)
+        self._tick = 0
+
+    def _load(self, path: str, column: str, rows: int) -> np.ndarray:
+        with self._mu:
+            hit = self._cache.get(path)
+            if hit is not None:
+                self._tick += 1
+                self._cache[path] = (self._tick, hit[1])
+                return hit[1]
+        _pa, pq = _pyarrow()
+        try:
+            table = pq.read_table(path, columns=[column])
+            col = table.column(column).combine_chunks()
+            arr = np.asarray(col.values).reshape(len(col), self.shape[1])
+        except Exception as e:
+            raise SourceError(
+                f"input shard {path} is unreadable/torn ({e}); input data "
+                "cannot be recomputed — restore the shard or rebuild the "
+                "source directory") from e
+        if arr.shape != (rows, self.shape[1]) or arr.dtype != self.dtype:
+            raise SourceError(
+                f"input shard {path} payload is {arr.shape} {arr.dtype}, "
+                f"but its footer promised ({rows}, {self.shape[1]}) "
+                f"{self.dtype} — the shard changed after the source "
+                "was opened")
+        with self._mu:
+            self._tick += 1
+            self._cache[path] = (self._tick, arr)
+            while len(self._cache) > self._cache_n:
+                oldest = min(self._cache, key=lambda p: self._cache[p][0])
+                del self._cache[oldest]
+        return arr
+
+    def read_rows(self, lo, hi, out):
+        for path, column, slo, shi, _d in self._shards:
+            if shi <= lo or slo >= hi:
+                continue
+            a, b = max(lo, slo), min(hi, shi)
+            arr = self._load(path, column, shi - slo)
+            np.copyto(out[a - lo:b - lo], arr[a - slo:b - slo])
+
+    def _nan_probe(self):
+        nan_any = nan_last = False
+        for path, column, slo, shi, _d in self._shards:
+            arr = self._load(path, column, shi - slo)
+            nan = np.isnan(arr)
+            nan_any = nan_any or bool(nan.any())
+            nan_last = nan_last or bool(nan[:, -1].any())
+            if nan_last:
+                break
+        return nan_any, nan_last
+
+    def append_rows(self, values, rows_per_shard: Optional[int] = None
+                    ) -> "ParquetShardSource":
+        """Append NEW series as additional ``part_*.parquet`` files
+        (existing shards untouched) and return a fresh source."""
+        write_parquet_shards(self.directory, values,
+                             rows_per_shard=rows_per_shard,
+                             key=self.key or self._shards[0][1],
+                             append_rows=True)
+        return ParquetShardSource(self.directory, key=self.key,
+                                  cache_shards=self._cache_n)
+
+    def append_time(self, values) -> "ParquetShardSource":
+        """Append new time steps to EVERY row — each shard atomically
+        rewritten — and return a fresh source over the grown panel."""
+        write_parquet_shards(self.directory, values,
+                             key=self.key or self._shards[0][1],
+                             append_time=True)
+        return ParquetShardSource(self.directory, key=self.key,
+                                  cache_shards=self._cache_n)
+
+    def _shard_digest(self, path: str, digest: Optional[str]) -> str:
+        if digest is not None:
+            return digest
+        # foreign file without our stamped content digest: hash the file
+        # bytes once — same identity guarantee, paid at fingerprint time
+        import hashlib
+
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for block in iter(lambda: f.read(1 << 20), b""):
+                h.update(block)
+        return h.hexdigest()
+
+    def fingerprint(self) -> str:
+        """Content-derived without decoding row groups: shape/dtype plus
+        every shard's (name, rows, payload sha256).  The digest is
+        stamped into the parquet key-value metadata by
+        :func:`write_parquet_shards`; foreign files fall back to hashing
+        the file bytes.  Like the npz spelling, a shard-dir fingerprint
+        differs from the same panel's in-RAM fingerprint — a journal
+        follows its source spelling."""
+        with self._mu:
+            if self._fingerprint is None:
+                import hashlib
+
+                h = hashlib.sha256(
+                    f"parquetdir:{self.shape}:{self.dtype}".encode())
+                for path, _c, slo, shi, digest in self._shards:
+                    h.update(f"{os.path.basename(path)}:{shi - slo}:"
+                             f"{self._shard_digest(path, digest)}".encode())
+                self._fingerprint = h.hexdigest()[:16]
+            return self._fingerprint
+
+
+def _write_parquet_file(f, values: np.ndarray, column: str) -> None:
+    """Write ``values [rows, T]`` to an open file object as one
+    fixed_size_list column, content digest stamped in the metadata."""
+    import hashlib
+
+    pa, pq = _pyarrow()
+    rows, n_cols = values.shape
+    flat = pa.array(np.ascontiguousarray(values).reshape(-1))
+    col = pa.FixedSizeListArray.from_arrays(flat, n_cols)
+    digest = hashlib.sha256(np.ascontiguousarray(values).tobytes())
+    table = pa.table({column: col})
+    table = table.replace_schema_metadata(
+        {_PARQUET_DIGEST_KEY: digest.hexdigest().encode()})
+    pq.write_table(table, f)
+
+
+def write_parquet_shards(directory, values,
+                         rows_per_shard: Optional[int] = None,
+                         key: str = "values", *, append_rows: bool = False,
+                         append_time: bool = False,
+                         expect_time: Optional[int] = None) -> Sequence[str]:
+    """Write ``values [B, T]`` as a row-partitioned ``.parquet`` shard
+    directory that :class:`ParquetShardSource` reads back — same naming,
+    same durability, and same append semantics as
+    :func:`write_npz_shards` (``expect_time`` included), with the
+    content digest stamped into each shard's key-value metadata."""
+    values = np.asarray(values)
+    if values.ndim != 2:
+        raise SourceError(f"expected [batch, time], got {values.shape}")
+    if append_rows and append_time:
+        raise SourceError("append_rows and append_time are exclusive: "
+                          "appended series and appended time steps are "
+                          "different shard edits")
+    if append_rows or append_time:
+        existing = sorted(n for n in os.listdir(directory)
+                          if n.endswith(".parquet")
+                          and not n.startswith("."))
+        if not existing:
+            raise SourceError(f"nothing to append to: no .parquet shards "
+                              f"in {directory}")
+    if append_time:
+        # validated UP FRONT from footers, and per-shard width-gated so a
+        # killed append re-runs to completion (see write_npz_shards)
+        dt = values.shape[1]
+        headers = []
+        total_rows = 0
+        widths = set()
+        for fname in existing:
+            path = os.path.join(directory, fname)
+            rows, cols, _dt, _dig, col = _parquet_shard_header(path)
+            headers.append((path, rows, cols, col))
+            total_rows += rows
+            widths.add(cols)
+        if total_rows != values.shape[0]:
+            raise SourceError(
+                f"append_time values have {values.shape[0]} rows but the "
+                f"directory holds {total_rows}")
+        if expect_time is not None:
+            allowed = {int(expect_time), int(expect_time) + dt}
+            if not widths <= allowed:
+                raise SourceError(
+                    f"append_time(expect_time={expect_time}) found shard "
+                    f"widths {sorted(widths)}; expected only "
+                    f"{sorted(allowed)}")
+        elif len(widths) > 1:
+            raise SourceError(
+                f"append_time found mixed shard widths {sorted(widths)}; "
+                "pass expect_time= to resume a torn append")
+        paths = []
+        row = 0
+        for path, rows, cols, col in headers:
+            lo, hi = row, row + rows
+            row = hi
+            if expect_time is not None and cols == int(expect_time) + dt:
+                paths.append(path)  # already appended: idempotent skip
+                continue
+            _pa, pq = _pyarrow()
+            table = pq.read_table(path, columns=[col])
+            carr = table.column(col).combine_chunks()
+            old = np.asarray(carr.values).reshape(rows, cols)
+            merged = np.concatenate(
+                [old, values[lo:hi].astype(old.dtype)], axis=1)
+            _durable_replace(path, lambda f, c=col, m=merged:
+                             _write_parquet_file(f, m, c),
+                             suffix=".parquet")
+            paths.append(path)
+        return paths
+    start = 0
+    if append_rows:
+        start = len(existing)
+        rows0, cols0, dt0, _dig, _col = _parquet_shard_header(
+            os.path.join(directory, existing[0]))
+        if values.shape[1] != cols0 or values.dtype != dt0:
+            raise SourceError(
+                f"append_rows values are [*, {values.shape[1]}] "
+                f"{values.dtype}, but the directory holds [*, {cols0}] "
+                f"{dt0} shards")
+        if rows_per_shard is None:
+            rows_per_shard = max(1, rows0)
+    if rows_per_shard is None:
+        raise SourceError("rows_per_shard is required when writing a "
+                          "fresh shard directory")
+    rows_per_shard = max(1, int(rows_per_shard))
+    os.makedirs(directory, exist_ok=True)
+    paths = []
+    n = -(-values.shape[0] // rows_per_shard)
+    for i in range(n):
+        lo = i * rows_per_shard
+        hi = min(lo + rows_per_shard, values.shape[0])
+        path = os.path.join(directory, f"part_{start + i:05d}.parquet")
+        _durable_replace(path, lambda f, lo=lo, hi=hi:
+                         _write_parquet_file(f, values[lo:hi], key),
+                         suffix=".parquet")
+        paths.append(path)
+    return paths
+
+
 def as_source(obj, **kwargs) -> ChunkSource:
     """Coerce a panel spelling into a :class:`ChunkSource`.
 
     - a ``ChunkSource`` passes through;
     - a directory path (str / ``os.PathLike``) opens an
-      :class:`NpzShardSource` (``key=`` rides along);
+      :class:`NpzShardSource`, or a :class:`ParquetShardSource` when the
+      directory holds ``.parquet`` shards and no ``.npz`` ones
+      (``key=`` rides along either way);
     - a host ``np.ndarray`` becomes a :class:`HostChunkSource`
       (host-resident walk — the opt-in this function exists for);
     - anything else (device arrays) becomes a :class:`DeviceChunkSource`.
@@ -728,6 +1066,12 @@ def as_source(obj, **kwargs) -> ChunkSource:
     if isinstance(obj, ChunkSource):
         return obj
     if isinstance(obj, (str, os.PathLike)):
+        path = os.fspath(obj)
+        if os.path.isdir(path):
+            names = [n for n in os.listdir(path) if not n.startswith(".")]
+            if any(n.endswith(".parquet") for n in names) and \
+                    not any(n.endswith(".npz") for n in names):
+                return ParquetShardSource(path, **kwargs)
         return NpzShardSource(obj, **kwargs)
     if isinstance(obj, np.ndarray):
         return HostChunkSource(obj)
@@ -736,7 +1080,8 @@ def as_source(obj, **kwargs) -> ChunkSource:
 
 def write_npz_shards(directory, values, rows_per_shard: Optional[int] = None,
                      key: str = "values", *, append_rows: bool = False,
-                     append_time: bool = False) -> Sequence[str]:
+                     append_time: bool = False,
+                     expect_time: Optional[int] = None) -> Sequence[str]:
     """Write ``values [B, T]`` as a row-partitioned shard directory that
     :class:`NpzShardSource` reads back — the test/bench/docs helper for
     producing larger-than-HBM inputs (real pipelines write shards from
@@ -754,7 +1099,14 @@ def write_npz_shards(directory, values, rows_per_shard: Optional[int] = None,
       steps for EVERY existing row; each shard is rewritten atomically
       (tmp → ``os.replace``) with its row-slice of the new columns —
       rewriting is unavoidable (every row grows), but a reader never
-      sees a torn shard.
+      sees a torn shard.  A kill BETWEEN shard rewrites still leaves the
+      directory mixed-width; pass ``expect_time=`` (the pre-append
+      width) to make the call idempotent — shards already at
+      ``expect_time + dt`` are skipped, shards still at ``expect_time``
+      are appended, any other width is rejected.  Re-running the same
+      append with the same values therefore always converges to the
+      fully-appended directory, which is what the tick loop's
+      kill-anywhere resume leans on.
 
     Both flags assume the ``part_%05d`` naming this function writes.
     Returns the paths written.
@@ -778,33 +1130,55 @@ def write_npz_shards(directory, values, rows_per_shard: Optional[int] = None,
     if append_time:
         # row-count validated UP FRONT from the zip headers: failing
         # mid-loop would leave the directory torn across shards (some
-        # rewritten at T+dt, the rest still at T)
+        # rewritten at T+dt, the rest still at T).  With expect_time=
+        # the loop is additionally width-gated per shard, so re-running
+        # the same append finishes a torn one instead of failing.
+        dt_cols = values.shape[1]
+        headers = []
         total_rows = 0
+        widths = set()
         for fname in existing:
             with zipfile.ZipFile(os.path.join(directory, fname)) as zf:
                 member = next(n for n in zf.namelist()
                               if n.endswith(".npy"))
                 shape, _dt = _npz_member_header(zf, member)
+            headers.append((fname, int(shape[0]), int(shape[1])))
             total_rows += int(shape[0])
+            widths.add(int(shape[1]))
         if total_rows != values.shape[0]:
             raise SourceError(
                 f"append_time values have {values.shape[0]} rows but the "
                 f"directory holds {total_rows}")
+        if expect_time is not None:
+            allowed = {int(expect_time), int(expect_time) + dt_cols}
+            if not widths <= allowed:
+                raise SourceError(
+                    f"append_time(expect_time={expect_time}) found shard "
+                    f"widths {sorted(widths)}; expected only "
+                    f"{sorted(allowed)}")
+        elif len(widths) > 1:
+            raise SourceError(
+                f"append_time found mixed shard widths {sorted(widths)}; "
+                "pass expect_time= to resume a torn append")
         paths = []
         row = 0
-        for fname in existing:
+        for fname, rows, cols in headers:
             path = os.path.join(directory, fname)
+            lo, hi = row, row + rows
+            row = hi
+            if expect_time is not None and \
+                    cols == int(expect_time) + dt_cols:
+                paths.append(path)  # already appended: idempotent skip
+                continue
             with np.load(path, allow_pickle=False) as z:
                 names = list(z.files)
                 k = key if key in names else names[0]
                 old = z[k]
-            lo, hi = row, row + old.shape[0]
             merged = np.concatenate(
                 [old, values[lo:hi].astype(old.dtype)], axis=1)
             _durable_replace(path, lambda f, k=k, m=merged:
                              np.savez(f, **{k: m}), suffix=".npz")
             paths.append(path)
-            row = hi
         return paths
     start = 0
     if append_rows:

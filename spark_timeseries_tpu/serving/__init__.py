@@ -43,6 +43,13 @@ Quickstart::
   per-endpoint circuit breaker / primary belief / latency EWMA; writes
   prefer the believed primary, reads fan to healthy standbys, failing
   endpoints cool down on a seeded deterministic schedule.
+- :mod:`.profiles` — :class:`TenantProfileStore` (ISSUE 19): durable
+  per-tenant auto-fit profiles with TTL/count eviction; repeat tenants
+  route to warm stepwise searches.
+- :mod:`.tickloop` — :class:`TickLoop` (ISSUE 20): the tick-to-forecast
+  streaming loop — record tick batch, idempotent shard append,
+  delta-warm refit, forecast, publish through a write-back sink, all as
+  one journaled cycle that resumes bitwise after SIGKILL.
 - :mod:`.fleet` — :class:`FleetReplica`: N replicas on one checkpoint
   root under a lease/fencing protocol; a SIGKILLed primary's write-ahead
   requests are taken over and re-answered bitwise by a surviving peer,
@@ -53,26 +60,29 @@ Quickstart::
   (:class:`StorageError` backpressure, ``storage_degraded`` on the wire).
 """
 
-from . import (admission, batcher, client, fleet, health, server, session,
-               transport)
+from . import (admission, batcher, client, fleet, health, profiles, server,
+               session, tickloop, transport)
 from .admission import AdmissionQueue, TenantQuota
 from .batcher import MicroBatch, batch_key
 from .client import ClientDeadlineError, FitClient, RemoteTicket, backoff_schedule
 from .fleet import FleetReplica, discover_endpoints
 from .health import EndpointHealthCache, cooldown_schedule
+from .profiles import TenantProfileStore
 from .server import FORECAST_MODEL, FitServer
 from .session import (CancelledError, FitRequest, FitTicket, RejectedError,
                       ServerClosedError, StorageError, TenantFitResult)
+from .tickloop import CycleResult, TickLoop, TickLoopError
 from .transport import (FrameError, NotLeaderError, ReadOnlyError,
                         TransportError, TransportServer, WireAuthError,
                         resolve_wire_secret)
 
 __all__ = [
-    "FORECAST_MODEL",
     "AdmissionQueue",
     "CancelledError",
     "ClientDeadlineError",
+    "CycleResult",
     "EndpointHealthCache",
+    "FORECAST_MODEL",
     "FitClient",
     "FitRequest",
     "FitServer",
@@ -87,7 +97,10 @@ __all__ = [
     "ServerClosedError",
     "StorageError",
     "TenantFitResult",
+    "TenantProfileStore",
     "TenantQuota",
+    "TickLoop",
+    "TickLoopError",
     "TransportError",
     "TransportServer",
     "WireAuthError",
@@ -100,8 +113,10 @@ __all__ = [
     "discover_endpoints",
     "fleet",
     "health",
+    "profiles",
     "resolve_wire_secret",
     "server",
     "session",
+    "tickloop",
     "transport",
 ]

@@ -32,7 +32,8 @@ import json
 import os
 import re
 import threading
-from typing import Callable, Dict, Optional
+import time
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -83,13 +84,31 @@ class TenantProfileStore:
     write and must raise to refuse the write — profile updates obey the
     same zombie-writer discipline as result stores and journal commits.
     Plain (non-fleet) servers leave it ``None``.
+
+    ``max_age_s`` / ``max_profiles`` bound the store (ISSUE 20 residue
+    of ISSUE 19): profiles older than ``max_age_s`` since their last
+    update, and the oldest profiles beyond ``max_profiles``, are
+    evicted — a dormant tenant's warm state must not hold the shared
+    root's disk forever.  Eviction runs after every fenced
+    :meth:`update` and on demand via :meth:`evict`; deletes are fenced
+    exactly like writes (a zombie primary must not reap the survivor's
+    profiles).  ``clock`` is injectable for tests; wall-clock here is
+    metadata-only and never feeds fitted bytes.
     """
 
     _protected_by_ = {"_cache": "_lock"}
 
-    def __init__(self, root: str, *, fence: Optional[Callable] = None):
+    def __init__(self, root: str, *, fence: Optional[Callable] = None,
+                 max_age_s: Optional[float] = None,
+                 max_profiles: Optional[int] = None,
+                 clock: Callable[[], float] = time.time):
         self.root = os.path.abspath(root)
         self.fence = fence
+        self.max_age_s = (float(max_age_s) if max_age_s is not None
+                          else None)
+        self.max_profiles = (int(max_profiles) if max_profiles is not None
+                             else None)
+        self._clock = clock
         self._lock = threading.Lock()
         self._cache: Dict[str, tuple] = {}
 
@@ -197,6 +216,7 @@ class TenantProfileStore:
                 stability = int(prev.get("stability", 0)) + 1
         meta = {
             "version": PROFILE_VERSION,
+            "updated_at": float(self._clock()),
             "tenant": str(tenant),
             "fingerprint": journal_mod.panel_fingerprint(values),
             "prefix_cols": int(values.shape[1]),
@@ -232,9 +252,61 @@ class TenantProfileStore:
                                     fault_kind="profile")
         with self._lock:
             self._cache.pop(tenant, None)
+        if self.max_age_s is not None or self.max_profiles is not None:
+            self.evict()
         prof = dict(meta)
         prof.update(arrays)
         return prof
+
+    def evict(self, now: Optional[float] = None) -> List[str]:
+        """Reap expired and over-count profiles; returns evicted tenants.
+
+        Age expiry first (``updated_at`` older than ``max_age_s``; a
+        profile without the stamp — written before eviction existed —
+        counts as oldest), then the count bound keeps the
+        ``max_profiles`` NEWEST by ``updated_at``.  Each unlink is
+        fenced like a write: on a fleet root only the leaseholder may
+        reap, and a zombie dies in ``FencedError`` before the first
+        delete.
+        """
+        now = float(self._clock()) if now is None else float(now)
+        profs = []
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return []
+        for fn in names:
+            if not fn.endswith(".npz") or fn.startswith(".tmp-"):
+                continue
+            path = os.path.join(self.root, fn)
+            prof = self._read(path)
+            if prof is None:
+                continue
+            profs.append((float(prof.get("updated_at", -1.0)),
+                          str(prof["tenant"]), path))
+        doomed = []
+        if self.max_age_s is not None:
+            doomed = [p for p in profs if now - p[0] > self.max_age_s]
+            profs = [p for p in profs if now - p[0] <= self.max_age_s]
+        if self.max_profiles is not None and len(profs) > self.max_profiles:
+            profs.sort(key=lambda p: (p[0], p[1]))
+            cut = len(profs) - self.max_profiles
+            doomed.extend(profs[:cut])
+        if not doomed:
+            return []
+        if self.fence is not None:
+            # deletes obey the same zombie-writer discipline as writes
+            self.fence()
+        evicted = []
+        for _, tenant, path in doomed:
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            evicted.append(tenant)
+            with self._lock:
+                self._cache.pop(tenant, None)
+        return sorted(evicted)
 
 
 def _winner_orders(orders: np.ndarray, order_index: np.ndarray) -> np.ndarray:

@@ -411,7 +411,7 @@ class TestProbeCompact:
         # the engagement plan must fire for this shape (max_iters=60
         # default, init_params exposed)
         full, probe_iters = delta_mod._probe_plan(fit_fn, 16, {})
-        assert full == 60 and probe_iters == 7
+        assert full == 60 and probe_iters == 4
         # and there must be real stragglers at the probe budget, else
         # this test pins nothing
         pr = fit_fn(y, init_params=np.where(np.isfinite(init), init, 0.0))
@@ -449,12 +449,18 @@ class TestProbeCompact:
         assert probe.__qualname__ != plain.__qualname__
         assert "compact=False" in plain.__qualname__
 
-    def test_explicit_max_iters_disables_the_probe(self):
+    def test_explicit_max_iters_is_the_probe_budget(self):
         import functools
 
         fit_fn = functools.partial(arima.fit, order=(1, 0, 0))
+        # a caller-pinned budget IS the full budget the probe splits
+        # (the delta walks pin max_iters=, and they are exactly the
+        # dispatches compaction exists for)
         assert delta_mod._probe_plan(fit_fn, 128,
-                                     {"max_iters": 20}) is None
+                                     {"max_iters": 64}) == (64, 4)
+        # ... unless it is too small for the two-stage split to pay
+        assert delta_mod._probe_plan(fit_fn, 128,
+                                     {"max_iters": 7}) is None
         assert delta_mod._probe_plan(fit_fn, 4, {}) is None
 
 

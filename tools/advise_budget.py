@@ -962,6 +962,216 @@ def _render_chaos(root: str, a: dict) -> None:
               "(longest observed outage x4 headroom)")
 
 
+def advise_tickloop(root: str) -> dict:
+    """Tick-loop advice (ISSUE 20): a ``serving.TickLoop`` root —
+    ``tickloop.json`` plus one ``cycle_%05d`` dir per completed tick
+    batch — instead of one walk's manifest.
+
+    Per published cycle the loop records its stage walls (append / fit /
+    publish) and the fit's delta classification; the advisor aggregates:
+
+    - **cycle cadence** — the sustained tick-to-publish wall with 2x
+      headroom is the shortest tick interval the loop keeps up with;
+      feed ticks faster than that and cycles queue behind the fit;
+    - **delta_from chaining** — whether the warm chain is actually
+      paying: the across-cycle dirty fraction (warm+dirty+new over all
+      chunks) near 1.0 with ``delta=False`` says turn chaining ON; a
+      low dirty fraction confirms the appended-ticks fast path held;
+    - the per-walk knobs (``chunk_rows``, budgets, depths) from the
+      newest published cycle's fit journal via :func:`advise` — every
+      cycle refits the same grown panel under the same config hash.
+    """
+    mp = os.path.join(root, "tickloop.json") if os.path.isdir(root) \
+        else root
+    try:
+        with open(mp, "rb") as f:
+            m = json.loads(f.read().decode())
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        sys.exit(f"advise_budget: tickloop manifest {mp} unreadable ({e})")
+    base = root if os.path.isdir(root) else os.path.dirname(mp)
+    cycles = []
+    for name in sorted(os.listdir(base)):
+        cm_path = os.path.join(base, name, "tick_manifest.json")
+        if not (name.startswith("cycle_") and os.path.exists(cm_path)):
+            continue
+        try:
+            with open(cm_path, "rb") as f:
+                cycles.append((name, json.loads(f.read().decode())))
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            continue
+    published = [(n, c) for n, c in cycles
+                 if c.get("stage") == "published"]
+    if not published:
+        return {"error": "no published cycles to learn from",
+                "tickloop": {"cycles_seen": len(cycles)}}
+    cycle_walls, counts = [], {}
+    ticks_total = 0
+    for _, c in published:
+        w = c.get("walls") or {}
+        cycle_walls.append(sum(v for v in w.values()
+                               if isinstance(v, (int, float))))
+        for key, v in (c.get("delta_counts") or {}).items():
+            counts[key] = counts.get(key, 0) + int(v)
+        ticks_total += int(c.get("n_ticks") or 0)
+    total_chunks = max(1, sum(counts.values()))
+    # appended ticks dirty every row's TAIL, so a healthy tick loop runs
+    # all-warm (warm-started from the previous cycle's params) — the
+    # churn signal is dirty+new (content revisions under the prefix),
+    # not the absence of bitwise adoption
+    dirty_fraction = round(((counts.get("dirty") or 0)
+                            + (counts.get("new") or 0)) / total_chunks, 4)
+    delta_on = bool((m.get("config") or {}).get("delta", True))
+    # cadence: the slowest published cycle with 2x headroom is the
+    # shortest tick interval this loop provably keeps up with
+    min_tick_interval_s = round(2.0 * max(cycle_walls), 4)
+    per_walk = None
+    fit_mp = os.path.join(base, published[-1][0], "fit", "manifest.json")
+    if os.path.exists(fit_mp):
+        a = advise(load_manifest(fit_mp))
+        if "error" not in a:
+            per_walk = a["suggest"]
+    chain = None
+    if not delta_on:
+        chain = ("delta chaining is OFF: every cycle refits the grown "
+                 "panel cold — pass delta_from chaining (delta=True) so "
+                 "appended ticks only recompute the warm tail")
+    elif dirty_fraction > 0.5 and len(published) > 1:
+        chain = ("delta chaining sees mostly dirty/new chunks: the panel "
+                 "is churning (revised rows), not appending — consider "
+                 "delta_warmstart=False (exact mode) or larger tick "
+                 "batches")
+    return {
+        "tickloop": {
+            "cycles_published": len(published),
+            "cycles_seen": len(cycles),
+            "n_rows": m.get("n_rows"),
+            "ticks_ingested": ticks_total,
+            "layout": m.get("layout"),
+            "delta_enabled": delta_on,
+            "cycle_wall_s_max": round(max(cycle_walls), 4),
+            "cycle_wall_s_mean": round(sum(cycle_walls)
+                                       / len(cycle_walls), 4),
+            "delta_counts": counts,
+            "dirty_fraction": dirty_fraction,
+        },
+        "suggest": {
+            "min_tick_interval_s": min_tick_interval_s,
+            "delta_from_chaining": chain,
+            "per_walk": per_walk,
+        },
+    }
+
+
+def _render_tickloop(root: str, a: dict) -> None:
+    o, s = a["tickloop"], a["suggest"]
+    print(f"tick loop {root}")
+    print(f"  loop: {o['cycles_published']}/{o['cycles_seen']} cycles "
+          f"published, {o['ticks_ingested']} ticks ingested over "
+          f"{o['n_rows']} rows ({o['layout']} shards, "
+          f"delta={'on' if o['delta_enabled'] else 'off'})")
+    dc = o["delta_counts"]
+    print(f"  refits: dirty fraction {o['dirty_fraction']} "
+          f"({dc.get('adopted', 0)} adopted / {dc.get('warm', 0)} warm / "
+          f"{dc.get('dirty', 0)} dirty / {dc.get('new', 0)} new chunks "
+          "across published cycles)")
+    print(f"  cycle wall: mean {o['cycle_wall_s_mean']}s, "
+          f"max {o['cycle_wall_s_max']}s")
+    print("  suggest for this loop's next life:")
+    print(f"    min_tick_interval_s = {s['min_tick_interval_s']}  "
+          "(slowest tick-to-publish cycle x2 headroom)")
+    if s["delta_from_chaining"]:
+        print(f"    delta_from chaining: {s['delta_from_chaining']}")
+    else:
+        print("    delta_from chaining: holding (appended ticks ride the "
+              "warm tail; leave delta=True)")
+    if s["per_walk"]:
+        p = s["per_walk"]
+        print(f"    per-cycle fit knobs: chunk_rows = {p.get('chunk_rows')}"
+              f", chunk_budget_s = {p.get('chunk_budget_s')}, "
+              f"pipeline_depth = {p.get('pipeline_depth')}")
+
+
+def advise_backtest(root: str) -> dict:
+    """Backtest-campaign advice (ISSUE 20): a rolling-origin campaign
+    root (``backtest_manifest.json``) — the window-class wall split says
+    whether the NEXT campaign of this config should run ``delta=True``.
+
+    A campaign whose prior-compatible windows were adopted spent wall
+    only on the genuinely new origins; a fresh campaign re-paid every
+    window.  The advisor reads the per-window ``window_class`` tags and
+    walls and prints the delta economy: adopted windows' recorded walls
+    are what ``delta=True`` saves on an unchanged-prefix rerun.
+    """
+    mp = os.path.join(root, "backtest_manifest.json") \
+        if os.path.isdir(root) else root
+    try:
+        with open(mp, "rb") as f:
+            m = json.loads(f.read().decode())
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        sys.exit(f"advise_budget: backtest manifest {mp} unreadable ({e})")
+    windows = [w for w in m.get("windows") or []
+               if w.get("status") == "committed"]
+    if not windows:
+        return {"error": "no committed windows to learn from",
+                "backtest": {"campaign_hash": m.get("campaign_hash")}}
+    by_class = {}
+    for w in windows:
+        cls = w.get("window_class") or (
+            "warm" if w.get("warm_start") else "cold")
+        ent = by_class.setdefault(cls, {"n": 0, "wall_s": 0.0})
+        ent["n"] += 1
+        ent["wall_s"] += float(w.get("wall_s") or 0.0)
+    computed_wall = sum(v["wall_s"] for k, v in by_class.items()
+                        if k != "adopted")
+    adopted = by_class.get("adopted", {"n": 0, "wall_s": 0.0})
+    d = m.get("delta") or {}
+    return {
+        "backtest": {
+            "campaign_hash": m.get("campaign_hash"),
+            "windows_committed": len(windows),
+            "horizon": m.get("horizon"),
+            "window_classes": {k: {"n": v["n"],
+                                   "wall_s": round(v["wall_s"], 4)}
+                               for k, v in sorted(by_class.items())},
+            "computed_wall_s": round(computed_wall, 4),
+            "delta": {"adopted": d.get("adopted"),
+                      "recomputed": d.get("recomputed"),
+                      "prior_n_time": d.get("prior_n_time")} if d else None,
+        },
+        "suggest": {
+            # the campaign-level delta knob: an unchanged-prefix rerun
+            # (appended ticks, extra origins) re-pays computed_wall_s
+            # unless it adopts — this manifest is the prior to adopt from
+            "delta": True,
+            "adopted_windows": adopted["n"],
+            "delta_reason": (
+                f"{adopted['n']} window(s) adopted free (their recorded "
+                f"walls total ~{round(adopted['wall_s'], 2)}s a fresh "
+                "campaign would re-pay)"
+                if adopted["n"] else
+                f"no adoptions yet: a delta=True rerun on a grown panel "
+                f"adopts every unchanged window and skips up to "
+                f"~{round(computed_wall, 2)}s of window wall"),
+        },
+    }
+
+
+def _render_backtest(root: str, a: dict) -> None:
+    o, s = a["backtest"], a["suggest"]
+    print(f"backtest campaign {root}")
+    print(f"  campaign {o['campaign_hash']}  horizon {o['horizon']}  "
+          f"{o['windows_committed']} committed window(s)")
+    for cls, v in o["window_classes"].items():
+        print(f"    {cls}: {v['n']} window(s), wall {v['wall_s']}s")
+    if o["delta"]:
+        d = o["delta"]
+        print(f"  delta campaign: {d['adopted']} adopted / "
+              f"{d['recomputed']} recomputed from a prior at "
+              f"n_time {d['prior_n_time']}")
+    print("  suggest for the next campaign of this config:")
+    print(f"    delta = True  ({s['delta_reason']})")
+
+
 def _device_budget_bytes():
     """The local device allocator's budget (``memory_stats()['bytes_limit']``)
     when the backend reports one; None on CPU-only hosts (the advice then
@@ -1015,6 +1225,32 @@ def main():
             _render_profiles(prof)
             return
         _render_serving(args.path, a)
+        return
+    # a tick-loop root (ISSUE 20) is identified by its loop manifest
+    if ((os.path.isdir(args.path)
+         and os.path.exists(os.path.join(args.path, "tickloop.json")))
+            or args.path.endswith("tickloop.json")):
+        a = advise_tickloop(args.path)
+        if args.json:
+            print(json.dumps(a, indent=1, sort_keys=True))
+        elif "error" in a:
+            sys.exit(f"advise_budget: {a['error']}")
+        else:
+            _render_tickloop(args.path, a)
+        return
+    # a backtest campaign root (ISSUE 14/20): per-window fit journals
+    # under a campaign-level backtest_manifest.json
+    if ((os.path.isdir(args.path)
+         and os.path.exists(os.path.join(args.path,
+                                         "backtest_manifest.json")))
+            or args.path.endswith("backtest_manifest.json")):
+        a = advise_backtest(args.path)
+        if args.json:
+            print(json.dumps(a, indent=1, sort_keys=True))
+        elif "error" in a:
+            sys.exit(f"advise_budget: {a['error']}")
+        else:
+            _render_backtest(args.path, a)
         return
     # an auto-fit search root (ISSUE 9) has no root manifest.json — the
     # grid-level auto_manifest.json plus per-order journals stand in

@@ -138,6 +138,13 @@ CONFIG_HASH_SURFACES = {
                           "extra.delta, never the hash",
             "journal_extra": "opaque manifest extra= block, documented "
                              "as non-hashed provenance",
+            "sink": "write-back DESTINATION (ISSUE 20): committed chunk "
+                    "params stream out to a WritableChunkSource shard "
+                    "dir instead of the in-host assembly — the journal "
+                    "bytes are identical either way (the sink is fed "
+                    "from the same committed arrays) and a sink walk "
+                    "resumes a sinkless journal; provenance rides "
+                    "manifest extra.sink, never the hash",
             "_journal_commit_hook": "fault-injection instrumentation "
                                     "(tests only)",
         },
@@ -201,6 +208,10 @@ CONFIG_HASH_SURFACES = {
             "prefetch_depth": "see fit_chunked",
             "shard": "see fit_chunked",
             "mesh": "see fit_chunked",
+            "sink": "write-back destination for the packed forecast "
+                    "rows (ISSUE 20) — see fit_chunked; the published "
+                    "shards are the same bytes split_forecast would "
+                    "have unpacked in host RAM",
             "_journal_commit_hook": "fault-injection instrumentation "
                                     "(tests only)",
         },
@@ -242,6 +253,14 @@ CONFIG_HASH_SURFACES = {
             "server": "routes window forecasts through a FitServer's "
                       "batching — placement, not content (batched == "
                       "solo bitwise is the server's contract)",
+            "delta": "campaign ADOPTION switch (ISSUE 20): selects "
+                     "whether a prior campaign's committed windows may "
+                     "be spliced — adoption is gated on the "
+                     "origin-independent window_config_hash plus a "
+                     "prefix content digest, so an adopted window is "
+                     "bitwise the recompute and the campaign_hash "
+                     "identity is unchanged; provenance rides the "
+                     "manifest's delta block, never the hash",
             "_journal_commit_hook": "fault-injection instrumentation "
                                     "(tests only)",
         },
@@ -445,6 +464,13 @@ FILE_WRITE_OWNERS = {
                             "rewrites each shard with its new columns "
                             "(the NpzShardSource append helpers route "
                             "through here)",
+        "write_parquet_shards": "the parquet sibling (ISSUE 20): sole "
+                                "writer of a parquet shard directory — "
+                                "fresh writes and the append_rows/"
+                                "append_time extensions all land via "
+                                "the journal's durable-replace "
+                                "primitive, one file per shard "
+                                "(ParquetShardSource only READS)",
     },
     "spark_timeseries_tpu/reliability/delta.py": {
         "plan_delta": "READS prior shards only; the delta walk's "
@@ -454,6 +480,17 @@ FILE_WRITE_OWNERS = {
                       "ONE manifest update) — this module performs no "
                       "direct writes, registered so the ownership of "
                       "the manifest splice is written down",
+    },
+    "spark_timeseries_tpu/reliability/sink.py": {
+        "WritableChunkSource": "sole writer of its own output shard "
+                               "directory (ISSUE 20): one background "
+                               "worker drains the bounded write queue, "
+                               "each committed chunk lands as an "
+                               "out_<lo>_<hi>.npz via the journal's "
+                               "durable-replace primitive, and finalize "
+                               "alone writes sink_manifest.json after "
+                               "sweeping orphans — the walk's journal "
+                               "namespace is never touched",
     },
     "spark_timeseries_tpu/reliability/faultinject.py": {
         "tear_file": "the fault harness DELIBERATELY corrupts a named "
@@ -493,6 +530,15 @@ FILE_WRITE_OWNERS = {
                               "fenced on fleet roots exactly like the "
                               "result store — standbys and tools only "
                               "READ profiles",
+    },
+    "spark_timeseries_tpu/serving/tickloop.py": {
+        "TickLoop": "sole writer of its loop root (ISSUE 20): "
+                    "tickloop.json, each cycle's ticks.npz (tmp+fsync+"
+                    "replace) and tick_manifest.json — the data shards "
+                    "are extended only through the source module's "
+                    "append owners, the fit/forecast journals belong "
+                    "to ChunkJournal, and the published forecasts to "
+                    "the cycle's WritableChunkSource",
     },
     "spark_timeseries_tpu/serving/batcher.py": {
         "MicroBatch": "durable batch-membership records under the batch "
@@ -582,6 +628,7 @@ LOCKMAP_RUNTIME_CLASSES = (
     "spark_timeseries_tpu.reliability.journal:ChunkJournal",
     "spark_timeseries_tpu.reliability.source:StagingPool",
     "spark_timeseries_tpu.reliability.source:ChunkSource",
+    "spark_timeseries_tpu.reliability.sink:WritableChunkSource",
     "spark_timeseries_tpu.forecasting.augment:ColumnBlockSource",
     "spark_timeseries_tpu.serving.admission:TenantQuota",
     "spark_timeseries_tpu.serving.admission:AdmissionQueue",

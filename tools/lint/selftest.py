@@ -411,6 +411,117 @@ FIXTURES["lock-map/profiles"] = (_PROFILES, _fix("""
                 self._cache[tenant] = (key, prof)
     """), [lockmap.check])
 
+# ISSUE 20: the streaming plane joined the registries — seed a
+# violation of each NEW entry shape so a checker that stopped matching
+# them cannot pass vacuously.  (a) config-hash: a write-back-walk-shaped
+# surface grows an unregistered sink knob next to the registered one;
+# (b) journal-writer: a rogue helper writes an out_*.npz shard into a
+# sink directory outside the registered WritableChunkSource owner; (c)
+# journal-writer: a rogue helper writes a cycle's tick_manifest.json
+# outside the registered TickLoop owner; (d) lock-map: a sink-shaped
+# class mutates its queue accounting outside the declared lock — the
+# exact shape the driver-enqueue / writer-thread race would take.
+_SINK = "spark_timeseries_tpu/reliability/fixture_sink.py"
+_SINK_SURFACES = {
+    f"{_SINK}::sink_fixture": {
+        "kwargs_param": "fit_kwargs",
+        "hashed": {"chunk_rows": "extra= key 'chunk_rows'"},
+        "extra_keys": ("chunk_rows",),
+        "excluded": {"sink": "write-back destination; journal bytes "
+                             "identical either way"},
+    },
+}
+
+FIXTURES["config-hash/sink"] = (_SINK, _fix("""
+    def sink_fixture(*, chunk_rows=None, sink=None, sink_compress=False,
+                     **fit_kwargs):
+        cfg = config_hash(sink_fixture, fit_kwargs,
+                          extra={"chunk_rows": chunk_rows})
+        return cfg
+    """), _fix("""
+    def sink_fixture(*, chunk_rows=None, sink=None, **fit_kwargs):
+        cfg = config_hash(sink_fixture, fit_kwargs,
+                          extra={"chunk_rows": chunk_rows})
+        return cfg
+    """), [functools.partial(confighash.check, surfaces=_SINK_SURFACES)])
+
+_SINK_OWNERS = {_SINK: {"WritableChunkSource":
+                        "sole writer of its output shard directory"}}
+
+FIXTURES["journal-writer/sink"] = (_SINK, _fix("""
+    import numpy as np
+
+    def rogue_shard_note(directory, lo, hi, arrays):
+        path = "%s/out_%09d_%09d.npz" % (directory, lo, hi)
+        np.savez(path, **arrays)       # unregistered writer
+    """), _fix("""
+    import os
+
+    import numpy as np
+
+    class WritableChunkSource:
+        def _write_one(self, directory, lo, hi, arrays):
+            path = "%s/out_%09d_%09d.npz" % (directory, lo, hi)
+            tmp = path + ".tmp"
+            np.savez(tmp, **arrays)
+            os.replace(tmp, path)
+    """), [functools.partial(journalwriter.check, owners=_SINK_OWNERS)])
+
+_TICK = "spark_timeseries_tpu/serving/fixture_tickloop.py"
+_TICK_OWNERS = {_TICK: {"TickLoop": "sole writer of its loop root"}}
+
+FIXTURES["journal-writer/tickloop"] = (_TICK, _fix("""
+    import json
+    import os
+
+    def rogue_cycle_note(cycle_dir, manifest):
+        path = os.path.join(cycle_dir, "tick_manifest.json")
+        with open(path, "w") as f:     # unregistered writer
+            f.write(json.dumps(manifest, sort_keys=True))
+    """), _fix("""
+    import json
+    import os
+
+    class TickLoop:
+        def _write_cycle_manifest(self, cycle_dir, manifest):
+            path = os.path.join(cycle_dir, "tick_manifest.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(manifest, sort_keys=True))
+            os.replace(tmp, path)
+    """), [functools.partial(journalwriter.check, owners=_TICK_OWNERS)])
+
+FIXTURES["lock-map/sink"] = (_SINK, _fix("""
+    import threading
+
+    class WriteBackSink:
+        _protected_by_ = {"_in_flight": "_lock", "_spans": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._in_flight = 0
+            self._spans = []
+
+        def write(self, lo, hi, nbytes):
+            self._in_flight += nbytes   # mutation outside the lock
+            self._spans.append((lo, hi))
+    """), _fix("""
+    import threading
+
+    class WriteBackSink:
+        _protected_by_ = {"_in_flight": "_lock", "_spans": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._in_flight = 0
+            self._spans = []
+
+        def write(self, lo, hi, nbytes):
+            with self._lock:
+                self._in_flight += nbytes
+                self._spans.append((lo, hi))
+    """), [lockmap.check])
+
 _OWNERS = {HOT: {"Owner": "fixture namespace owner"}}
 
 FIXTURES["journal-writer"] = (HOT, _fix("""

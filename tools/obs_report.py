@@ -226,6 +226,11 @@ def validate_manifest_telemetry(ckpt_dir: str) -> list:
             # a backtest campaign root (ISSUE 14): campaign manifest +
             # per-window fit journals, no root manifest.json
             return validate_backtest_manifest(path)
+        if (os.path.exists(os.path.join(path, "tickloop.json"))
+                and not os.path.exists(os.path.join(path, "manifest.json"))):
+            # a tick-loop root (ISSUE 20): loop manifest + per-cycle
+            # dirs, each holding its own fit/forecast journals + sink
+            return validate_tickloop_root(path)
         path = os.path.join(path, "manifest.json")
     try:
         with open(path, "rb") as f:
@@ -298,6 +303,7 @@ def validate_manifest_telemetry(ckpt_dir: str) -> list:
     errors += validate_manifest_shards(m, path)
     errors += validate_manifest_auto_extra(m, path)
     errors += validate_manifest_delta(m, path)
+    errors += validate_manifest_sink(m, path)
     return errors
 
 
@@ -361,6 +367,197 @@ def validate_manifest_delta(m: dict, path: str) -> list:
         errors.append(
             f"{len(adopted_entries)} adopted chunk entries exceed the "
             f"plan's adopted count {counts.get('adopted')}")
+    return errors
+
+
+def validate_sink_dir(sink_dir: str, *, expect_rows=None) -> list:
+    """Validate a write-back sink directory (ISSUE 20): the durable
+    ``sink_manifest.json`` parses, its recorded shards tile
+    ``[0, n_rows)`` exactly, every shard file exists on disk, no
+    unrecorded ``out_*.npz`` stray survived finalize, and the
+    accounting block carries the footprint counters the CI smoke and
+    the budget advisor read."""
+    errors = []
+    mp = os.path.join(sink_dir, "sink_manifest.json")
+    try:
+        with open(mp, "rb") as f:
+            m = json.loads(f.read().decode())
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        return [f"sink manifest {mp}: unreadable ({e})"]
+    if m.get("kind") != "sink":
+        errors.append(f"sink manifest: kind {m.get('kind')!r} != 'sink'")
+    n_rows = m.get("n_rows")
+    if not isinstance(n_rows, int) or n_rows < 1:
+        errors.append(f"sink manifest: bad n_rows {n_rows!r}")
+        n_rows = None
+    if expect_rows is not None and n_rows is not None and \
+            n_rows != int(expect_rows):
+        errors.append(f"sink manifest: n_rows {n_rows} != walk rows "
+                      f"{expect_rows}")
+    shards = m.get("shards")
+    if not isinstance(shards, list) or not shards:
+        return errors + ["sink manifest: shards missing/empty"]
+    pos = 0
+    names = set()
+    for s in shards:
+        lo, hi, name = s.get("lo"), s.get("hi"), s.get("name")
+        if not isinstance(lo, int) or not isinstance(hi, int) or \
+                not isinstance(name, str) or hi <= lo:
+            errors.append(f"sink shard entry malformed: {s!r}")
+            continue
+        if lo != pos:
+            errors.append(f"sink shards not contiguous at [{lo}, {hi}) "
+                          f"(expected lo={pos})")
+        pos = max(pos, hi)
+        names.add(name)
+        if not os.path.exists(os.path.join(sink_dir, name)):
+            errors.append(f"sink shard {name} missing on disk")
+    if n_rows is not None and pos != n_rows:
+        errors.append(f"sink shards cover [0, {pos}) but n_rows is "
+                      f"{n_rows}")
+    try:
+        on_disk = sorted(os.listdir(sink_dir))
+    except OSError as e:
+        return errors + [f"sink dir unreadable: {e}"]
+    for fn in on_disk:
+        if fn.startswith("out_") and fn.endswith(".npz") \
+                and fn not in names:
+            errors.append(f"sink dir holds unrecorded shard {fn} "
+                          "(finalize must sweep strays)")
+    acct = m.get("accounting")
+    if not isinstance(acct, dict):
+        errors.append("sink manifest: accounting block missing")
+    else:
+        for k in ("writes", "spans", "bytes_written",
+                  "peak_in_flight_bytes"):
+            if not isinstance(acct.get(k), int) or acct[k] < 0:
+                errors.append(f"sink accounting.{k} invalid: "
+                              f"{acct.get(k)!r}")
+        if not isinstance(acct.get("status_counts"), dict):
+            errors.append("sink accounting.status_counts missing")
+    return errors
+
+
+def validate_manifest_sink(m: dict, path: str) -> list:
+    """Validate a journaled walk's ``extra.sink`` block (ISSUE 20) and
+    the write-back sink directory it points at.  Manifests without the
+    block (no sink) pass untouched."""
+    s = (m.get("extra") or {}).get("sink")
+    if s is None:
+        return []
+    if not isinstance(s, dict):
+        return [f"manifest {path}: extra.sink is not an object: {s!r}"]
+    errors = []
+    d = s.get("directory")
+    if not isinstance(d, str) or not d:
+        errors.append(f"extra.sink.directory invalid: {d!r}")
+        return errors
+    if not isinstance(s.get("depth"), int) or s["depth"] < 1:
+        errors.append(f"extra.sink.depth invalid: {s.get('depth')!r}")
+    if not os.path.isdir(d):
+        errors.append(f"extra.sink.directory {d} is not a directory")
+        return errors
+    errors += [f"sink {d}: {e}"
+               for e in validate_sink_dir(d, expect_rows=m.get("n_rows"))]
+    return errors
+
+
+TICKLOOP_STAGES = ("ticked", "appended", "fitted", "published")
+
+
+def validate_tickloop_root(root: str) -> list:
+    """Validate a tick-loop root (ISSUE 20): the ``tickloop.json`` loop
+    manifest, every ``cycle_%05d`` dir's ``tick_manifest.json`` (stage
+    progression, tick-count chain), and — for published cycles — the
+    cycle's fit/forecast journals and write-back sink directory.  Only
+    the LAST cycle may be mid-flight (anything but ``published``)."""
+    import re as _re
+
+    errors = []
+    mp = os.path.join(root, "tickloop.json")
+    try:
+        with open(mp, "rb") as f:
+            m = json.loads(f.read().decode())
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        return [f"tickloop manifest {mp}: unreadable ({e})"]
+    if m.get("kind") != "tickloop":
+        errors.append(f"tickloop manifest: kind {m.get('kind')!r} != "
+                      "'tickloop'")
+    n_rows, n_time0 = m.get("n_rows"), m.get("n_time0")
+    for k, v in (("n_rows", n_rows), ("n_time0", n_time0)):
+        if not isinstance(v, int) or v < 1:
+            errors.append(f"tickloop manifest: bad {k} {v!r}")
+    if not isinstance(m.get("config"), dict):
+        errors.append("tickloop manifest: config block missing")
+    cycles = sorted(
+        (int(mm.group(1)), name)
+        for name in os.listdir(root)
+        for mm in [_re.match(r"^cycle_(\d{5})$", name)] if mm)
+    expect_t = n_time0 if isinstance(n_time0, int) else None
+    for pos, (i, name) in enumerate(cycles):
+        if i != pos:
+            errors.append(f"cycle dirs not consecutive: {name} at "
+                          f"position {pos}")
+        cdir = os.path.join(root, name)
+        cm_path = os.path.join(cdir, "tick_manifest.json")
+        try:
+            with open(cm_path, "rb") as f:
+                cm = json.loads(f.read().decode())
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+            errors.append(f"{name}: tick_manifest.json unreadable ({e})")
+            continue
+        stage = cm.get("stage")
+        if stage not in TICKLOOP_STAGES:
+            errors.append(f"{name}: bad stage {stage!r}")
+        elif stage != "published" and pos != len(cycles) - 1:
+            errors.append(f"{name}: stage {stage!r} but later cycles "
+                          "exist — only the last cycle may be mid-flight")
+        if cm.get("cycle") != i:
+            errors.append(f"{name}: cycle field {cm.get('cycle')!r} != "
+                          f"{i}")
+        n_ticks = cm.get("n_ticks")
+        if not isinstance(n_ticks, int) or n_ticks < 1:
+            errors.append(f"{name}: bad n_ticks {n_ticks!r}")
+            n_ticks = None
+        if expect_t is not None:
+            if cm.get("t_before") != expect_t:
+                errors.append(f"{name}: t_before {cm.get('t_before')!r} "
+                              f"breaks the chain (expected {expect_t})")
+            expect_t = (expect_t + n_ticks if n_ticks is not None
+                        else None)
+        if not isinstance(cm.get("ticks_digest"), str):
+            errors.append(f"{name}: ticks_digest missing")
+        if not os.path.exists(os.path.join(cdir, "ticks.npz")):
+            errors.append(f"{name}: ticks.npz missing (the durable tick "
+                          "record is the resume seed)")
+        if not isinstance(cm.get("walls"), dict):
+            errors.append(f"{name}: walls block missing")
+        if stage != "published":
+            continue
+        pub = cm.get("published")
+        if not isinstance(pub, dict):
+            errors.append(f"{name}: published block missing")
+        elif not isinstance(pub.get("status_counts"), dict):
+            errors.append(f"{name}: published.status_counts missing")
+        errors += [f"{name}/published: {e}" for e in
+                   validate_sink_dir(os.path.join(cdir, "published"),
+                                     expect_rows=n_rows)]
+        for sub in ("fit", "forecast"):
+            smp = os.path.join(cdir, sub, "manifest.json")
+            if not os.path.exists(smp):
+                errors.append(f"{name}: {sub}/manifest.json missing")
+                continue
+            try:
+                with open(smp, "rb") as f:
+                    sm = json.loads(f.read().decode())
+            except (OSError, json.JSONDecodeError,
+                    UnicodeDecodeError) as e:
+                errors.append(f"{name}: {sub} manifest unreadable ({e})")
+                continue
+            if isinstance(sm.get("telemetry"), dict):
+                errors += [f"{name}/{sub}: {e}" for e in
+                           validate_manifest_telemetry(
+                               os.path.join(cdir, sub))]
     return errors
 
 
@@ -635,6 +832,9 @@ def validate_backtest_manifest(root: str) -> list:
             errors.append(f"backtest window {i}: bad status "
                           f"{w.get('status')!r}")
             continue
+        wc = w.get("window_class")
+        if wc is not None and wc not in ("adopted", "warm", "cold"):
+            errors.append(f"backtest window {i}: bad window_class {wc!r}")
         if w.get("status") != "committed":
             continue
         for key in ("mae", "rmse", "mape"):
@@ -683,6 +883,24 @@ def validate_backtest_manifest(root: str) -> list:
                     errors += [f"window {i}: {e2}" for e2 in
                                validate_manifest_telemetry(
                                    os.path.join(root, fd))]
+    d = m.get("delta")
+    if d is not None:
+        # a delta-warm campaign (ISSUE 20): the manifest records what
+        # window-level adoption kept from the prior campaign
+        if not isinstance(d, dict):
+            errors.append(f"backtest manifest: delta block is not an "
+                          f"object: {d!r}")
+        else:
+            if not isinstance(d.get("prior_campaign_hash"), str):
+                errors.append("backtest delta: prior_campaign_hash "
+                              "missing")
+            pt = d.get("prior_n_time")
+            if not isinstance(pt, int) or pt < 1:
+                errors.append(f"backtest delta: bad prior_n_time {pt!r}")
+            for key in ("adopted", "recomputed"):
+                v = d.get(key)
+                if not isinstance(v, int) or v < 0:
+                    errors.append(f"backtest delta: bad {key} {v!r}")
     return errors
 
 
